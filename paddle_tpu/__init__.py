@@ -1,0 +1,82 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+A ground-up rebuild of the capabilities of the reference framework
+(PaddlePaddle; see SURVEY.md at repo root) designed for TPU from the start:
+
+- compute = JAX/XLA (one compiler, replacing the reference's 5 execution
+  engines: eager C++ dispatch, basic_engine, PIR interpreter, CINN,
+  fleet_executor),
+- fused hot ops = Pallas kernels (flash attention, rms/layer norm, rope, ...),
+- parallelism = one mechanism: ``jax.sharding.Mesh`` + placements (DistTensor
+  semantics) with explicit schedules only where GSPMD has none (pipeline),
+- eager UX = a thin Tensor/autograd tape over jnp for interactive work, with
+  ``paddle_tpu.jit`` as the performance path.
+
+Public surface mirrors ``paddle.*``: Tensor, nn, optimizer, io, amp, jit,
+distributed, vision, metric, profiler.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# framework core
+from .framework import dtype as _dtype_mod
+from .framework.dtype import (  # noqa: F401
+    bool_ as bool,  # noqa: A001
+    bfloat16, complex128, complex64, float16, float32, float64,
+    float8_e4m3fn, float8_e5m2, int16, int32, int64, int8,
+    uint8, uint16, uint32, uint64,
+    get_default_dtype, set_default_dtype,
+)
+from .framework.tensor import Tensor, Parameter, to_tensor  # noqa: F401
+from .framework.autograd import no_grad, enable_grad, is_grad_enabled, grad  # noqa: F401
+from .framework.device import (  # noqa: F401
+    set_device, get_device, device_count, is_compiled_with_tpu, synchronize,
+)
+from .framework.random import seed, get_rng_state_tracker  # noqa: F401
+from .framework.flags import get_flags, set_flags  # noqa: F401
+from .framework import flags as _flags  # noqa: F401
+
+# ops (this also installs Tensor methods)
+from .ops import *  # noqa: F401,F403
+from . import ops  # noqa: F401
+
+# subsystems
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import autograd  # noqa: F401
+from . import distributed  # noqa: F401
+from . import metric  # noqa: F401
+from . import vision  # noqa: F401
+from . import profiler  # noqa: F401
+from . import incubate  # noqa: F401
+from . import static  # noqa: F401
+from . import device  # noqa: F401
+from . import linalg as _linalg_ns  # noqa: F401
+from . import fft  # noqa: F401
+
+from .framework.io import save, load  # noqa: F401
+
+# paddle-style CPU/generator seeds
+disable_static = lambda *a, **k: None  # dynamic-by-default, parity no-op
+enable_static = lambda *a, **k: None
+
+def in_dynamic_mode() -> bool:
+    return True
+
+
+class CUDAPinnedPlace:  # placement shims for API parity
+    pass
+
+
+class CPUPlace:
+    pass
+
+
+class TPUPlace:
+    def __init__(self, idx: int = 0):
+        self.idx = idx

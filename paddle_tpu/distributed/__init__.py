@@ -1,0 +1,53 @@
+"""``paddle_tpu.distributed`` (reference: ``python/paddle/distributed/``).
+
+One mechanism underneath: the global device mesh + shardings (GSPMD/ICI).
+- semi-auto API: ``shard_tensor``/``reshard``/``shard_layer`` (DistTensor semantics)
+- fleet: hybrid-parallel entry (dp/pp/sharding/sep/mp axes over one mesh)
+- collective: host-level eager collectives (control plane)
+- parallel: TP layers, pipeline engine, MoE, context parallel
+- checkpoint: sharded save/load with dedup + cross-topology reshard
+"""
+
+from .collective import (  # noqa: F401
+    ReduceOp, all_gather, all_gather_object, all_reduce, alltoall, barrier,
+    broadcast, destroy_process_group, get_group, get_rank, get_world_size,
+    init_parallel_env, is_initialized, new_group, recv, reduce, scatter, send, wait,
+)
+from .mesh import ProcessMesh, auto_mesh, get_mesh, set_global_mesh  # noqa: F401
+from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
+from .api import (  # noqa: F401
+    dtensor_from_fn, dtensor_from_local, reshard, shard_dataloader, shard_layer,
+    shard_optimizer, shard_tensor, unshard_dtensor,
+)
+from . import fleet  # noqa: F401
+from . import parallel  # noqa: F401
+from .parallel import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear, VocabParallelEmbedding,
+)
+from .parallel.pipeline import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """Single-program SPMD note: multi-chip execution on TPU is one process
+    per host driving all local chips — per-chip process spawn (the reference's
+    ``spawn``) does not apply.  Runs func locally for API compatibility."""
+    func(*args)
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return 0
+
+
+def get_data_parallel_world_size():
+    hcg = fleet.get_hybrid_communicate_group()
+    return hcg.get_data_parallel_world_size() if hcg else get_world_size()

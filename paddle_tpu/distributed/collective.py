@@ -1,0 +1,253 @@
+"""Eager collective communication API.
+
+Counterpart of the reference's ``paddle.distributed.{all_reduce,...}`` over
+ProcessGroupNCCL (``fluid/distributed/collective/process_group_nccl.h:37``).
+
+TPU-native semantics: *in-graph* collectives (inside jit/shard_map) are the
+performance path and are expressed with jax collectives by the parallel
+layers.  This module provides the *host-level* eager API used for control
+work — metric reduction, checkpoint dedup, loss broadcast.  Implementation:
+``jax.experimental.multihost_utils``-style process_allgather built from tiny
+pjit programs over the global device set; on a single process they degrade to
+identity, matching the reference's world_size==1 behavior.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "is_initialized",
+    "all_reduce", "all_gather", "all_gather_object", "broadcast", "reduce",
+    "scatter", "alltoall", "send", "recv", "barrier", "new_group", "wait",
+    "ReduceOp", "get_group", "destroy_process_group",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    def __init__(self, ranks: List[int], gid: int = 0):
+        self.ranks = ranks
+        self.id = gid
+        self.nranks = len(ranks)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+
+_INITIALIZED = False
+_GROUPS = {}
+_NEXT_GID = 1
+
+
+def init_parallel_env():
+    """Bootstrap multi-host (reference ``init_parallel_env``, parallel.py:978).
+
+    PJRT's coordination service replaces the reference's TCPStore+NCCL-id
+    exchange: ``jax.distributed.initialize`` reads the cluster env
+    (COORDINATOR_ADDRESS / process id) set by the launcher.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    import os
+
+    if os.environ.get("PADDLE_TPU_COORDINATOR"):
+        jax.distributed.initialize(
+            coordinator_address=os.environ["PADDLE_TPU_COORDINATOR"],
+            num_processes=int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", "1")),
+            process_id=int(os.environ.get("PADDLE_TPU_PROCESS_ID", "0")),
+        )
+    _INITIALIZED = True
+    _GROUPS[0] = Group(list(range(get_world_size())), 0)
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def get_rank(group: Optional[Group] = None) -> int:
+    r = jax.process_index()
+    if group is not None:
+        return group.get_group_rank(r)
+    return r
+
+
+def get_world_size(group: Optional[Group] = None) -> int:
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def get_group(gid: int = 0) -> Group:
+    return _GROUPS.get(gid)
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    global _NEXT_GID
+    g = Group(list(ranks) if ranks is not None else list(range(get_world_size())), _NEXT_GID)
+    _GROUPS[_NEXT_GID] = g
+    _NEXT_GID += 1
+    return g
+
+
+def destroy_process_group(group=None):
+    global _INITIALIZED
+    _INITIALIZED = False
+
+
+def _host_allreduce(arr: np.ndarray, op: str) -> np.ndarray:
+    """Cross-process reduction via a compiled psum over the global devices."""
+    if jax.process_count() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(arr)
+    if op == ReduceOp.SUM:
+        return gathered.sum(axis=0)
+    if op == ReduceOp.MAX:
+        return gathered.max(axis=0)
+    if op == ReduceOp.MIN:
+        return gathered.min(axis=0)
+    if op == ReduceOp.PROD:
+        return np.prod(gathered, axis=0)
+    if op == ReduceOp.AVG:
+        return gathered.mean(axis=0)
+    raise ValueError(op)
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    out = _host_allreduce(np.asarray(tensor._data), op)
+    tensor._data = jnp.asarray(out)
+    return tensor
+
+
+def all_gather(tensor_list: list, tensor: Tensor, group=None, sync_op=True):
+    if jax.process_count() == 1:
+        tensor_list.clear()
+        tensor_list.append(Tensor(tensor._data))
+        return tensor_list
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(np.asarray(tensor._data))
+    tensor_list.clear()
+    for i in range(gathered.shape[0]):
+        tensor_list.append(Tensor(gathered[i]))
+    return tensor_list
+
+
+def all_gather_object(object_list: list, obj, group=None):
+    if jax.process_count() == 1:
+        object_list.clear()
+        object_list.append(obj)
+        return object_list
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    # pad to max length across processes
+    n = np.asarray([payload.size])
+    max_n = int(_host_allreduce(n, ReduceOp.MAX)[0])
+    padded = np.zeros(max_n + 8, dtype=np.uint8)
+    padded[:8] = np.frombuffer(np.asarray([payload.size], np.int64).tobytes(), np.uint8)
+    padded[8:8 + payload.size] = payload
+    gathered = multihost_utils.process_allgather(padded)
+    object_list.clear()
+    for row in gathered:
+        size = int(np.frombuffer(row[:8].tobytes(), np.int64)[0])
+        object_list.append(pickle.loads(row[8:8 + size].tobytes()))
+    return object_list
+
+
+def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
+    if jax.process_count() == 1:
+        return tensor
+    from jax.experimental import multihost_utils
+
+    out = multihost_utils.broadcast_one_to_all(np.asarray(tensor._data), is_source=get_rank() == src)
+    tensor._data = jnp.asarray(out)
+    return tensor
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
+    out = _host_allreduce(np.asarray(tensor._data), op)
+    if get_rank() == dst or jax.process_count() == 1:
+        tensor._data = jnp.asarray(out)
+    return tensor
+
+
+def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
+    if jax.process_count() == 1:
+        if tensor_list:
+            tensor._data = tensor_list[0]._data
+        return tensor
+    from jax.experimental import multihost_utils
+
+    stacked = np.stack([np.asarray(t._data) for t in tensor_list]) if tensor_list else None
+    full = multihost_utils.broadcast_one_to_all(
+        stacked if stacked is not None else np.zeros((get_world_size(),) + tuple(tensor.shape), np.float32),
+        is_source=get_rank() == src,
+    )
+    tensor._data = jnp.asarray(full[get_rank()])
+    return tensor
+
+
+def alltoall(out_tensor_list: list, in_tensor_list: list, group=None, sync_op=True):
+    if jax.process_count() == 1:
+        out_tensor_list.clear()
+        out_tensor_list.extend(Tensor(t._data) for t in in_tensor_list)
+        return out_tensor_list
+    from jax.experimental import multihost_utils
+
+    stacked = np.stack([np.asarray(t._data) for t in in_tensor_list])
+    gathered = multihost_utils.process_allgather(stacked)  # [P, P, ...]
+    me = get_rank()
+    out_tensor_list.clear()
+    for p in range(get_world_size()):
+        out_tensor_list.append(Tensor(gathered[p, me]))
+    return out_tensor_list
+
+
+def send(tensor: Tensor, dst: int = 0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "host-level point-to-point send/recv is not part of the TPU backend; "
+        "in-graph transfers use ppermute (see distributed.parallel.pipeline)"
+    )
+
+
+def recv(tensor: Tensor, src: int = 0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "host-level point-to-point send/recv is not part of the TPU backend; "
+        "in-graph transfers use ppermute (see distributed.parallel.pipeline)"
+    )
+
+
+def barrier(group=None):
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        jax.block_until_ready(tensor._data)

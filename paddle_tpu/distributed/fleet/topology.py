@@ -1,0 +1,142 @@
+"""Hybrid-parallel topology (reference: ``fleet/base/topology.py:70,189``).
+
+On TPU the topology is a *view* over the global mesh: per-axis world sizes,
+this process's coordinates, and sub-mesh handles.  No comm groups are created
+— mesh axes replace ring ids.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..collective import get_rank
+from ..mesh import ProcessMesh
+
+
+class ParallelMode(Enum):
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+_HCG: Optional["HybridCommunicateGroup"] = None
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("dp", "pp", "sharding", "sep", "mp"), dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world = int(np.prod(dims))
+        self._coord_array = np.arange(self._world).reshape(dims)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kwargs):
+        coords = tuple(kwargs[n] for n in self._parallel_names)
+        return int(self._coord_array[coords])
+
+    def get_coord(self, rank):
+        idx = np.unravel_index(rank, self._coord_array.shape)
+        return dict(zip(self._parallel_names, (int(i) for i in idx)))
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._coord_array, axis, 0)
+        return moved.reshape(moved.shape[0], -1)[:, index].tolist()
+
+    def get_comm_list(self, axis_name):
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._coord_array, axis, -1)
+        return moved.reshape(-1, moved.shape[-1]).tolist()
+
+
+class HybridCommunicateGroup:
+    def __init__(self, mesh: ProcessMesh, degrees: Dict[str, int], order: List[str]):
+        self.mesh = mesh
+        self._degrees = degrees
+        self._order = order
+        self._topo = CommunicateTopology(order, [degrees[a] for a in order])
+        self.global_rank = get_rank()
+
+    # reference-shaped getters -------------------------------------------------
+    def get_parallel_mode(self) -> ParallelMode:
+        if self._degrees.get("mp", 1) > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._degrees.get("pp", 1) > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._degrees.get("sharding", 1) > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        if self._degrees.get("sep", 1) > 1:
+            return ParallelMode.SEGMENT_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    def _coord(self, axis: str) -> int:
+        return self._topo.get_coord(self.global_rank)[axis]
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # dp
+    def get_data_parallel_world_size(self):
+        return self._degrees.get("dp", 1)
+
+    def get_data_parallel_rank(self):
+        return self._coord("dp")
+
+    # mp
+    def get_model_parallel_world_size(self):
+        return self._degrees.get("mp", 1)
+
+    def get_model_parallel_rank(self):
+        return self._coord("mp")
+
+    # pp
+    def get_pipe_parallel_world_size(self):
+        return self._degrees.get("pp", 1)
+
+    def get_stage_id(self):
+        return self._coord("pp")
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self.get_pipe_parallel_world_size() - 1
+
+    # sharding
+    def get_sharding_parallel_world_size(self):
+        return self._degrees.get("sharding", 1)
+
+    def get_sharding_parallel_rank(self):
+        return self._coord("sharding")
+
+    # sep
+    def get_sep_parallel_world_size(self):
+        return self._degrees.get("sep", 1)
+
+    def get_sep_parallel_rank(self):
+        return self._coord("sep")
+
+    # mesh handles (TPU-native accessors used by the parallel layers)
+    def get_mesh(self) -> ProcessMesh:
+        return self.mesh
+
+    def axis(self, name: str) -> str:
+        return name

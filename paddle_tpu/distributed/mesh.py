@@ -1,0 +1,121 @@
+"""ProcessMesh — the device mesh abstraction.
+
+Counterpart of the reference's ``phi::distributed::ProcessMesh``
+(``phi/core/distributed/auto_parallel/process_mesh.h:34``) and the Python
+``paddle.distributed.ProcessMesh``.  Backed directly by ``jax.sharding.Mesh``:
+the mesh IS the parallelism mechanism on TPU (GSPMD partitions programs over
+it; ICI collectives ride the mesh axes).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+_GLOBAL_MESH: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None, shape=None, process_ids=None):
+        if shape is not None and process_ids is not None:
+            arr = np.asarray(process_ids).reshape(shape)
+        else:
+            arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(f"dim_names {dim_names} does not match mesh ndim {arr.ndim}")
+        self._rank_array = arr
+        self._dim_names = list(dim_names)
+        self._jax_mesh: Optional[Mesh] = None
+
+    # -- reference-shaped accessors -----------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._rank_array.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._rank_array.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return self._rank_array.reshape(-1).tolist()
+
+    @property
+    def size(self) -> int:
+        return int(self._rank_array.size)
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._rank_array.shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name: str, index=None):
+        """Sub-mesh: move ``dim_name`` first; optionally index into it."""
+        axis = self._dim_names.index(dim_name)
+        moved = np.moveaxis(self._rank_array, axis, 0)
+        names = [dim_name] + [n for n in self._dim_names if n != dim_name]
+        if index is None:
+            return ProcessMesh(moved, names)
+        return ProcessMesh(moved[index], names[1:])
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and np.array_equal(self._rank_array, other._rank_array)
+            and self._dim_names == other._dim_names
+        )
+
+    def __hash__(self):
+        return hash((self._rank_array.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+    # -- jax backing ---------------------------------------------------------
+    @property
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devs = np.asarray(_mesh_devices(self.size))[self._rank_array.reshape(-1)]
+            self._jax_mesh = Mesh(devs.reshape(self._rank_array.shape), tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __enter__(self):
+        self._ctx = self.jax_mesh.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self.jax_mesh.__exit__(*exc)
+
+
+def _mesh_devices(n: int):
+    devs = jax.devices()
+    if n > len(devs):
+        raise RuntimeError(
+            f"mesh needs {n} devices but only {len(devs)} are visible; "
+            f"for CPU-simulated meshes set XLA_FLAGS=--xla_force_host_platform_device_count={n}"
+        )
+    return devs[:n]
+
+
+def set_global_mesh(mesh: ProcessMesh) -> None:
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _GLOBAL_MESH
+
+
+def auto_mesh(dim_names: Sequence[str], shape: Sequence[int]) -> ProcessMesh:
+    """Build a mesh over the first prod(shape) visible devices."""
+    n = int(np.prod(shape))
+    return ProcessMesh(np.arange(n).reshape(shape), dim_names)

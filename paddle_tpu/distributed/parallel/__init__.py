@@ -1,0 +1,9 @@
+"""Hybrid-parallel building blocks (TP layers, pipeline engine, MoE, sequence/context parallel)."""
+
+from . import mp_layers  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)

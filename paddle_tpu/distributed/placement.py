@@ -1,0 +1,103 @@
+"""Placements: Shard / Replicate / Partial.
+
+Counterpart of the reference's placement types
+(``phi/core/distributed/auto_parallel/placement_types.h:68``).  Conversion to
+``jax.sharding.PartitionSpec`` is the bridge onto GSPMD: one placement per
+mesh dimension, exactly like DistTensor's dist_attr.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .mesh import ProcessMesh
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial", "to_partition_spec", "named_sharding"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def get_dim(self):
+        return self.dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    """Pending-reduction placement.  GSPMD materializes partials internally;
+    at the API boundary a Partial tensor is reduced on reshard (like the
+    reference's ``p_to_r`` reshard function)."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+def to_partition_spec(mesh: ProcessMesh, placements: Sequence[Placement], ndim: int) -> PartitionSpec:
+    """placements[i] says how mesh dim i acts on the tensor."""
+    entries: List = [None] * ndim
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            axis_name = mesh.dim_names[mesh_dim]
+            d = p.dim
+            if entries[d] is None:
+                entries[d] = axis_name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (axis_name,)
+            else:
+                entries[d] = (entries[d], axis_name)
+    return PartitionSpec(*entries)
+
+
+def named_sharding(mesh: ProcessMesh, placements: Sequence[Placement], ndim: int) -> NamedSharding:
+    return NamedSharding(mesh.jax_mesh, to_partition_spec(mesh, placements, ndim))

@@ -1,0 +1,78 @@
+"""``paddle_tpu.fft`` — FFT family (reference: ``python/paddle/fft.py``).
+
+Wraps jnp.fft; XLA lowers these natively on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.tensor import Tensor
+from .ops.common import unary_op
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _mk1(name, jf):
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        return unary_op(name, lambda a: jf(a, n=n, axis=axis, norm=norm), x)
+
+    op.__name__ = name
+    return op
+
+
+def _mkn(name, jf):
+    def op(x, s=None, axes=None, norm="backward", name_=None):
+        return unary_op(name, lambda a: jf(a, s=s, axes=axes if axes is not None else None, norm=norm), x)
+
+    op.__name__ = name
+    return op
+
+
+fft = _mk1("fft", jnp.fft.fft)
+ifft = _mk1("ifft", jnp.fft.ifft)
+rfft = _mk1("rfft", jnp.fft.rfft)
+irfft = _mk1("irfft", jnp.fft.irfft)
+hfft = _mk1("hfft", jnp.fft.hfft)
+ihfft = _mk1("ihfft", jnp.fft.ihfft)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return unary_op("fft2", lambda a: jnp.fft.fft2(a, s=s, axes=axes, norm=norm), x)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return unary_op("ifft2", lambda a: jnp.fft.ifft2(a, s=s, axes=axes, norm=norm), x)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return unary_op("rfft2", lambda a: jnp.fft.rfft2(a, s=s, axes=axes, norm=norm), x)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return unary_op("irfft2", lambda a: jnp.fft.irfft2(a, s=s, axes=axes, norm=norm), x)
+
+
+fftn = _mkn("fftn", jnp.fft.fftn)
+ifftn = _mkn("ifftn", jnp.fft.ifftn)
+rfftn = _mkn("rfftn", jnp.fft.rfftn)
+irfftn = _mkn("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d=d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d=d))
+
+
+def fftshift(x, axes=None, name=None):
+    return unary_op("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return unary_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), x)

@@ -1,0 +1,227 @@
+"""Eager autograd engine.
+
+TPU-native counterpart of the reference's eager autograd runtime
+(``paddle/fluid/eager/``: ``GradNodeBase`` at ``grad_node_info.h:197``,
+``egr::Backward`` at ``backward.cc:439``).  Design difference: the reference
+codegens a C++ grad-node class per op; here every op records ONE kind of node
+holding a ``jax.vjp`` closure — JAX computes the vjp, the tape only routes
+cotangents.  Inside ``jit``-traced programs the tape is bypassed entirely in
+favor of ``jax.grad`` (see ``paddle_tpu.jit``), which is where performance
+comes from on TPU.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_STATE = threading.local()
+
+
+def _grad_enabled() -> bool:
+    return getattr(_STATE, "grad_enabled", True)
+
+
+def _set_grad_enabled(v: bool) -> None:
+    _STATE.grad_enabled = v
+
+
+@contextlib.contextmanager
+def no_grad():
+    """Disable tape recording (``paddle.no_grad``)."""
+    prev = _grad_enabled()
+    _set_grad_enabled(False)
+    try:
+        yield
+    finally:
+        _set_grad_enabled(prev)
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = _grad_enabled()
+    _set_grad_enabled(True)
+    try:
+        yield
+    finally:
+        _set_grad_enabled(prev)
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled()
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    ``vjp_fn`` maps output cotangents -> input cotangents (from ``jax.vjp`` or a
+    custom PyLayer backward).  ``inputs`` are the producing op's Tensor inputs.
+    """
+
+    __slots__ = (
+        "vjp_fn",
+        "inputs",
+        "num_outputs",
+        "out_avals",
+        "name",
+    )
+
+    def __init__(self, vjp_fn, inputs, num_outputs, out_avals, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list[Tensor]
+        self.num_outputs = num_outputs
+        self.out_avals = out_avals  # list[(shape, dtype)] for zero-filling
+        self.name = name
+
+    def __repr__(self):
+        return f"<GradNode {self.name} n_in={len(self.inputs)} n_out={self.num_outputs}>"
+
+
+def _is_float0(x) -> bool:
+    return hasattr(x, "dtype") and x.dtype == jax.dtypes.float0
+
+
+def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None, retain_graph: bool = False):
+    """Run reverse-mode over the tape from ``tensors``.
+
+    Reference semantics (``egr::RunBackward``, ``backward.cc:105``): seeds with
+    ones (or ``grad_tensors``), accumulates into leaf ``Tensor.grad``, frees the
+    graph unless ``retain_graph``.
+    """
+    from .tensor import Tensor  # local import to avoid cycle
+
+    tensors = list(tensors)
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    grad_tensors = list(grad_tensors)
+
+    # Seed cotangents per (node, out_index); leaf roots accumulate directly.
+    node_cots: dict = {}
+
+    def _seed(t: Tensor, g):
+        if g is None:
+            g = jnp.ones(t.shape, dtype=t.dtype)
+        elif isinstance(g, Tensor):
+            g = g._data
+        node = t._grad_node
+        if node is None:
+            if not t.stop_gradient:
+                t._accumulate_grad(g)
+            return
+        slots = node_cots.setdefault(id(node), [None] * node.num_outputs)
+        slots[t._out_index] = g if slots[t._out_index] is None else slots[t._out_index] + g
+
+    roots: List[GradNode] = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._grad_node is None:
+            raise RuntimeError("backward() on a tensor with stop_gradient=True and no graph")
+        _seed(t, g)
+        if t._grad_node is not None:
+            roots.append(t._grad_node)
+
+    # Topological order over nodes (DFS post-order, children = producer nodes of inputs).
+    topo: List[GradNode] = []
+    visited = set()
+    for root in roots:
+        if id(root) in visited:
+            continue
+        stack = [(root, False)]
+        while stack:
+            node, processed = stack.pop()
+            if processed:
+                topo.append(node)
+                continue
+            if id(node) in visited:
+                continue
+            visited.add(id(node))
+            stack.append((node, True))
+            for inp in node.inputs:
+                child = inp._grad_node
+                if child is not None and id(child) not in visited:
+                    stack.append((child, False))
+
+    # Process in reverse topological order.
+    for node in reversed(topo):
+        slots = node_cots.pop(id(node), None)
+        if slots is None:
+            continue  # no cotangent reached this node
+        cots = []
+        for i, s in enumerate(slots):
+            if s is None:
+                shape, dt = node.out_avals[i]
+                if jnp.issubdtype(dt, jnp.floating) or jnp.issubdtype(dt, jnp.complexfloating):
+                    s = jnp.zeros(shape, dtype=dt)
+                else:
+                    # integer/bool outputs take float0 cotangents under jax.vjp
+                    s = np.zeros(shape, dtype=jax.dtypes.float0)
+            cots.append(s)
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "trying to backward through a graph a second time: "
+                "set retain_graph=True on the first backward"
+            )
+        in_grads = node.vjp_fn(tuple(cots) if node.num_outputs > 1 else cots[0])
+        if not isinstance(in_grads, (tuple, list)):
+            in_grads = (in_grads,)
+        for inp, g in zip(node.inputs, in_grads):
+            if g is None or _is_float0(g) or inp.stop_gradient:
+                continue
+            for hook in inp._hooks:
+                out = hook(g)
+                if out is not None:
+                    g = out._data if isinstance(out, Tensor) else out
+            child = inp._grad_node
+            if child is None:
+                inp._accumulate_grad(g)
+            else:
+                cslots = node_cots.setdefault(id(child), [None] * child.num_outputs)
+                j = inp._out_index
+                cslots[j] = g if cslots[j] is None else cslots[j] + g
+        if not retain_graph:
+            node.vjp_fn = None
+            node.inputs = ()
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph: Optional[bool] = None,
+    create_graph: bool = False,
+    allow_unused: bool = False,
+):
+    """``paddle.grad`` equivalent: returns grads of ``outputs`` wrt ``inputs``
+    without touching ``.grad`` of other leaves.
+    """
+    from .tensor import Tensor
+
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported on the eager tape; "
+            "use paddle_tpu.jit / jax.grad composition for higher-order grads"
+        )
+    # Save and clear .grad on the requested inputs, run backward, collect.
+    saved = [(t, t.grad) for t in inputs]
+    for t in inputs:
+        t._grad = None
+    try:
+        backward(outputs, grad_outputs, retain_graph=bool(retain_graph))
+        results = []
+        for t in inputs:
+            if t._grad is None:
+                if not allow_unused:
+                    raise RuntimeError("one of the input tensors received no gradient; pass allow_unused=True")
+                results.append(None)
+            else:
+                results.append(Tensor(t._grad, stop_gradient=True))
+        return results
+    finally:
+        for t, g in saved:
+            t._grad = g

@@ -1,0 +1,154 @@
+"""Eager op dispatch.
+
+Counterpart of the reference's generated op entry points (``_C_ops.*`` +
+``*_ad_func``; generator ``eager/auto_code_generator/generator/eager_gen.py``).
+Every functional op funnels through :func:`apply_op`, which
+
+1. unwraps Tensor storage,
+2. if any input needs grad (and the tape is on), runs ``jax.vjp`` and records a
+   single generic :class:`~paddle_tpu.framework.autograd.GradNode`,
+3. otherwise calls the jnp implementation directly,
+4. optionally scans outputs for NaN/Inf (``FLAGS_check_nan_inf``).
+
+Under ``jax.jit`` tracing the same path works on tracers; the tape is normally
+disabled there (``paddle_tpu.jit`` uses ``jax.grad`` instead).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd, flags
+from .tensor import Tensor
+
+
+class _AmpState:
+    enabled = False
+    dtype = None
+    level = "O1"
+    white = frozenset()
+    black = frozenset()
+
+
+amp_state = _AmpState()
+
+
+def _amp_cast(name: str, datas: tuple) -> tuple:
+    """Per-op input casting under auto_cast (reference: eager_gen.py AMP template)."""
+    base = name.split("_")[0] if name not in amp_state.white and name not in amp_state.black else name
+    target = None
+    if name in amp_state.black or base in amp_state.black:
+        target = jnp.float32
+    elif amp_state.level == "O2":
+        target = amp_state.dtype
+    elif name in amp_state.white or base in amp_state.white:
+        target = amp_state.dtype
+    if target is None:
+        return datas
+    return tuple(
+        d.astype(target) if hasattr(d, "dtype") and jnp.issubdtype(d.dtype, jnp.floating) and d.dtype != target else d
+        for d in datas
+    )
+
+
+def _check_nan_inf(name: str, arrays) -> None:
+    for a in arrays:
+        if not hasattr(a, "dtype") or not jnp.issubdtype(a.dtype, jnp.floating):
+            continue
+        if isinstance(a, jax.core.Tracer):
+            continue
+        bad = bool(jnp.any(~jnp.isfinite(a)))
+        if bad:
+            msg = f"NaN or Inf found in output of op '{name}'"
+            if flags.get_flag("check_nan_inf_level") > 0:
+                print("WARNING:", msg)
+            else:
+                raise FloatingPointError(msg)
+
+
+def apply_op(
+    name: str,
+    fn: Callable,
+    tensor_args: Sequence[Tensor],
+    kwargs: dict,
+    num_outputs: int = 1,
+):
+    """Run ``fn(*datas, **kwargs)`` with tape recording.
+
+    ``tensor_args`` are the differentiable Tensor inputs; all static/config
+    arguments must be captured in ``kwargs`` (passed to fn as keywords) or
+    closed over by ``fn``.
+    """
+    datas = tuple(t._data for t in tensor_args)
+    if amp_state.enabled:
+        datas = _amp_cast(name, datas)
+    needs_grad = (
+        autograd.is_grad_enabled()
+        and any(not t.stop_gradient for t in tensor_args)
+    )
+
+    if needs_grad:
+        call = (lambda *xs: fn(*xs, **kwargs)) if kwargs else fn
+        outs, vjp_fn = jax.vjp(call, *datas)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+        any_float_out = any(
+            jnp.issubdtype(o.dtype, jnp.floating) or jnp.issubdtype(o.dtype, jnp.complexfloating)
+            for o in out_list
+        )
+        if not any_float_out:
+            # pure integer/bool op (argmax, comparisons, ...) — nothing to tape
+            results = [Tensor(o, stop_gradient=True) for o in out_list]
+        else:
+            node = autograd.GradNode(
+                vjp_fn,
+                list(tensor_args),
+                len(out_list),
+                [(o.shape, o.dtype) for o in out_list],
+                name=name,
+            )
+            results = []
+            for i, o in enumerate(out_list):
+                is_float = jnp.issubdtype(o.dtype, jnp.floating) or jnp.issubdtype(o.dtype, jnp.complexfloating)
+                t = Tensor(o, stop_gradient=not is_float)
+                t._grad_node = node
+                t._out_index = i
+                results.append(t)
+    else:
+        outs = fn(*datas, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+        results = [Tensor(o, stop_gradient=True) for o in out_list]
+
+    if flags.get_flag("check_nan_inf"):
+        _check_nan_inf(name, [r._data for r in results])
+
+    if num_outputs == 1 and not multi:
+        return results[0]
+    return tuple(results)
+
+
+def unwrap(x):
+    """Tensor -> jax.Array passthrough for pytrees."""
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(unwrap(v) for v in x)
+    if isinstance(x, dict):
+        return {k: unwrap(v) for k, v in x.items()}
+    return x
+
+
+def wrap(x, stop_gradient: bool = True):
+    """jax.Array -> Tensor passthrough for pytrees."""
+    if isinstance(x, (jax.Array, jax.core.Tracer, np.ndarray)):
+        return Tensor(x, stop_gradient=stop_gradient)
+    if isinstance(x, (list, tuple)):
+        return type(x)(wrap(v, stop_gradient) for v in x)
+    if isinstance(x, dict):
+        return {k: wrap(v, stop_gradient) for k, v in x.items()}
+    return x

@@ -1,0 +1,106 @@
+"""Dtype system.
+
+Counterpart of the reference's ``phi::DataType`` (``paddle/phi/common/data_type.h``)
+— a small canonical dtype namespace that maps directly onto JAX/XLA dtypes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtypes, addressable as paddle_tpu.float32 etc.
+bool_ = jnp.bool_
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_ALIASES = {
+    "bool": bool_,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "uint8": uint8,
+    "uint16": uint16,
+    "uint32": uint32,
+    "uint64": uint64,
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+_INTEGRAL = {int8, int16, int32, int64, uint8, uint16, uint32, uint64}
+_COMPLEX = {complex64, complex128}
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize a user-supplied dtype (string / np / jnp) to a numpy dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _ALIASES:
+            raise ValueError(f"unsupported dtype string {dtype!r}")
+        dtype = _ALIASES[dtype]
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def is_floating_point(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return any(d == np.dtype(f) for f in _FLOATING)
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return any(d == np.dtype(i) for i in _INTEGRAL) or d == np.dtype(np.bool_)
+
+
+def is_complex(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return any(d == np.dtype(c) for c in _COMPLEX)
+
+
+# default dtype management (paddle.get_default_dtype / set_default_dtype)
+_DEFAULT_DTYPE = np.dtype("float32")
+
+
+def set_default_dtype(dtype) -> None:
+    global _DEFAULT_DTYPE
+    d = convert_dtype(dtype)
+    if not is_floating_point(d):
+        raise TypeError("default dtype must be floating point")
+    _DEFAULT_DTYPE = d
+
+
+def get_default_dtype() -> str:
+    return _DEFAULT_DTYPE.name

@@ -1,0 +1,80 @@
+"""Global runtime flag registry.
+
+TPU-native counterpart of the reference's flag system (``paddle/common/flags.cc``,
+``PD_DEFINE_*`` macros): a single registry of typed runtime flags, settable via
+environment variables (``FLAGS_*``), ``set_flags`` or per-call overrides.  We keep
+it pure Python — there is no C++ gflags dependency on the TPU stack.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+_LOCK = threading.RLock()
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    type: type
+    help: str
+    value: Any = None
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def _parse(raw: str, ty: type) -> Any:
+    if ty is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return ty(raw)
+
+
+def define_flag(name: str, default: Any, help: str = "") -> None:
+    """Register a flag. Environment variable ``FLAGS_<name>`` overrides the default."""
+    with _LOCK:
+        ty = type(default)
+        env = os.environ.get("FLAGS_" + name)
+        value = _parse(env, ty) if env is not None else default
+        _REGISTRY[name] = _Flag(name=name, default=default, type=ty, help=help, value=value)
+
+
+def get_flags(names=None) -> Dict[str, Any]:
+    with _LOCK:
+        if names is None:
+            return {k: f.value for k, f in _REGISTRY.items()}
+        if isinstance(names, str):
+            names = [names]
+        return {n: _REGISTRY[n].value for n in names}
+
+
+def get_flag(name: str) -> Any:
+    with _LOCK:
+        return _REGISTRY[name].value
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    with _LOCK:
+        for name, value in flags.items():
+            key = name[len("FLAGS_"):] if name.startswith("FLAGS_") else name
+            if key not in _REGISTRY:
+                raise ValueError(f"unknown flag {name!r}")
+            f = _REGISTRY[key]
+            f.value = _parse(value, f.type) if isinstance(value, str) and f.type is not str else f.type(value)
+
+
+# ---------------------------------------------------------------------------
+# Core flags (mirrors of the reference's most-used runtime flags)
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf in eager mode")
+define_flag("check_nan_inf_level", 0, "0: abort on nan/inf; >0: warn only")
+define_flag("benchmark", False, "Synchronize after every eager op (for timing)")
+define_flag("use_pallas_kernels", True, "Use Pallas kernels for fused ops when on TPU")
+define_flag("pallas_interpret", False, "Run Pallas kernels in interpreter mode (CPU/testing)")
+define_flag("deterministic", False, "Prefer deterministic kernels")
+define_flag("eager_jit_ops", True, "Cache per-op jitted callables for eager dispatch")
+define_flag("log_level", 0, "Framework verbose log level (VLOG equivalent)")

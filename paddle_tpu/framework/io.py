@@ -1,0 +1,68 @@
+"""Serialization: ``paddle_tpu.save`` / ``paddle_tpu.load``.
+
+Reference: ``python/paddle/framework/io.py:773,1020`` (pickle-based state_dict
+save/load).  We serialize numpy-ified pytrees with pickle; Tensors/Parameters
+round-trip as numpy arrays and are rehydrated on load.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from .tensor import Parameter, Tensor
+
+
+class _TensorPayload:
+    def __init__(self, array: np.ndarray, is_param: bool, name: str, stop_gradient: bool):
+        self.array = array
+        self.is_param = is_param
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(
+            np.asarray(obj._data), isinstance(obj, Parameter), obj.name, obj.stop_gradient
+        )
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        if obj.is_param:
+            p = Parameter(obj.array, name=obj.name)
+            p.stop_gradient = obj.stop_gradient
+            return p
+        t = Tensor(obj.array, stop_gradient=obj.stop_gradient)
+        t.name = obj.name
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

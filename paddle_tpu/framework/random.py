@@ -1,0 +1,126 @@
+"""RNG state management.
+
+Counterpart of the reference's ``phi::Generator`` (``paddle/phi/core/generator.h``)
+and the TP-aware ``RNGStatesTracker`` (``fleet/layers/mpu/random.py:34``), built on
+JAX's functional PRNG: the framework keeps a root key and splits a fresh subkey per
+random op in eager mode; under ``jit`` tracing, a traced key can be installed with
+``rng_guard`` so random ops stay functional.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Optional
+
+import jax
+
+
+class Generator:
+    """A splittable PRNG stream."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed: int) -> "Generator":
+        with self._lock:
+            self._seed = seed
+            self._key = jax.random.key(seed)
+        return self
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key) -> None:
+        with self._lock:
+            self._key = key
+
+
+_DEFAULT = Generator(0)
+
+# Optional traced-key override stack (for use inside jit-traced functions).
+_TRACED: list = []
+
+
+def default_generator() -> Generator:
+    return _DEFAULT
+
+
+def seed(s: int) -> Generator:
+    """Seed the global generator (``paddle.seed`` equivalent)."""
+    _DEFAULT.manual_seed(int(s))
+    for g in _TRACKER._states.values():
+        g.manual_seed(int(s))
+    return _DEFAULT
+
+
+def next_key():
+    """Fresh PRNG key for one random op."""
+    if _TRACED:
+        key, sub = jax.random.split(_TRACED[-1][0])
+        _TRACED[-1][0] = key
+        return sub
+    return _DEFAULT.next_key()
+
+
+@contextlib.contextmanager
+def rng_guard(key):
+    """Install a (possibly traced) key as the source for random ops.
+
+    Used when tracing a model under jit: ``with rng_guard(step_key): model(x)``
+    keeps dropout etc. functional in the traced program.
+    """
+    _TRACED.append([key])
+    try:
+        yield
+    finally:
+        _TRACED.pop()
+
+
+class RNGStatesTracker:
+    """Named RNG domains (reference: ``mpu/random.py`` RNGStatesTracker).
+
+    Tensor-parallel dropout needs *different* streams per model-parallel rank for
+    non-replicated activations and the *same* stream for replicated ones; named
+    domains provide that.
+    """
+
+    def __init__(self):
+        self._states: Dict[str, Generator] = {}
+
+    def add(self, name: str, seed_: int) -> None:
+        if name in self._states:
+            raise ValueError(f"rng state {name!r} already exists")
+        self._states[name] = Generator(seed_)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        gen = self._states.get(name)
+        if gen is None:
+            gen = Generator(_DEFAULT.initial_seed)
+            self._states[name] = gen
+        old = _DEFAULT.get_state()
+        _DEFAULT.set_state(gen.get_state())
+        try:
+            yield
+        finally:
+            gen.set_state(_DEFAULT.get_state())
+            _DEFAULT.set_state(old)
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER

@@ -1,0 +1,7 @@
+"""``paddle_tpu.incubate`` — fused-op APIs (reference: ``python/paddle/incubate/``).
+
+The reference exposes its fused CUDA kernels here (fused_rms_norm, swiglu,
+fused_rotary_position_embedding, ...); ours route to the Pallas kernel library.
+"""
+
+from . import nn  # noqa: F401

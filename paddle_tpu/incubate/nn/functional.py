@@ -1,0 +1,104 @@
+"""Fused functional ops (reference: ``python/paddle/incubate/nn/functional/``).
+
+Each routes to the Pallas kernel library (``paddle_tpu.kernels``) — the
+counterpart of the reference's ``phi/kernels/fusion/gpu`` bindings.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...framework.dispatch import apply_op
+from ...framework.tensor import Tensor
+from ...kernels import flash_attention as _fa
+from ...kernels import rms_norm as _rms
+from ...kernels import rope as _rope
+from ...kernels import swiglu as _swiglu
+
+__all__ = ["fused_rms_norm", "fused_layer_norm", "swiglu", "fused_rotary_position_embedding",
+           "fused_bias_act", "fused_linear", "fused_dropout_add"]
+
+
+def _t(v):
+    return v if isinstance(v, Tensor) else Tensor(v)
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6, begin_norm_axis=-1,
+                   bias=None, residual=None, quant_scale=-1, **kw):
+    args = [_t(x)]
+    if norm_weight is not None:
+        args.append(_t(norm_weight))
+
+    def f(a, *w):
+        out = _rms.rms_norm(a, w[0] if w else None, epsilon)
+        return out
+
+    out = apply_op("fused_rms_norm", f, tuple(args), {})
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, begin_norm_axis=1, **kw):
+    from ...nn import functional as F
+
+    return F.layer_norm(x, [x.shape[-1]], norm_weight, norm_bias, epsilon)
+
+
+def swiglu(x, y=None, name=None):
+    if y is None:
+        return apply_op("swiglu", lambda a: _swiglu.swiglu(a), (_t(x),), {})
+    return apply_op("swiglu", _swiglu.swiglu, (_t(x), _t(y)), {})
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None, position_ids=None,
+                                    use_neox_rotary_style=True, time_major=False, rotary_emb_base=10000.0):
+    tensors = [t for t in (q, k, v) if t is not None]
+    n = len(tensors)
+    sin_d = sin._data if isinstance(sin, Tensor) else sin
+    cos_d = cos._data if isinstance(cos, Tensor) else cos
+    pos_d = position_ids._data if isinstance(position_ids, Tensor) else position_ids
+
+    def f(*args):
+        outs = _rope.fused_rotary_position_embedding(
+            *args, *(None,) * (3 - len(args)), sin=sin_d, cos=cos_d,
+            position_ids=pos_d, use_neox_rotary_style=use_neox_rotary_style)
+        return tuple(o for o in outs[:len(args)])
+
+    outs = apply_op("fused_rope", f, tuple(_t(t) for t in tensors), {}, num_outputs=n)
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    result = []
+    i = 0
+    for t in (q, k, v):
+        if t is None:
+            result.append(None)
+        else:
+            result.append(outs[i])
+            i += 1
+    return tuple(result)
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None, act_method="gelu", **kw):
+    from ...nn import functional as F
+
+    out = _t(x)
+    if bias is not None:
+        out = out + _t(bias)
+    if act_method in ("swiglu", "geglu"):
+        return swiglu(out)
+    return getattr(F, act_method)(out)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    from ...nn import functional as F
+    from ...ops.manipulation import transpose
+
+    w = transpose(weight, [1, 0]) if transpose_weight else weight
+    return F.linear(x, w, bias)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=None):
+    from ...nn import functional as F
+
+    return F.dropout(x, p, training=training, mode=mode) + y

@@ -1,0 +1,29 @@
+"""Pallas/TPU fused kernel library.
+
+Counterpart of the reference's fused GPU kernels (``paddle/phi/kernels/fusion/gpu``:
+flash_attn, fused_rope, fused_rms_norm, fused_bias_act, block_multi_head_attention)
+and its flashattn third-party dynload.  Each kernel ships two implementations:
+
+- a Pallas TPU kernel (the performance path), and
+- an XLA reference implementation (CPU tests, correctness oracle, fallback).
+
+Selection: ``FLAGS_use_pallas_kernels`` AND running on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..framework import flags
+
+
+def use_pallas() -> bool:
+    if not flags.get_flag("use_pallas_kernels"):
+        return False
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+from . import flash_attention, rms_norm, rope, swiglu  # noqa: E402,F401

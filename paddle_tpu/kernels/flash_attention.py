@@ -1,0 +1,304 @@
+"""Flash attention for TPU.
+
+Counterpart of the reference's flash-attention integration
+(``phi/kernels/gpu/flash_attn_kernel.cu:587`` ``FlashAttnKernel`` dynloading
+``third_party/flashattn``).  This is NOT a port: the TPU kernel is a Pallas
+implementation of the memory-efficient attention algorithm (online softmax over
+KV blocks), designed around VMEM tiling and the MXU.
+
+Layout convention follows the reference's API (``nn/functional/flash_attention.py``):
+``q, k, v: [batch, seq, num_heads, head_dim]``.
+
+The XLA reference path is used on CPU and as the numerics oracle in tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# XLA reference implementation
+# ---------------------------------------------------------------------------
+
+def _attention_reference(q, k, v, causal: bool, mask, sm_scale: float):
+    # [B, S, H, D] -> [B, H, S, D]
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * sm_scale
+    sq, sk = scores.shape[-2], scores.shape[-1]
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(causal_mask, scores, NEG_INF)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, NEG_INF)
+        else:
+            scores = scores + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel (fwd + bwd), installed lazily to keep CPU imports cheap
+# ---------------------------------------------------------------------------
+
+def _pallas_flash(q, k, v, causal: bool, sm_scale: float,
+                  block_q: int = 128, block_k: int = 128):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    # operate in [B*H, S, D]
+    qr = jnp.swapaxes(q, 1, 2).reshape(B * H, Sq, D)
+    kr = jnp.swapaxes(k, 1, 2).reshape(B * H, Sk, D)
+    vr = jnp.swapaxes(v, 1, 2).reshape(B * H, Sk, D)
+
+    out = _flash_fwd_bh(qr, kr, vr, causal, sm_scale, block_q, block_k)
+    return jnp.swapaxes(out.reshape(B, H, Sq, D), 1, 2).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_fwd_bh(q, k, v, causal, sm_scale, block_q, block_k):
+    o, _ = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k)
+    return o
+
+
+def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k):
+    """q,k,v: [BH, S, D]. Returns (o, lse)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    n_q = Sq // block_q
+    n_k = Sk // block_k
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref):
+        qi = pl.program_id(1)
+        qb = q_ref[0].astype(jnp.float32)  # [block_q, D]
+
+        def body(ki, carry):
+            acc, m_prev, l_prev = carry
+            kb = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+            s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * sm_scale
+            if causal:
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(q_pos + (Sk - Sq) >= k_pos, s, NEG_INF)
+            m_cur = jnp.max(s, axis=1)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1)
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            return acc, m_new, l_new
+
+        acc0 = jnp.zeros((block_q, D), jnp.float32)
+        m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((block_q,), jnp.float32)
+        if causal:
+            # only blocks with k_start <= q_end participate
+            hi = jnp.minimum(((qi + 1) * block_q + (Sk - Sq) + block_k - 1) // block_k, n_k)
+        else:
+            hi = n_k
+        acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m + jnp.log(l_safe)).astype(jnp.float32)
+
+    grid = (BH, n_q)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Sq), jnp.float32),
+        ],
+    )(q, k, v)
+    return o, lse
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k):
+    o, lse = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k)
+    return dq, dk, dv
+
+
+_flash_fwd_bh.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
+    """Two-pass flash backward: dKV pass (grid over KV blocks) and dQ pass."""
+    from jax.experimental import pallas as pl
+
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    n_q = Sq // block_q
+    n_k = Sk // block_k
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, Sq]
+
+    def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref):
+        ki = pl.program_id(1)
+        kb = k_ref[0].astype(jnp.float32)  # [block_k, D]
+        vb = v_ref[0].astype(jnp.float32)
+
+        def body(qi, carry):
+            dk_acc, dv_acc = carry
+            qb = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+            dob = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+            lseb = lse_ref[0, pl.ds(qi * block_q, block_q)]
+            deltab = delta_ref[0, pl.ds(qi * block_q, block_q)]
+            s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * sm_scale
+            if causal:
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(q_pos + (Sk - Sq) >= k_pos, s, NEG_INF)
+            p = jnp.exp(s - lseb[:, None])  # [bq, bk]
+            dv_acc = dv_acc + jax.lax.dot_general(p, dob, (((0,), (0,)), ((), ())),
+                                                  preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - deltab[:, None]) * sm_scale
+            dk_acc = dk_acc + jax.lax.dot_general(ds, qb, (((0,), (0,)), ((), ())),
+                                                  preferred_element_type=jnp.float32)
+            return dk_acc, dv_acc
+
+        if causal:
+            lo = jnp.maximum((ki * block_k - (Sk - Sq)) // block_q, 0)
+        else:
+            lo = 0
+        dk_acc0 = jnp.zeros((block_k, D), jnp.float32)
+        dv_acc0 = jnp.zeros((block_k, D), jnp.float32)
+        dk_acc, dv_acc = jax.lax.fori_loop(lo, n_q, body, (dk_acc0, dv_acc0))
+        dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(BH, n_k),
+        in_specs=[
+            pl.BlockSpec((1, Sq, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sq, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sq), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, Sq), lambda b, i: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sk, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), q.dtype),
+        ],
+    )(q, k, v, do, lse, delta)
+
+    def dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
+        qi = pl.program_id(1)
+        qb = q_ref[0].astype(jnp.float32)
+        dob = do_ref[0].astype(jnp.float32)
+        lseb = lse_ref[0]
+        deltab = delta_ref[0]
+
+        def body(ki, dq_acc):
+            kb = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+            s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * sm_scale
+            if causal:
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(q_pos + (Sk - Sq) >= k_pos, s, NEG_INF)
+            p = jnp.exp(s - lseb[:, None])
+            dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - deltab[:, None]) * sm_scale
+            return dq_acc + jax.lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
+                                                preferred_element_type=jnp.float32)
+
+        if causal:
+            hi = jnp.minimum(((qi + 1) * block_q + (Sk - Sq) + block_k - 1) // block_k, n_k)
+        else:
+            hi = n_k
+        dq_acc = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, D), jnp.float32))
+        dq_ref[0] = dq_acc.astype(dq_ref.dtype)
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(BH, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+    )(q, k, v, do, lse, delta)
+
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+def flash_attention(q, k, v, causal: bool = False, mask=None, sm_scale: Optional[float] = None):
+    """Memory-efficient attention. q,k,v: [B, S, H, D] jax arrays."""
+    from . import use_pallas
+
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    Hk = k.shape[2]
+    if Hk != H and Hk > 0 and H % Hk == 0 and Hk != H:
+        # grouped-query attention: repeat KV heads
+        rep = H // Hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    pallas_ok = (
+        use_pallas()
+        and mask is None
+        and D in (64, 128, 256)
+        and Sq % 128 == 0
+        and Sk % 128 == 0
+    )
+    if pallas_ok:
+        return _pallas_flash(q, k, v, causal, sm_scale)
+    return _attention_reference(q, k, v, causal, mask, sm_scale)

@@ -1,0 +1,69 @@
+"""Fused RMSNorm.
+
+Counterpart of the reference's ``fused_rms_norm`` (``phi/kernels/fusion/gpu``,
+Python API ``incubate/nn/functional/fused_rms_norm.py``).  On TPU a Pallas
+kernel keeps the row statistics in VMEM; on CPU the jnp form is used (XLA
+fuses it anyway — the Pallas version exists to guarantee the fusion and to
+keep fp32 statistics under bf16 inputs).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _rms_norm_ref(x, weight=None, epsilon=1e-6):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    out = x32 * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _rms_norm_pallas(x, weight, epsilon, block_rows: int = 256):
+    from jax.experimental import pallas as pl
+
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    xr = x.reshape(-1, d)
+    n = xr.shape[0]
+    if n % block_rows != 0:
+        block_rows = _largest_divisor(n, block_rows)
+
+    def kernel(x_ref, w_ref, o_ref):
+        xb = x_ref[...].astype(jnp.float32)
+        var = jnp.mean(jnp.square(xb), axis=-1, keepdims=True)
+        out = xb * jax.lax.rsqrt(var + epsilon) * w_ref[...].astype(jnp.float32)
+        o_ref[...] = out.astype(o_ref.dtype)
+
+    w = weight if weight is not None else jnp.ones((d,), x.dtype)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+    )(xr, w)
+    return out.reshape(orig_shape)
+
+
+def _largest_divisor(n, cap):
+    for b in range(min(cap, n), 0, -1):
+        if n % b == 0:
+            return b
+    return 1
+
+
+def rms_norm(x, weight=None, epsilon: float = 1e-6):
+    from . import use_pallas
+
+    if use_pallas() and x.shape[-1] % 128 == 0:
+        return _rms_norm_pallas(x, weight, epsilon)
+    return _rms_norm_ref(x, weight, epsilon)

@@ -1,0 +1,73 @@
+"""Rotary position embedding (RoPE).
+
+Counterpart of the reference's ``fused_rotary_position_embedding``
+(``phi/kernels/fusion/gpu/fused_rope_kernel.cu``; Python API
+``incubate/nn/functional/fused_rotary_position_embedding.py``).
+
+Uses the half-rotation formulation (rotate_half), matching the reference's
+``use_neox_rotary_style=True`` default and the Llama family.  Pure XLA: the op
+is bandwidth-bound elementwise work that XLA fuses into adjacent matmuls, so a
+Pallas version buys nothing here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def rope_freqs(head_dim: int, max_seq_len: int, base: float = 10000.0, dtype=jnp.float32):
+    """Precompute cos/sin tables: [max_seq_len, head_dim]."""
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [S, D/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [S, D]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(q, k, cos, sin, position_ids=None):
+    """q,k: [B, S, H, D]; cos/sin: [S_max, D] or [B, S, D].
+
+    Returns rotated (q, k) in the input dtype; rotation math runs in fp32.
+    """
+    if position_ids is not None:
+        cos = jnp.take(cos, position_ids, axis=0)  # [B, S, D]
+        sin = jnp.take(sin, position_ids, axis=0)
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    else:
+        s = q.shape[1]
+        cos = cos[None, :s, None, :]
+        sin = sin[None, :s, None, :]
+
+    def rot(x):
+        x32 = x.astype(jnp.float32)
+        return (x32 * cos + _rotate_half(x32) * sin).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None, position_ids=None, use_neox_rotary_style=True):
+    """Reference-shaped entry (``incubate/nn/functional``): optionally rotates q/k/v."""
+    if cos is None or sin is None:
+        d = q.shape[-1]
+        s = q.shape[1]
+        cos, sin = rope_freqs(d, s, dtype=jnp.float32)
+    else:
+        cos = jnp.squeeze(cos)
+        sin = jnp.squeeze(sin)
+    outs = []
+    for x in (q, k, v):
+        if x is None:
+            outs.append(None)
+            continue
+        xq, _ = apply_rope(x, x, cos, sin, position_ids)
+        outs.append(xq)
+    return tuple(outs)

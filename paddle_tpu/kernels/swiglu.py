@@ -1,0 +1,16 @@
+"""SwiGLU activation (reference: ``incubate/nn/functional/swiglu.py`` / fused_bias_act).
+
+silu(gate) * up — elementwise, left to XLA fusion; kept as a named kernel for
+API parity and so a Pallas variant can slot in if profiling ever shows a gap.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def swiglu(x, y=None):
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y

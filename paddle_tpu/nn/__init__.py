@@ -1,0 +1,27 @@
+"""``paddle_tpu.nn`` — neural network layers (reference: ``python/paddle/nn/``)."""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layers import Layer, LayerList, ParameterList, Sequential  # noqa: F401
+from .common_layers import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .transformer import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+
+from ..framework.tensor import Parameter  # noqa: F401
+
+
+class ParamAttr:
+    """Parameter configuration (reference: ``python/paddle/base/param_attr.py``)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0, regularizer=None,
+                 trainable=True, do_model_average=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip

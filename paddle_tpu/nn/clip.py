@@ -1,0 +1,79 @@
+"""Gradient clipping (reference: ``python/paddle/nn/clip.py``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        """params_grads: list of (param, grad_array). Returns same structure clipped."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        return [(p, jnp.clip(g, self.min, self.max) if g is not None else None) for p, g in params_grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, None))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            factor = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, (g * factor).astype(g.dtype)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        sq_sum = 0.0
+        any_grad = False
+        for p, g in params_grads:
+            if g is None:
+                continue
+            any_grad = True
+            sq_sum = sq_sum + jnp.sum(jnp.square(g.astype(jnp.float32)))
+        if not any_grad:
+            return params_grads
+        global_norm = jnp.sqrt(sq_sum)
+        factor = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [(p, (g * factor).astype(g.dtype) if g is not None else None) for p, g in params_grads]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    params = [p for p in parameters if p._grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p._grad)) for p in params]))
+    else:
+        total = jnp.sum(jnp.stack([jnp.sum(jnp.abs(p._grad.astype(jnp.float32)) ** norm_type) for p in params])) ** (1.0 / norm_type)
+    factor = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        p._grad = (p._grad * factor).astype(p._grad.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    for p in parameters:
+        if p._grad is not None:
+            p._grad = jnp.clip(p._grad, -clip_value, clip_value)

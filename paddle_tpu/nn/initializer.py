@@ -1,0 +1,197 @@
+"""Weight initializers (reference: ``python/paddle/nn/initializer/``).
+
+Initializers are pure functions ``(shape, dtype) -> jax.Array`` drawing from
+the framework PRNG, so ``paddle_tpu.seed`` reproduces weights exactly.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as rnd
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain",
+]
+
+
+def _fan_in_out(shape: Sequence[int]):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    # conv weights are stored OIHW (paddle layout)
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    if nonlinearity in ("sigmoid", "linear", "conv1d", "conv2d", "conv3d"):
+        return 1.0
+    if nonlinearity == "tanh":
+        return 5.0 / 3
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        neg = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + neg ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4
+    raise ValueError(f"unknown nonlinearity {nonlinearity}")
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(tuple(int(s) for s in shape), self.value, dtype=dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, shape, dtype):
+        shape = tuple(int(s) for s in shape)
+        x = jax.random.normal(rnd.next_key(), shape, dtype=jnp.float32)
+        return (self.mean + self.std * x).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, a: float = -2.0, b: float = 2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype):
+        shape = tuple(int(s) for s in shape)
+        x = jax.random.truncated_normal(rnd.next_key(), self.a, self.b, shape, dtype=jnp.float32)
+        return (self.mean + self.std * x).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        shape = tuple(int(s) for s in shape)
+        x = jax.random.uniform(rnd.next_key(), shape, dtype=jnp.float32, minval=self.low, maxval=self.high)
+        return x.astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in: Optional[float] = None, fan_out: Optional[float] = None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        x = jax.random.normal(rnd.next_key(), tuple(int(s) for s in shape), dtype=jnp.float32)
+        return (std * x).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in: Optional[float] = None, fan_out: Optional[float] = None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        x = jax.random.uniform(rnd.next_key(), tuple(int(s) for s in shape), dtype=jnp.float32, minval=-limit, maxval=limit)
+        return x.astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in: Optional[float] = None, negative_slope: float = 0.0, nonlinearity: str = "relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        x = jax.random.normal(rnd.next_key(), tuple(int(s) for s in shape), dtype=jnp.float32)
+        return (std * x).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in: Optional[float] = None, negative_slope: float = 0.0, nonlinearity: str = "relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        x = jax.random.uniform(rnd.next_key(), tuple(int(s) for s in shape), dtype=jnp.float32, minval=-limit, maxval=limit)
+        return x.astype(dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        from ..framework.tensor import Tensor
+
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.numpy()
+        arr = jnp.asarray(np.asarray(v), dtype=dtype)
+        return arr.reshape(tuple(int(s) for s in shape))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        shape = tuple(int(s) for s in shape)
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        flat = jax.random.normal(rnd.next_key(), (max(rows, cols), min(rows, cols)), dtype=jnp.float32)
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups: int = 1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        shape = tuple(int(s) for s in shape)
+        out = np.zeros(shape, dtype=np.float32)
+        o, i = shape[0], shape[1]
+        spatial_center = tuple(s // 2 for s in shape[2:])
+        for g in range(self.groups):
+            for k in range(min(o // self.groups, i)):
+                out[(g * (o // self.groups) + k, k) + spatial_center] = 1.0
+        return jnp.asarray(out, dtype=dtype)

@@ -1,0 +1,289 @@
+"""Recurrent layers (reference: ``python/paddle/nn/layer/rnn.py``).
+
+TPU-native design note: recurrences are expressed with ``jax.lax.scan`` inside
+one taped op so XLA compiles the whole time loop — the reference instead runs
+a per-step cuDNN/eager loop.  Weights follow the reference layout
+(``weight_ih: [hidden, input]``, gates ordered i,f,c,o for LSTM; r,z,c for GRU).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import apply_op
+from ..framework.tensor import Tensor
+from .initializer import Uniform
+from .layers import Layer
+
+__all__ = ["SimpleRNN", "LSTM", "GRU", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN"]
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, gate_mult, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([gate_mult * hidden_size, input_size], attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([gate_mult * hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([gate_mult * hidden_size], attr=bias_ih_attr, is_bias=True, default_initializer=init) if bias_ih_attr is not False else None
+        self.bias_hh = self.create_parameter([gate_mult * hidden_size], attr=bias_hh_attr, is_bias=True, default_initializer=init) if bias_hh_attr is not False else None
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, 1, **kw)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        from ..ops.creation import zeros
+
+        if states is None:
+            states = zeros([inputs.shape[0], self.hidden_size])
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, *biases):
+            z = x @ wi.T + h @ wh.T
+            for b in biases:
+                z = z + b
+            return act(z)
+
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        args += [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        h = apply_op("rnn_cell", f, tuple(args), {})
+        return h, h
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 4, **kw)
+
+    def forward(self, inputs, states=None):
+        from ..ops.creation import zeros
+
+        if states is None:
+            h0 = zeros([inputs.shape[0], self.hidden_size])
+            c0 = zeros([inputs.shape[0], self.hidden_size])
+        else:
+            h0, c0 = states
+
+        def f(x, h, c, wi, wh, *biases):
+            z = x @ wi.T + h @ wh.T
+            for b in biases:
+                z = z + b
+            i, fgate, g, o = jnp.split(z, 4, axis=-1)
+            i, fgate, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fgate), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = fgate * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        args = [inputs, h0, c0, self.weight_ih, self.weight_hh]
+        args += [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        h, c = apply_op("lstm_cell", f, tuple(args), {}, num_outputs=2)
+        return h, (h, c)
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 3, **kw)
+
+    def forward(self, inputs, states=None):
+        from ..ops.creation import zeros
+
+        if states is None:
+            states = zeros([inputs.shape[0], self.hidden_size])
+
+        def f(x, h, wi, wh, *biases):
+            gi = x @ wi.T
+            gh = h @ wh.T
+            if biases:
+                gi = gi + biases[0]
+                if len(biases) > 1:
+                    gh = gh + biases[1]
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        args += [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        h = apply_op("gru_cell", f, tuple(args), {})
+        return h, h
+
+
+class RNN(Layer):
+    """Wraps a cell into a time-loop (reference ``paddle.nn.RNN``)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        t_axis = 0 if self.time_major else 1
+        steps = inputs.shape[t_axis]
+        outputs = []
+        states = initial_states
+        idxs = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        from ..ops.manipulation import stack
+
+        for t in idxs:
+            xt = inputs[:, t] if not self.time_major else inputs[t]
+            out, states = self.cell(xt, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        return stack(outputs, axis=t_axis), states
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional recurrent net over lax.scan."""
+
+    MODE = "RNN_TANH"
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirect else 1
+        self.num_directions = num_dirs
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._weights = []
+        for layer in range(num_layers):
+            for d in range(num_dirs):
+                in_sz = input_size if layer == 0 else hidden_size * num_dirs
+                wih = self.create_parameter([self.GATES * hidden_size, in_sz], default_initializer=init)
+                whh = self.create_parameter([self.GATES * hidden_size, hidden_size], default_initializer=init)
+                bih = self.create_parameter([self.GATES * hidden_size], is_bias=True, default_initializer=init)
+                bhh = self.create_parameter([self.GATES * hidden_size], is_bias=True, default_initializer=init)
+                names = [f"weight_ih_l{layer}{'_reverse' if d else ''}",
+                         f"weight_hh_l{layer}{'_reverse' if d else ''}",
+                         f"bias_ih_l{layer}{'_reverse' if d else ''}",
+                         f"bias_hh_l{layer}{'_reverse' if d else ''}"]
+                for n, p in zip(names, (wih, whh, bih, bhh)):
+                    self.add_parameter(n, p)
+                self._weights.append((wih, whh, bih, bhh))
+
+    def _cell_fn(self):
+        mode = self.MODE
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        if mode == "LSTM":
+            def step(carry, x, wih, whh, bih, bhh):
+                h, c = carry
+                z = x @ wih.T + h @ whh.T + bih + bhh
+                i, f, g, o = jnp.split(z, 4, axis=-1)
+                i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+                g = jnp.tanh(g)
+                c_new = f * c + i * g
+                h_new = o * jnp.tanh(c_new)
+                return (h_new, c_new), h_new
+        elif mode == "GRU":
+            def step(carry, x, wih, whh, bih, bhh):
+                h = carry
+                gi = x @ wih.T + bih
+                gh = h @ whh.T + bhh
+                ir, iz, ic = jnp.split(gi, 3, axis=-1)
+                hr, hz, hc = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                c = jnp.tanh(ic + r * hc)
+                h_new = (1 - z) * c + z * h
+                return h_new, h_new
+        else:
+            def step(carry, x, wih, whh, bih, bhh):
+                h = carry
+                h_new = act(x @ wih.T + h @ whh.T + bih + bhh)
+                return h_new, h_new
+
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        is_lstm = self.MODE == "LSTM"
+        step = self._cell_fn()
+        num_dirs = self.num_directions
+        nl = self.num_layers
+        hs = self.hidden_size
+        time_major = self.time_major
+
+        flat_w = []
+        for wset in self._weights:
+            flat_w.extend(wset)
+
+        def f(x, *weights):
+            xs = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, F]
+            T, B = xs.shape[0], xs.shape[1]
+            h_finals, c_finals = [], []
+            cur = xs
+            wi = iter(range(0, len(weights), 4))
+            idx = 0
+            for layer in range(nl):
+                outs_dir = []
+                for d in range(num_dirs):
+                    wih, whh, bih, bhh = weights[idx:idx + 4]
+                    idx += 4
+                    h0 = jnp.zeros((B, hs), cur.dtype)
+                    carry0 = (h0, jnp.zeros((B, hs), cur.dtype)) if is_lstm else h0
+                    seq = jnp.flip(cur, axis=0) if d == 1 else cur
+
+                    def scan_step(carry, xt):
+                        return step(carry, xt, wih, whh, bih, bhh)
+
+                    carry, ys = jax.lax.scan(scan_step, carry0, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, axis=0)
+                    outs_dir.append(ys)
+                    if is_lstm:
+                        h_finals.append(carry[0])
+                        c_finals.append(carry[1])
+                    else:
+                        h_finals.append(carry)
+                cur = jnp.concatenate(outs_dir, axis=-1) if num_dirs == 2 else outs_dir[0]
+            out = cur if time_major else jnp.swapaxes(cur, 0, 1)
+            h_stack = jnp.stack(h_finals, axis=0)
+            if is_lstm:
+                c_stack = jnp.stack(c_finals, axis=0)
+                return out, h_stack, c_stack
+            return out, h_stack
+
+        args = tuple([inputs if isinstance(inputs, Tensor) else Tensor(inputs)] + flat_w)
+        if is_lstm:
+            out, h, c = apply_op(self.MODE, f, args, {}, num_outputs=3)
+            return out, (h, c)
+        out, h = apply_op(self.MODE, f, args, {}, num_outputs=2)
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+    GATES = 1
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+    GATES = 4
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+    GATES = 3

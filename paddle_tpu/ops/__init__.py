@@ -1,0 +1,102 @@
+"""Functional op library + Tensor method installation.
+
+Mirrors the reference's pattern of attaching the functional API onto the Tensor
+class (``python/paddle/tensor/__init__.py`` method registration), so
+``t.matmul(u)``, ``t + u``, ``t.sum()`` all work.
+"""
+
+from __future__ import annotations
+
+from . import creation, linalg, logic, manipulation, math, random, reduction, search
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+
+from ..framework.tensor import Tensor
+
+__all__ = (
+    creation.__all__
+    + linalg.__all__
+    + logic.__all__
+    + manipulation.__all__
+    + math.__all__
+    + random.__all__
+    + reduction.__all__
+    + search.__all__
+)
+
+
+def _install_tensor_methods():
+    """Attach functional ops as Tensor methods + dunders."""
+    g = globals()
+    method_names = [n for n in __all__ if n not in ("to_tensor", "is_tensor")]
+    for name in method_names:
+        fn = g.get(name)
+        if fn is not None and not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+    # Paddle-style aliases
+    Tensor.mm = g["matmul"]
+    Tensor.dim = lambda self: self.ndim
+    Tensor.rank = lambda self: Tensor(self.ndim)
+    Tensor.numel = lambda self: g["numel"](self)
+    Tensor.element_size = lambda self: self._data.dtype.itemsize
+    Tensor.add_ = lambda self, y: _inplace(self, g["add"](self, y))
+    Tensor.subtract_ = lambda self, y: _inplace(self, g["subtract"](self, y))
+    Tensor.multiply_ = lambda self, y: _inplace(self, g["multiply"](self, y))
+    Tensor.scale_ = lambda self, scale=1.0, bias=0.0, bias_after_scale=True: _inplace(
+        self, g["scale"](self, scale, bias, bias_after_scale)
+    )
+    Tensor.clip_ = lambda self, min=None, max=None: _inplace(self, g["clip"](self, min, max))
+    Tensor.zero_ = lambda self: _inplace(self, g["zeros_like"](self))
+    Tensor.fill_ = lambda self, v: _inplace(self, g["full_like"](self, v))
+    Tensor.exp_ = lambda self: _inplace(self, g["exp"](self))
+
+    # arithmetic dunders
+    Tensor.__add__ = lambda self, o: g["add"](self, o)
+    Tensor.__radd__ = lambda self, o: g["add"](self, o)
+    Tensor.__sub__ = lambda self, o: g["subtract"](self, o)
+    Tensor.__rsub__ = lambda self, o: g["subtract"](o, self)
+    Tensor.__mul__ = lambda self, o: g["multiply"](self, o)
+    Tensor.__rmul__ = lambda self, o: g["multiply"](self, o)
+    Tensor.__truediv__ = lambda self, o: g["divide"](self, o)
+    Tensor.__rtruediv__ = lambda self, o: g["divide"](o, self)
+    Tensor.__floordiv__ = lambda self, o: g["floor_divide"](self, o)
+    Tensor.__rfloordiv__ = lambda self, o: g["floor_divide"](o, self)
+    Tensor.__mod__ = lambda self, o: g["remainder"](self, o)
+    Tensor.__rmod__ = lambda self, o: g["remainder"](o, self)
+    Tensor.__pow__ = lambda self, o: g["pow"](self, o)
+    Tensor.__rpow__ = lambda self, o: g["pow"](o, self)
+    Tensor.__neg__ = lambda self: g["neg"](self)
+    Tensor.__abs__ = lambda self: g["abs"](self)
+    Tensor.__matmul__ = lambda self, o: g["matmul"](self, o)
+    Tensor.__rmatmul__ = lambda self, o: g["matmul"](o, self)
+    Tensor.__eq__ = lambda self, o: g["equal"](self, o)
+    Tensor.__ne__ = lambda self, o: g["not_equal"](self, o)
+    Tensor.__lt__ = lambda self, o: g["less_than"](self, o)
+    Tensor.__le__ = lambda self, o: g["less_equal"](self, o)
+    Tensor.__gt__ = lambda self, o: g["greater_than"](self, o)
+    Tensor.__ge__ = lambda self, o: g["greater_equal"](self, o)
+    Tensor.__and__ = lambda self, o: g["bitwise_and"](self, o)
+    Tensor.__or__ = lambda self, o: g["bitwise_or"](self, o)
+    Tensor.__xor__ = lambda self, o: g["bitwise_xor"](self, o)
+    Tensor.__invert__ = lambda self: g["bitwise_not"](self)
+
+    # properties paddle exposes
+    Tensor.T = property(lambda self: g["transpose"](self, list(range(self.ndim))[::-1]))
+
+
+def _inplace(t, out):
+    t._data = out._data
+    t._grad_node = out._grad_node
+    t._out_index = out._out_index
+    t.stop_gradient = out.stop_gradient
+    return t
+
+
+_install_tensor_methods()

@@ -1,0 +1,68 @@
+"""Shared helpers for the functional op library.
+
+The op modules here are the counterpart of the reference's PHI op library +
+Python API layer (``python/paddle/tensor/*.py`` dispatching to ``_C_ops``).
+Each op is a thin wrapper: normalize arguments, then route the jnp/lax
+implementation through :func:`paddle_tpu.framework.dispatch.apply_op` so the
+eager tape sees it.  There is no kernel registry keyed by backend — XLA is the
+single backend and handles fusion/placement.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import apply_op
+from ..framework.tensor import Tensor, to_tensor
+
+
+def ensure_tensor(x, ref: Tensor = None) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    dtype = ref.dtype if ref is not None and not isinstance(x, (np.ndarray,)) else None
+    if isinstance(x, (bool, int, float)) and ref is not None:
+        return Tensor(jnp.asarray(x, dtype=ref.dtype))
+    return Tensor(x, dtype=dtype)
+
+
+def binary_op(name, fn, x, y):
+    """Binary op with scalar fast-path: scalars are closed over, not taped."""
+    if isinstance(x, Tensor) and not isinstance(y, Tensor):
+        if isinstance(y, (bool, int, float)):
+            return apply_op(name, lambda a: fn(a, y), (x,), {})
+        y = ensure_tensor(y, x)
+    elif isinstance(y, Tensor) and not isinstance(x, Tensor):
+        if isinstance(x, (bool, int, float)):
+            return apply_op(name, lambda b: fn(x, b), (y,), {})
+        x = ensure_tensor(x, y)
+    return apply_op(name, fn, (x, y), {})
+
+
+def unary_op(name, fn, x, **kw):
+    if not isinstance(x, Tensor):
+        x = Tensor(x)
+    return apply_op(name, fn, (x,), kw)
+
+
+def axis_or_none(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in axis.numpy().reshape(-1))
+    return int(axis)
+
+
+def int_list(v):
+    if v is None:
+        return None
+    if isinstance(v, Tensor):
+        return [int(a) for a in v.numpy().reshape(-1)]
+    if isinstance(v, (list, tuple)):
+        out = []
+        for a in v:
+            out.append(int(a.item()) if isinstance(a, Tensor) else int(a))
+        return out
+    return [int(v)]

@@ -1,0 +1,177 @@
+"""Tensor creation ops (reference: ``python/paddle/tensor/creation.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as rnd
+from ..framework.dispatch import apply_op
+from ..framework.dtype import convert_dtype, get_default_dtype
+from ..framework.tensor import Tensor, to_tensor
+from .common import int_list
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye", "tril", "triu",
+    "meshgrid", "diag", "diagflat", "assign", "clone", "complex", "polar",
+    "tril_indices", "triu_indices", "one_hot",
+]
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return convert_dtype(default or get_default_dtype())
+    return convert_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), dtype=_dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), dtype=_dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, dtype=_dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), dtype=_dt(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros(x.shape, dtype=_dt(dtype, x.dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones(x.shape, dtype=_dt(dtype, x.dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full(x.shape, fill_value, dtype=_dt(dtype, x.dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = _val(start), _val(end), _val(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = "int64" if all(isinstance(v, (int, np.integer)) for v in (start, end, step)) else get_default_dtype()
+    d = convert_dtype(dtype)
+    if d == np.dtype(np.int64):
+        d = np.dtype(np.int32)  # TPU fast lane
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return Tensor(jnp.linspace(_val(start), _val(stop), int(_val(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return Tensor(jnp.logspace(_val(start), _val(stop), int(_val(num)), base=_val(base), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=_dt(dtype)))
+
+
+def tril(x, diagonal=0, name=None):
+    from .common import unary_op
+
+    return unary_op("tril", lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    from .common import unary_op
+
+    return unary_op("triu", lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = apply_op("meshgrid", lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), args, {}, num_outputs=len(args))
+    return list(outs)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    from .common import unary_op
+
+    def f(a):
+        if a.ndim == 1 and padding_value != 0:
+            n = a.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, dtype=a.dtype)
+            return base + jnp.diag(a - jnp.asarray(padding_value, a.dtype), k=offset)
+        return jnp.diag(a, k=offset)
+
+    return unary_op("diag", f, x)
+
+
+def diagflat(x, offset=0, name=None):
+    from .common import unary_op
+
+    return unary_op("diagflat", lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def assign(x, output=None, name=None):
+    val = x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+    if output is not None:
+        output._set_data(val._data)
+        return output
+    from .common import unary_op
+
+    return unary_op("assign", lambda a: a + jnp.zeros((), a.dtype), val)
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def complex(real, imag, name=None):
+    return apply_op("complex", jax.lax.complex, (real, imag), {})
+
+
+def polar(abs_t, angle_t, name=None):
+    return apply_op("polar", lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)), (abs_t, angle_t), {})
+
+
+def tril_indices(row, col, offset=0, dtype="int64", name=None):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(np.int32)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(np.int32)))
+
+
+def one_hot(x, num_classes, name=None):
+    from .common import unary_op
+
+    return unary_op("one_hot", lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32), x)

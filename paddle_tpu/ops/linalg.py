@@ -1,0 +1,257 @@
+"""Linear algebra ops (reference: ``python/paddle/tensor/linalg.py``).
+
+``matmul`` is the single most important op on TPU (MXU-bound); everything here
+defers to XLA's dot_general / LAPACK-on-CPU lowering.  Decompositions run in
+fp32 (TPU has no fp64 MXU path).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import apply_op
+from ..framework.tensor import Tensor
+from .common import binary_op, unary_op, axis_or_none
+
+__all__ = [
+    "matmul", "dot", "bmm", "mv", "t", "norm", "vector_norm", "matrix_norm", "dist",
+    "cholesky", "cholesky_solve", "qr", "svd", "svdvals", "pinv", "inv", "det", "slogdet",
+    "solve", "triangular_solve", "eig", "eigh", "eigvals", "eigvalsh", "matrix_power",
+    "matrix_rank", "einsum", "cross", "multi_dot", "cov", "corrcoef", "lu", "householder_product",
+    "tensordot",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply_op("matmul", f, (_t(x), _t(y)), {})
+
+
+def _t(v):
+    return v if isinstance(v, Tensor) else Tensor(v)
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        return jnp.sum(a * b, axis=-1)
+
+    return apply_op("dot", f, (_t(x), _t(y)), {})
+
+
+def bmm(x, y, name=None):
+    return apply_op("bmm", jnp.matmul, (_t(x), _t(y)), {})
+
+
+def mv(x, vec, name=None):
+    return apply_op("mv", jnp.matmul, (_t(x), _t(vec)), {})
+
+
+def t(input, name=None):
+    return unary_op("t", lambda a: a.T if a.ndim >= 2 else a, input)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+
+    def f(a):
+        if p is None or p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord=None, axis=ax, keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=ax, keepdims=keepdim)
+        if p == float("inf") or p == "inf":
+            val = jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim) if ax is not None else jnp.max(jnp.abs(a))
+            return val
+        if p == float("-inf") or p == "-inf":
+            val = jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim) if ax is not None else jnp.min(jnp.abs(a))
+            return val
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        flat_ax = ax if ax is not None else tuple(range(a.ndim))
+        return jnp.sum(jnp.abs(a) ** p, axis=flat_ax, keepdims=keepdim) ** (1.0 / p)
+
+    return unary_op("norm", f, x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    ax = tuple(axis)
+    return unary_op("matrix_norm", lambda a: jnp.linalg.norm(a, ord=None if p == "fro" else p, axis=ax, keepdims=keepdim), x)
+
+
+def dist(x, y, p=2, name=None):
+    def f(a, b):
+        d = a - b
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+    return apply_op("dist", f, (_t(x), _t(y)), {})
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return unary_op("cholesky", f, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+
+    return apply_op("cholesky_solve", f, (_t(x), _t(y)), {})
+
+
+def qr(x, mode="reduced", name=None):
+    outs = apply_op("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), (_t(x),), {}, num_outputs=2)
+    return outs
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op("svd", lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), (_t(x),), {}, num_outputs=3)
+
+
+def svdvals(x, name=None):
+    return unary_op("svdvals", lambda a: jnp.linalg.svd(a, compute_uv=False), x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return unary_op("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x)
+
+
+def inv(x, name=None):
+    return unary_op("inv", jnp.linalg.inv, x)
+
+
+def det(x, name=None):
+    return unary_op("det", jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    return apply_op("slogdet", lambda a: tuple(jnp.linalg.slogdet(a)), (_t(x),), {}, num_outputs=2)
+
+
+def solve(x, y, name=None):
+    return apply_op("solve", jnp.linalg.solve, (_t(x), _t(y)), {})
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular)
+
+    return apply_op("triangular_solve", f, (_t(x), _t(y)), {})
+
+
+def eig(x, name=None):
+    # CPU-only lowering in XLA; fine for eager use
+    a = np.asarray(x._data)
+    w, v = np.linalg.eig(a)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    a = np.asarray(x._data)
+    return Tensor(np.linalg.eigvals(a))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), (_t(x),), {}, num_outputs=2)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return unary_op("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x)
+
+
+def matrix_power(x, n, name=None):
+    return unary_op("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return unary_op("matrix_rank", lambda a: jnp.linalg.matrix_rank(a, rtol=tol), x)
+
+
+def einsum(equation, *operands):
+    tensors = tuple(_t(o) for o in operands)
+    return apply_op("einsum", lambda *xs: jnp.einsum(equation, *xs), tensors, {})
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis of size 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return apply_op("cross", f, (_t(x), _t(y)), {})
+
+
+def multi_dot(x, name=None):
+    tensors = tuple(_t(o) for o in x)
+    return apply_op("multi_dot", lambda *xs: jnp.linalg.multi_dot(list(xs)), tensors, {})
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = fweights._data if isinstance(fweights, Tensor) else fweights
+    aw = aweights._data if isinstance(aweights, Tensor) else aweights
+    return unary_op("cov", lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw), x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return unary_op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, (piv + 1).astype(jnp.int32)
+
+    outs = apply_op("lu", f, (_t(x),), {}, num_outputs=2)
+    if get_infos:
+        return outs[0], outs[1], Tensor(jnp.zeros((), jnp.int32))
+    return outs
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else eye
+
+        def body(i, q_acc):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
+            v = v.at[..., i].set(1.0)
+            h = jnp.eye(m, dtype=a.dtype) - t_[..., i][..., None, None] * jnp.einsum("...i,...j->...ij", v, v)
+            return q_acc @ h
+
+        for i in range(a.shape[-1]):
+            q = body(i, q)
+        return q[..., :, :n]
+
+    return apply_op("householder_product", f, (_t(x), _t(tau)), {})
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(axes, Tensor):
+        ax = axes.tolist()
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(int(i) for i in (a.tolist() if isinstance(a, Tensor) else a)) if isinstance(a, (list, tuple, Tensor)) else int(a) for a in ax)
+    return apply_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), (_t(x), _t(y)), {})

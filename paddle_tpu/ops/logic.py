@@ -1,0 +1,101 @@
+"""Comparison / logical / bitwise ops (reference: ``python/paddle/tensor/logic.py``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .common import binary_op, unary_op
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than", "less_equal",
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor",
+    "bitwise_left_shift", "bitwise_right_shift",
+    "isclose", "allclose", "equal_all", "is_empty", "is_tensor",
+]
+
+
+def equal(x, y, name=None):
+    return binary_op("equal", jnp.equal, x, y)
+
+
+def not_equal(x, y, name=None):
+    return binary_op("not_equal", jnp.not_equal, x, y)
+
+
+def greater_than(x, y, name=None):
+    return binary_op("greater_than", jnp.greater, x, y)
+
+
+def greater_equal(x, y, name=None):
+    return binary_op("greater_equal", jnp.greater_equal, x, y)
+
+
+def less_than(x, y, name=None):
+    return binary_op("less_than", jnp.less, x, y)
+
+
+def less_equal(x, y, name=None):
+    return binary_op("less_equal", jnp.less_equal, x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return binary_op("logical_and", jnp.logical_and, x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return binary_op("logical_or", jnp.logical_or, x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return unary_op("logical_not", jnp.logical_not, x)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return binary_op("logical_xor", jnp.logical_xor, x, y)
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return binary_op("bitwise_and", jnp.bitwise_and, x, y)
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return binary_op("bitwise_or", jnp.bitwise_or, x, y)
+
+
+def bitwise_not(x, out=None, name=None):
+    return unary_op("bitwise_not", jnp.bitwise_not, x)
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return binary_op("bitwise_xor", jnp.bitwise_xor, x, y)
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return binary_op("bitwise_left_shift", jnp.left_shift, x, y)
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return binary_op("bitwise_right_shift", jnp.right_shift, x, y)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return binary_op("isclose", lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return binary_op("allclose", lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y)
+
+
+def equal_all(x, y, name=None):
+    return binary_op("equal_all", lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,583 @@
+"""Shape/layout manipulation ops (reference: ``python/paddle/tensor/manipulation.py``).
+
+All of these lower to XLA reshape/transpose/gather/scatter/pad — free or cheap
+on TPU when static-shaped.  Ops that would produce data-dependent shapes
+(``masked_select``, ``nonzero``, ``unique``) are implemented host-side in eager
+mode and documented as not jit-traceable, mirroring how XLA itself refuses
+dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import apply_op
+from ..framework.dtype import convert_dtype
+from ..framework.tensor import Tensor
+from .common import unary_op, binary_op, int_list, axis_or_none
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "flatten", "squeeze", "unsqueeze",
+    "concat", "stack", "split", "tensor_split", "chunk", "tile", "expand", "expand_as",
+    "broadcast_to", "broadcast_tensors", "flip", "rot90", "roll", "gather", "gather_nd",
+    "scatter", "scatter_", "scatter_nd", "scatter_nd_add", "index_select", "index_add",
+    "index_put", "masked_select", "masked_fill", "masked_scatter", "where",
+    "take_along_axis", "put_along_axis", "slice", "strided_slice", "crop", "pad",
+    "unstack", "unbind", "repeat_interleave", "cast", "moveaxis", "swapaxes",
+    "unique", "unique_consecutive", "nonzero", "as_complex", "as_real", "view", "view_as",
+    "unfold", "flatten_", "squeeze_", "unsqueeze_", "unflatten", "atleast_1d",
+    "atleast_2d", "atleast_3d", "diag_embed", "index_fill", "select_scatter",
+]
+
+
+def reshape(x, shape, name=None):
+    s = int_list(shape)
+    return unary_op("reshape", lambda a: jnp.reshape(a, s), x)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    from ..framework.tensor import inplace_rebind_
+
+    return inplace_rebind_(x, out)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return unary_op("view_dtype", lambda a: a.view(convert_dtype(shape_or_dtype)), x)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def transpose(x, perm=None, name=None):
+    p = int_list(perm)
+    return unary_op("transpose", lambda a: jnp.transpose(a, p), x)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+
+    return unary_op("flatten", f, x)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    from ..framework.tensor import inplace_rebind_
+
+    return inplace_rebind_(x, out)
+
+
+def unflatten(x, axis, shape, name=None):
+    s = int_list(shape)
+
+    def f(a):
+        ax = axis % a.ndim
+        return jnp.reshape(a, a.shape[:ax] + tuple(s) + a.shape[ax + 1:])
+
+    return unary_op("unflatten", f, x)
+
+
+def squeeze(x, axis=None, name=None):
+    ax = axis_or_none(axis)
+
+    def f(a):
+        if ax is None:
+            return jnp.squeeze(a)
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        axes = tuple(a_ % a.ndim for a_ in axes if a.shape[a_ % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return unary_op("squeeze", f, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    from ..framework.tensor import inplace_rebind_
+
+    return inplace_rebind_(x, out)
+
+
+def unsqueeze(x, axis, name=None):
+    ax = axis_or_none(axis)
+    axes = ax if isinstance(ax, tuple) else (ax,)
+    return unary_op("unsqueeze", lambda a: jnp.expand_dims(a, axes), x)
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    from ..framework.tensor import inplace_rebind_
+
+    return inplace_rebind_(x, out)
+
+
+def concat(x, axis=0, name=None):
+    tensors = tuple(t if isinstance(t, Tensor) else Tensor(t) for t in x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op("concat", lambda *xs: jnp.concatenate(xs, axis=ax), tensors, {})
+
+
+def stack(x, axis=0, name=None):
+    tensors = tuple(t if isinstance(t, Tensor) else Tensor(t) for t in x)
+    return apply_op("stack", lambda *xs: jnp.stack(xs, axis=axis), tensors, {})
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        sizes = [dim // n] * n
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        if any(s == -1 for s in sizes):
+            rest = dim - sum(s for s in sizes if s != -1)
+            sizes = [rest if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def f(a):
+        return tuple(jax.lax.slice_in_dim(a, o, o + s, axis=ax) for o, s in zip(offsets, sizes))
+
+    return list(apply_op("split", f, (x,), {}, num_outputs=len(sizes)))
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    ax = int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        base, rem = divmod(dim, n)
+        sizes = [base + (1 if i < rem else 0) for i in range(n)]
+        return split(x, sizes, axis=ax)
+    idx = [0] + list(num_or_indices) + [dim]
+    sizes = [idx[i + 1] - idx[i] for i in range(len(idx) - 1)]
+    return split(x, sizes, axis=ax)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tile(x, repeat_times, name=None):
+    r = int_list(repeat_times)
+    return unary_op("tile", lambda a: jnp.tile(a, r), x)
+
+
+def expand(x, shape, name=None):
+    s = int_list(shape)
+
+    def f(a):
+        target = list(s)
+        for i in range(len(target)):
+            if target[i] == -1:
+                target[i] = a.shape[i - len(target) + a.ndim]
+        return jnp.broadcast_to(a, target)
+
+    return unary_op("expand", f, x)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = tuple(inputs)
+    return list(apply_op("broadcast_tensors", lambda *xs: tuple(jnp.broadcast_arrays(*xs)), tensors, {}, num_outputs=len(tensors)))
+
+
+def flip(x, axis, name=None):
+    ax = axis_or_none(axis)
+    return unary_op("flip", lambda a: jnp.flip(a, axis=ax), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return unary_op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = int_list(shifts)
+    sh = sh[0] if len(sh) == 1 and not isinstance(shifts, (list, tuple)) else sh
+    ax = axis_or_none(axis)
+    return unary_op("roll", lambda a: jnp.roll(a, sh, axis=ax), x)
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op("gather", lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i, axis=ax), (x, _as_t(index)), {})
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        k = idx.shape[-1]
+        out = a[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+
+    return apply_op("gather_nd", f, (x, _as_t(index)), {})
+
+
+def _as_t(v):
+    return v if isinstance(v, Tensor) else Tensor(v)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, idx, upd):
+        idx = idx.reshape(-1)
+        if overwrite:
+            return a.at[idx].set(upd)
+        return a.at[idx].add(upd)
+
+    return apply_op("scatter", f, (x, _as_t(index), _as_t(updates)), {})
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    from ..framework.tensor import inplace_rebind_
+
+    return inplace_rebind_(x, out)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    s = int_list(shape)
+
+    def f(idx, upd):
+        zeros = jnp.zeros(s, dtype=upd.dtype)
+        return zeros.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+
+    return apply_op("scatter_nd", f, (_as_t(index), _as_t(updates)), {})
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, upd):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+
+    return apply_op("scatter_nd_add", f, (x, _as_t(index), _as_t(updates)), {})
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op("index_select", lambda a, i: jnp.take(a, i, axis=axis), (x, _as_t(index)), {})
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, i, v):
+        sl = [builtins_slice(None)] * a.ndim
+        return a.at[tuple(sl[:axis]) + (i,)].add(v)
+
+    import builtins
+
+    builtins_slice = builtins.slice
+    return apply_op("index_add", f, (x, _as_t(index), _as_t(value)), {})
+
+
+def index_fill(x, index, axis, fill_value, name=None):
+    def f(a, i):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[i].set(jnp.asarray(fill_value, a.dtype))
+        return jnp.moveaxis(moved, 0, axis)
+
+    return apply_op("index_fill", f, (x, _as_t(index)), {})
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(i._data if isinstance(i, Tensor) else i for i in indices)
+
+    def f(a, v):
+        return a.at[idx].add(v) if accumulate else a.at[idx].set(v)
+
+    return apply_op("index_put", f, (x, _as_t(value)), {})
+
+
+def masked_select(x, mask, name=None):
+    # data-dependent shape: eager only (host round-trip), like np.extract
+    m = np.asarray(mask._data if isinstance(mask, Tensor) else mask)
+    a = np.asarray(x._data)
+    return Tensor(jnp.asarray(a[m.astype(bool)]))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value.item() if isinstance(value, Tensor) and value.ndim == 0 else value
+    if isinstance(v, Tensor):
+        return apply_op("masked_fill", lambda a, m, val: jnp.where(m, val.astype(a.dtype), a), (x, _as_t(mask), v), {})
+    return apply_op("masked_fill", lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a), (x, _as_t(mask)), {})
+
+
+def masked_scatter(x, mask, value, name=None):
+    m = np.asarray(mask._data if isinstance(mask, Tensor) else mask).astype(bool)
+    n = int(m.sum())
+
+    def f(a, v):
+        flat_idx = jnp.cumsum(m.reshape(-1)) - 1
+        src = v.reshape(-1)[:m.size]
+        picked = src[jnp.clip(flat_idx, 0, src.shape[0] - 1)].reshape(a.shape)
+        return jnp.where(m, picked.astype(a.dtype), a)
+
+    return apply_op("masked_scatter", f, (x, _as_t(value)), {})
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    cond = _as_t(condition)
+    if not isinstance(x, Tensor) and not isinstance(y, Tensor):
+        return apply_op("where", lambda c: jnp.where(c, x, y), (cond,), {})
+    if not isinstance(x, Tensor):
+        return apply_op("where", lambda c, b: jnp.where(c, jnp.asarray(x, b.dtype), b), (cond, y), {})
+    if not isinstance(y, Tensor):
+        return apply_op("where", lambda c, a: jnp.where(c, a, jnp.asarray(y, a.dtype)), (cond, x), {})
+    return apply_op("where", lambda c, a, b: jnp.where(c, a, b), (cond, x, y), {})
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply_op("take_along_axis", lambda a, i: jnp.take_along_axis(a, i, axis=axis), (arr, _as_t(indices)), {})
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True, name=None):
+    def f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape) if v.ndim else jnp.full(i.shape, v, a.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v.astype(a.dtype), axis=axis, inplace=False)
+        mode = {"add": "add", "multiply": "multiply", "mul": "multiply", "amax": "max", "amin": "min"}[reduce]
+        moved_a = jnp.moveaxis(a, axis, 0)
+        moved_i = jnp.moveaxis(i, axis, 0)
+        moved_v = jnp.moveaxis(v.astype(a.dtype), axis, 0)
+        rest = jnp.indices(moved_i.shape[1:], sparse=True)
+        idx = (moved_i,) + tuple(rest)
+        if mode == "add":
+            out = moved_a.at[idx].add(moved_v)
+        elif mode == "multiply":
+            out = moved_a.at[idx].multiply(moved_v)
+        elif mode == "max":
+            out = moved_a.at[idx].max(moved_v)
+        else:
+            out = moved_a.at[idx].min(moved_v)
+        return jnp.moveaxis(out, 0, axis)
+
+    vals = _as_t(values)
+    return apply_op("put_along_axis", f, (arr, _as_t(indices), vals), {})
+
+
+def slice(input, axes, starts, ends, name=None):
+    axes = int_list(axes)
+    starts = int_list(starts)
+    ends = int_list(ends)
+
+    def f(a):
+        out = a
+        for ax, st, en in zip(axes, starts, ends):
+            dim = a.shape[ax]
+            st2 = max(st + dim, 0) if st < 0 else min(st, dim)
+            en2 = max(en + dim, 0) if en < 0 else min(en, dim)
+            out = jax.lax.slice_in_dim(out, st2, en2, axis=ax)
+        return out
+
+    return unary_op("slice", f, input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes = int_list(axes)
+    starts = int_list(starts)
+    ends = int_list(ends)
+    strides_l = int_list(strides)
+
+    def f(a):
+        import builtins
+
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides_l):
+            idx[ax] = builtins.slice(st, en, sd)
+        return a[tuple(idx)]
+
+    return unary_op("strided_slice", f, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    s = int_list(shape)
+    o = int_list(offsets) or [0] * len(s)
+
+    def f(a):
+        sizes = [a.shape[i] if s[i] == -1 else s[i] for i in range(len(s))]
+        return jax.lax.dynamic_slice(a, o, sizes)
+
+    return unary_op("crop", f, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    p = int_list(pad)
+
+    def f(a):
+        if len(p) == 2 * a.ndim:
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(a.ndim)]
+        else:
+            # paddle semantics: pad applies to the last len(p)//2 spatial dims
+            # in NCHW/NCL/NCDHW order, given innermost-first pairs
+            n_spatial = len(p) // 2
+            width = [(0, 0)] * a.ndim
+            if data_format.startswith("NC"):
+                dims = builtins_range(a.ndim - n_spatial, a.ndim)
+            else:
+                dims = builtins_range(1, 1 + n_spatial)
+            for j, d in enumerate(reversed(list(dims))):
+                width[d] = (p[2 * j], p[2 * j + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, width, mode=jmode, constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+
+    import builtins
+
+    builtins_range = builtins.range
+    return unary_op("pad", f, x)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or x.shape[axis]
+
+    def f(a):
+        parts = jnp.split(a, n, axis=axis)
+        return tuple(jnp.squeeze(p, axis=axis) for p in parts)
+
+    return list(apply_op("unstack", f, (x,), {}, num_outputs=n))
+
+
+def unbind(input, axis=0, name=None):
+    return unstack(input, axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        r = repeats._data
+        return apply_op("repeat_interleave", lambda a: jnp.repeat(a, r, axis=axis, total_repeat_length=int(r.sum())), (x,), {})
+    return unary_op("repeat_interleave", lambda a: jnp.repeat(a, repeats, axis=axis), x)
+
+
+def cast(x, dtype, name=None):
+    return x.astype(dtype)
+
+
+def moveaxis(x, source, destination, name=None):
+    return unary_op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return unary_op("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    # data-dependent shape: host-side eager op
+    a = np.asarray(x._data)
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r.astype(np.int32) if r.dtype == np.int64 else r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    a = np.asarray(x._data)
+    if axis is None:
+        a = a.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    mask = np.ones(a.shape[ax], dtype=bool)
+    if a.shape[ax] > 1:
+        sliced = np.moveaxis(a, ax, 0)
+        eq = (sliced[1:] == sliced[:-1]).reshape(sliced.shape[0] - 1, -1).all(axis=1)
+        mask[1:] = ~eq
+    out = np.compress(mask, a, axis=ax)
+    results = [Tensor(out)]
+    if return_inverse:
+        inv = np.cumsum(mask) - 1
+        results.append(Tensor(inv.astype(np.int32)))
+    if return_counts:
+        idx = np.flatnonzero(mask)
+        counts = np.diff(np.append(idx, a.shape[ax]))
+        results.append(Tensor(counts.astype(np.int32)))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+def nonzero(x, as_tuple=False, name=None):
+    a = np.asarray(x._data)
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(i.astype(np.int32)) for i in nz)
+    return Tensor(np.stack(nz, axis=1).astype(np.int32))
+
+
+def as_complex(x, name=None):
+    return unary_op("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return unary_op("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = int_list(kernel_sizes)
+    ks = ks * 2 if len(ks) == 1 else ks
+    st = int_list(strides)
+    st = st * 2 if len(st) == 1 else st
+    pd = int_list(paddings)
+    pd = pd * 2 if len(pd) == 1 else pd
+    dl = int_list(dilations)
+    dl = dl * 2 if len(dl) == 1 else dl
+
+    def f(a):
+        n, c, h, w = a.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=ks, window_strides=st,
+            padding=[(pd[0], pd[0]), (pd[1], pd[1])] if len(pd) == 2 else [(pd[0], pd[1]), (pd[2], pd[3])],
+            rhs_dilation=dl, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * ks[0] * ks[1], -1)
+
+    return unary_op("unfold", f, x)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [unary_op("atleast_1d", jnp.atleast_1d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [unary_op("atleast_2d", jnp.atleast_2d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [unary_op("atleast_3d", jnp.atleast_3d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        out = jnp.zeros(a.shape + (a.shape[-1] + abs(offset),), dtype=a.dtype)
+        n = a.shape[-1]
+        rows = jnp.arange(n) + (abs(offset) if offset < 0 else 0)
+        cols = jnp.arange(n) + (offset if offset > 0 else 0)
+        full = jnp.zeros(a.shape[:-1] + (n + abs(offset), n + abs(offset)), dtype=a.dtype)
+        full = full.at[..., rows, cols].set(a)
+        if (dim1, dim2) != (-2, -1):
+            full = jnp.moveaxis(full, (-2, -1), (dim1, dim2))
+        return full
+
+    return unary_op("diag_embed", f, input)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def f(a, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[index].set(v.astype(a.dtype))
+        return jnp.moveaxis(moved, 0, axis)
+
+    return apply_op("select_scatter", f, (x, _as_t(values)), {})

@@ -1,0 +1,443 @@
+"""Elementwise & scalar math ops.
+
+Parity target: the reference's ``python/paddle/tensor/math.py`` (elementwise
+entries of ``phi/ops/yaml/ops.yaml``).  Implementations are jnp one-liners —
+XLA fuses chains of these into single kernels, which is the TPU replacement
+for PHI's hand-written elementwise CUDA kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import apply_op
+from ..framework.tensor import Tensor
+from .common import binary_op, unary_op, ensure_tensor, axis_or_none
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod",
+    "pow", "float_power", "sqrt", "rsqrt", "exp", "expm1", "log", "log2", "log10", "log1p",
+    "abs", "neg", "sign", "floor", "ceil", "round", "trunc", "frac",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+    "asinh", "acosh", "atanh", "atan2", "hypot", "deg2rad", "rad2deg",
+    "reciprocal", "square", "maximum", "minimum", "fmax", "fmin",
+    "clip", "scale", "lerp", "erf", "erfinv", "logit", "stanh", "multiplex",
+    "isnan", "isinf", "isfinite", "nan_to_num", "cumsum", "cumprod", "cummax", "cummin",
+    "add_n", "logaddexp", "logsumexp", "trace", "diagonal", "kron", "inner", "outer",
+    "heaviside", "gcd", "lcm", "digamma", "lgamma", "polygamma", "i0", "i1",
+    "angle", "conj", "real", "imag", "sgn", "ldexp", "copysign", "nextafter",
+    "renorm", "diff", "signbit",
+]
+
+
+def add(x, y, name=None):
+    return binary_op("add", jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return binary_op("subtract", jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return binary_op("multiply", jnp.multiply, x, y)
+
+
+def divide(x, y, name=None):
+    return binary_op("divide", jnp.divide, x, y)
+
+
+def floor_divide(x, y, name=None):
+    return binary_op("floor_divide", jnp.floor_divide, x, y)
+
+
+def remainder(x, y, name=None):
+    return binary_op("remainder", jnp.remainder, x, y)
+
+
+mod = remainder
+
+
+def pow(x, y, name=None):
+    return binary_op("pow", jnp.power, x, y)
+
+
+float_power = pow
+
+
+def sqrt(x, name=None):
+    return unary_op("sqrt", jnp.sqrt, x)
+
+
+def rsqrt(x, name=None):
+    return unary_op("rsqrt", jax.lax.rsqrt, x)
+
+
+def exp(x, name=None):
+    return unary_op("exp", jnp.exp, x)
+
+
+def expm1(x, name=None):
+    return unary_op("expm1", jnp.expm1, x)
+
+
+def log(x, name=None):
+    return unary_op("log", jnp.log, x)
+
+
+def log2(x, name=None):
+    return unary_op("log2", jnp.log2, x)
+
+
+def log10(x, name=None):
+    return unary_op("log10", jnp.log10, x)
+
+
+def log1p(x, name=None):
+    return unary_op("log1p", jnp.log1p, x)
+
+
+def abs(x, name=None):
+    return unary_op("abs", jnp.abs, x)
+
+
+def neg(x, name=None):
+    return unary_op("neg", jnp.negative, x)
+
+
+def sign(x, name=None):
+    return unary_op("sign", jnp.sign, x)
+
+
+def floor(x, name=None):
+    return unary_op("floor", jnp.floor, x)
+
+
+def ceil(x, name=None):
+    return unary_op("ceil", jnp.ceil, x)
+
+
+def round(x, decimals=0, name=None):
+    if decimals:
+        return unary_op("round", lambda a: jnp.round(a, decimals=decimals), x)
+    return unary_op("round", jnp.round, x)
+
+
+def trunc(x, name=None):
+    return unary_op("trunc", jnp.trunc, x)
+
+
+def frac(x, name=None):
+    return unary_op("frac", lambda a: a - jnp.trunc(a), x)
+
+
+def sin(x, name=None):
+    return unary_op("sin", jnp.sin, x)
+
+
+def cos(x, name=None):
+    return unary_op("cos", jnp.cos, x)
+
+
+def tan(x, name=None):
+    return unary_op("tan", jnp.tan, x)
+
+
+def asin(x, name=None):
+    return unary_op("asin", jnp.arcsin, x)
+
+
+def acos(x, name=None):
+    return unary_op("acos", jnp.arccos, x)
+
+
+def atan(x, name=None):
+    return unary_op("atan", jnp.arctan, x)
+
+
+def sinh(x, name=None):
+    return unary_op("sinh", jnp.sinh, x)
+
+
+def cosh(x, name=None):
+    return unary_op("cosh", jnp.cosh, x)
+
+
+def tanh(x, name=None):
+    return unary_op("tanh", jnp.tanh, x)
+
+
+def asinh(x, name=None):
+    return unary_op("asinh", jnp.arcsinh, x)
+
+
+def acosh(x, name=None):
+    return unary_op("acosh", jnp.arccosh, x)
+
+
+def atanh(x, name=None):
+    return unary_op("atanh", jnp.arctanh, x)
+
+
+def atan2(x, y, name=None):
+    return binary_op("atan2", jnp.arctan2, x, y)
+
+
+def hypot(x, y, name=None):
+    return binary_op("hypot", jnp.hypot, x, y)
+
+
+def deg2rad(x, name=None):
+    return unary_op("deg2rad", jnp.deg2rad, x)
+
+
+def rad2deg(x, name=None):
+    return unary_op("rad2deg", jnp.rad2deg, x)
+
+
+def reciprocal(x, name=None):
+    return unary_op("reciprocal", jnp.reciprocal, x)
+
+
+def square(x, name=None):
+    return unary_op("square", jnp.square, x)
+
+
+def maximum(x, y, name=None):
+    return binary_op("maximum", jnp.maximum, x, y)
+
+
+def minimum(x, y, name=None):
+    return binary_op("minimum", jnp.minimum, x, y)
+
+
+def fmax(x, y, name=None):
+    return binary_op("fmax", jnp.fmax, x, y)
+
+
+def fmin(x, y, name=None):
+    return binary_op("fmin", jnp.fmin, x, y)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return unary_op("clip", lambda a: jnp.clip(a, lo, hi), x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+    if bias_after_scale:
+        out = unary_op("scale", lambda a: a * s + bias, x)
+    else:
+        out = unary_op("scale", lambda a: (a + bias) * s, x)
+    return out
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply_op("lerp", lambda a, b, w: a + w * (b - a), (x, y, weight), {})
+    return apply_op("lerp", lambda a, b: a + weight * (b - a), (x, y), {})
+
+
+def erf(x, name=None):
+    return unary_op("erf", jax.scipy.special.erf, x)
+
+
+def erfinv(x, name=None):
+    return unary_op("erfinv", jax.scipy.special.erfinv, x)
+
+
+def logit(x, eps=None, name=None):
+    def f(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+
+    return unary_op("logit", f, x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return unary_op("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def multiplex(inputs, index, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def f(*xs):
+        stacked = jnp.stack(xs, axis=0)
+        rows = idx.reshape(-1)
+        return stacked[rows, jnp.arange(stacked.shape[1])]
+
+    return apply_op("multiplex", f, tuple(inputs), {})
+
+
+def isnan(x, name=None):
+    return unary_op("isnan", jnp.isnan, x)
+
+
+def isinf(x, name=None):
+    return unary_op("isinf", jnp.isinf, x)
+
+
+def isfinite(x, name=None):
+    return unary_op("isfinite", jnp.isfinite, x)
+
+
+def signbit(x, name=None):
+    return unary_op("signbit", jnp.signbit, x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return unary_op("nan_to_num", lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    return unary_op("cumsum", lambda a: jnp.cumsum(a, axis=axis, dtype=dtype), x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return unary_op("cumprod", lambda a: jnp.cumprod(a, axis=dim, dtype=dtype), x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        vals = jax.lax.cummax(arr, axis=ax)
+        eq = arr == vals
+        n = arr.shape[ax]
+        idx_range = jnp.arange(n).reshape([-1 if i == (ax % arr.ndim) else 1 for i in range(arr.ndim)])
+        idx = jax.lax.cummax(jnp.where(eq, idx_range, 0), axis=ax)
+        return vals, idx.astype(jnp.int32)
+
+    return apply_op("cummax", f, (x if isinstance(x, Tensor) else Tensor(x),), {}, num_outputs=2)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        vals = jax.lax.cummin(arr, axis=ax)
+        eq = arr == vals
+        n = arr.shape[ax]
+        idx_range = jnp.arange(n).reshape([-1 if i == (ax % arr.ndim) else 1 for i in range(arr.ndim)])
+        idx = jax.lax.cummax(jnp.where(eq, idx_range, 0), axis=ax)
+        return vals, idx.astype(jnp.int32)
+
+    return apply_op("cummin", f, (x if isinstance(x, Tensor) else Tensor(x),), {}, num_outputs=2)
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply_op("add_n", lambda *xs: sum(xs[1:], xs[0]), tuple(inputs), {})
+
+
+def logaddexp(x, y, name=None):
+    return binary_op("logaddexp", jnp.logaddexp, x, y)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return unary_op("logsumexp", lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim), x)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return unary_op("trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return unary_op("diagonal", lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def kron(x, y, name=None):
+    return binary_op("kron", jnp.kron, x, y)
+
+
+def inner(x, y, name=None):
+    return binary_op("inner", jnp.inner, x, y)
+
+
+def outer(x, y, name=None):
+    return binary_op("outer", jnp.outer, x, y)
+
+
+def heaviside(x, y, name=None):
+    return binary_op("heaviside", jnp.heaviside, x, y)
+
+
+def gcd(x, y, name=None):
+    return binary_op("gcd", jnp.gcd, x, y)
+
+
+def lcm(x, y, name=None):
+    return binary_op("lcm", jnp.lcm, x, y)
+
+
+def digamma(x, name=None):
+    return unary_op("digamma", jax.scipy.special.digamma, x)
+
+
+def lgamma(x, name=None):
+    return unary_op("lgamma", jax.scipy.special.gammaln, x)
+
+
+def polygamma(x, n, name=None):
+    return unary_op("polygamma", lambda a: jax.scipy.special.polygamma(n, a), x)
+
+
+def i0(x, name=None):
+    return unary_op("i0", jax.scipy.special.i0, x)
+
+
+def i1(x, name=None):
+    return unary_op("i1", jax.scipy.special.i1, x)
+
+
+def angle(x, name=None):
+    return unary_op("angle", jnp.angle, x)
+
+
+def conj(x, name=None):
+    return unary_op("conj", jnp.conj, x)
+
+
+def real(x, name=None):
+    return unary_op("real", jnp.real, x)
+
+
+def imag(x, name=None):
+    return unary_op("imag", jnp.imag, x)
+
+
+def sgn(x, name=None):
+    return unary_op("sgn", jnp.sign, x)
+
+
+def ldexp(x, y, name=None):
+    return binary_op("ldexp", jnp.ldexp, x, y)
+
+
+def copysign(x, y, name=None):
+    return binary_op("copysign", jnp.copysign, x, y)
+
+
+def nextafter(x, y, name=None):
+    return binary_op("nextafter", jnp.nextafter, x, y)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def f(a):
+        dims = tuple(i for i in range(a.ndim) if i != axis % a.ndim)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+
+    return unary_op("renorm", f, x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = prepend._data if isinstance(prepend, Tensor) else prepend
+    app = append._data if isinstance(append, Tensor) else append
+    return unary_op("diff", lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app), x)

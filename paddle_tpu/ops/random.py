@@ -1,0 +1,138 @@
+"""Random sampling ops (reference: ``python/paddle/tensor/random.py``).
+
+Built on JAX's functional PRNG: each eager call consumes a fresh subkey from
+the framework generator (``paddle_tpu.framework.random``), so ``paddle_tpu.seed``
+gives reproducible streams; under jit tracing install a key via ``rng_guard``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as rnd
+from ..framework.dispatch import apply_op
+from ..framework.dtype import convert_dtype, get_default_dtype
+from ..framework.tensor import Tensor
+from .creation import _shape, _dt
+
+__all__ = [
+    "uniform", "uniform_", "normal", "normal_", "standard_normal", "randn", "rand",
+    "randint", "randint_like", "randperm", "bernoulli", "bernoulli_", "multinomial",
+    "poisson", "exponential_", "standard_gamma", "log_normal", "cauchy_", "geometric_",
+]
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = rnd.next_key()
+    d = _dt(dtype)
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=d, minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._set_data(jax.random.uniform(rnd.next_key(), tuple(x.shape), dtype=x.dtype, minval=min, maxval=max))
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, dtype=None, name=None):
+    key = rnd.next_key()
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else jnp.asarray(mean, jnp.float32)
+        s = std._data if isinstance(std, Tensor) else jnp.asarray(std, jnp.float32)
+        shp = jnp.broadcast_shapes(m.shape, s.shape)
+        return Tensor(m + s * jax.random.normal(key, shp, dtype=jnp.float32))
+    shp = _shape(shape) if shape is not None else ()
+    d = _dt(dtype)
+    return Tensor(mean + std * jax.random.normal(key, shp, dtype=d))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._set_data(mean + std * jax.random.normal(rnd.next_key(), tuple(x.shape), dtype=x.dtype))
+    return x
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(rnd.next_key(), _shape(shape), dtype=_dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    d = convert_dtype(dtype)
+    if d == np.dtype(np.int64):
+        d = np.dtype(np.int32)
+    return Tensor(jax.random.randint(rnd.next_key(), _shape(shape), low, high, dtype=d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, tuple(x.shape), dtype or "int32")
+
+
+def randperm(n, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+    if d == np.dtype(np.int64):
+        d = np.dtype(np.int32)
+    return Tensor(jax.random.permutation(rnd.next_key(), n).astype(d))
+
+
+def bernoulli(x, p=None, name=None):
+    probs = x._data if p is None else p
+    return Tensor(jax.random.bernoulli(rnd.next_key(), probs, shape=tuple(x.shape)).astype(x.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._set_data(jax.random.bernoulli(rnd.next_key(), p, shape=tuple(x.shape)).astype(x.dtype))
+    return x
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = rnd.next_key()
+    probs = x._data
+    if probs.ndim == 1:
+        out = jax.random.choice(key, probs.shape[0], shape=(num_samples,), replace=replacement, p=probs / probs.sum())
+        return Tensor(out.astype(jnp.int32))
+    keys = jax.random.split(key, probs.shape[0])
+    outs = [
+        jax.random.choice(k, probs.shape[1], shape=(num_samples,), replace=replacement, p=row / row.sum())
+        for k, row in zip(keys, probs)
+    ]
+    return Tensor(jnp.stack(outs).astype(jnp.int32))
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(rnd.next_key(), x._data, dtype=jnp.int32).astype(x.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._set_data(jax.random.exponential(rnd.next_key(), tuple(x.shape), dtype=x.dtype) / lam)
+    return x
+
+
+def standard_gamma(alpha, name=None):
+    a = alpha._data if isinstance(alpha, Tensor) else jnp.asarray(alpha)
+    return Tensor(jax.random.gamma(rnd.next_key(), a))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(jnp.exp(mean + std * jax.random.normal(rnd.next_key(), shp, dtype=_dt(dtype))))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    x._set_data(loc + scale * jax.random.cauchy(rnd.next_key(), tuple(x.shape), dtype=x.dtype))
+    return x
+
+
+def geometric_(x, probs, name=None):
+    u = jax.random.uniform(rnd.next_key(), tuple(x.shape), dtype=jnp.float32, minval=1e-7, maxval=1.0)
+    x._set_data((jnp.ceil(jnp.log(u) / jnp.log1p(-probs))).astype(x.dtype))
+    return x

@@ -1,0 +1,147 @@
+"""Reduction & statistics ops (reference: ``python/paddle/tensor/{math,stat}.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import apply_op
+from ..framework.tensor import Tensor
+from .common import unary_op, axis_or_none
+
+__all__ = [
+    "sum", "nansum", "mean", "nanmean", "max", "min", "amax", "amin", "prod",
+    "all", "any", "std", "var", "median", "nanmedian", "quantile", "nanquantile",
+    "count_nonzero", "bincount", "histogram", "histogramdd", "numel",
+]
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return unary_op("sum", lambda a: jnp.sum(a, axis=ax, dtype=dtype, keepdims=keepdim), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return unary_op("nansum", lambda a: jnp.nansum(a, axis=ax, dtype=dtype, keepdims=keepdim), x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return unary_op("mean", lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return unary_op("nanmean", lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return unary_op("max", lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return unary_op("min", lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x)
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    ax = axis_or_none(axis)
+    return unary_op("prod", lambda a: jnp.prod(a, axis=ax, dtype=dtype, keepdims=keepdim), x)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return unary_op("all", lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return unary_op("any", lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return unary_op("std", lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return unary_op("var", lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = axis_or_none(axis)
+    if mode == "avg":
+        return unary_op("median", lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x)
+
+    def f(a):
+        arr = a.reshape(-1) if ax is None else a
+        axis_ = 0 if ax is None else ax
+        n = arr.shape[axis_]
+        k = (n - 1) // 2
+        sorted_vals = jnp.sort(arr, axis=axis_)
+        sorted_idx = jnp.argsort(arr, axis=axis_)
+        vals = jnp.take(sorted_vals, k, axis=axis_)
+        idx = jnp.take(sorted_idx, k, axis=axis_)
+        if keepdim and ax is not None:
+            vals = jnp.expand_dims(vals, axis_)
+            idx = jnp.expand_dims(idx, axis_)
+        return vals, idx.astype(jnp.int32)
+
+    return apply_op("median_min", f, (x if isinstance(x, Tensor) else Tensor(x),), {}, num_outputs=2)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = axis_or_none(axis)
+    return unary_op("nanmedian", lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = axis_or_none(axis)
+    qv = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    return unary_op("quantile", lambda a: jnp.quantile(a.astype(jnp.float32), qv, axis=ax, keepdims=keepdim, method=interpolation), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = axis_or_none(axis)
+    qv = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    return unary_op("nanquantile", lambda a: jnp.nanquantile(a.astype(jnp.float32), qv, axis=ax, keepdims=keepdim, method=interpolation), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return unary_op("count_nonzero", lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim).astype(jnp.int32), x)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    # output length is data-dependent: host-side eager op
+    a = np.asarray(x._data)
+    w = np.asarray(weights._data) if isinstance(weights, Tensor) else weights
+    out = np.bincount(a, weights=w, minlength=minlength)
+    return Tensor(out.astype(np.int32) if w is None else out)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    a = np.asarray(input._data)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+    w = np.asarray(weight._data) if isinstance(weight, Tensor) else weight
+    hist, _ = np.histogram(a, bins=bins, range=(lo, hi), weights=w, density=density)
+    return Tensor(hist if density else hist.astype(np.int32))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    a = np.asarray(x._data)
+    w = np.asarray(weights._data) if isinstance(weights, Tensor) else weights
+    hist, edges = np.histogramdd(a, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(hist), [Tensor(e) for e in edges]
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int32))

@@ -1,0 +1,125 @@
+"""Search / sort ops (reference: ``python/paddle/tensor/search.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import apply_op
+from ..framework.tensor import Tensor
+from .common import unary_op, axis_or_none
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "searchsorted", "bucketize",
+    "kthvalue", "mode", "index_sample",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    ax = axis_or_none(axis)
+    return unary_op("argmax", lambda a: jnp.argmax(a, axis=ax, keepdims=keepdim).astype(jnp.int32), x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    ax = axis_or_none(axis)
+    return unary_op("argmin", lambda a: jnp.argmin(a, axis=ax, keepdims=keepdim).astype(jnp.int32), x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable, descending=descending)
+        return idx.astype(jnp.int32)
+
+    return unary_op("argsort", f, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        out = jnp.sort(a, axis=axis, stable=stable, descending=descending)
+        return out
+
+    return unary_op("sort", f, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def f(a):
+        ax = (a.ndim - 1) if axis is None else axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(moved, kk)
+        else:
+            vals, idx = jax.lax.top_k(-moved, kk)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int32), -1, ax)
+
+    return apply_op("topk", f, (x if isinstance(x, Tensor) else Tensor(x),), {}, num_outputs=2)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def f(seq, v):
+        side = "right" if right else "left"
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            out = jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(
+                seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int32)
+
+    sv = values if isinstance(values, Tensor) else Tensor(values)
+    ss = sorted_sequence if isinstance(sorted_sequence, Tensor) else Tensor(sorted_sequence)
+    return apply_op("searchsorted", f, (ss, sv), {})
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        sorted_vals = jnp.sort(a, axis=ax)
+        sorted_idx = jnp.argsort(a, axis=ax)
+        vals = jnp.take(sorted_vals, k - 1, axis=ax)
+        idx = jnp.take(sorted_idx, k - 1, axis=ax)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return vals, idx.astype(jnp.int32)
+
+    return apply_op("kthvalue", f, (x,), {}, num_outputs=2)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = np.asarray(x._data)
+    ax = axis % a.ndim
+    moved = np.moveaxis(a, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=a.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int32)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts[::-1])] if False else uniq[np.argmax(counts)]
+        # paddle picks the largest value among maxima of counts? take last occurrence
+        maxc = counts.max()
+        cand = uniq[counts == maxc][-1]
+        vals[i] = cand
+        idxs[i] = np.where(row == cand)[0][-1]
+    out_shape = moved.shape[:-1]
+    vals = vals.reshape(out_shape)
+    idxs = idxs.reshape(out_shape)
+    if keepdim:
+        vals = np.expand_dims(vals, ax)
+        idxs = np.expand_dims(idxs, ax)
+    return Tensor(vals), Tensor(idxs)
+
+
+def index_sample(x, index, name=None):
+    def f(a, idx):
+        return jnp.take_along_axis(a, idx, axis=1)
+
+    it = index if isinstance(index, Tensor) else Tensor(index)
+    return apply_op("index_sample", f, (x, it), {})

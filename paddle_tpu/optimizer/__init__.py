@@ -1,0 +1,19 @@
+"""``paddle_tpu.optimizer`` (reference: ``python/paddle/optimizer/``)."""
+
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    ASGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, NAdam,
+    Optimizer, RAdam, RMSProp, SGD,
+)
+
+
+class L2Decay:
+    """Weight decay coefficient holder (reference: ``paddle.regularizer.L2Decay``)."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff

@@ -1,0 +1,439 @@
+"""Optimizer base + the standard family.
+
+Reference: ``python/paddle/optimizer/optimizer.py:127`` (Optimizer base),
+``adamw.py``, ``adam.py``, ``sgd.py``, ``momentum.py``...
+
+TPU-native design: each optimizer defines a pure functional core
+(``_init_slot`` / ``_update``) over jax arrays.  The eager ``step()`` runs ONE
+jitted XLA program over the whole parameter pytree (not a launch per param —
+the eager counterpart of the reference's fused/multi-tensor optimizer
+kernels).  The same functional core is reused by ``paddle_tpu.jit``'s compiled
+train step and by the distributed sharding wrappers (ZeRO states shard along
+the mesh simply by sharding the state pytree).
+
+Master weights: with bf16/fp16 params, fp32 master copies are kept in the
+state (reference ``multi_precision`` behavior) — essential on TPU where
+training dtype is bf16.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Parameter, Tensor
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad", "RMSProp",
+           "Adadelta", "Adamax", "Lamb", "NAdam", "RAdam", "ASGD"]
+
+
+def _is_float(dtype) -> bool:
+    return jnp.issubdtype(dtype, jnp.floating)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided (eager mode, like the reference)")
+        self._parameter_list = list(parameters)
+        self._lr = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if weight_decay is None:
+            self._weight_decay = 0.0
+        elif isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+        else:  # L2Decay object
+            self._weight_decay = float(getattr(weight_decay, "_coeff", getattr(weight_decay, "coeff", 0.0)))
+        self._step_count = 0
+        self._state: Optional[List[Dict[str, jax.Array]]] = None
+        self._jitted_update = None
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return float(self._lr)
+
+    def set_lr(self, value: float):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler: LRScheduler):
+        self._lr = scheduler
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- functional core (override in subclasses) -----------------------------
+    def _init_slots(self, p: jax.Array) -> Dict[str, jax.Array]:
+        return {}
+
+    def _update(self, p32, g32, slots, lr, step):
+        """Return (new_p32, new_slots). Pure function of arrays."""
+        raise NotImplementedError
+
+    def _decoupled_decay(self) -> bool:
+        return False  # AdamW overrides
+
+    # -- state ----------------------------------------------------------------
+    def _ensure_state(self):
+        if self._state is None:
+            self._state = []
+            for p in self._parameter_list:
+                slots = self._init_slots(p._data)
+                if self._multi_precision and _is_float(p.dtype) and p._data.dtype != jnp.float32:
+                    slots["master"] = p._data.astype(jnp.float32)
+                self._state.append(slots)
+
+    def _build_update_fn(self):
+        wd = self._weight_decay
+        decoupled = self._decoupled_decay()
+        no_decay = [getattr(p, "no_weight_decay", False) or p.ndim <= 1 and decoupled and getattr(self, "_decay_matrices_only", False)
+                    for p in self._parameter_list]
+
+        def update_all(params, grads, states, lr, step):
+            new_params, new_states = [], []
+            for i, (p, g, s) in enumerate(zip(params, grads, states)):
+                if g is None:
+                    new_params.append(p)
+                    new_states.append(s)
+                    continue
+                p32 = s.get("master", p.astype(jnp.float32) if p.dtype != jnp.float32 else p)
+                g32 = g.astype(jnp.float32)
+                if wd and not decoupled and not no_decay[i]:
+                    g32 = g32 + wd * p32
+                slots = {k: v for k, v in s.items() if k != "master"}
+                if wd and decoupled and not no_decay[i]:
+                    p32 = p32 * (1.0 - lr * wd)
+                p32_new, slots_new = self._update(p32, g32, slots, lr, step)
+                if "master" in s:
+                    slots_new["master"] = p32_new
+                new_params.append(p32_new.astype(p.dtype))
+                new_states.append(slots_new)
+            return new_params, new_states
+
+        return jax.jit(update_all)
+
+    # -- eager step ------------------------------------------------------------
+    @property
+    def _param_groups(self):
+        return self._parameter_list
+
+    def step(self):
+        self._ensure_state()
+        if self._jitted_update is None:
+            self._jitted_update = self._build_update_fn()
+        params = [p._data for p in self._parameter_list]
+        grads = [p._grad for p in self._parameter_list]
+
+        if self._grad_clip is not None:
+            pg = self._grad_clip(list(zip(self._parameter_list, grads)))
+            grads = [g for _, g in pg]
+
+        self._step_count += 1
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        step = jnp.asarray(self._step_count, jnp.int32)
+        new_params, new_state = self._jitted_update(params, grads, self._state, lr, step)
+        for p, np_ in zip(self._parameter_list, new_params):
+            p._data = np_
+        self._state = new_state
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # -- serialization ---------------------------------------------------------
+    def state_dict(self) -> dict:
+        self._ensure_state()
+        out = {"step": self._step_count, "slots": []}
+        for s in self._state:
+            out["slots"].append({k: np.asarray(v) for k, v in s.items()})
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state: dict):
+        self._step_count = state.get("step", 0)
+        slots = state.get("slots")
+        if slots is not None:
+            self._state = [{k: jnp.asarray(v) for k, v in s.items()} for s in slots]
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+
+    # -- functional interface for jit/pjit trainers ----------------------------
+    def functional(self):
+        """Returns (init_fn, update_fn) over pytrees for the compiled path.
+
+        init_fn(params_pytree) -> state_pytree
+        update_fn(params, grads, state, lr, step) -> (new_params, new_state)
+        Dtype policy matches the eager path: fp32 math + master weights.
+        """
+        self_ref = self
+        wd = self._weight_decay
+        decoupled = self._decoupled_decay()
+
+        def init_fn(params):
+            def per_leaf(p):
+                slots = self_ref._init_slots(p)
+                if self_ref._multi_precision and _is_float(p.dtype) and p.dtype != jnp.float32:
+                    slots["master"] = p.astype(jnp.float32)
+                return slots
+
+            return jax.tree.map(per_leaf, params)
+
+        def update_fn(params, grads, state, lr, step):
+            def per_leaf(p, g, s):
+                p32 = s.get("master", p.astype(jnp.float32) if p.dtype != jnp.float32 else p)
+                g32 = g.astype(jnp.float32)
+                if wd and not decoupled:
+                    g32 = g32 + wd * p32
+                slots = {k: v for k, v in s.items() if k != "master"}
+                if wd and decoupled:
+                    p32 = p32 * (1.0 - lr * wd)
+                p32_new, slots_new = self_ref._update(p32, g32, slots, lr, step)
+                if "master" in s:
+                    slots_new["master"] = p32_new
+                return p32_new.astype(p.dtype), slots_new
+
+            flat_p, treedef = jax.tree.flatten(params)
+            flat_g = treedef.flatten_up_to(grads)
+            flat_s = treedef.flatten_up_to(state)
+            outs = [per_leaf(p, g, s) for p, g, s in zip(flat_p, flat_g, flat_s)]
+            new_p = treedef.unflatten([o[0] for o in outs])
+            new_s = treedef.unflatten([o[1] for o in outs])
+            return new_p, new_s
+
+        return init_fn, update_fn
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+
+    def _update(self, p32, g32, slots, lr, step):
+        return p32 - lr * g32, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False,
+                 weight_decay=None, grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_slots(self, p):
+        return {"velocity": jnp.zeros(p.shape, jnp.float32)}
+
+    def _update(self, p32, g32, slots, lr, step):
+        v = self._momentum * slots["velocity"] + g32
+        if self._nesterov:
+            p_new = p32 - lr * (g32 + self._momentum * v)
+        else:
+            p_new = p32 - lr * v
+        return p_new, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None,
+                 weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=True,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_slots(self, p):
+        return {"m": jnp.zeros(p.shape, jnp.float32), "v": jnp.zeros(p.shape, jnp.float32)}
+
+    def _update(self, p32, g32, slots, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * slots["m"] + (1 - b1) * g32
+        v = b2 * slots["v"] + (1 - b2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        m_hat = m / (1 - b1 ** t)
+        v_hat = v / (1 - b2 ** t)
+        p_new = p32 - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+        return p_new, {"m": m, "v": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference ``python/paddle/optimizer/adamw.py``)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None,
+                 weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=True, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision, name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        if apply_decay_param_fun is not None:
+            for p in self._parameter_list:
+                if not apply_decay_param_fun(p.name):
+                    p.no_weight_decay = True
+
+    def _decoupled_decay(self):
+        return True
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_slots(self, p):
+        return {"moment": jnp.full(p.shape, self._init_acc, jnp.float32)}
+
+    def _update(self, p32, g32, slots, lr, step):
+        mom = slots["moment"] + jnp.square(g32)
+        p_new = p32 - lr * g32 / (jnp.sqrt(mom) + self._epsilon)
+        return p_new, {"moment": mom}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _init_slots(self, p):
+        s = {"mean_square": jnp.zeros(p.shape, jnp.float32), "momentum": jnp.zeros(p.shape, jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros(p.shape, jnp.float32)
+        return s
+
+    def _update(self, p32, g32, slots, lr, step):
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * jnp.square(g32)
+        out = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            out["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * slots["momentum"] + lr * g32 / denom
+        out["momentum"] = mom
+        return p32 - mom, out
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _init_slots(self, p):
+        return {"avg_sq_grad": jnp.zeros(p.shape, jnp.float32), "avg_sq_update": jnp.zeros(p.shape, jnp.float32)}
+
+    def _update(self, p32, g32, slots, lr, step):
+        rho, eps = self._rho, self._epsilon
+        asg = rho * slots["avg_sq_grad"] + (1 - rho) * jnp.square(g32)
+        update = g32 * jnp.sqrt(slots["avg_sq_update"] + eps) / jnp.sqrt(asg + eps)
+        asu = rho * slots["avg_sq_update"] + (1 - rho) * jnp.square(update)
+        return p32 - lr * update, {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, p):
+        return {"m": jnp.zeros(p.shape, jnp.float32), "inf_norm": jnp.zeros(p.shape, jnp.float32)}
+
+    def _update(self, p32, g32, slots, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * slots["m"] + (1 - b1) * g32
+        u = jnp.maximum(b2 * slots["inf_norm"], jnp.abs(g32))
+        t = step.astype(jnp.float32)
+        p_new = p32 - lr / (1 - b1 ** t) * m / (u + eps)
+        return p_new, {"m": m, "inf_norm": u}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-06, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_slots(self, p):
+        return {"m": jnp.zeros(p.shape, jnp.float32), "v": jnp.zeros(p.shape, jnp.float32)}
+
+    def _update(self, p32, g32, slots, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * slots["m"] + (1 - b1) * g32
+        v = b2 * slots["v"] + (1 - b2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        m_hat = m / (1 - b1 ** t)
+        v_hat = v / (1 - b2 ** t)
+        r = m_hat / (jnp.sqrt(v_hat) + eps) + self._lamb_wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p32 - lr * trust * r, {"m": m, "v": v}
+
+
+class NAdam(Adam):
+    def _update(self, p32, g32, slots, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * slots["m"] + (1 - b1) * g32
+        v = b2 * slots["v"] + (1 - b2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        m_hat = m / (1 - b1 ** t)
+        v_hat = v / (1 - b2 ** t)
+        m_bar = b1 * m_hat + (1 - b1) * g32 / (1 - b1 ** t)
+        return p32 - lr * m_bar / (jnp.sqrt(v_hat) + eps), {"m": m, "v": v}
+
+
+class RAdam(Adam):
+    def _update(self, p32, g32, slots, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * slots["m"] + (1 - b1) * g32
+        v = b2 * slots["v"] + (1 - b2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2 * t * (b2 ** t) / (1 - b2 ** t)
+        m_hat = m / (1 - b1 ** t)
+
+        def rect_update():
+            r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            v_hat = jnp.sqrt(v / (1 - b2 ** t))
+            return p32 - lr * r * m_hat / (v_hat + eps)
+
+        p_new = jnp.where(rho_t > 5.0, rect_update(), p32 - lr * m_hat)
+        return p_new, {"m": m, "v": v}
+
+
+class ASGD(Optimizer):
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+
+    def _update(self, p32, g32, slots, lr, step):
+        return p32 - lr * g32, slots

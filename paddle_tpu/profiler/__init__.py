@@ -1,0 +1,202 @@
+"""``paddle_tpu.profiler`` (reference: ``python/paddle/profiler/`` + C++ tracers).
+
+Host annotations (``RecordEvent``) + chrome-trace export are native here; the
+device side delegates to the JAX/XLA profiler (XPlane → TensorBoard), which is
+the TPU equivalent of the reference's CUPTI tracer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, List, Optional
+
+__all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState", "make_scheduler",
+           "export_chrome_tracing", "benchmark", "Timer"]
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    TPU = 3
+    CUSTOM_DEVICE = 4
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class _EventStore:
+    def __init__(self):
+        self.events: List[dict] = []
+        self.lock = threading.Lock()
+        self.enabled = False
+
+    def add(self, name, ts, dur, tid):
+        with self.lock:
+            self.events.append({"name": name, "ph": "X", "ts": ts * 1e6, "dur": dur * 1e6,
+                                "pid": os.getpid(), "tid": tid, "cat": "host"})
+
+
+_store = _EventStore()
+
+
+class RecordEvent:
+    """Host-side scoped annotation (reference: ``phi::RecordEvent``)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+
+    def end(self):
+        if self._t0 is not None and _store.enabled:
+            t1 = time.perf_counter()
+            _store.add(self.name, self._t0, t1 - self._t0, threading.get_ident())
+        self._t0 = None
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0, skip_first: int = 0):
+    total = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * total:
+            return ProfilerState.CLOSED
+        pos = s % total
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None) -> Callable:
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        path = os.path.join(dir_name, f"{worker_name or 'worker'}_{int(time.time())}.json")
+        with open(path, "w") as f:
+            json.dump({"traceEvents": _store.events}, f)
+
+    return handler
+
+
+class Profiler:
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None, timer_only=False,
+                 record_shapes=False, profile_memory=False, with_flops=False):
+        self.scheduler = scheduler if callable(scheduler) else None
+        if isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self.scheduler = make_scheduler(closed=lo, ready=0, record=hi - lo, repeat=1)
+        self.on_trace_ready = on_trace_ready
+        self.step_num = 0
+        self.timer_only = timer_only
+        self._jax_running = False
+
+    def start(self):
+        _store.enabled = True
+        _store.events.clear()
+        try:
+            import jax
+
+            logdir = os.environ.get("PADDLE_TPU_PROFILE_DIR")
+            if logdir and not self.timer_only:
+                jax.profiler.start_trace(logdir)
+                self._jax_running = True
+        except Exception:
+            pass
+
+    def stop(self):
+        _store.enabled = False
+        if self._jax_running:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._jax_running = False
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples: Optional[int] = None):
+        self.step_num += 1
+
+    def step_info(self, unit=None):
+        return f"step {self.step_num}"
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        by_name = {}
+        for e in _store.events:
+            d = by_name.setdefault(e["name"], [0.0, 0])
+            d[0] += e["dur"] / 1e3
+            d[1] += 1
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}"]
+        for name, (tot, calls) in sorted(by_name.items(), key=lambda kv: -kv[1][0]):
+            lines.append(f"{name:<40}{calls:>8}{tot:>12.3f}")
+        return "\n".join(lines)
+
+
+class Timer:
+    """Throughput timer (reference: ``python/paddle/profiler/timer.py`` ips)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._start = None
+        self.steps = 0
+        self.samples = 0
+        self.elapsed = 0.0
+
+    def begin(self):
+        self._start = time.perf_counter()
+
+    def step(self, num_samples=1):
+        if self._start is None:
+            self.begin()
+            return
+        now = time.perf_counter()
+        self.elapsed += now - self._start
+        self._start = now
+        self.steps += 1
+        self.samples += num_samples
+
+    def ips(self):
+        return self.samples / self.elapsed if self.elapsed else 0.0
+
+    def step_time(self):
+        return self.elapsed / self.steps if self.steps else 0.0
+
+
+def benchmark():
+    return Timer()

@@ -1,0 +1,86 @@
+"""``paddle_tpu.static`` — graph-mode compatibility shims.
+
+The reference's static graph mode (Program/Executor/CompiledProgram) is an
+artifact of its two-engine design; here every compiled execution is a traced
+XLA program (``paddle_tpu.jit``).  These shims keep the API importable and map
+the common patterns onto jit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..framework.tensor import Tensor
+
+__all__ = ["InputSpec", "Program", "default_main_program", "default_startup_program",
+           "program_guard", "Executor", "gradients", "name_scope"]
+
+
+class InputSpec:
+    """Shape/dtype spec for to_static input signatures (kept: it is useful for AOT export)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=False):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class Program:
+    def __init__(self):
+        self.ops = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_main = Program()
+_startup = Program()
+
+
+def default_main_program():
+    return _main
+
+
+def default_startup_program():
+    return _startup
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    yield
+
+
+@contextlib.contextmanager
+def name_scope(prefix):
+    yield
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        raise NotImplementedError(
+            "static Program execution is not part of the TPU-native design; "
+            "use eager mode or paddle_tpu.jit.to_static"
+        )
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..framework.autograd import grad
+
+    return grad(targets, inputs, target_gradients, retain_graph=True, allow_unused=True)

@@ -1,0 +1,162 @@
+"""Vision datasets (reference: ``python/paddle/vision/datasets/``).
+
+No-network environment: these read local files in the standard formats; a
+``FakeData`` dataset provides synthetic samples for tests/benchmarks.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData", "ImageFolder", "DatasetFolder"]
+
+
+class FakeData(Dataset):
+    def __init__(self, num_samples=1000, image_shape=(3, 32, 32), num_classes=10, transform=None, seed=0):
+        rng = np.random.RandomState(seed)
+        self.images = rng.rand(num_samples, *image_shape).astype(np.float32)
+        self.labels = rng.randint(0, num_classes, size=(num_samples,)).astype(np.int32)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class MNIST(Dataset):
+    """Reads the classic IDX-format files from ``root``."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None,
+                 download=False, backend=None, root=None):
+        root = root or os.path.expanduser("~/.cache/paddle_tpu/mnist")
+        prefix = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(root, f"{prefix}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(root, f"{prefix}-labels-idx1-ubyte.gz")
+        if not os.path.exists(image_path):
+            raise FileNotFoundError(
+                f"MNIST files not found at {image_path}; no network access — place files locally")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+        self.transform = transform
+
+    @staticmethod
+    def _read_images(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows, cols)
+        return data
+
+    @staticmethod
+    def _read_labels(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), dtype=np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None, :, :]
+        if self.transform:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None, download=False, backend=None):
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError("Cifar10 archive not found; no network access — place file locally")
+        self.transform = transform
+        self.data = []
+        with tarfile.open(data_file) as tf:
+            names = [n for n in tf.getnames() if ("data_batch" in n if mode == "train" else "test_batch" in n)]
+            for name in sorted(names):
+                d = pickle.load(tf.extractfile(name), encoding="bytes")
+                imgs = d[b"data"].reshape(-1, 3, 32, 32)
+                for img, lbl in zip(imgs, d[b"labels"]):
+                    self.data.append((img, lbl))
+
+    def __getitem__(self, idx):
+        img, lbl = self.data[idx]
+        img = img.astype(np.float32)
+        if self.transform:
+            img = self.transform(img)
+        return img, int(lbl)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    pass
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS, transform=None, is_valid_file=None):
+        classes = sorted(d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            for fname in sorted(os.listdir(os.path.join(root, c))):
+                if fname.lower().endswith(extensions):
+                    self.samples.append((os.path.join(root, c, fname), self.class_to_idx[c]))
+        self.transform = transform
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        from PIL import Image  # pillow ships with matplotlib deps if present
+
+        return np.asarray(Image.open(path).convert("RGB"))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS, transform=None, is_valid_file=None):
+        self.samples = []
+        for dirpath, _, files in os.walk(root):
+            for fname in sorted(files):
+                if fname.lower().endswith(extensions):
+                    self.samples.append((os.path.join(dirpath, fname), 0))
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+
+    def __getitem__(self, idx):
+        path, _ = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return (img,)

@@ -1,0 +1,106 @@
+"""Vision ops (reference: ``python/paddle/vision/ops.py``: NMS, RoIAlign, DeformConv...)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["nms", "box_iou", "roi_align", "distribute_fpn_proposals"]
+
+
+def box_iou(boxes1, boxes2):
+    """IoU matrix between two box sets (xyxy)."""
+    b1 = np.asarray(boxes1._data if isinstance(boxes1, Tensor) else boxes1)
+    b2 = np.asarray(boxes2._data if isinstance(boxes2, Tensor) else boxes2)
+    area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+    area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+    lt = np.maximum(b1[:, None, :2], b2[None, :, :2])
+    rb = np.minimum(b1[:, None, 2:], b2[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    return Tensor(inter / (area1[:, None] + area2[None, :] - inter + 1e-10))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None, top_k=None):
+    """Greedy NMS (host-side; data-dependent output size)."""
+    b = np.asarray(boxes._data if isinstance(boxes, Tensor) else boxes)
+    s = np.asarray(scores._data) if isinstance(scores, Tensor) else (
+        np.asarray(scores) if scores is not None else np.ones(len(b), np.float32))
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), dtype=bool)
+    iou = np.asarray(box_iou(Tensor(b), Tensor(b))._data)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        suppressed |= iou[i] > iou_threshold
+        suppressed[i] = True  # keep marked, but not re-visited
+    keep = np.asarray(keep, dtype=np.int32)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign via bilinear sampling (XLA-friendly gather formulation)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.dispatch import apply_op
+
+    bx = boxes._data if isinstance(boxes, Tensor) else jnp.asarray(boxes)
+    bn = np.asarray(boxes_num._data if isinstance(boxes_num, Tensor) else boxes_num)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else output_size
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+
+    def f(feat):
+        n, c, h, w = feat.shape
+        off = 0.5 if aligned else 0.0
+        x1 = bx[:, 0] * spatial_scale - off
+        y1 = bx[:, 1] * spatial_scale - off
+        x2 = bx[:, 2] * spatial_scale - off
+        y2 = bx[:, 3] * spatial_scale - off
+        roi_w = jnp.maximum(x2 - x1, 1e-3)
+        roi_h = jnp.maximum(y2 - y1, 1e-3)
+        ys = y1[:, None] + (jnp.arange(oh) + 0.5)[None, :] * (roi_h[:, None] / oh)  # [R, oh]
+        xs = x1[:, None] + (jnp.arange(ow) + 0.5)[None, :] * (roi_w[:, None] / ow)  # [R, ow]
+
+        def sample(r):
+            fmap = feat[batch_idx[r]]  # [C, H, W]
+            yy = ys[r]
+            xx = xs[r]
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+            y1_ = jnp.clip(y0 + 1, 0, h - 1)
+            x1_ = jnp.clip(x0 + 1, 0, w - 1)
+            wy = jnp.clip(yy - y0, 0, 1)
+            wx = jnp.clip(xx - x0, 0, 1)
+            f00 = fmap[:, y0][:, :, x0]
+            f01 = fmap[:, y0][:, :, x1_]
+            f10 = fmap[:, y1_][:, :, x0]
+            f11 = fmap[:, y1_][:, :, x1_]
+            top = f00 * (1 - wx)[None, None, :] + f01 * wx[None, None, :]
+            bot = f10 * (1 - wx)[None, None, :] + f11 * wx[None, None, :]
+            return top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+
+        return jax.vmap(sample)(jnp.arange(bx.shape[0]))
+
+    return apply_op("roi_align", f, (x,), {})
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level, refer_scale, pixel_offset=False, rois_num=None, name=None):
+    rois = np.asarray(fpn_rois._data)
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = np.sqrt(w * h)
+    level = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    level = np.clip(level, min_level, max_level).astype(np.int32)
+    outs, idxs = [], []
+    for lv in range(min_level, max_level + 1):
+        sel = np.where(level == lv)[0]
+        outs.append(Tensor(rois[sel]))
+        idxs.append(sel)
+    restore = np.argsort(np.concatenate(idxs)).astype(np.int32)
+    return outs, [Tensor(np.asarray([len(i)], np.int32)) for i in idxs], Tensor(restore)

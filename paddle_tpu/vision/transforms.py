@@ -1,0 +1,199 @@
+"""Vision transforms (reference: ``python/paddle/vision/transforms/``).
+
+Operate on numpy HWC arrays (or Tensors); pure host-side preprocessing.
+"""
+
+from __future__ import annotations
+
+import numbers
+import random as pyrandom
+from typing import List, Sequence
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop", "RandomCrop",
+           "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad", "RandomRotation",
+           "to_tensor", "normalize", "resize", "hflip", "vflip", "center_crop", "crop"]
+
+
+def _np(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img._data)
+    return np.asarray(img)
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+def to_tensor(pic, data_format="CHW"):
+    arr = _np(pic).astype(np.float32)
+    if arr.max() > 1.5:
+        arr = arr / 255.0
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = _np(img).astype(np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        arr = (arr - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    else:
+        arr = (arr - mean) / std
+    return Tensor(arr) if isinstance(img, Tensor) else arr
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        self.mean = mean if isinstance(mean, (list, tuple)) else [mean] * 3
+        self.std = std if isinstance(std, (list, tuple)) else [std] * 3
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+def _resize_np(arr, size):
+    import jax
+
+    h, w = (size, size) if isinstance(size, int) else size
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    out = jax.image.resize(arr.astype(np.float32), (h, w, arr.shape[2]), method="bilinear")
+    return np.asarray(out)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return _resize_np(_np(img), size)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return resize(img, self.size)
+
+
+def crop(img, top, left, height, width):
+    return _np(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _np(img)
+    th, tw = (output_size, output_size) if isinstance(output_size, int) else output_size
+    h, w = arr.shape[0], arr.shape[1]
+    i = max((h - th) // 2, 0)
+    j = max((w - tw) // 2, 0)
+    return arr[i:i + th, j:j + tw]
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = _np(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) else [self.padding] * 4
+            arr = np.pad(arr, [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2))
+        th, tw = self.size
+        h, w = arr.shape[0], arr.shape[1]
+        i = pyrandom.randint(0, max(h - th, 0))
+        j = pyrandom.randint(0, max(w - tw, 0))
+        return arr[i:i + th, j:j + tw]
+
+
+def hflip(img):
+    return _np(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _np(img)[::-1].copy()
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if pyrandom.random() < self.prob:
+            return hflip(img)
+        return _np(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if pyrandom.random() < self.prob:
+            return vflip(img)
+        return _np(img)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        arr = _np(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = _np(img)
+        p = self.padding
+        width = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
+        return np.pad(arr, width, constant_values=self.fill)
+
+
+class RandomRotation:
+    def __init__(self, degrees, interpolation="nearest", expand=False, center=None, fill=0, keys=None):
+        self.degrees = (-degrees, degrees) if isinstance(degrees, numbers.Number) else degrees
+
+    def __call__(self, img):
+        import scipy.ndimage as ndi  # available via scipy; fallback to no-op
+
+        try:
+            angle = pyrandom.uniform(*self.degrees)
+            return ndi.rotate(_np(img), angle, reshape=False, order=1)
+        except Exception:
+            return _np(img)

@@ -1,0 +1,17 @@
+"""Test config: simulate an 8-device CPU mesh (SURVEY §4: better than the
+reference's subprocess-only story — XLA can fake N devices on one host)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+# the axon TPU plugin (sitecustomize) force-selects itself; pin CPU for tests
+jax.config.update("jax_platforms", "cpu")
+# deterministic fp32 matmuls for numerics comparisons against numpy
+jax.config.update("jax_default_matmul_precision", "highest")
+assert jax.default_backend() == "cpu", jax.default_backend()

@@ -1,0 +1,129 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x + 3 * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_chain_and_accumulation():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    a = x * 2
+    b = a + x  # two paths into x
+    b.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+def test_matmul_grad_matches_numpy():
+    rng = np.random.RandomState(0)
+    A = rng.randn(3, 4).astype(np.float32)
+    B = rng.randn(4, 5).astype(np.float32)
+    x = paddle.to_tensor(A, stop_gradient=False)
+    w = paddle.to_tensor(B, stop_gradient=False)
+    out = paddle.matmul(x, w)
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones((3, 5)) @ B.T, rtol=1e-5)
+    np.testing.assert_allclose(w.grad.numpy(), A.T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 3
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_double_backward_without_retain_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 3
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_grad_unused_input():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    z = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [z])
+    gx, gz = paddle.grad(x * 2, [x, z], allow_unused=True)
+    assert gz is None
+
+
+def test_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(np.asarray(g))
+        return g * 10
+
+    x.register_hook(hook)
+    (x * 2).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+    vals, idx = paddle.topk(x, 2)
+    vals.sum().backward()
+    expected = np.zeros(6)
+    expected[[5, 4]] = 1
+    np.testing.assert_allclose(x.grad.numpy(), expected)
+
+
+def test_int_op_no_tape():
+    x = paddle.to_tensor([1.0, 5.0, 2.0], stop_gradient=False)
+    i = paddle.argmax(x)
+    assert i._grad_node is None
+    assert i.item() == 1
+
+
+def test_pylayer():
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor
+            return dy * 3 * x * x
+
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = Cube.apply(x)
+    y.backward()
+    np.testing.assert_allclose(y.numpy(), [8.0])
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_stop_gradient_leaf_protected():
+    x = paddle.to_tensor([1.0])  # stop_gradient=True
+    y = x * 2
+    assert y._grad_node is None

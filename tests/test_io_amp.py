@@ -1,0 +1,138 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset, DistributedBatchSampler,
+                           IterableDataset, RandomSampler, TensorDataset, random_split)
+
+
+class _SquaresDataset(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i]), np.float32([i * i])
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_batching():
+    dl = DataLoader(_SquaresDataset(), batch_size=8)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == [8, 1]
+    np.testing.assert_allclose(x.numpy().reshape(-1), np.arange(8))
+
+
+def test_dataloader_drop_last_shuffle():
+    dl = DataLoader(_SquaresDataset(), batch_size=8, shuffle=True, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 2
+    all_vals = np.concatenate([b[0].numpy().reshape(-1) for b in batches])
+    assert len(set(all_vals.tolist())) == 16
+
+
+def test_iterable_dataset():
+    class Stream(IterableDataset):
+        def __iter__(self):
+            for i in range(10):
+                yield np.float32([i])
+
+    dl = DataLoader(Stream(), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 3
+    assert batches[-1].shape == [2, 1]
+
+
+def test_tensor_dataset_and_split():
+    xs = paddle.randn([10, 3])
+    ys = paddle.randn([10, 1])
+    ds = TensorDataset([xs, ys])
+    assert len(ds) == 10
+    a, b = random_split(ds, [7, 3])
+    assert len(a) == 7 and len(b) == 3
+
+
+def test_distributed_batch_sampler():
+    ds = _SquaresDataset(20)
+    s0 = DistributedBatchSampler(ds, batch_size=5, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=5, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 10
+    assert set(i0) & set(i1) == set()
+
+
+def test_prefetch_thread():
+    dl = DataLoader(_SquaresDataset(), batch_size=4, num_workers=2)
+    assert len(list(dl)) == 5
+
+
+def test_auto_cast_o1():
+    m = nn.Linear(8, 8)
+    x = paddle.randn([2, 8])
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = paddle.matmul(x, m.weight)
+        assert str(out.dtype) == "bfloat16"
+        sm = F.softmax(out.astype("float32"))
+        assert sm.dtype == np.float32
+    out2 = paddle.matmul(x, m.weight)
+    assert out2.dtype == np.float32
+
+
+def test_amp_decorate_o2():
+    m = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    m2 = paddle.amp.decorate(m, level="O2", dtype="bfloat16")
+    assert str(m2._sub_layers["0"].weight.dtype) == "bfloat16"
+    # norms stay fp32
+    assert m2._sub_layers["1"].weight.dtype == np.float32
+
+
+def test_grad_scaler_disabled_passthrough():
+    scaler = paddle.amp.GradScaler(enable=False)
+    t = paddle.to_tensor([2.0])
+    assert float(scaler.scale(t)) == 2.0
+
+
+def test_grad_scaler_dynamic():
+    w = paddle.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    scaler = paddle.amp.GradScaler(enable=True, init_loss_scaling=4.0, incr_every_n_steps=1)
+    loss = (w * 2).sum()
+    scaled = scaler.scale(loss)
+    assert float(scaled) == pytest.approx(8.0)  # loss 2.0 × scale 4.0
+    scaled.backward()
+    scaler.step(opt)
+    # unscaled grad = 2 → w = 1 - 0.2
+    np.testing.assert_allclose(w.numpy(), [0.8], rtol=1e-5)
+
+
+def test_metrics():
+    acc = paddle.metric.Accuracy()
+    pred = paddle.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8]], np.float32))
+    label = paddle.to_tensor(np.array([[0], [1]]))
+    correct = acc.compute(pred, label)
+    acc.update(correct)
+    assert acc.accumulate() == 1.0
+
+
+def test_flags():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    assert paddle.get_flags("check_nan_inf")["check_nan_inf"] is True
+    x = paddle.to_tensor([1.0, 0.0])
+    with pytest.raises(FloatingPointError):
+        _ = paddle.log(x * 0 - 1)
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_profiler_record_event():
+    prof = paddle.profiler.Profiler(timer_only=True)
+    prof.start()
+    with paddle.profiler.RecordEvent("my_op"):
+        paddle.randn([10]).sum()
+    prof.stop()
+    assert "my_op" in prof.summary()

@@ -1,0 +1,80 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_to_static_function_parity():
+    @paddle.jit.to_static
+    def f(x, y):
+        return paddle.matmul(x, y) + 1.0
+
+    a = paddle.randn([3, 4])
+    b = paddle.randn([4, 5])
+    out = f(a, b)
+    np.testing.assert_allclose(out.numpy(), a.numpy() @ b.numpy() + 1.0, rtol=1e-5)
+
+
+def test_to_static_layer_parity():
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    m.eval()
+    x = paddle.randn([2, 8])
+    eager = m(x).numpy()
+    static = paddle.jit.to_static(m)
+    out = static(x)
+    np.testing.assert_allclose(out.numpy(), eager, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_sees_weight_updates():
+    m = nn.Linear(4, 4, bias_attr=False)
+    static = paddle.jit.to_static(m)
+    x = paddle.ones([1, 4])
+    out1 = static(x).numpy()
+    m.weight.set_value(m.weight.numpy() * 2)
+    out2 = static(x).numpy()
+    np.testing.assert_allclose(out2, out1 * 2, rtol=1e-5)
+
+
+def test_train_step_matches_eager():
+    def build():
+        paddle.seed(7)
+        return nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 2))
+
+    x = paddle.randn([16, 8])
+    y = paddle.to_tensor(np.random.RandomState(0).randint(0, 2, 16))
+
+    m1 = build()
+    opt1 = paddle.optimizer.SGD(learning_rate=0.1, parameters=m1.parameters())
+    losses_eager = []
+    for _ in range(5):
+        loss = F.cross_entropy(m1(x), y)
+        loss.backward()
+        opt1.step()
+        opt1.clear_grad()
+        losses_eager.append(float(loss))
+
+    m2 = build()
+    opt2 = paddle.optimizer.SGD(learning_rate=0.1, parameters=m2.parameters())
+    step = paddle.jit.TrainStep(m2, lambda m, a, b: F.cross_entropy(m(a), b), opt2)
+    losses_jit = [float(step(x, y)) for _ in range(5)]
+
+    np.testing.assert_allclose(losses_eager, losses_jit, rtol=1e-4, atol=1e-5)
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_train_step_with_clip_and_scheduler():
+    m = nn.Linear(4, 2)
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2)
+    opt = paddle.optimizer.AdamW(learning_rate=sched, parameters=m.parameters(),
+                                 grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    step = paddle.jit.TrainStep(m, lambda mm, a, b: F.mse_loss(mm(a), b), opt)
+    x = paddle.randn([4, 4])
+    y = paddle.randn([4, 2])
+    l0 = float(step(x, y))
+    sched.step()
+    l1 = float(step(x, y))
+    assert l1 <= l0 * 1.5

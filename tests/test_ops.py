@@ -1,0 +1,176 @@
+"""Op unit tests vs NumPy references — the OpTest pattern from the reference
+(``test/legacy_test/op_test.py``), collapsed to parametrized comparisons."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+rng = np.random.RandomState(7)
+X = rng.rand(3, 4).astype(np.float32) + 0.5
+Y = rng.rand(3, 4).astype(np.float32) + 0.5
+
+UNARY_CASES = [
+    ("sqrt", np.sqrt), ("exp", np.exp), ("log", np.log), ("abs", np.abs),
+    ("tanh", np.tanh), ("sin", np.sin), ("cos", np.cos), ("floor", np.floor),
+    ("ceil", np.ceil), ("square", np.square), ("sign", np.sign),
+    ("reciprocal", lambda a: 1 / a), ("log1p", np.log1p), ("expm1", np.expm1),
+    ("rsqrt", lambda a: 1 / np.sqrt(a)),
+]
+
+BINARY_CASES = [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+    ("pow", np.power), ("atan2", np.arctan2),
+]
+
+
+@pytest.mark.parametrize("name,ref", UNARY_CASES, ids=[c[0] for c in UNARY_CASES])
+def test_unary(name, ref):
+    out = getattr(paddle, name)(paddle.to_tensor(X))
+    np.testing.assert_allclose(out.numpy(), ref(X), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("name,ref", BINARY_CASES, ids=[c[0] for c in BINARY_CASES])
+def test_binary(name, ref):
+    out = getattr(paddle, name)(paddle.to_tensor(X), paddle.to_tensor(Y))
+    np.testing.assert_allclose(out.numpy(), ref(X, Y), rtol=1e-5, atol=1e-6)
+
+
+def test_reductions():
+    t = paddle.to_tensor(X)
+    np.testing.assert_allclose(t.sum().numpy(), X.sum(), rtol=1e-5)
+    np.testing.assert_allclose(paddle.sum(t, axis=1).numpy(), X.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.mean(t, axis=0, keepdim=True).numpy(), X.mean(0, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(paddle.max(t, axis=1).numpy(), X.max(1))
+    np.testing.assert_allclose(paddle.prod(t, axis=0).numpy(), X.prod(0), rtol=1e-4)
+    np.testing.assert_allclose(paddle.std(t).numpy(), X.std(ddof=1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.var(t, unbiased=False).numpy(), X.var(), rtol=1e-5)
+    np.testing.assert_allclose(paddle.logsumexp(t, axis=1).numpy(),
+                               np.log(np.exp(X).sum(1)), rtol=1e-5)
+
+
+def test_manipulation():
+    t = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert paddle.reshape(t, [6, 4]).shape == [6, 4]
+    assert paddle.reshape(t, [-1]).shape == [24]
+    assert paddle.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(t, 1).shape == [2, 12]
+    assert paddle.squeeze(paddle.unsqueeze(t, 0), 0).shape == [2, 3, 4]
+    cc = paddle.concat([t, t], axis=1)
+    assert cc.shape == [2, 6, 4]
+    st = paddle.stack([t, t], axis=0)
+    assert st.shape == [2, 2, 3, 4]
+    parts = paddle.split(t, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    parts2 = paddle.split(t, [1, -1], axis=1)
+    assert parts2[1].shape == [2, 2, 4]
+    assert paddle.tile(t, [2, 1, 1]).shape == [4, 3, 4]
+    assert paddle.expand(paddle.to_tensor(np.zeros((1, 4), np.float32)), [3, 4]).shape == [3, 4]
+    assert paddle.flip(t, axis=0).numpy()[0, 0, 0] == 12.0
+    assert paddle.roll(t, 1, axis=2).numpy()[0, 0, 0] == 3.0
+
+
+def test_gather_scatter():
+    t = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = paddle.to_tensor(np.array([0, 2]))
+    g = paddle.gather(t, idx, axis=0)
+    np.testing.assert_allclose(g.numpy(), t.numpy()[[0, 2]])
+    nd_idx = paddle.to_tensor(np.array([[0, 0], [3, 2]]))
+    gn = paddle.gather_nd(t, nd_idx)
+    np.testing.assert_allclose(gn.numpy(), [0.0, 11.0])
+    s = paddle.scatter(t, paddle.to_tensor(np.array([1])), paddle.to_tensor(np.zeros((1, 3), np.float32)))
+    np.testing.assert_allclose(s.numpy()[1], 0.0)
+    tk = paddle.take_along_axis(t, paddle.to_tensor(np.array([[0], [1], [2], [0]])), axis=1)
+    assert tk.shape == [4, 1]
+
+
+def test_where_and_logic():
+    a = paddle.to_tensor([1.0, -1.0, 2.0])
+    w = paddle.where(a > 0, a, paddle.zeros_like(a))
+    np.testing.assert_allclose(w.numpy(), [1, 0, 2])
+    assert bool(paddle.allclose(a, a))
+    assert bool(paddle.equal_all(a, a))
+    assert not bool(paddle.logical_not(paddle.to_tensor(True)))
+
+
+def test_search_sort():
+    x = np.array([[3.0, 1.0, 2.0], [9.0, 7.0, 8.0]], np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.sort(t, axis=1).numpy(), np.sort(x, 1))
+    np.testing.assert_allclose(paddle.argsort(t, axis=1).numpy(), np.argsort(x, 1))
+    vals, idx = paddle.topk(t, 2, axis=1)
+    np.testing.assert_allclose(vals.numpy(), [[3, 2], [9, 8]])
+    assert paddle.argmax(t, axis=1).numpy().tolist() == [0, 0]
+    seq = paddle.to_tensor(np.array([1.0, 3.0, 5.0], np.float32))
+    out = paddle.searchsorted(seq, paddle.to_tensor(np.array([2.0, 5.0], np.float32)))
+    assert out.numpy().tolist() == [1, 2]
+
+
+def test_linalg():
+    A = rng.rand(4, 4).astype(np.float32) + np.eye(4, dtype=np.float32) * 2
+    t = paddle.to_tensor(A)
+    np.testing.assert_allclose(paddle.inv(t).numpy(), np.linalg.inv(A), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(paddle.det(t).numpy(), np.linalg.det(A), rtol=1e-3)
+    sym = A @ A.T
+    w, v = paddle.eigh(paddle.to_tensor(sym))
+    np.testing.assert_allclose(w.numpy(), np.linalg.eigh(sym)[0], rtol=1e-3, atol=1e-3)
+    e = paddle.einsum("ij,jk->ik", t, t)
+    np.testing.assert_allclose(e.numpy(), A @ A, rtol=1e-4)
+    np.testing.assert_allclose(paddle.norm(t).numpy(), np.linalg.norm(A), rtol=1e-5)
+    q, r = paddle.qr(t)
+    np.testing.assert_allclose((q.numpy() @ r.numpy()), A, rtol=1e-3, atol=1e-4)
+    L = paddle.cholesky(paddle.to_tensor(sym))
+    np.testing.assert_allclose(L.numpy() @ L.numpy().T, sym, rtol=1e-3, atol=1e-3)
+
+
+def test_creation():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3]).numpy().sum() == 6
+    assert paddle.full([2], 7.0).numpy().tolist() == [7, 7]
+    np.testing.assert_allclose(paddle.arange(0, 10, 2).numpy(), [0, 2, 4, 6, 8])
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), [0, 0.25, 0.5, 0.75, 1])
+    assert paddle.eye(3).numpy().trace() == 3
+    tri = paddle.tril(paddle.ones([3, 3]))
+    assert tri.numpy().sum() == 6
+    oh = paddle.one_hot(paddle.to_tensor(np.array([0, 2])), 3)
+    np.testing.assert_allclose(oh.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+def test_random_reproducible():
+    paddle.seed(123)
+    a = paddle.randn([4])
+    paddle.seed(123)
+    b = paddle.randn([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    p = paddle.randperm(10)
+    assert sorted(p.numpy().tolist()) == list(range(10))
+    r = paddle.randint(0, 5, [100])
+    assert r.numpy().min() >= 0 and r.numpy().max() < 5
+
+
+def test_cumulative():
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(paddle.cumsum(paddle.to_tensor(x)).numpy(), [1, 3, 6])
+    np.testing.assert_allclose(paddle.cumprod(paddle.to_tensor(x), dim=0).numpy(), [1, 2, 6])
+
+
+def test_unique_nonzero():
+    x = paddle.to_tensor(np.array([3, 1, 2, 1, 3]))
+    u = paddle.unique(x)
+    assert u.numpy().tolist() == [1, 2, 3]
+    nz = paddle.nonzero(paddle.to_tensor(np.array([0, 1, 0, 2])))
+    assert nz.numpy().reshape(-1).tolist() == [1, 3]
+
+
+def test_fft():
+    x = rng.rand(8).astype(np.float32)
+    out = paddle.fft.fft(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), np.fft.fft(x), rtol=1e-4, atol=1e-5)
+
+
+def test_pad():
+    x = paddle.to_tensor(np.ones((1, 1, 2, 2), np.float32))
+    out = paddle.nn.functional.pad(x, [1, 1, 1, 1])
+    assert out.shape == [1, 1, 4, 4]
+    assert out.numpy().sum() == 4

@@ -1,0 +1,116 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import Tensor
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert str(t.dtype) == "float32"
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_int64_downcast_to_int32():
+    t = paddle.to_tensor([1, 2, 3])
+    assert t.dtype == np.int32
+
+
+def test_dtype_conversion():
+    t = paddle.to_tensor([1.0, 2.0])
+    u = t.astype("bfloat16")
+    assert str(u.dtype) == "bfloat16"
+    v = u.astype(paddle.float32)
+    assert v.dtype == np.float32
+
+
+def test_scalar_item():
+    t = paddle.to_tensor(3.5)
+    assert t.item() == pytest.approx(3.5)
+    assert float(t) == pytest.approx(3.5)
+    assert t.ndim == 0
+
+
+def test_indexing():
+    t = paddle.to_tensor(np.arange(24).reshape(2, 3, 4).astype(np.float32))
+    assert t[0].shape == [3, 4]
+    assert t[0, 1, 2].item() == 6.0
+    assert t[:, 1].shape == [2, 4]
+    assert t[..., -1].shape == [2, 3]
+    mask = t > 11
+    assert paddle.masked_select(t, mask).shape == [12]
+
+
+def test_setitem():
+    t = paddle.to_tensor(np.zeros((3, 3), np.float32))
+    t[0, 0] = 5.0
+    t[1] = paddle.to_tensor(np.ones(3, np.float32))
+    assert t.numpy()[0, 0] == 5.0
+    np.testing.assert_allclose(t.numpy()[1], 1.0)
+
+
+def test_setitem_grad():
+    x = paddle.to_tensor(np.ones((3,), np.float32), stop_gradient=False)
+    y = x * 2
+    y[0] = 0.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+
+
+def test_arithmetic_dunders():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((2 + a).numpy(), [3, 4])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+    assert bool((a < b).all())
+
+
+def test_tensor_methods_installed():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.sum().item() == 10.0
+    assert t.mean().item() == 2.5
+    assert t.reshape([4]).shape == [4]
+    assert t.transpose([1, 0]).shape == [2, 2]
+    assert t.T.shape == [2, 2]
+    np.testing.assert_allclose(t.matmul(t).numpy(), t.numpy() @ t.numpy())
+
+
+def test_clone_detach():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    c = t.clone()
+    assert not c.stop_gradient
+    d = t.detach()
+    assert d.stop_gradient
+    d2 = t.numpy()
+    d2[0] = 99
+    assert t.numpy()[0] == 1.0
+
+
+def test_parameter():
+    p = paddle.Parameter(np.zeros((2, 2), np.float32))
+    assert not p.stop_gradient
+    assert p.trainable
+
+
+def test_set_value():
+    t = paddle.to_tensor([1.0, 2.0])
+    t.set_value(np.array([5.0, 6.0], np.float32))
+    np.testing.assert_allclose(t.numpy(), [5, 6])
+    with pytest.raises(ValueError):
+        t.set_value(np.zeros(3, np.float32))
+
+
+def test_save_load(tmp_path):
+    state = {"w": paddle.to_tensor([1.0, 2.0]), "nested": {"b": paddle.Parameter(np.ones(2, np.float32))}, "step": 7}
+    p = str(tmp_path / "ckpt.pdparams")
+    paddle.save(state, p)
+    loaded = paddle.load(p)
+    np.testing.assert_allclose(loaded["w"].numpy(), [1, 2])
+    assert isinstance(loaded["nested"]["b"], paddle.Parameter)
+    assert loaded["step"] == 7

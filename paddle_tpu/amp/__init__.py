@@ -27,7 +27,8 @@ __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "is_auto_cast_ena
 FP16_WHITE_LIST = {"matmul", "linear", "bmm", "mv", "conv", "einsum"}
 # ops kept in fp32 under O1 (numerically sensitive)
 FP16_BLACK_LIST = {
-    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax", "log_softmax",
+    "exp", "square", "square_error_cost", "log", "mean", "sum", "cosine_similarity",
+    "softmax", "log_softmax",
     "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits", "cross_entropy",
     "c_softmax_with_cross_entropy", "layer_norm", "group_norm", "batch_norm", "rms_norm",
 }
@@ -80,6 +81,10 @@ def auto_cast(enable: bool = True, custom_white_list=None, custom_black_list=Non
         dispatch.amp_state.enabled = prev[0]
         dispatch.amp_state.dtype = convert_dtype(prev[1]) if prev[0] else None
         dispatch.amp_state.level = prev[2]
+        # restore the op lists too, so an outer auto_cast context with custom
+        # lists keeps casting with ITS lists after an inner context exits
+        dispatch.amp_state.white = prev_lists[0] if prev_lists[0] is not None else FP16_WHITE_LIST
+        dispatch.amp_state.black = prev_lists[1] if prev_lists[1] is not None else FP16_BLACK_LIST
 
 
 amp_guard = auto_cast
@@ -137,6 +142,12 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # per-optimizer iteration state, mirroring the reference's
+        # OptimizerState (grad_scaler.py:802): guards against double-unscaling
+        # when the user calls unscale_() explicitly before step() (the standard
+        # gradient-clipping pattern), and lets step()+update() be the
+        # documented usage without double-adjusting the scale.
+        self._opt_states: dict = {}  # id(optimizer) -> "UNSCALED" | "STEPPED"
 
     def scale(self, var: Tensor) -> Tensor:
         if not self._enable:
@@ -146,6 +157,11 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        state = self._opt_states.get(id(optimizer))
+        if state is not None and state[0] == "UNSCALED":
+            raise RuntimeError("unscale_() has already been called on this optimizer this step")
+        if state is not None and state[0] == "STEPPED":
+            raise RuntimeError("unscale_() is being called after step()")
         import jax.numpy as jnp
 
         found = False
@@ -155,21 +171,32 @@ class GradScaler:
                 if bool(jnp.any(~jnp.isfinite(p._grad))):
                     found = True
         self._found_inf = found
+        self._opt_states[id(optimizer)] = ("UNSCALED", found)
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
-        if not self._found_inf:
+        state = self._opt_states.get(id(optimizer))
+        if state is not None and state[0] == "STEPPED":
+            raise RuntimeError("step() has already been called on this optimizer this iteration")
+        if state is None or state[0] != "UNSCALED":
+            self.unscale_(optimizer)
+        found = self._opt_states[id(optimizer)][1]
+        if not found:
             optimizer.step()
-        self.update()
+        self._opt_states[id(optimizer)] = ("STEPPED", found)
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
 
     def update(self):
+        # an inf seen by ANY optimizer this iteration decrements the scale
+        any_inf = self._found_inf or any(f for _, f in self._opt_states.values())
+        self._found_inf = any_inf
+        self._opt_states.clear()
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

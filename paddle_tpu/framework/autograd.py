@@ -207,8 +207,11 @@ def grad(
             "create_graph=True is not supported on the eager tape; "
             "use paddle_tpu.jit / jax.grad composition for higher-order grads"
         )
-    # Save and clear .grad on the requested inputs, run backward, collect.
-    saved = [(t, t.grad) for t in inputs]
+    # Save and clear the raw grad field on the requested inputs, run backward,
+    # collect.  The raw ``_grad`` (jax.Array) is saved, not the ``.grad``
+    # property (a Tensor wrapper), so the finally-restore keeps the field a
+    # valid JAX type for subsequent optimizer steps.
+    saved = [(t, t._grad) for t in inputs]
     for t in inputs:
         t._grad = None
     try:

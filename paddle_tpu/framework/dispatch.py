@@ -38,14 +38,18 @@ amp_state = _AmpState()
 
 
 def _amp_cast(name: str, datas: tuple) -> tuple:
-    """Per-op input casting under auto_cast (reference: eager_gen.py AMP template)."""
-    base = name.split("_")[0] if name not in amp_state.white and name not in amp_state.black else name
+    """Per-op input casting under auto_cast (reference: eager_gen.py AMP template).
+
+    Matching is EXACT against the white/black lists (like the reference's
+    ``amp_lists.py`` sets, which enumerate full op names) — no prefix
+    heuristics, so an unlisted op never inherits a policy by accident.
+    """
     target = None
-    if name in amp_state.black or base in amp_state.black:
+    if name in amp_state.black:
         target = jnp.float32
     elif amp_state.level == "O2":
         target = amp_state.dtype
-    elif name in amp_state.white or base in amp_state.white:
+    elif name in amp_state.white:
         target = amp_state.dtype
     if target is None:
         return datas

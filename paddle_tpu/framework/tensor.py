@@ -314,7 +314,7 @@ def _unwrap_index(idx):
 class Parameter(Tensor):
     """Trainable tensor (reference: ``EagerParamBase``). stop_gradient defaults False."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed", "no_weight_decay")
 
     def __init__(self, data, dtype=None, name=None, trainable: bool = True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
@@ -322,6 +322,7 @@ class Parameter(Tensor):
         self.optimize_attr = {"learning_rate": 1.0}
         self.regularizer = None
         self.is_distributed = False
+        self.no_weight_decay = False
         self.persistable = True
 
     def __repr__(self):

@@ -1,0 +1,94 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Parameter
+
+
+def test_adamw_apply_decay_param_fun():
+    # previously crashed: Parameter.__slots__ lacked no_weight_decay
+    w = Parameter(np.ones((4, 4), np.float32), name="linear_w")
+    b = Parameter(np.zeros((4,), np.float32), name="linear_b")
+    opt = paddle.optimizer.AdamW(
+        learning_rate=0.1,
+        parameters=[w, b],
+        weight_decay=0.5,
+        apply_decay_param_fun=lambda n: n == "linear_w",
+    )
+    assert b.no_weight_decay and not w.no_weight_decay
+    # zero grads: only weight decay moves params; b must stay fixed
+    w._grad = jnp.zeros((4, 4), jnp.float32)
+    b._grad = jnp.zeros((4,), jnp.float32)
+    opt.step()
+    assert float(jnp.max(jnp.abs(b._data))) == 0.0
+    assert float(jnp.max(jnp.abs(w._data - 1.0))) > 0.0
+
+
+def test_grad_restores_raw_field_then_step():
+    # previously: grad() left t._grad holding a Tensor wrapper -> step() crashed
+    x = Parameter(np.ones((3,), np.float32))
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    assert x._grad is not None
+    g = paddle.grad([(x * x).sum()], [x])
+    assert np.allclose(g[0].numpy(), 2.0)
+    # restored field must be a jax array, and step() must work
+    assert not hasattr(x._grad, "_data")
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[x])
+    opt.step()
+
+
+def test_grad_scaler_explicit_unscale_then_step():
+    # the standard grad-clipping pattern: unscale_() then step() must not
+    # divide gradients by the scale twice
+    p = Parameter(np.ones((2,), np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+    scaler = paddle.amp.GradScaler(enable=True, init_loss_scaling=4.0)
+    p._grad = jnp.full((2,), 4.0)  # pretend scaled grad of 1.0
+    scaler.unscale_(opt)
+    np.testing.assert_allclose(np.asarray(p._grad), 1.0)
+    scaler.step(opt)  # must NOT unscale again
+    scaler.update()
+    np.testing.assert_allclose(p.numpy(), 0.0)  # 1.0 - lr*1.0
+
+
+def test_grad_scaler_step_update_single_adjustment():
+    p = Parameter(np.ones((2,), np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+    scaler = paddle.amp.GradScaler(
+        enable=True, init_loss_scaling=8.0, decr_every_n_nan_or_inf=1, decr_ratio=0.5
+    )
+    p._grad = jnp.array([np.inf, 1.0], jnp.float32) * 8.0
+    scaler.step(opt)
+    scaler.update()
+    # a NaN step decrements the scale exactly once (previously twice: once in
+    # step()'s internal update, once in the user's update())
+    assert scaler.get_loss_scaling().item() == 4.0
+
+
+def test_nested_auto_cast_restores_outer_lists():
+    from paddle_tpu.framework import dispatch
+
+    with paddle.amp.auto_cast(custom_white_list={"outer_op"}):
+        outer_white = set(dispatch.amp_state.white)
+        with paddle.amp.auto_cast(custom_white_list={"inner_op"}):
+            assert "inner_op" in dispatch.amp_state.white
+        # after inner exit the OUTER lists must be active again in dispatch
+        assert "outer_op" in dispatch.amp_state.white
+        assert "inner_op" not in dispatch.amp_state.white
+        assert set(dispatch.amp_state.white) == outer_white
+
+
+def test_amp_no_prefix_inheritance():
+    from paddle_tpu.framework import dispatch
+    from paddle_tpu.framework.tensor import Tensor
+
+    with paddle.amp.auto_cast():
+        # an op sharing a prefix with a white-listed op must not be cast
+        x = Tensor(np.ones((2, 2), np.float32))
+        out = dispatch.apply_op("matmul_custom_thing", lambda a: a * 2, (x,), {})
+        assert out.dtype == jnp.float32

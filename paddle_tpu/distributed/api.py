@@ -41,14 +41,25 @@ def _norm_placements(mesh: ProcessMesh, placements) -> list:
 
 
 def shard_tensor(data, mesh: ProcessMesh, placements=None, dtype=None, place=None, stop_gradient=None):
-    """Place ``data`` on ``mesh`` with ``placements``; returns a dist Tensor."""
+    """Place ``data`` on ``mesh`` with ``placements``; returns a dist Tensor.
+
+    With a ``Partial("sum")`` placement, ``data`` is the GLOBAL value: the
+    per-device addends are ``data / axis_size`` so that the p_to_r reduction
+    reconstructs ``data`` (the reference zero-fills non-origin ranks instead —
+    same global value, different addend split).  Use :func:`dtensor_from_local`
+    when the local tensor is itself one addend.
+    """
     t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
     placements = _norm_placements(mesh, placements)
+    arr = t._data
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Partial) and p.reduce_type == "sum":
+            arr = arr / mesh.shape[mesh_dim]
     sharding = named_sharding(mesh, placements, t.ndim)
-    if isinstance(t._data, jax.core.Tracer):
-        new_data = jax.lax.with_sharding_constraint(t._data, sharding)
+    if isinstance(arr, jax.core.Tracer):
+        new_data = jax.lax.with_sharding_constraint(arr, sharding)
     else:
-        new_data = jax.device_put(t._data, sharding)
+        new_data = jax.device_put(arr, sharding)
     if isinstance(t, Parameter):
         # preserve parameter identity: shard in place (used by shard_layer)
         t._data = new_data
@@ -73,10 +84,7 @@ def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements) -> Tensor:
         src_mesh, src_placements = src
         for mesh_dim, p in enumerate(src_placements):
             if isinstance(p, Partial):
-                axis = src_mesh.dim_names[mesh_dim]
-                # a Partial eager tensor stores unreduced addends replicated on
-                # that axis; sum them via a tiny jitted psum over the mesh
-                data = _reduce_partial(data, src_mesh, mesh_dim, p.reduce_type)
+                data = _reduce_partial(data, src_mesh, src_placements, mesh_dim, p.reduce_type)
     sharding = named_sharding(mesh, placements, dist_tensor.ndim)
     if isinstance(data, jax.core.Tracer):
         new_data = jax.lax.with_sharding_constraint(data, sharding)
@@ -87,12 +95,38 @@ def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements) -> Tensor:
     return out
 
 
-def _reduce_partial(data, mesh: ProcessMesh, mesh_dim: int, reduce_type: str):
-    # eager Partial semantics: the global value is the reduction over that
-    # axis of per-shard addends; we emulate by summing the per-device shards.
-    # In compiled programs GSPMD handles partials internally; eager Partial
-    # mainly occurs right after dtensor_from_local(..., Partial()).
-    return data  # per-shard values already placed; reduction happens lazily in matmul-style consumers
+def _reduce_partial(data, mesh: ProcessMesh, src_placements, mesh_dim: int, reduce_type: str):
+    """The eager p_to_r transition (reference
+    ``phi/core/distributed/auto_parallel/reshard/p_to_r_reshard_function.cc``).
+
+    A Partial tensor's devices along ``mesh_dim`` each hold an unreduced
+    addend; the global value is the reduction over that axis.  Implemented as
+    a ``shard_map`` whose in_spec omits the partial axis (each device's local
+    block is its addend; ``check_vma=False`` because the buffers are NOT the
+    identical replicas the spec would normally promise) with a ``psum``/
+    ``pmax``/``pmin`` over the axis.  One addend per device: in a single
+    process with k devices holding the same addend, the reduction yields k*x —
+    exactly what k reference ranks contributing x each would produce.
+    """
+    from jax import shard_map
+
+    axis = mesh.dim_names[mesh_dim]
+    # partition spec of the data as currently placed: Shard dims map to axes,
+    # Partial/Replicate axes are absent
+    spec = to_partition_spec(mesh, [p if isinstance(p, Shard) else Replicate() for p in src_placements], data.ndim)
+    if reduce_type in ("sum", "avg"):
+        red = lambda x: jax.lax.psum(x, axis)
+    elif reduce_type == "max":
+        red = lambda x: jax.lax.pmax(x, axis)
+    elif reduce_type == "min":
+        red = lambda x: jax.lax.pmin(x, axis)
+    else:
+        raise ValueError(f"unsupported Partial reduce_type: {reduce_type}")
+    fn = shard_map(red, mesh=mesh.jax_mesh, in_specs=spec, out_specs=spec, check_vma=False)
+    out = fn(data)
+    if reduce_type == "avg":
+        out = out / mesh.shape[mesh_dim]
+    return out
 
 
 def dtensor_from_local(local_tensor, mesh: ProcessMesh, placements) -> Tensor:
@@ -106,7 +140,14 @@ def dtensor_from_local(local_tensor, mesh: ProcessMesh, placements) -> Tensor:
     t = local_tensor if isinstance(local_tensor, Tensor) else Tensor(local_tensor)
     placements = _norm_placements(mesh, placements)
     if jax.process_count() == 1:
-        return shard_tensor(t, mesh, placements)
+        # the local tensor is ITSELF one addend (no 1/k rescale like
+        # shard_tensor): every device along a Partial axis holds it, and the
+        # p_to_r reduction sums k copies.
+        sharding = named_sharding(mesh, placements, t.ndim)
+        new_data = jax.device_put(t._data, sharding)
+        out = Tensor(new_data, stop_gradient=t.stop_gradient)
+        out._dist_attr = (mesh, placements)
+        return out
     # multi-host: build global array from local shards
     global_shape = list(t.shape)
     for mesh_dim, p in enumerate(placements):
@@ -152,14 +193,58 @@ def shard_layer(layer, process_mesh: ProcessMesh, shard_fn: Optional[Callable] =
     return layer
 
 
-def shard_optimizer(optimizer, shard_fn=None, mesh: Optional[ProcessMesh] = None):
-    """ZeRO-style optimizer-state sharding (reference api.py:1591 + ShardingStage1/2/3).
+def _zero1_state_placements(p, mesh: ProcessMesh, shard_axes) -> list:
+    """ZeRO-1 placement for one optimizer-state buffer of param ``p``: keep the
+    param's own sharding and ADDITIONALLY shard over the dp/sharding axes
+    (reference ``GroupShardedOptimizerStage2`` semantics: each dp rank owns a
+    1/dp slice of every moment/master buffer)."""
+    base = list(p._dist_attr[1]) if p._dist_attr is not None else [Replicate()] * mesh.ndim
+    while len(base) < mesh.ndim:
+        base.append(Replicate())
+    taken = {pl.dim for pl in base if isinstance(pl, Shard)}
+    shape = list(p.shape)
+    for mesh_dim in shard_axes:
+        if not isinstance(base[mesh_dim], Replicate):
+            continue
+        k = mesh.shape[mesh_dim]
+        if k == 1:
+            continue
+        # largest tensor dim not already sharded and divisible by the axis size
+        cands = [d for d in range(len(shape)) if d not in taken and shape[d] % k == 0 and shape[d] >= k]
+        if not cands:
+            continue
+        d = max(cands, key=lambda i: shape[i])
+        base[mesh_dim] = Shard(d)
+        taken.add(d)
+    return base
 
-    Wraps ``optimizer._init_slots`` so moment/master buffers inherit (or
-    override via ``shard_fn``) the parameter's sharding — the TPU equivalent
-    of sharding optimizer states across the dp axis.
+
+def shard_optimizer(optimizer, shard_fn=None, mesh: Optional[ProcessMesh] = None):
+    """ZeRO-1 optimizer-state sharding (reference api.py:1591 + ShardingStage1;
+    ``fleet/meta_parallel/sharding/group_sharded_optimizer_stage2.py:53``).
+
+    Every moment/master-weight buffer is placed with the param's sharding PLUS
+    a shard over the dp/sharding mesh axes, so per-device optimizer-state
+    bytes shrink by the dp degree.  The optimizer update is elementwise per
+    buffer, so XLA runs each shard's update locally; the updated master weight
+    is re-placed into the param's own placement on write-back — the
+    reduce-scatter/all-gather pattern of ZeRO, planned by GSPMD.
+
+    ``shard_fn(param, state_name, mesh) -> placements`` overrides the default
+    placement per state buffer.
     """
     mesh = mesh or get_mesh()
+    if mesh is None:
+        raise ValueError("shard_optimizer needs a mesh (pass mesh= or set one via fleet.init)")
+    shard_axes = [i for i, n in enumerate(mesh.dim_names) if n in ("dp", "sharding")]
+    if not shard_axes:
+        shard_axes = [0]
+
+    def _state_sharding(p, state_name, v):
+        placements = (shard_fn(p, state_name, mesh) if shard_fn is not None
+                      else _zero1_state_placements(p, mesh, shard_axes))
+        return named_sharding(mesh, placements, v.ndim)
+
     orig_build = optimizer._build_update_fn
 
     def build_with_shardings():
@@ -170,17 +255,28 @@ def shard_optimizer(optimizer, shard_fn=None, mesh: Optional[ProcessMesh] = None
             new_params, new_states = fn(params_data, grads, states, lr, step)
             out_p = []
             for p, np_ in zip(params, new_params):
-                if p._dist_attr is not None:
+                if p._dist_attr is not None and not isinstance(np_, jax.core.Tracer):
                     m, pl = p._dist_attr
-                    np_ = jax.device_put(np_, named_sharding(m, pl, np_.ndim)) if not isinstance(np_, jax.core.Tracer) else np_
+                    np_ = jax.device_put(np_, named_sharding(m, pl, np_.ndim))
                 out_p.append(np_)
-            return out_p, new_states
+            # pin state shardings so the ZeRO layout survives the jitted update
+            out_s = []
+            for p, s in zip(params, new_states):
+                out_s.append({
+                    k: (v if isinstance(v, jax.core.Tracer) else jax.device_put(v, _state_sharding(p, k, v)))
+                    for k, v in s.items()
+                })
+            return out_p, out_s
 
         return wrapped
 
     optimizer._build_update_fn = build_with_shardings
-    if shard_fn is not None:
-        optimizer._shard_fn = shard_fn
+    optimizer._jitted_update = None  # drop any pre-wrap compiled update
+    # shard any existing/initial state now: per-device state bytes shrink by dp
+    optimizer._ensure_state()
+    for p, slots in zip(optimizer._parameter_list, optimizer._state):
+        for k, v in slots.items():
+            slots[k] = jax.device_put(v, _state_sharding(p, k, v))
     return optimizer
 
 

@@ -113,29 +113,58 @@ def destroy_process_group(group=None):
     _INITIALIZED = False
 
 
-def _host_allreduce(arr: np.ndarray, op: str) -> np.ndarray:
-    """Cross-process reduction via a compiled psum over the global devices."""
-    if jax.process_count() == 1:
-        return arr
+def _group_ranks(group: Optional[Group]) -> List[int]:
+    """The participating global ranks: the whole world when group is None."""
+    if group is None:
+        return list(range(jax.process_count()))
+    return list(group.ranks)
+
+
+def _in_group(group: Optional[Group]) -> bool:
+    return group is None or jax.process_index() in group.ranks
+
+
+def _gather_rows(arr: np.ndarray) -> np.ndarray:
+    """All processes' copies of ``arr``, stacked along axis 0 (world order)."""
     from jax.experimental import multihost_utils
 
-    gathered = multihost_utils.process_allgather(arr)
+    return np.asarray(multihost_utils.process_allgather(arr))
+
+
+def _reduce_rows(rows: np.ndarray, op: str) -> np.ndarray:
     if op == ReduceOp.SUM:
-        return gathered.sum(axis=0)
+        return rows.sum(axis=0)
     if op == ReduceOp.MAX:
-        return gathered.max(axis=0)
+        return rows.max(axis=0)
     if op == ReduceOp.MIN:
-        return gathered.min(axis=0)
+        return rows.min(axis=0)
     if op == ReduceOp.PROD:
-        return np.prod(gathered, axis=0)
+        return np.prod(rows, axis=0)
     if op == ReduceOp.AVG:
-        return gathered.mean(axis=0)
+        return rows.mean(axis=0)
     raise ValueError(op)
 
 
+def _host_allreduce(arr: np.ndarray, op: str, group: Optional[Group] = None) -> np.ndarray:
+    """Cross-process reduction over the group's ranks (world when None).
+
+    Every process participates in the underlying allgather (a collective over
+    the PJRT coordination service must be entered by all processes), but only
+    the group members' rows enter the reduction — the subgroup semantics the
+    reference gets from per-group NCCL communicators.
+    """
+    if jax.process_count() == 1:
+        return arr
+    rows = _gather_rows(arr)
+    return _reduce_rows(rows[_group_ranks(group)], op)
+
+
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
-    out = _host_allreduce(np.asarray(tensor._data), op)
-    tensor._data = jnp.asarray(out)
+    # every process enters the gather (collectives must be entered globally);
+    # only group members take the reduced value
+    out = _host_allreduce(np.asarray(tensor._data), op, group)
+    if _in_group(group):
+        tensor._data = jnp.asarray(out)
     return tensor
 
 
@@ -144,12 +173,10 @@ def all_gather(tensor_list: list, tensor: Tensor, group=None, sync_op=True):
         tensor_list.clear()
         tensor_list.append(Tensor(tensor._data))
         return tensor_list
-    from jax.experimental import multihost_utils
-
-    gathered = multihost_utils.process_allgather(np.asarray(tensor._data))
+    gathered = _gather_rows(np.asarray(tensor._data))
     tensor_list.clear()
-    for i in range(gathered.shape[0]):
-        tensor_list.append(Tensor(gathered[i]))
+    for r in _group_ranks(group):
+        tensor_list.append(Tensor(gathered[r]))
     return tensor_list
 
 
@@ -160,8 +187,6 @@ def all_gather_object(object_list: list, obj, group=None):
         return object_list
     import pickle
 
-    from jax.experimental import multihost_utils
-
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
     # pad to max length across processes
     n = np.asarray([payload.size])
@@ -169,60 +194,65 @@ def all_gather_object(object_list: list, obj, group=None):
     padded = np.zeros(max_n + 8, dtype=np.uint8)
     padded[:8] = np.frombuffer(np.asarray([payload.size], np.int64).tobytes(), np.uint8)
     padded[8:8 + payload.size] = payload
-    gathered = multihost_utils.process_allgather(padded)
+    gathered = _gather_rows(padded)
     object_list.clear()
-    for row in gathered:
+    for r in _group_ranks(group):
+        row = gathered[r]
         size = int(np.frombuffer(row[:8].tobytes(), np.int64)[0])
         object_list.append(pickle.loads(row[8:8 + size].tobytes()))
     return object_list
 
 
 def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
+    """``src`` is the GLOBAL rank of the source (reference semantics)."""
     if jax.process_count() == 1:
         return tensor
     from jax.experimental import multihost_utils
 
     out = multihost_utils.broadcast_one_to_all(np.asarray(tensor._data), is_source=get_rank() == src)
-    tensor._data = jnp.asarray(out)
+    if _in_group(group):
+        tensor._data = jnp.asarray(out)
     return tensor
 
 
 def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
-    out = _host_allreduce(np.asarray(tensor._data), op)
-    if get_rank() == dst or jax.process_count() == 1:
+    if jax.process_count() == 1:
+        return tensor
+    out = _host_allreduce(np.asarray(tensor._data), op, group)
+    if get_rank() == dst:
         tensor._data = jnp.asarray(out)
     return tensor
 
 
 def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
+    ranks = _group_ranks(group)
     if jax.process_count() == 1:
         if tensor_list:
             tensor._data = tensor_list[0]._data
         return tensor
+    stacked = (np.stack([np.asarray(t._data) for t in tensor_list])
+               if tensor_list else np.zeros((len(ranks),) + tuple(tensor.shape), np.float32))
     from jax.experimental import multihost_utils
 
-    stacked = np.stack([np.asarray(t._data) for t in tensor_list]) if tensor_list else None
-    full = multihost_utils.broadcast_one_to_all(
-        stacked if stacked is not None else np.zeros((get_world_size(),) + tuple(tensor.shape), np.float32),
-        is_source=get_rank() == src,
-    )
-    tensor._data = jnp.asarray(full[get_rank()])
+    full = multihost_utils.broadcast_one_to_all(stacked, is_source=get_rank() == src)
+    if _in_group(group):
+        tensor._data = jnp.asarray(full[ranks.index(jax.process_index())])
     return tensor
 
 
 def alltoall(out_tensor_list: list, in_tensor_list: list, group=None, sync_op=True):
+    ranks = _group_ranks(group)
     if jax.process_count() == 1:
         out_tensor_list.clear()
         out_tensor_list.extend(Tensor(t._data) for t in in_tensor_list)
         return out_tensor_list
-    from jax.experimental import multihost_utils
-
     stacked = np.stack([np.asarray(t._data) for t in in_tensor_list])
-    gathered = multihost_utils.process_allgather(stacked)  # [P, P, ...]
-    me = get_rank()
-    out_tensor_list.clear()
-    for p in range(get_world_size()):
-        out_tensor_list.append(Tensor(gathered[p, me]))
+    gathered = _gather_rows(stacked)  # [world, len(group), ...]
+    if _in_group(group):
+        me = ranks.index(jax.process_index())
+        out_tensor_list.clear()
+        for r in ranks:
+            out_tensor_list.append(Tensor(gathered[r, me]))
     return out_tensor_list
 
 

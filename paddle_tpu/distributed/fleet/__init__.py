@@ -23,8 +23,8 @@ from . import topology as tp_mod
 from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode
 
 __all__ = ["init", "DistributedStrategy", "get_hybrid_communicate_group", "fleet",
-           "distributed_model", "distributed_optimizer", "HybridCommunicateGroup",
-           "CommunicateTopology", "ParallelMode"]
+           "distributed_model", "distributed_optimizer", "HybridParallelOptimizer",
+           "HybridCommunicateGroup", "CommunicateTopology", "ParallelMode"]
 
 
 class DistributedStrategy:
@@ -136,10 +136,74 @@ def distributed_model(model):
     return model
 
 
-def distributed_optimizer(optimizer, strategy=None):
-    """Hybrid optimizer wrap (reference ``HybridParallelOptimizer``).
+class HybridParallelOptimizer:
+    """Hybrid optimizer wrap (reference ``HybridParallelOptimizer``,
+    ``fleet/meta_parallel/dygraph_optimizer/hybrid_parallel_optimizer.py:42``).
 
-    Grad sync & global-norm clip across mesh axes are inherent to the compiled
-    program (grads of replicated params are reduced by GSPMD), so the eager
-    wrapper is the optimizer itself."""
-    return optimizer
+    Single-process (GSPMD) training needs no wrapper work: grads of replicated
+    params are reduced inside the compiled program.  In the eager MULTI-PROCESS
+    path nothing reduces grads automatically, so ``step()`` first averages each
+    trainable param's grad across the data-parallel ranks (the reference's
+    EagerReducer fused allreduce, ``fluid/distributed/collective/reducer.h:88``)."""
+
+    _OWN_FIELDS = ("_inner_opt", "_hcg")
+
+    def __init__(self, optimizer, hcg=None):
+        object.__setattr__(self, "_inner_opt", optimizer)
+        object.__setattr__(self, "_hcg", hcg)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def __setattr__(self, item, value):
+        # forward writes to the inner optimizer so monkey-patches (e.g.
+        # dist.shard_optimizer replacing _build_update_fn) land where step()
+        # will read them
+        if item in HybridParallelOptimizer._OWN_FIELDS:
+            object.__setattr__(self, item, value)
+        else:
+            setattr(self._inner_opt, item, value)
+
+    def _dp_group(self):
+        if self._hcg is None:
+            return None
+        try:
+            return self._hcg.get_data_parallel_group()
+        except Exception:
+            return None
+
+    def _sync_grads(self):
+        import jax
+
+        if jax.process_count() == 1:
+            return
+        from .. import collective
+        from ...framework.tensor import Tensor
+
+        group = self._dp_group()
+        for p in self._inner_opt._parameter_list:
+            if p._grad is not None:
+                t = Tensor(p._grad)
+                collective.all_reduce(t, op=collective.ReduceOp.AVG, group=group)
+                p._grad = t._data
+
+    def step(self):
+        self._sync_grads()
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Wrap the optimizer with hybrid-parallel grad sync (see
+    :class:`HybridParallelOptimizer`)."""
+    return HybridParallelOptimizer(optimizer, get_hybrid_communicate_group())

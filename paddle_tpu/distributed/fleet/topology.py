@@ -134,6 +134,38 @@ class HybridCommunicateGroup:
     def get_sep_parallel_rank(self):
         return self._coord("sep")
 
+    # comm groups (reference HybridCommunicateGroup get_*_parallel_group).
+    # Topology coordinates index DEVICES; host-level collectives operate on
+    # PROCESSES — so the returned Group holds the (deduped) process indices
+    # owning this process's axis row's devices.
+    def _axis_group(self, axis: str):
+        import jax
+
+        from ..collective import Group
+
+        devices = jax.devices()
+        my_dev_ranks = [i for i, d in enumerate(devices) if d.process_index == jax.process_index()]
+        for row in self._topo.get_comm_list(axis):
+            if any(r in my_dev_ranks for r in row):
+                procs = sorted({devices[r].process_index for r in row if r < len(devices)})
+                return Group(procs)
+        return Group([jax.process_index()])
+
+    def get_data_parallel_group(self):
+        return self._axis_group("dp")
+
+    def get_model_parallel_group(self):
+        return self._axis_group("mp")
+
+    def get_pipe_parallel_group(self):
+        return self._axis_group("pp")
+
+    def get_sharding_parallel_group(self):
+        return self._axis_group("sharding")
+
+    def get_sep_parallel_group(self):
+        return self._axis_group("sep")
+
     # mesh handles (TPU-native accessors used by the parallel layers)
     def get_mesh(self) -> ProcessMesh:
         return self.mesh

@@ -1,0 +1,162 @@
+"""Activation recomputation (gradient checkpointing).
+
+Counterpart of the reference's ``fleet/recompute/recompute.py`` —
+``RecomputeFunction`` PyLayer (:124) with RNG-state replay and the public
+``recompute()`` entry (:455).
+
+TPU-native split:
+
+- **Compiled path** (inside ``jit``/``TrainStep`` tracing, where the eager
+  tape is off): ``jax.checkpoint`` — XLA rematerializes the segment's
+  activations in backward.  RNG replay is structural: the traced program IS
+  the replay.
+- **Eager path**: the forward runs WITHOUT tape recording (no per-op vjp
+  residuals are held), and one lazy :class:`GradNode` is recorded whose
+  backward re-runs the segment under ``jax.vjp`` with the SAME PRNG key
+  captured at forward time (the reference's RNG-state stash/replay,
+  ``recompute.py:124-210``).
+
+Tensor kwargs are rejected (pass differentiable tensors positionally) so the
+eager and compiled paths cannot silently disagree about what receives grads.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import autograd, random as rnd
+from ...framework.tensor import Tensor
+
+__all__ = ["recompute"]
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _wrap_outs(out_datas, multi: bool, stop_gradient: bool):
+    results = [Tensor(o, stop_gradient=stop_gradient) for o in out_datas]
+    return tuple(results) if multi else results[0]
+
+
+def recompute(function, *args, preserve_rng_state: bool = True, **kwargs):
+    """Run ``function(*args, **kwargs)`` without storing its intermediate
+    activations; recompute them during backward.
+
+    ``function`` may be a Layer (its parameters are differentiated through) or
+    any callable over Tensors.  Differentiable tensors must be POSITIONAL;
+    a Tensor passed by keyword raises.
+    """
+    from ...nn.layers import Layer
+
+    # reference-API compat: accepted but behaviorally identical here — the
+    # lazy-GradNode eager path has no autograd-graph re-entry to choose between
+    kwargs.pop("use_reentrant", None)
+
+    for k, v in kwargs.items():
+        if isinstance(v, Tensor):
+            raise ValueError(
+                f"recompute: Tensor kwarg {k!r} would not receive gradients; "
+                "pass differentiable tensors positionally")
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    raw_in = [t._data for t in tensor_args]
+    grad_on = autograd.is_grad_enabled()
+    traced = any(_is_traced(d) for d in raw_in)
+
+    # params to differentiate through (eager path)
+    params: List[Tensor] = []
+    if isinstance(function, Layer):
+        params = [p for p in function.parameters() if not p.stop_gradient]
+
+    def _call_with_data(arg_datas, param_datas):
+        """Re-run the segment with substituted storage; returns raw outputs
+        and whether the function returned a multi-output container."""
+        swaps = list(zip(tensor_args, arg_datas)) + list(zip(params, param_datas))
+        old = [(t, t._data) for t, _ in swaps]
+        try:
+            for t, d in swaps:
+                t._data = d
+            out = function(*args, **kwargs)
+        finally:
+            for t, d in old:
+                t._data = d
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        return [o._data if isinstance(o, Tensor) else o for o in outs], multi
+
+    if not grad_on and not traced:
+        # inference-only eager call: no checkpointing to set up
+        out_datas, multi = _call_with_data(raw_in, [p._data for p in params])
+        return _wrap_outs(out_datas, multi, stop_gradient=True)
+
+    if traced:
+        # compiled path: let XLA rematerialize.  Probe the output container
+        # shape with an uncheckpointed abstract call is not needed — run the
+        # checkpointed call and recover `multi` via a mutable cell.
+        container = {}
+
+        def pure(arg_datas, param_datas):
+            with autograd.no_grad():
+                outs, multi = _call_with_data(list(arg_datas), list(param_datas))
+            container["multi"] = multi
+            return tuple(outs)
+
+        outs = jax.checkpoint(pure)(tuple(raw_in), tuple(p._data for p in params))
+        return _wrap_outs(list(outs), container["multi"], stop_gradient=False)
+
+    # ---- eager path ----
+    # draw ONE key from the global stream (advancing it), then derive both the
+    # forward and the backward-replay randomness from it
+    rng_key = rnd.next_key() if preserve_rng_state else None
+    ctx = (lambda: rnd.rng_guard(rng_key)) if rng_key is not None else contextlib.nullcontext
+
+    # only tensors that can receive grads enter the vjp; the rest (e.g. rope
+    # cos/sin buffers) are closed over so backward never builds their cotangents
+    diff_args = [t for t in tensor_args if not t.stop_gradient]
+    diff_inputs = diff_args + params
+
+    with autograd.no_grad(), ctx():
+        out_datas, multi = _call_with_data(raw_in, [p._data for p in params])
+
+    if not diff_inputs:
+        return _wrap_outs(out_datas, multi, stop_gradient=True)
+
+    captured = [t._data for t in diff_inputs]
+    n_args = len(diff_args)
+
+    def pure(*flat):
+        darg = {id(t): d for t, d in zip(diff_args, flat[:n_args])}
+        arg_datas = [darg.get(id(t), t._data) for t in tensor_args]
+        param_datas = list(flat[n_args:])
+        with autograd.no_grad(), ctx():
+            outs, _ = _call_with_data(arg_datas, param_datas)
+        return tuple(outs)
+
+    def lazy_vjp(cots):
+        # THE recompute: forward re-runs here, inside jax.vjp
+        _, vjp_fn = jax.vjp(pure, *captured)
+        if not isinstance(cots, tuple):
+            cots = (cots,)
+        return vjp_fn(tuple(cots))
+
+    node = autograd.GradNode(
+        lazy_vjp,
+        diff_inputs,
+        len(out_datas),
+        [(o.shape, o.dtype) for o in out_datas],
+        name="recompute",
+    )
+    results = []
+    for i, o in enumerate(out_datas):
+        is_float = jnp.issubdtype(o.dtype, jnp.floating) or jnp.issubdtype(o.dtype, jnp.complexfloating)
+        t = Tensor(o, stop_gradient=not is_float)
+        if is_float:
+            t._grad_node = node
+            t._out_index = i
+        results.append(t)
+    return tuple(results) if multi else results[0]

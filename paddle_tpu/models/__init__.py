@@ -1,0 +1,16 @@
+"""Model zoo: flagship recipes exercising the framework end-to-end.
+
+Counterpart of the reference's flagship integration models
+(``test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py`` and
+the out-of-repo PaddleNLP model zoo referenced by BASELINE configs).
+"""
+
+from . import llama  # noqa: F401
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    llama_tiny_config,
+    llama3_8b_config,
+    llama3_70b_config,
+)

@@ -1,0 +1,118 @@
+"""Flagship Llama recipe tests (VERDICT item 2): eager/compiled parity,
+recompute parity, hybrid dp x mp training on the simulated 8-device mesh.
+
+Reference model being matched:
+``test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py``.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+
+
+def _batch(cfg, bsz=4, seq=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return paddle.to_tensor(rng.integers(0, cfg.vocab_size, size=(bsz, seq)).astype(np.int32))
+
+
+def loss_fn(m, ids):
+    return m.compute_loss(m(ids), ids)
+
+
+def test_eager_forward_and_init_loss():
+    paddle.seed(0)
+    cfg = llama_tiny_config()
+    model = LlamaForCausalLM(cfg)
+    ids = _batch(cfg)
+    logits = model(ids)
+    assert logits.shape == [4, 64, cfg.vocab_size]
+    loss = model.compute_loss(logits, ids)
+    # random init -> CE near ln(vocab)
+    assert abs(loss.item() - math.log(cfg.vocab_size)) < 0.5
+    loss.backward()
+    assert model.llama.embed_tokens._grad is not None
+
+
+def test_gqa_head_shapes():
+    cfg = llama_tiny_config(num_attention_heads=4, num_key_value_heads=2)
+    assert cfg.kv_heads == 2
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    att = model.llama.layers[0].self_attn
+    h, hk, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    assert att.qkv_proj.shape == [cfg.hidden_size, (h + 2 * hk) * d]
+
+
+def test_trainstep_loss_decreases():
+    paddle.seed(0)
+    cfg = llama_tiny_config()
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    ids = _batch(cfg)
+    losses = [float(step(ids).numpy()) for _ in range(15)]
+    assert losses[-1] < losses[0] - 1.0
+
+
+def test_recompute_parity():
+    ids = None
+    paddle.seed(1)
+    m1 = LlamaForCausalLM(llama_tiny_config(recompute=True))
+    paddle.seed(1)
+    m2 = LlamaForCausalLM(llama_tiny_config())
+    ids = _batch(m1.config)
+    l1 = loss_fn(m1, ids)
+    l1.backward()
+    l2 = loss_fn(m2, ids)
+    l2.backward()
+    assert abs(l1.item() - l2.item()) < 1e-5
+    np.testing.assert_allclose(
+        np.asarray(m1.llama.embed_tokens._grad),
+        np.asarray(m2.llama.embed_tokens._grad), rtol=1e-4, atol=1e-6)
+
+
+def test_recompute_compiled():
+    paddle.seed(1)
+    model = LlamaForCausalLM(llama_tiny_config(recompute=True))
+    opt = paddle.optimizer.SGD(learning_rate=1e-2, parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    ids = _batch(model.config)
+    l0 = float(step(ids).numpy())
+    l5 = None
+    for _ in range(5):
+        l5 = float(step(ids).numpy())
+    assert l5 < l0
+
+
+def test_hybrid_mesh_training_parity():
+    import paddle_tpu.distributed.fleet as fleet
+
+    # single-device truth
+    paddle.seed(0)
+    ref = LlamaForCausalLM(llama_tiny_config())
+    ids = _batch(ref.config)
+    ref_loss = loss_fn(ref, ids).item()
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(0)
+        cfg = llama_tiny_config(sequence_parallel=True)
+        model = LlamaForCausalLM(cfg)
+        # TP shardings landed
+        assert "mp" in str(model.llama.layers[0].self_attn.qkv_proj._data.sharding.spec)
+        assert "mp" in str(model.llama.embed_tokens._data.sharding.spec)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        step = paddle.jit.TrainStep(model, loss_fn, opt)
+        losses = [float(step(ids).numpy()) for _ in range(8)]
+        # same init (same seed) -> same first loss as single-device
+        assert abs(losses[0] - ref_loss) < 1e-3
+        assert losses[-1] < losses[0]
+    finally:
+        from paddle_tpu.distributed.mesh import set_global_mesh
+        set_global_mesh(None)

@@ -9,7 +9,12 @@ KV blocks), designed around VMEM tiling and the MXU.
 Layout convention follows the reference's API (``nn/functional/flash_attention.py``):
 ``q, k, v: [batch, seq, num_heads, head_dim]``.
 
-The XLA reference path is used on CPU and as the numerics oracle in tests.
+TPU tiling note: the softmax statistics (lse, delta) are carried as
+``[BH, 1, S]`` so their blocks ``(1, 1, block)`` satisfy Mosaic's trailing-two
+-dims rule ((div 8, div 128) or equal-to-array).
+
+The XLA reference path is used on CPU and as the numerics oracle in tests;
+``interpret=True`` runs the Pallas kernels on CPU for CI.
 """
 
 from __future__ import annotations
@@ -49,14 +54,29 @@ def _attention_reference(q, k, v, causal: bool, mask, sm_scale: float):
 
 
 # ---------------------------------------------------------------------------
-# Pallas TPU kernel (fwd + bwd), installed lazily to keep CPU imports cheap
+# Pallas TPU kernel (fwd + bwd)
 # ---------------------------------------------------------------------------
 
-def _pallas_flash(q, k, v, causal: bool, sm_scale: float,
-                  block_q: int = 128, block_k: int = 128):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+def _causal_mask(s, qi, ki, block_q, block_k, seq_offset):
+    """Mask scores s [block_q, block_k] to q_pos + seq_offset >= k_pos, where
+    seq_offset = Sk - Sq aligns the causal diagonal for cross attention."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos + seq_offset >= k_pos, s, NEG_INF)
 
+
+def _causal_hi(qi, block_q, block_k, seq_offset, n_k):
+    """Exclusive upper bound on k-blocks visible to q-block qi."""
+    return jnp.minimum(((qi + 1) * block_q + seq_offset + block_k - 1) // block_k, n_k)
+
+
+def _causal_lo(ki, block_q, block_k, seq_offset):
+    """First q-block that can see k-block ki."""
+    return jnp.maximum((ki * block_k - seq_offset) // block_q, 0)
+
+
+def _pallas_flash(q, k, v, causal: bool, sm_scale: float,
+                  block_q: int = 128, block_k: int = 128, interpret: bool = False):
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     # operate in [B*H, S, D]
@@ -64,20 +84,19 @@ def _pallas_flash(q, k, v, causal: bool, sm_scale: float,
     kr = jnp.swapaxes(k, 1, 2).reshape(B * H, Sk, D)
     vr = jnp.swapaxes(v, 1, 2).reshape(B * H, Sk, D)
 
-    out = _flash_fwd_bh(qr, kr, vr, causal, sm_scale, block_q, block_k)
+    out = _flash_fwd_bh(qr, kr, vr, causal, sm_scale, block_q, block_k, interpret)
     return jnp.swapaxes(out.reshape(B, H, Sq, D), 1, 2).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_fwd_bh(q, k, v, causal, sm_scale, block_q, block_k):
-    o, _ = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_fwd_bh(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    o, _ = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret)
     return o
 
 
-def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k):
-    """q,k,v: [BH, S, D]. Returns (o, lse)."""
+def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    """q,k,v: [BH, S, D]. Returns (o, lse) with lse: [BH, 1, Sq]."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     BH, Sq, D = q.shape
     Sk = k.shape[1]
@@ -95,9 +114,7 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k):
             s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) * sm_scale
             if causal:
-                q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-                k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(q_pos + (Sk - Sq) >= k_pos, s, NEG_INF)
+                s = _causal_mask(s, qi, ki, block_q, block_k, Sk - Sq)
             m_cur = jnp.max(s, axis=1)
             m_new = jnp.maximum(m_prev, m_cur)
             p = jnp.exp(s - m_new[:, None])
@@ -110,15 +127,11 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k):
         acc0 = jnp.zeros((block_q, D), jnp.float32)
         m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
         l0 = jnp.zeros((block_q,), jnp.float32)
-        if causal:
-            # only blocks with k_start <= q_end participate
-            hi = jnp.minimum(((qi + 1) * block_q + (Sk - Sq) + block_k - 1) // block_k, n_k)
-        else:
-            hi = n_k
+        hi = _causal_hi(qi, block_q, block_k, Sk - Sq, n_k) if causal else n_k
         acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
         l_safe = jnp.maximum(l, 1e-30)
         o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = (m + jnp.log(l_safe)).astype(jnp.float32)
+        lse_ref[0, 0] = (m + jnp.log(l_safe)).astype(jnp.float32)
 
     grid = (BH, n_q)
     o, lse = pl.pallas_call(
@@ -131,32 +144,38 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            # stats carried [BH, 1, Sq]: trailing block dims (1, block_q)
+            # satisfy Mosaic tiling ((equal-to-array, div 128))
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, Sq), jnp.float32),
+            jax.ShapeDtypeStruct((BH, 1, Sq), jnp.float32),
         ],
+        interpret=interpret,
     )(q, k, v)
     return o, lse
 
 
-def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k):
-    o, lse = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k)
+def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    o, lse = _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(causal, sm_scale, block_q, block_k, res, do):
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, do):
     q, k, v, o, lse = res
-    dq, dk, dv = _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k)
+    dq, dk, dv = _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, interpret)
     return dq, dk, dv
 
 
 _flash_fwd_bh.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
-    """Two-pass flash backward: dKV pass (grid over KV blocks) and dQ pass."""
+def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, interpret):
+    """Two-pass flash backward: dKV pass (grid over KV blocks) and dQ pass.
+
+    lse: [BH, 1, Sq] (fp32); delta is computed the same shape.
+    """
     from jax.experimental import pallas as pl
 
     BH, Sq, D = q.shape
@@ -164,7 +183,7 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
     n_q = Sq // block_q
     n_k = Sk // block_k
 
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, Sq]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[:, None, :]  # [BH, 1, Sq]
 
     def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref):
         ki = pl.program_id(1)
@@ -175,14 +194,12 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
             dk_acc, dv_acc = carry
             qb = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
             dob = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-            lseb = lse_ref[0, pl.ds(qi * block_q, block_q)]
-            deltab = delta_ref[0, pl.ds(qi * block_q, block_q)]
+            lseb = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+            deltab = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
             s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) * sm_scale
             if causal:
-                q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-                k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(q_pos + (Sk - Sq) >= k_pos, s, NEG_INF)
+                s = _causal_mask(s, qi, ki, block_q, block_k, Sk - Sq)
             p = jnp.exp(s - lseb[:, None])  # [bq, bk]
             dv_acc = dv_acc + jax.lax.dot_general(p, dob, (((0,), (0,)), ((), ())),
                                                   preferred_element_type=jnp.float32)
@@ -193,10 +210,7 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
                                                   preferred_element_type=jnp.float32)
             return dk_acc, dv_acc
 
-        if causal:
-            lo = jnp.maximum((ki * block_k - (Sk - Sq)) // block_q, 0)
-        else:
-            lo = 0
+        lo = _causal_lo(ki, block_q, block_k, Sk - Sq) if causal else 0
         dk_acc0 = jnp.zeros((block_k, D), jnp.float32)
         dv_acc0 = jnp.zeros((block_k, D), jnp.float32)
         dk_acc, dv_acc = jax.lax.fori_loop(lo, n_q, body, (dk_acc0, dv_acc0))
@@ -211,8 +225,8 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, Sq, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Sq), lambda b, i: (b, 0)),
-            pl.BlockSpec((1, Sq), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, 1, Sq), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, Sq), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
@@ -222,14 +236,15 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
             jax.ShapeDtypeStruct((BH, Sk, D), q.dtype),
             jax.ShapeDtypeStruct((BH, Sk, D), q.dtype),
         ],
+        interpret=interpret,
     )(q, k, v, do, lse, delta)
 
     def dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref):
         qi = pl.program_id(1)
         qb = q_ref[0].astype(jnp.float32)
         dob = do_ref[0].astype(jnp.float32)
-        lseb = lse_ref[0]
-        deltab = delta_ref[0]
+        lseb = lse_ref[0, 0]
+        deltab = delta_ref[0, 0]
 
         def body(ki, dq_acc):
             kb = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
@@ -237,9 +252,7 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
             s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) * sm_scale
             if causal:
-                q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-                k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(q_pos + (Sk - Sq) >= k_pos, s, NEG_INF)
+                s = _causal_mask(s, qi, ki, block_q, block_k, Sk - Sq)
             p = jnp.exp(s - lseb[:, None])
             dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32)
@@ -247,10 +260,7 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
             return dq_acc + jax.lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
                                                 preferred_element_type=jnp.float32)
 
-        if causal:
-            hi = jnp.minimum(((qi + 1) * block_q + (Sk - Sq) + block_k - 1) // block_k, n_k)
-        else:
-            hi = n_k
+        hi = _causal_hi(qi, block_q, block_k, Sk - Sq, n_k) if causal else n_k
         dq_acc = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, D), jnp.float32))
         dq_ref[0] = dq_acc.astype(dq_ref.dtype)
 
@@ -262,11 +272,12 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
             pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+        interpret=interpret,
     )(q, k, v, do, lse, delta)
 
     return dq, dk, dv
@@ -276,8 +287,12 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
 # public entry
 # ---------------------------------------------------------------------------
 
-def flash_attention(q, k, v, causal: bool = False, mask=None, sm_scale: Optional[float] = None):
-    """Memory-efficient attention. q,k,v: [B, S, H, D] jax arrays."""
+def flash_attention(q, k, v, causal: bool = False, mask=None, sm_scale: Optional[float] = None,
+                    interpret: bool = False):
+    """Memory-efficient attention. q,k,v: [B, S, H, D] jax arrays.
+
+    ``interpret=True`` forces the Pallas kernel in interpreter mode (CPU CI).
+    """
     from . import use_pallas
 
     if sm_scale is None:
@@ -286,19 +301,23 @@ def flash_attention(q, k, v, causal: bool = False, mask=None, sm_scale: Optional
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     Hk = k.shape[2]
-    if Hk != H and Hk > 0 and H % Hk == 0 and Hk != H:
+    if Hk != H and Hk > 0 and H % Hk == 0:
         # grouped-query attention: repeat KV heads
         rep = H // Hk
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
 
-    pallas_ok = (
-        use_pallas()
-        and mask is None
+    kernel_shapes_ok = (
+        mask is None
         and D in (64, 128, 256)
         and Sq % 128 == 0
         and Sk % 128 == 0
     )
+    if interpret and not kernel_shapes_ok:
+        raise ValueError(
+            "flash_attention(interpret=True) requires kernel-compatible shapes "
+            f"(mask=None, D in 64/128/256, S % 128 == 0); got D={D}, Sq={Sq}, Sk={Sk}")
+    pallas_ok = (use_pallas() or interpret) and kernel_shapes_ok
     if pallas_ok:
-        return _pallas_flash(q, k, v, causal, sm_scale)
+        return _pallas_flash(q, k, v, causal, sm_scale, interpret=interpret)
     return _attention_reference(q, k, v, causal, mask, sm_scale)

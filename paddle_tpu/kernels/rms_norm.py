@@ -5,6 +5,15 @@ Python API ``incubate/nn/functional/fused_rms_norm.py``).  On TPU a Pallas
 kernel keeps the row statistics in VMEM; on CPU the jnp form is used (XLA
 fuses it anyway — the Pallas version exists to guarantee the fusion and to
 keep fp32 statistics under bf16 inputs).
+
+The Pallas forward carries an analytic custom VJP (pallas_call itself does not
+support reverse-mode autodiff): with g = dy*w, x_hat = x*rsqrt(var+eps),
+
+    dx = r * (g - x_hat * mean(g * x_hat))
+    dw = sum_rows(dy * x_hat)
+
+computed in fp32 by XLA (bandwidth-bound elementwise + reduction — XLA fuses
+it; the win of the Pallas kernel is the fwd's guaranteed single HBM pass).
 """
 
 from __future__ import annotations
@@ -24,7 +33,7 @@ def _rms_norm_ref(x, weight=None, epsilon=1e-6):
     return out.astype(x.dtype)
 
 
-def _rms_norm_pallas(x, weight, epsilon, block_rows: int = 256):
+def _rms_norm_fwd_kernel_call(x, w, epsilon, block_rows: int = 256, interpret: bool = False):
     from jax.experimental import pallas as pl
 
     orig_shape = x.shape
@@ -40,7 +49,6 @@ def _rms_norm_pallas(x, weight, epsilon, block_rows: int = 256):
         out = xb * jax.lax.rsqrt(var + epsilon) * w_ref[...].astype(jnp.float32)
         o_ref[...] = out.astype(o_ref.dtype)
 
-    w = weight if weight is not None else jnp.ones((d,), x.dtype)
     out = pl.pallas_call(
         kernel,
         grid=(n // block_rows,),
@@ -50,8 +58,35 @@ def _rms_norm_pallas(x, weight, epsilon, block_rows: int = 256):
         ],
         out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+        interpret=interpret,
     )(xr, w)
     return out.reshape(orig_shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms_norm_pallas(x, w, epsilon, interpret=False):
+    return _rms_norm_fwd_kernel_call(x, w, epsilon, interpret=interpret)
+
+
+def _rms_fwd_rule(x, w, epsilon, interpret):
+    return _rms_norm_fwd_kernel_call(x, w, epsilon, interpret=interpret), (x, w)
+
+
+def _rms_bwd_rule(epsilon, interpret, res, dy):
+    x, w = res
+    x32 = x.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    w32 = w.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    r = jax.lax.rsqrt(var + epsilon)
+    x_hat = x32 * r
+    g = dy32 * w32
+    dx = r * (g - x_hat * jnp.mean(g * x_hat, axis=-1, keepdims=True))
+    dw = jnp.sum(dy32 * x_hat, axis=tuple(range(x.ndim - 1)))
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+_rms_norm_pallas.defvjp(_rms_fwd_rule, _rms_bwd_rule)
 
 
 def _largest_divisor(n, cap):
@@ -61,9 +96,14 @@ def _largest_divisor(n, cap):
     return 1
 
 
-def rms_norm(x, weight=None, epsilon: float = 1e-6):
+def rms_norm(x, weight=None, epsilon: float = 1e-6, interpret: bool = False):
     from . import use_pallas
 
-    if use_pallas() and x.shape[-1] % 128 == 0:
-        return _rms_norm_pallas(x, weight, epsilon)
+    kernel_ok = x.shape[-1] % 128 == 0
+    if interpret and not kernel_ok:
+        raise ValueError(
+            f"rms_norm(interpret=True) requires last dim % 128 == 0; got {x.shape[-1]}")
+    if (use_pallas() or interpret) and kernel_ok:
+        w = weight if weight is not None else jnp.ones((x.shape[-1],), x.dtype)
+        return _rms_norm_pallas(x, w, epsilon, interpret)
     return _rms_norm_ref(x, weight, epsilon)

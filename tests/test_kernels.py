@@ -39,18 +39,95 @@ def test_flash_attention_reference_path(causal):
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_pallas_interpret_matches_reference(causal):
-    """Run the Pallas kernel path in interpret-free CPU mode via direct impl call."""
+    """Pallas kernel in interpret mode on CPU — same code path as TPU."""
     rng = np.random.RandomState(1)
     B, S, H, D = 1, 256, 2, 64
     q = rng.randn(B, S, H, D).astype(np.float32)
     k = rng.randn(B, S, H, D).astype(np.float32)
     v = rng.randn(B, S, H, D).astype(np.float32)
-    try:
-        out = fa._pallas_flash(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal, 1.0 / np.sqrt(D))
-    except Exception as e:
-        pytest.skip(f"pallas unavailable on this backend: {e}")
+    out = fa._pallas_flash(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                           causal, 1.0 / np.sqrt(D), interpret=True)
     ref = _naive_attention(q, k, v, causal)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [
+    (1, 256, 2, 64),      # square S
+    (1, 128, 2, 64),      # short
+    (2, 256, 4, 128),     # head_dim 128
+])
+def test_flash_pallas_backward_interpret(causal, shape):
+    """Flash BACKWARD numerics vs the XLA reference vjp (VERDICT weak #3)."""
+    B, S, H, D = shape
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    sm = 1.0 / np.sqrt(D)
+
+    def f_pallas(q, k, v):
+        return fa._pallas_flash(q, k, v, causal, sm, interpret=True).sum()
+
+    def f_ref(q, k, v):
+        return fa._attention_reference(q, k, v, causal, None, sm).sum()
+
+    gp = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_flash_pallas_gqa_backward_interpret():
+    """GQA (kv_heads < heads) through the full public entry, fwd+bwd."""
+    B, S, H, Hk, D = 1, 256, 4, 2, 64
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, Hk, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, Hk, D).astype(np.float32))
+
+    def f(q, k, v, interp):
+        return fa.flash_attention(q, k, v, causal=True, interpret=interp).sum()
+
+    gp = jax.grad(lambda *a: f(*a, True), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: fa._attention_reference(
+        a[0], jnp.repeat(a[1], 2, axis=2), jnp.repeat(a[2], 2, axis=2),
+        True, None, 1.0 / np.sqrt(D)).sum(), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_flash_pallas_nonsquare_cross_attention_interpret():
+    """Sq != Sk (cross/prefix attention), causal offset alignment — fwd AND bwd
+    (the bwd exercises the _causal_lo/_causal_hi block-range math with a
+    nonzero Sk-Sq offset)."""
+    B, Sq, Sk, H, D = 1, 128, 256, 2, 64
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(B, Sq, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, Sk, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, Sk, H, D).astype(np.float32))
+    sm = 1.0 / np.sqrt(D)
+    for causal in (False, True):
+        out = fa._pallas_flash(q, k, v, causal, sm, interpret=True)
+        ref = fa._attention_reference(q, k, v, causal, None, sm)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+        gp = jax.grad(lambda *a: fa._pallas_flash(*a, causal, sm, interpret=True).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: fa._attention_reference(*a, causal, None, sm).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3,
+                                       err_msg=f"d{name} mismatch (causal={causal})")
+
+
+def test_flash_interpret_rejects_incompatible_shapes():
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(1, 100, 2, 32).astype(np.float32))
+    with pytest.raises(ValueError, match="kernel-compatible"):
+        fa.flash_attention(q, q, q, interpret=True)
 
 
 def test_flash_gqa_head_repeat():
@@ -82,6 +159,28 @@ def test_rms_norm_kernel():
     out = krms.rms_norm(jnp.asarray(x), jnp.asarray(w))
     ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+
+def test_rms_norm_pallas_interpret_fwd_bwd():
+    """Pallas RMSNorm (interpret) + analytic custom-vjp vs autodiff oracle."""
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(16, 128).astype(np.float32))
+    w = jnp.asarray(rng.randn(128).astype(np.float32))
+
+    out = krms.rms_norm(x, w, interpret=True)
+    ref = krms._rms_norm_ref(x, w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+    def f_pallas(x, w):
+        return (krms.rms_norm(x, w, interpret=True) * 1.7).sum()
+
+    def f_ref(x, w):
+        return (krms._rms_norm_ref(x, w) * 1.7).sum()
+
+    gp = jax.grad(f_pallas, argnums=(0, 1))(x, w)
+    gr = jax.grad(f_ref, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gp[0]), np.asarray(gr[0]), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(gr[1]), rtol=1e-4, atol=1e-5)
 
 
 def test_rope_rotation_properties():

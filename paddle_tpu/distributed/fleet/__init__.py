@@ -132,7 +132,9 @@ def distributed_model(model):
     from ..parallel.pipeline import PipelineLayer, PipelineParallel
 
     hcg = get_hybrid_communicate_group()
-    if hcg is not None and isinstance(model, PipelineLayer) and hcg.get_pipe_parallel_world_size() > 1:
+    if hcg is not None and hcg.get_pipe_parallel_world_size() > 1 and (
+        isinstance(model, PipelineLayer) or PipelineParallel._is_pipeline_capable(model)
+    ):
         return PipelineParallel(model, hcg)
     return model
 

@@ -106,53 +106,62 @@ class PipelineLayer(Layer):
 
 def pipeline_spmd_step(block_fn: Callable, n_stages: int, n_micro: int, axis_name: str = "pp",
                        remat: bool = True):
-    """Build a GPipe schedule as a pure function.
+    """Build a GPipe schedule as a pure function FOR USE INSIDE ``shard_map``
+    (manual over ``axis_name``; other mesh axes stay GSPMD-automatic).
 
-    block_fn(stage_params, x) -> y   runs ONE stage's body on one microbatch.
+    ``block_fn(stage_params, x, *extra) -> y`` runs ONE stage's body on one
+    microbatch.  Returns ``schedule(stage_params_local, micro_inputs, *extra)``:
 
-    Returns ``schedule(stacked_params, micro_inputs) -> outputs`` where
-    - stacked_params: pytree with leading [n_stages] axis (shard over 'pp'),
-    - micro_inputs:   [n_micro, micro_batch, ...] activations entering stage 0,
-    - outputs:        [n_micro, micro_batch, ...] activations leaving the last stage.
+    - stage_params_local: this device's stage-param shard (leading [1] pp axis
+      still present — block_fn strips it),
+    - micro_inputs: [n_micro, mb, ...] activations entering stage 0
+      (pp-replicated operand),
+    - returns [1, n_micro, mb, ...] — only the LAST stage's row holds the
+      pipeline output (out_specs P('pp'), caller takes index -1).
 
-    Must be called inside ``shard_map`` (see ``models.llama_pp``) or wrapped by
-    the caller; here we use jax.lax primitives only so it inlines anywhere.
+    Schedule: T = n_micro + n_stages - 1 ticks under ``lax.scan``; activations
+    rotate stage->stage+1 with ``ppermute`` each tick.  Autodiff through the
+    scan gives the backward pipeline; with ``remat`` the saved state per tick
+    is one microbatch activation — the activation bound 1F1B+recompute has
+    (reference ``pipeline_parallel.py:575`` forward_backward_pipeline).
     """
     if remat:
         block_fn = jax.checkpoint(block_fn)
 
-    def schedule(stage_params, micro_inputs, stage_index):
-        # stage_params: this device's stage params (leading axis already split)
-        # micro_inputs: full [n_micro, ...] batch (only stage 0 consumes)
+    def schedule(stage_params, micro_inputs, *extra):
+        stage = jax.lax.axis_index(axis_name)
         T = n_micro + n_stages - 1
         mb_shape = micro_inputs.shape[1:]
-        state = jnp.zeros(mb_shape, micro_inputs.dtype)
-        outputs = jnp.zeros((n_micro,) + mb_shape, micro_inputs.dtype)
+        # the carry becomes stage-dependent after tick 1; mark it varying over
+        # the pp axis up front so scan's carry type is stable (JAX vma typing)
+        state0 = jax.lax.pcast(jnp.zeros(mb_shape, micro_inputs.dtype),
+                               (axis_name,), to="varying")
+        out0 = jax.lax.pcast(jnp.zeros((n_micro,) + mb_shape, micro_inputs.dtype),
+                             (axis_name,), to="varying")
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def tick(carry, t):
             state, outputs = carry
-            # stage 0 ingests microbatch t (if any)
-            incoming = jax.lax.dynamic_index_in_dim(micro_inputs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
-            state = jnp.where(stage_index == 0, jnp.where(t < n_micro, incoming, state), state)
-            active = (t >= stage_index) & (t - stage_index < n_micro)
-            new_state = block_fn(stage_params, state)
+            # stage 0 ingests microbatch t while any remain
+            incoming = jax.lax.dynamic_index_in_dim(
+                micro_inputs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+            state = jnp.where((stage == 0) & (t < n_micro), incoming, state)
+            # stage s is active at tick t iff microbatch t-s is in range
+            active = (t >= stage) & (t - stage < n_micro)
+            new_state = block_fn(stage_params, state, *extra)
             state = jnp.where(active, new_state, state)
             # last stage emits microbatch t - (n_stages - 1)
             out_idx = t - (n_stages - 1)
-            emit = (stage_index == n_stages - 1) & (out_idx >= 0)
-            outputs = jax.lax.cond(
-                emit,
-                lambda o: jax.lax.dynamic_update_index_in_dim(o, state, jnp.clip(out_idx, 0, n_micro - 1), 0),
-                lambda o: o,
-                outputs,
-            )
+            emit = (stage == n_stages - 1) & (out_idx >= 0)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                outputs, state, jnp.clip(out_idx, 0, n_micro - 1), 0)
+            outputs = jnp.where(emit, updated, outputs)
             # rotate activations to the next stage over ICI
             state = jax.lax.ppermute(state, axis_name, perm)
             return (state, outputs), None
 
-        (state, outputs), _ = jax.lax.scan(tick, (state, outputs), jnp.arange(T))
-        return outputs
+        (_, outputs), _ = jax.lax.scan(tick, (state0, out0), jnp.arange(T))
+        return outputs[None]  # local [1, n_micro, ...] -> global [pp, n_micro, ...]
 
     return schedule
 
@@ -160,24 +169,57 @@ def pipeline_spmd_step(block_fn: Callable, n_stages: int, n_micro: int, axis_nam
 class PipelineParallel(Layer):
     """Runtime wrapper chosen by ``fleet.distributed_model`` (reference
     ``pipeline_parallel.py:255``).  ``train_batch`` compiles the full pipeline
-    step (fwd+bwd+opt) on first use."""
+    step (fwd+bwd+opt) on first use.
 
-    def __init__(self, layers: PipelineLayer, hcg, strategy=None):
+    A model is pipeline-capable when its ``forward`` itself runs the compiled
+    pipeline schedule over the 'pp' mesh axis — e.g.
+    ``models.llama_pp.LlamaForCausalLMPipe`` (stacked stage params +
+    ``pipeline_spmd_step`` under ``shard_map``).  Wrapping a model with NO
+    pipeline forward while pp_degree > 1 raises: silently training
+    unpipelined (round-1 behavior) hid a correctness/perf lie.
+    """
+
+    def __init__(self, layers, hcg, strategy=None):
         super().__init__()
         self._layers = layers
         self._hcg = hcg
         self._compiled = None
+        self._compiled_key = None
+        pp_degree = hcg.get_pipe_parallel_world_size() if hcg is not None else 1
+        if pp_degree > 1 and not self._is_pipeline_capable(layers):
+            raise ValueError(
+                f"pp_degree={pp_degree} but {type(layers).__name__} does not run a "
+                "pipeline schedule in forward. Use a pipe model (e.g. "
+                "models.llama_pp.LlamaForCausalLMPipe) or build one from "
+                "pipeline_spmd_step; see distributed/parallel/pipeline.py.")
 
-    def forward(self, x):
-        return self._layers(x)
+    @staticmethod
+    def _is_pipeline_capable(model) -> bool:
+        # explicit opt-in flag only — duck-typing on generic attribute names
+        # would let unrelated models defeat the guard
+        return bool(getattr(model, "_pipeline_capable", False))
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None, loss_fn=None):
         from ...jit import TrainStep
 
+        if scaler is not None and getattr(scaler, "_enable", False):
+            raise NotImplementedError(
+                "GradScaler inside the compiled pipeline step is not supported; "
+                "bf16 training on TPU needs no loss scaling")
         inputs, labels = data
-        if self._compiled is None:
-            lf = loss_fn or (lambda model, x, y: self._layers._loss_fn(model(x), y))
+        cache_key = (id(optimizer), id(loss_fn))
+        if self._compiled is None or self._compiled_key != cache_key:
+            if loss_fn is not None:
+                lf = loss_fn
+            elif hasattr(self._layers, "compute_loss"):
+                lf = lambda model, x, y: model.compute_loss(model(x), y)
+            else:
+                lf = lambda model, x, y: self._layers._loss_fn(model(x), y)
             self._compiled = TrainStep(self._layers, lf, optimizer)
+            self._compiled_key = cache_key
         loss = self._compiled(inputs, labels)
         if lr_scheduler is not None:
             lr_scheduler.step()

@@ -264,7 +264,17 @@ class Tensor:
         arr = _to_jax_array(value, dtype=self.dtype)
         if tuple(arr.shape) != tuple(self._data.shape):
             raise ValueError(f"set_value shape mismatch: {arr.shape} vs {self._data.shape}")
-        self._data = arr.astype(self.dtype)
+        arr = arr.astype(self.dtype)
+        if self._dist_attr is not None:
+            # keep the dist placement: loading weights must not silently
+            # collapse a sharded parameter onto one device
+            import jax as _jax
+
+            from ..distributed.placement import named_sharding
+
+            mesh, placements = self._dist_attr
+            arr = _jax.device_put(arr, named_sharding(mesh, placements, arr.ndim))
+        self._data = arr
 
     def copy_(self, other, blocking=True):
         self.set_value(other)

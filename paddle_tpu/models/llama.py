@@ -165,6 +165,35 @@ class LlamaRMSNorm(Layer):
         return F.rms_norm(x, self.weight, self.epsilon)
 
 
+def attention_fn(hidden, w_qkv, w_o, cos, sin, cfg: LlamaConfig, position_ids=None):
+    """Pure GQA attention over raw arrays: fused qkv matmul, rope, flash (or
+    XLA reference) causal attention, output projection.  Shared by the
+    sequential model and the pipeline model (``llama_pp``)."""
+    h, hk, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    B, S, _ = hidden.shape
+    qkv = hidden @ w_qkv.astype(hidden.dtype)
+    q, k, v = jnp.split(qkv, [h * d, (h + hk) * d], axis=-1)
+    q = q.reshape(B, S, h, d)
+    k = k.reshape(B, S, hk, d)
+    v = v.reshape(B, S, hk, d)
+    q, k = rope_mod.apply_rope(q, k, cos, sin, position_ids)
+    if cfg.use_flash_attention:
+        o = fa_mod.flash_attention(q, k, v, causal=True)
+    else:
+        rep = h // hk
+        o = fa_mod._attention_reference(
+            q, jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2),
+            True, None, 1.0 / math.sqrt(d))
+    return o.reshape(B, S, h * d) @ w_o.astype(hidden.dtype)
+
+
+def mlp_fn(hidden, w_gate_up, w_down, intermediate_size: int):
+    """Pure SwiGLU MLP over raw arrays with fused gate_up matmul."""
+    gu = hidden @ w_gate_up.astype(hidden.dtype)
+    gate, up = jnp.split(gu, [intermediate_size], axis=-1)
+    return (jax.nn.silu(gate) * up) @ w_down.astype(hidden.dtype)
+
+
 class LlamaAttention(Layer):
     """GQA attention with fused qkv and rope; flash attention on TPU.
 
@@ -184,30 +213,12 @@ class LlamaAttention(Layer):
             [h * d, config.hidden_size], dtype=config.dtype, default_initializer=init)
         _shard_param(self.qkv_proj, mesh, 1)
         _shard_param(self.o_proj, mesh, 0)
-        self.num_heads = h
-        self.kv_heads = hk
-        self.head_dim = d
 
     def forward(self, x, cos, sin, position_ids=None):
-        h, hk, d = self.num_heads, self.kv_heads, self.head_dim
-        use_flash = self.config.use_flash_attention
+        cfg = self.config
 
         def attn(hidden, w_qkv, w_o, cos_t, sin_t):
-            B, S, _ = hidden.shape
-            qkv = hidden @ w_qkv.astype(hidden.dtype)
-            q, k, v = jnp.split(qkv, [h * d, (h + hk) * d], axis=-1)
-            q = q.reshape(B, S, h, d)
-            k = k.reshape(B, S, hk, d)
-            v = v.reshape(B, S, hk, d)
-            q, k = rope_mod.apply_rope(q, k, cos_t, sin_t, position_ids)
-            if use_flash:
-                o = fa_mod.flash_attention(q, k, v, causal=True)
-            else:
-                rep = h // hk
-                o = fa_mod._attention_reference(
-                    q, jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2),
-                    True, None, 1.0 / math.sqrt(d))
-            return o.reshape(B, S, h * d) @ w_o.astype(hidden.dtype)
+            return attention_fn(hidden, w_qkv, w_o, cos_t, sin_t, cfg, position_ids)
 
         return apply_op("scaled_dot_product_attention", attn,
                         (x, self.qkv_proj, self.o_proj, cos, sin), {})
@@ -233,9 +244,7 @@ class LlamaMLP(Layer):
         inter = self.intermediate_size
 
         def mlp(hidden, w_gu, w_d):
-            gu = hidden @ w_gu.astype(hidden.dtype)
-            gate, up = jnp.split(gu, [inter], axis=-1)
-            return (jax.nn.silu(gate) * up) @ w_d.astype(hidden.dtype)
+            return mlp_fn(hidden, w_gu, w_d, inter)
 
         return apply_op("swiglu_mlp", mlp, (x, self.gate_up_proj, self.down_proj), {})
 

@@ -1,0 +1,90 @@
+"""Pipeline-parallel runtime tests (VERDICT item 4): GPipe schedule under
+shard_map over the 'pp' axis, parity vs the sequential model, wired
+train_batch, and the not-actually-pipelined guard.
+
+Reference: ``fleet/meta_parallel/pipeline_parallel.py:255,575``,
+``pp_layers.py:257``.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed.fleet as fleet
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.models.llama_pp import LlamaForCausalLMPipe
+
+
+@pytest.fixture
+def pp_fleet():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "pp_degree": 2, "mp_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    yield fleet
+    from paddle_tpu.distributed.mesh import set_global_mesh
+    set_global_mesh(None)
+
+
+def _ids(cfg, bsz=4, seq=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return paddle.to_tensor(rng.integers(0, cfg.vocab_size, size=(bsz, seq)).astype(np.int32))
+
+
+def test_pipe_forward_backward_parity(pp_fleet):
+    cfg = llama_tiny_config()
+    paddle.seed(0)
+    seq_model = LlamaForCausalLM(cfg, mesh=None)
+    pipe = LlamaForCausalLMPipe(cfg, n_microbatches=2)
+    pipe.load_from_sequential(seq_model)
+
+    ids = _ids(cfg)
+    lp = pipe.compute_loss(pipe(ids), ids)
+    ls = seq_model.compute_loss(seq_model(ids), ids)
+    assert abs(lp.item() - ls.item()) < 1e-3
+    lp.backward()
+    ls.backward()
+    np.testing.assert_allclose(np.asarray(pipe.embed_tokens._grad),
+                               np.asarray(seq_model.llama.embed_tokens._grad),
+                               rtol=1e-3, atol=1e-5)
+    # stacked decoder grads exist and are pp-sharded
+    g = pipe.qkv_w._grad
+    assert g is not None and g.shape[0] == 2
+
+
+def test_pipe_stacked_param_shardings(pp_fleet):
+    cfg = llama_tiny_config()
+    pipe = LlamaForCausalLMPipe(cfg, n_microbatches=2)
+    spec = pipe.qkv_w._data.sharding.spec
+    assert spec[0] == "pp", spec
+    assert "mp" in str(spec), spec  # TP composes on the matmul dim
+
+
+def test_pipe_train_batch_loss_decreases(pp_fleet):
+    cfg = llama_tiny_config()
+    paddle.seed(0)
+    pipe = LlamaForCausalLMPipe(cfg, n_microbatches=2)
+    model = fleet.distributed_model(pipe)
+    from paddle_tpu.distributed.parallel.pipeline import PipelineParallel
+    assert isinstance(model, PipelineParallel)
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=pipe.parameters())
+    ids = _ids(cfg)
+    losses = [float(model.train_batch((ids, ids), opt).numpy()) for _ in range(10)]
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_unpipelined_model_raises(pp_fleet):
+    cfg = llama_tiny_config()
+    paddle.seed(0)
+    seq_model = LlamaForCausalLM(cfg, mesh=None)
+    from paddle_tpu.distributed.parallel.pipeline import PipelineParallel
+    with pytest.raises(ValueError, match="pipeline"):
+        PipelineParallel(seq_model, fleet.get_hybrid_communicate_group())
+
+
+def test_pipe_microbatch_validation(pp_fleet):
+    cfg = llama_tiny_config()
+    pipe = LlamaForCausalLMPipe(cfg, n_microbatches=3)
+    ids = _ids(cfg, bsz=4)  # 4 % 3 != 0
+    with pytest.raises(ValueError, match="divisible"):
+        pipe(ids)

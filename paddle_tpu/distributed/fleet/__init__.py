@@ -39,7 +39,10 @@ class DistributedStrategy:
             "pp_degree": 1,
             "sharding_degree": 1,
             "sep_degree": 1,
-            "order": ["dp", "pp", "sharding", "sep", "mp"],
+            "ep_degree": 1,  # expert parallel (TPU extension of the reference's
+            #                  5-axis order; the reference keeps MoE groups out
+            #                  of topology, incubate/distributed/models/moe)
+            "order": ["dp", "pp", "sharding", "sep", "ep", "mp"],
             "mp_configs": {},
             "pp_configs": {},
         }
@@ -73,7 +76,13 @@ class Fleet:
         init_parallel_env()
         self._strategy = strategy or DistributedStrategy()
         hc = self._strategy.hybrid_configs
-        order = hc.get("order", ["dp", "pp", "sharding", "sep", "mp"])
+        order = hc.get("order", ["dp", "pp", "sharding", "sep", "ep", "mp"])
+        for key, val in hc.items():
+            if key.endswith("_degree") and int(val) > 1 and key[:-len("_degree")] not in order:
+                raise ValueError(
+                    f"hybrid_configs sets {key}={val} but axis {key[:-len('_degree')]!r} "
+                    f"is not in order={order}; add it to 'order' (parallelism would "
+                    "otherwise be silently disabled)")
         degrees = {ax: int(hc.get(f"{ax}_degree", 1)) for ax in order}
         total = int(np.prod(list(degrees.values())))
         import jax

@@ -72,6 +72,13 @@ class LlamaConfig:
     sequence_parallel: bool = False  # shard seq dim over 'mp' between blocks
     use_flash_attention: bool = True
     recompute: bool = False          # jax.checkpoint each decoder layer
+    # MoE (Qwen2-MoE / DeepSeekMoE shape, BASELINE configs[4]): >1 turns the
+    # MLP into an expert-parallel MoE FFN (incubate.moe.MoELayer over 'ep')
+    moe_num_experts: int = 1
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_gate: str = "gshard"
+    moe_aux_loss_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -255,17 +262,35 @@ class LlamaDecoderLayer(Layer):
         self.input_layernorm = LlamaRMSNorm(config)
         self.self_attn = LlamaAttention(config, mesh)
         self.post_attention_layernorm = LlamaRMSNorm(config)
-        self.mlp = LlamaMLP(config, mesh)
+        if config.moe_num_experts > 1:
+            from ..incubate.moe import MoELayer
+
+            self.mlp = MoELayer(
+                config.hidden_size, config.intermediate_size, config.moe_num_experts,
+                top_k=config.moe_top_k, capacity_factor=config.moe_capacity_factor,
+                gate=config.moe_gate, mesh=mesh, dtype=config.dtype)
+        else:
+            self.mlp = LlamaMLP(config, mesh)
+        self._is_moe = config.moe_num_experts > 1
         self._mesh = mesh
         self._sp = config.sequence_parallel
 
     def forward(self, x, cos, sin, position_ids=None):
+        """MoE configs return ``(x, aux_loss)`` so the router's load-balancing
+        loss flows FUNCTIONALLY through jit/checkpoint boundaries; dense
+        configs return just ``x``."""
         h = self.self_attn(self.input_layernorm(x), cos, sin, position_ids)
         x = x + h
         x = _constrain_hidden(x, self._mesh, self._sp)
-        h = self.mlp(self.post_attention_layernorm(x))
+        if self._is_moe:
+            h, aux = self.mlp.forward_with_aux(self.post_attention_layernorm(x))
+        else:
+            h = self.mlp(self.post_attention_layernorm(x))
+            aux = None
         x = x + h
         x = _constrain_hidden(x, self._mesh, self._sp)
+        if self._is_moe:
+            return x, aux
         return x
 
 
@@ -288,17 +313,32 @@ class LlamaModel(Layer):
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
     def forward(self, input_ids, position_ids=None):
+        """Returns the final hidden states; for MoE configs returns
+        ``(hidden, aux_loss_total)``."""
         x = F.embedding(input_ids, self.embed_tokens)
         x = _constrain_hidden(x, self._mesh, self.config.sequence_parallel)
         cos, sin = self.rope_cos, self.rope_sin
+        is_moe = self.config.moe_num_experts > 1
+        aux_total = None
         if self.config.recompute:
             from ..distributed.fleet.recompute import recompute as _rc
             for layer in self.layers:
-                x = _rc(layer, x, cos, sin, position_ids)
+                out = _rc(layer, x, cos, sin, position_ids)
+                x, aux_total = self._merge_aux(out, aux_total, is_moe)
         else:
             for layer in self.layers:
-                x = layer(x, cos, sin, position_ids)
+                out = layer(x, cos, sin, position_ids)
+                x, aux_total = self._merge_aux(out, aux_total, is_moe)
+        if is_moe:
+            return self.norm(x), aux_total
         return self.norm(x)
+
+    @staticmethod
+    def _merge_aux(out, aux_total, is_moe):
+        if not is_moe:
+            return out, None
+        x, aux = out
+        return x, aux if aux_total is None else aux_total + aux
 
 
 class LlamaForCausalLM(Layer):
@@ -320,7 +360,12 @@ class LlamaForCausalLM(Layer):
             _shard_param(self.lm_head, mesh, 1)
 
     def forward(self, input_ids, position_ids=None):
-        x = self.llama(input_ids, position_ids)
+        out = self.llama(input_ids, position_ids)
+        if self.config.moe_num_experts > 1:
+            x, self._moe_aux = out  # consumed by compute_loss in the SAME trace
+        else:
+            x = out
+            self._moe_aux = None
         w = self.lm_head
 
         if w is None:
@@ -349,4 +394,10 @@ class LlamaForCausalLM(Layer):
             mask = (lb != ignore_index).astype(jnp.float32)
             return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
-        return apply_op("cross_entropy", ce, (logits,), {})
+        loss = apply_op("cross_entropy", ce, (logits,), {})
+        if self.config.moe_num_experts > 1 and getattr(self, "_moe_aux", None) is not None:
+            # the routers' load-balancing total from THIS forward (threaded
+            # functionally through the decoder chain; forward and compute_loss
+            # must run in the same trace, which TrainStep's loss_fn does)
+            loss = loss + self.config.moe_aux_loss_weight * self._moe_aux
+        return loss

@@ -1,0 +1,228 @@
+"""MoE / expert-parallel tests (VERDICT item 6): gating semantics, dense
+-dispatch oracle parity, EP sharding on the CPU mesh, aux-loss gradients.
+
+Reference: ``incubate/distributed/models/moe/moe_layer.py:119-190``,
+``moe/gate/``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.moe import MoELayer, top_k_gating
+
+
+def _dense_oracle(tokens, wg, w_gate_up, w_down, top_k):
+    """Every token runs through its top-k experts with renormalized gates —
+    no capacity, no dispatch tensors.  Experts are bias-free SwiGLU (the
+    Qwen2-MoE/DeepSeekMoE shape).  Ground truth when capacity is ample."""
+    T, d = tokens.shape
+    dh = w_down.shape[1]
+    probs = np.asarray(jax.nn.softmax(tokens.astype(np.float32) @ wg, axis=-1))
+    out = np.zeros((T, d), np.float32)
+    for t in range(T):
+        idx = np.argsort(-probs[t])[:top_k]
+        gates = probs[t, idx] / probs[t, idx].sum()
+        for g, e in zip(gates, idx):
+            gu = tokens[t] @ w_gate_up[e]
+            gate_act, up = gu[:dh], gu[dh:]
+            h = np.asarray(jax.nn.silu(gate_act)) * up
+            out[t] += g * (h @ w_down[e])
+    return out
+
+
+def test_gating_shapes_and_capacity():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(16, 4)).astype(np.float32))
+    combine, dispatch, aux = top_k_gating(logits, top_k=2, capacity=8, gate_type="naive")
+    assert combine.shape == (16, 4, 8)
+    assert dispatch.shape == (16, 4, 8)
+    # each token dispatched to at most top_k slots
+    per_token = np.asarray(dispatch).sum(axis=(1, 2))
+    assert (per_token <= 2).all()
+    # no expert queue exceeds capacity
+    per_slot = np.asarray(dispatch).sum(axis=0)  # [E, C] each slot used <= once
+    assert (per_slot <= 1).all()
+    # combine weights of a kept token sum to ~1
+    csum = np.asarray(combine).sum(axis=(1, 2))
+    kept = per_token == 2
+    np.testing.assert_allclose(csum[kept], 1.0, rtol=1e-5)
+
+
+def test_switch_gate_top1():
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
+    combine, dispatch, aux = top_k_gating(logits, top_k=2, capacity=16, gate_type="switch")
+    per_token = np.asarray(dispatch).sum(axis=(1, 2))
+    assert (per_token <= 1).all()
+    assert float(aux) > 0
+
+
+def test_capacity_drops_tokens():
+    # all tokens prefer expert 0; capacity 2 keeps only the first 2
+    logits = jnp.asarray(np.tile([10.0, 0.0], (8, 1)).astype(np.float32))
+    combine, dispatch, aux = top_k_gating(logits, top_k=1, capacity=2, gate_type="naive")
+    kept = np.asarray(dispatch)[:, 0, :].sum(axis=1)
+    assert kept[:2].sum() == 2 and kept[2:].sum() == 0
+
+
+def test_moe_layer_matches_dense_oracle():
+    paddle.seed(0)
+    layer = MoELayer(d_model=16, d_hidden=32, num_experts=4, top_k=2,
+                     capacity_factor=8.0, gate="naive", mesh=None)
+    rng = np.random.default_rng(2)
+    x = paddle.to_tensor(rng.normal(size=(2, 8, 16)).astype(np.float32))
+    out = layer(x)
+    oracle = _dense_oracle(
+        np.asarray(x._data).reshape(-1, 16),
+        np.asarray(layer.gate_weight._data), np.asarray(layer.w_gate_up._data),
+        np.asarray(layer.w_down._data), top_k=2)
+    np.testing.assert_allclose(out.numpy().reshape(-1, 16), oracle, rtol=1e-3, atol=1e-4)
+
+
+def test_moe_backward_and_aux_loss():
+    paddle.seed(0)
+    layer = MoELayer(d_model=16, d_hidden=32, num_experts=4, top_k=2,
+                     capacity_factor=4.0, gate="switch", mesh=None)
+    x = paddle.to_tensor(np.random.default_rng(3).normal(size=(2, 8, 16)).astype(np.float32))
+    out = layer(x)
+    loss = (out ** 2).mean() + 0.01 * layer.aux_loss
+    loss.backward()
+    assert layer.w_gate_up._grad is not None
+    assert layer.gate_weight._grad is not None  # grads flow through routing
+
+
+def test_moe_expert_parallel_mesh():
+    import paddle_tpu.distributed.fleet as fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "ep_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(0)
+        layer = MoELayer(d_model=16, d_hidden=32, num_experts=8, top_k=2,
+                         capacity_factor=8.0, gate="naive")
+        assert "ep" in str(layer.w_gate_up._data.sharding.spec)
+        # oracle parity still holds with ep-sharded experts
+        rng = np.random.default_rng(4)
+        x = paddle.to_tensor(rng.normal(size=(4, 8, 16)).astype(np.float32))
+        out = layer(x)
+        oracle = _dense_oracle(
+            np.asarray(x._data).reshape(-1, 16),
+            np.asarray(layer.gate_weight._data), np.asarray(layer.w_gate_up._data),
+            np.asarray(layer.w_down._data), top_k=2)
+        np.testing.assert_allclose(out.numpy().reshape(-1, 16), oracle, rtol=1e-3, atol=1e-4)
+
+        # compiled train step over the mesh: loss decreases
+        import paddle_tpu.nn as nn
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.moe = layer
+                self.head = nn.Linear(16, 1)
+
+            def forward(self, x):
+                return self.head(self.moe(x))
+
+        net = Net()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=net.parameters())
+        X = rng.normal(size=(4, 8, 16)).astype(np.float32)
+        Y = X.sum(axis=-1, keepdims=True).astype(np.float32)
+
+        def loss_fn(m, x, y):
+            return ((m(x) - y) ** 2).mean()
+
+        step = paddle.jit.TrainStep(net, loss_fn, opt)
+        losses = [float(step(paddle.to_tensor(X), paddle.to_tensor(Y)).numpy())
+                  for _ in range(10)]
+        assert losses[-1] < losses[0]
+    finally:
+        from paddle_tpu.distributed.mesh import set_global_mesh
+        set_global_mesh(None)
+
+
+def test_llama_moe_trains():
+    """Qwen2-MoE-shaped Llama variant (BASELINE configs[4]) trains end-to-end
+    on a dp x ep mesh."""
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "ep_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(0)
+        cfg = llama_tiny_config(moe_num_experts=4, moe_gate="switch",
+                                moe_capacity_factor=4.0)
+        model = LlamaForCausalLM(cfg)
+        assert any("ep" in str(getattr(p._data.sharding, "spec", ""))
+                   for p in model.parameters())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+
+        def loss_fn(m, ids):
+            return m.compute_loss(m(ids), ids)
+
+        step = paddle.jit.TrainStep(model, loss_fn, opt)
+        ids = paddle.to_tensor(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, size=(4, 64)).astype(np.int32))
+        losses = [float(step(ids).numpy()) for _ in range(10)]
+        assert losses[-1] < losses[0] - 0.5, losses
+    finally:
+        from paddle_tpu.distributed.mesh import set_global_mesh
+        set_global_mesh(None)
+
+
+def test_llama_moe_with_recompute():
+    """MoE + recompute: the aux loss must flow FUNCTIONALLY through the
+    jax.checkpoint boundary (previously crashed with UnexpectedTracerError)."""
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+
+    paddle.seed(0)
+    cfg = llama_tiny_config(moe_num_experts=4, moe_gate="switch",
+                            moe_capacity_factor=4.0, recompute=True)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.SGD(learning_rate=1e-2, parameters=model.parameters())
+
+    def loss_fn(m, ids):
+        return m.compute_loss(m(ids), ids)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, size=(2, 64)).astype(np.int32))
+    l0 = float(step(ids).numpy())
+    assert np.isfinite(l0)
+    # eager recompute path: router grads flow (aux is a recompute output)
+    loss = loss_fn(model, ids)
+    loss.backward()
+    g = model.llama.layers[0].mlp.gate_weight._grad
+    assert g is not None and float(jnp.abs(g).sum()) > 0
+
+
+def test_fleet_init_rejects_axis_missing_from_order():
+    import paddle_tpu.distributed.fleet as fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"order": ["dp", "pp", "sharding", "sep", "mp"],
+                               "ep_degree": 4}
+    with pytest.raises(ValueError, match="ep"):
+        fleet.init(is_collective=True, strategy=strategy)
+
+
+def test_dispatch_all_to_all_resharding():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.incubate.moe import dispatch_all_to_all
+
+    mesh = dist.ProcessMesh(np.arange(8).reshape(8,), ["ep"])
+    E, C, d = 8, 16, 4
+    x = jnp.asarray(np.random.default_rng(5).normal(size=(E, C, d)).astype(np.float32))
+    # tokens-sharded layout: capacity dim split over ep
+    xs = jax.device_put(x, jax.sharding.NamedSharding(
+        mesh.jax_mesh, jax.sharding.PartitionSpec(None, "ep")))
+    out = dispatch_all_to_all(xs, mesh)
+    # global values unchanged; sharding moved from capacity dim to expert dim
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+    spec = tuple(out.sharding.spec)
+    assert spec and spec[0] == "ep" and all(s is None for s in spec[1:])

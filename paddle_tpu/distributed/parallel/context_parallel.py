@@ -1,0 +1,167 @@
+"""Context parallelism: ring attention over a sequence-sharded mesh axis.
+
+The reference snapshot has NO ring attention / Ulysses (SURVEY §2.3: CP row
+"ABSENT") — its long-context tools are Megatron-SP + the SEP axis
+(``fleet/utils/sequence_parallel_utils.py``, ``meta_parallel/
+segment_parallel.py:26``).  This module is the capability upgrade SURVEY §5
+requires: true context parallelism so attention itself scales past one chip's
+sequence capacity.
+
+Design (Ring Attention, Liu et al. 2023, built TPU-first):
+
+- q, k, v are sharded over the sequence dim on the ``sep`` mesh axis (the
+  reference's segment-parallel axis doubles as the CP axis here);
+- ``shard_map`` manual over 'sep': each device computes blockwise attention
+  of its LOCAL q block against a ROTATING k/v block, accumulating with the
+  online-softmax (running max / running sum) combine;
+- k/v rotate around the ring with ``lax.ppermute`` over ICI each step —
+  compute and the next block's transfer overlap under XLA's async
+  collectives;
+- causal masking is block-aware: a device's q block skips k blocks from its
+  future, attends causally to its own block, fully to past blocks.  Autodiff
+  through the ``lax.scan`` ring gives the backward ring (reverse ppermute)
+  for free.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ...framework.dispatch import apply_op
+from ...framework.tensor import Tensor
+from ..mesh import ProcessMesh, get_mesh
+
+__all__ = ["ring_attention"]
+
+NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, sm_scale, mode):
+    """One q block vs one k/v block in fp32.
+
+    q: [B, Sq, H, D]; k, v: [B, Sk, H, D] (kv heads already repeated).
+    mode: 0 = full attention, 1 = causal (diagonal block), 2 = skip (future).
+    Returns unnormalized (acc [B, H, Sq, D], m [B, H, Sq], l [B, H, Sq]):
+    acc = sum_k exp(s - m) v,  l = sum_k exp(s - m),  m = rowwise max score.
+    Skipped blocks return l = 0 so they add nothing in the combine.
+    """
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)  # [B, H, Sq, D]
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * sm_scale
+    Sq, Sk = s.shape[-2], s.shape[-1]
+    causal_mask = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+    s = jnp.where(mode == 1, jnp.where(causal_mask, s, NEG_INF), s)
+    s = jnp.where(mode == 2, NEG_INF, s)
+    m = jnp.max(s, axis=-1)
+    masked_row = m <= NEG_INF / 2  # every score masked (skip block / top-left causal rows)
+    m_safe = jnp.where(masked_row, 0.0, m)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    return acc, jnp.where(masked_row, NEG_INF, m_safe), l
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=64)
+def _build_ring_fn(mesh: ProcessMesh, axis_name: str, cp: int, causal: bool,
+                   rep: int, scale: float):
+    """Build (once per configuration) the jitted shard_map ring attention —
+    rebuilding per call would recompile the whole cp-step scan every step."""
+
+    def ring_body(q_loc, k_loc, v_loc):
+        """Local blocks [B, S/cp, H, D]; manual over the cp axis."""
+        my = jax.lax.axis_index(axis_name)
+        B, Sq, Hh, D = q_loc.shape
+        perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+        def vary(x):
+            return jax.lax.pcast(x, (axis_name,), to="varying")
+
+        def step(carry, s_idx):
+            acc, m_run, l_run, kc, vc = carry
+            # kc originated on device (my - s_idx) mod cp
+            src = (my - s_idx) % cp
+            if causal:
+                mode = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
+            else:
+                mode = jnp.zeros((), jnp.int32)
+            blk_acc, blk_m, blk_l = _block_attention(q_loc, kc, vc, scale, mode)
+            m_new = jnp.maximum(m_run, blk_m)
+            # fully-masked blocks carry m = NEG_INF and l = 0: their beta
+            # weight underflows to 0, adding nothing
+            alpha = jnp.exp(jnp.maximum(m_run - m_new, NEG_INF))
+            beta = jnp.exp(jnp.maximum(blk_m - m_new, NEG_INF))
+            acc = acc * alpha[..., None] + blk_acc * beta[..., None]
+            l_new = l_run * alpha + blk_l * beta
+            kc = jax.lax.ppermute(kc, axis_name, perm)
+            vc = jax.lax.ppermute(vc, axis_name, perm)
+            return (acc, m_new, l_new, kc, vc), None
+
+        acc0 = vary(jnp.zeros((B, Hh, Sq, D), jnp.float32))
+        m0 = vary(jnp.full((B, Hh, Sq), NEG_INF, jnp.float32))
+        l0 = vary(jnp.zeros((B, Hh, Sq), jnp.float32))
+        (acc, _, l_run, _, _), _ = jax.lax.scan(
+            step, (acc0, m0, l0, k_loc, v_loc), jnp.arange(cp))
+        out = acc / jnp.maximum(l_run, 1e-30)[..., None]
+        return jnp.swapaxes(out, 1, 2).astype(q_loc.dtype)  # [B, Sq, H, D]
+
+    seq_spec = PartitionSpec(None, axis_name)
+    sm_fn = jax.shard_map(ring_body, mesh=mesh.jax_mesh,
+                          in_specs=(seq_spec, seq_spec, seq_spec),
+                          out_specs=seq_spec,
+                          axis_names={axis_name})
+
+    @jax.jit
+    def fn(qd, kd, vd):
+        # GQA repeat inside the traced fn so k/v gradients flow back to the
+        # caller's unrepeated tensors (sum over repeated heads via autodiff)
+        if rep != 1:
+            kd = jnp.repeat(kd, rep, axis=2)
+            vd = jnp.repeat(vd, rep, axis=2)
+        return sm_fn(qd, kd, vd)
+
+    return fn
+
+
+def ring_attention(q, k, v, mesh: Optional[ProcessMesh] = None, axis_name: str = "sep",
+                   causal: bool = True, sm_scale: Optional[float] = None):
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    q, k, v: [B, S, H, D] Tensors or arrays (S is the GLOBAL length; the
+    computation shards it over the axis).  kv heads may be fewer than q heads
+    (GQA) — they are repeated.  Returns [B, S, H, D].
+    """
+    mesh = mesh if mesh is not None else get_mesh()
+    if mesh is None or axis_name not in mesh.dim_names:
+        raise ValueError(f"ring_attention needs a mesh with a {axis_name!r} axis")
+    cp = mesh.get_dim_size(axis_name)
+
+    any_tensor = any(isinstance(t, Tensor) for t in (q, k, v))
+    qd = q._data if isinstance(q, Tensor) else q
+    kd = k._data if isinstance(k, Tensor) else k
+    vd = v._data if isinstance(v, Tensor) else v
+
+    H = qd.shape[2]
+    rep = H // kd.shape[2]  # GQA head repetition (1 for MHA)
+    if qd.shape[1] % cp != 0:
+        raise ValueError(f"sequence length {qd.shape[1]} not divisible by {axis_name} degree {cp}")
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(qd.shape[-1])
+
+    fn = _build_ring_fn(mesh, axis_name, cp, causal, rep, float(scale))
+
+    if not any_tensor:
+        return fn(qd, kd, vd)
+    # normalize mixed Tensor/array inputs so the tape sees Tensors only
+    qt = q if isinstance(q, Tensor) else Tensor(qd)
+    kt = k if isinstance(k, Tensor) else Tensor(kd)
+    vt = v if isinstance(v, Tensor) else Tensor(vd)
+    return apply_op("ring_attention", fn, (qt, kt, vt), {})

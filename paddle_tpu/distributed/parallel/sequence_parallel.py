@@ -1,0 +1,125 @@
+"""Megatron-style sequence parallelism, the annotation way.
+
+Counterpart of ``fleet/utils/sequence_parallel_utils.py:85-564``
+(ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers,
+ColumnSequenceParallelLinear/RowSequenceParallelLinear, allreduce hooks).
+
+TPU-native collapse: all of the reference's hand-written scatter/gather
+collectives are SHARDING TRANSITIONS — on a GSPMD mesh they are expressed as
+placement constraints and XLA inserts the all-gather/reduce-scatter pairs at
+the optimal points (often fusing them away entirely).  The classes below keep
+the reference API shape; each is a thin constraint + the standard Column/Row
+parallel matmul.  ``register_sequence_parallel_allreduce_hooks`` is
+unnecessary (grad reductions are part of the compiled program) and kept as a
+documented no-op for API parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...framework.dispatch import apply_op
+from ...framework.tensor import Tensor
+from ..mesh import ProcessMesh, get_mesh
+from .mp_layers import ColumnParallelLinear, RowParallelLinear
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+    "mark_as_sequence_parallel_parameter", "is_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+]
+
+
+def _constrain_seq(x, mesh: Optional[ProcessMesh], axis: Optional[str], seq_dim: int = 1):
+    mesh = mesh if mesh is not None else get_mesh()
+    if mesh is None:
+        return x
+    # only the sequence dim is pinned; other dims stay UNCONSTRAINED so GSPMD
+    # keeps e.g. the dp-sharded batch dim sharded (pinning them None would
+    # force a full-batch all-gather at every constraint)
+    U = PartitionSpec.UNCONSTRAINED
+    entries = [U] * x.ndim
+    entries[seq_dim] = axis
+
+    def g(h):
+        if isinstance(h, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(
+                h, NamedSharding(mesh.jax_mesh, PartitionSpec(*entries)))
+        # eager: device_put cannot take UNCONSTRAINED — pin only the seq dim
+        eager_entries = [None] * h.ndim
+        eager_entries[seq_dim] = axis
+        return jax.device_put(h, NamedSharding(mesh.jax_mesh, PartitionSpec(*eager_entries)))
+
+    return apply_op("seq_constraint", g, (x,), {}) if isinstance(x, Tensor) else g(x)
+
+
+class ScatterOp:
+    """Sequence-scatter (reference sequence_parallel_utils.py:85): constrain
+    the sequence dim to shard over 'mp'."""
+
+    @staticmethod
+    def apply(x, seq_dim: int = 1, mesh=None):
+        return _constrain_seq(x, mesh, "mp", seq_dim)
+
+
+class GatherOp:
+    """Sequence-gather: constrain the sequence dim replicated (XLA emits the
+    all-gather)."""
+
+    @staticmethod
+    def apply(x, seq_dim: int = 1, mesh=None):
+        return _constrain_seq(x, mesh, None, seq_dim)
+
+
+# in GSPMD the forward collective and its grad counterpart are one pair, so
+# AllGather/ReduceScatter are the same two constraints from the other side
+AllGatherOp = GatherOp
+ReduceScatterOp = ScatterOp
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Column-parallel matmul whose INPUT arrives sequence-sharded
+    (reference :336 wrapper): gather seq -> column matmul."""
+
+    def forward(self, x):
+        x = GatherOp.apply(x, mesh=self.mesh)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Row-parallel matmul whose OUTPUT leaves sequence-sharded
+    (reference :543): row matmul -> scatter seq."""
+
+    def forward(self, x):
+        out = super().forward(x)
+        return ScatterOp.apply(out, mesh=self.mesh)
+
+
+_SP_PARAMS = None  # lazily-created WeakSet of marked parameters
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """Reference marks params whose grads need the SP allreduce; under GSPMD
+    replicated-param grads are reduced by the partitioner — the tag is kept in
+    a registry (Parameter is slotted) for introspection only."""
+    global _SP_PARAMS
+    if _SP_PARAMS is None:
+        import weakref
+
+        _SP_PARAMS = weakref.WeakSet()
+    _SP_PARAMS.add(param)
+    return param
+
+
+def is_sequence_parallel_parameter(param) -> bool:
+    return _SP_PARAMS is not None and param in _SP_PARAMS
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1, use_fuse=False):
+    """No-op under GSPMD (grad sync is part of the compiled program); kept for
+    reference API parity."""
+    return model

@@ -180,7 +180,11 @@ class MoELayer(Layer):
         if mesh is not None and axis_name in mesh.dim_names:
             ax = mesh.dim_names.index(axis_name)
             ep = mesh.shape[ax]
-            if num_experts % max(ep, 1) == 0 and ep > 1:
+            if ep > 1:
+                if num_experts % ep != 0:
+                    raise ValueError(
+                        f"num_experts={num_experts} not divisible by {axis_name} "
+                        f"degree {ep}; expert parallelism would be silently disabled")
                 placements = [Replicate()] * mesh.ndim
                 placements[ax] = Shard(0)
                 for p in (self.w_gate_up, self.w_down):

@@ -1,0 +1,163 @@
+"""Multi-host launcher CLI: ``python -m paddle_tpu.distributed.launch``.
+
+Counterpart of the reference's ``python/paddle/distributed/launch``
+(``main.py``, controllers, HTTP/etcd masters) and the elastic manager
+(``fleet/elastic/manager.py:125``).
+
+TPU-native differences:
+
+- ONE process per host drives all local chips (single-program SPMD), so
+  there is no per-GPU process fan-out; ``--nproc_per_node`` exists only for
+  CPU simulation;
+- rendezvous is PJRT's coordination service: the launcher only wires
+  ``PADDLE_TPU_COORDINATOR`` / ``PADDLE_TPU_NUM_PROCESSES`` /
+  ``PADDLE_TPU_PROCESS_ID`` env (read by ``collective.init_parallel_env`` ->
+  ``jax.distributed.initialize``) — the reference's TCPStore/etcd key
+  exchange collapses into PJRT;
+- elastic: the child is watched and relaunched on failure/preemption up to
+  ``--max_restarts`` times (reference ``ELASTIC_EXIT_CODE=101`` auto-restart
+  semantics; training code resumes from its last checkpoint — see
+  ``distributed.checkpoint``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["main", "launch"]
+
+# reference fleet/elastic/__init__.py:33-34
+ELASTIC_EXIT_CODE = 101
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch a paddle_tpu training program across hosts")
+    p.add_argument("--master", default=None,
+                   help="coordinator address host:port (default: this host:8476 on node 0)")
+    p.add_argument("--nnodes", type=int, default=int(os.environ.get("PADDLE_NNODES", "1")),
+                   help="number of hosts in the job")
+    p.add_argument("--rank", "--node_rank", dest="rank", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+                   help="this host's index [0, nnodes)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (1 on TPU; >1 only for CPU simulation)")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="elastic: relaunch a failed training process this many times")
+    p.add_argument("--log_dir", default=None, help="write per-process logs here")
+    p.add_argument("--job_id", default="default", help="job name for logs")
+    p.add_argument("training_script", help="the training program")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def _child_env(args, local_rank: int) -> dict:
+    env = dict(os.environ)
+    nproc = args.nproc_per_node
+    world = args.nnodes * nproc
+    proc_id = args.rank * nproc + local_rank
+    if world > 1:
+        master = args.master or f"127.0.0.1:8476"
+        env["PADDLE_TPU_COORDINATOR"] = master
+        env["PADDLE_TPU_NUM_PROCESSES"] = str(world)
+        env["PADDLE_TPU_PROCESS_ID"] = str(proc_id)
+    # reference-compatible names, for user scripts that read them
+    env["PADDLE_TRAINER_ID"] = str(proc_id)
+    env["PADDLE_TRAINERS_NUM"] = str(world)
+    env["PADDLE_LOCAL_RANK"] = str(local_rank)
+    return env
+
+
+class _Proc:
+    def __init__(self, cmd: List[str], env: dict, log_path: Optional[str], tag: str):
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.tag = tag
+        self.restarts = 0
+        self.popen: Optional[subprocess.Popen] = None
+        self._log_f = None
+
+    def start(self):
+        if self.log_path:
+            self._log_f = open(self.log_path, "ab")
+            out = self._log_f
+        else:
+            out = None  # inherit
+        self.popen = subprocess.Popen(self.cmd, env=self.env, stdout=out, stderr=out)
+
+    def stop(self, sig=signal.SIGTERM):
+        if self.popen and self.popen.poll() is None:
+            self.popen.send_signal(sig)
+
+    def close(self):
+        if self._log_f:
+            self._log_f.close()
+            self._log_f = None
+
+
+def launch(args) -> int:
+    """Run the job on this host; returns the exit code."""
+    procs: List[_Proc] = []
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    for lr in range(args.nproc_per_node):
+        cmd = [sys.executable, args.training_script] + list(args.training_script_args)
+        log_path = (os.path.join(args.log_dir, f"{args.job_id}.rank{args.rank}.local{lr}.log")
+                    if args.log_dir else None)
+        p = _Proc(cmd, _child_env(args, lr), log_path, tag=f"rank{args.rank}.{lr}")
+        p.start()
+        procs.append(p)
+
+    exit_code = 0
+    try:
+        alive = list(procs)
+        while alive:
+            time.sleep(0.2)
+            for p in list(alive):
+                rc = p.popen.poll()
+                if rc is None:
+                    continue
+                if rc == 0:
+                    alive.remove(p)
+                    continue
+                # failure / preemption: elastic relaunch (reference
+                # ElasticManager watch->relaunch loop, manager.py:125)
+                if p.restarts < args.max_restarts:
+                    p.restarts += 1
+                    print(f"[launch] {p.tag} exited rc={rc}; restart "
+                          f"{p.restarts}/{args.max_restarts}", file=sys.stderr)
+                    p.start()
+                else:
+                    print(f"[launch] {p.tag} exited rc={rc}; restarts exhausted",
+                          file=sys.stderr)
+                    exit_code = rc
+                    alive.remove(p)
+                    for q in alive:
+                        q.stop()
+    except KeyboardInterrupt:
+        for p in procs:
+            p.stop(signal.SIGINT)
+        exit_code = 130
+    finally:
+        for p in procs:
+            if p.popen and p.popen.poll() is None:
+                try:
+                    p.popen.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.popen.kill()
+            p.close()
+    return exit_code
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return launch(args)

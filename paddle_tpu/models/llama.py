@@ -135,6 +135,19 @@ def _shard_param(p, mesh: Optional[ProcessMesh], tensor_dim: Optional[int], axis
     return shard_tensor(p, mesh, placements)
 
 
+def _place_all_params(layer, mesh: Optional[ProcessMesh]):
+    """Give every parameter WITHOUT a placement an explicit Replicate one
+    (via ``shard_layer``'s default shard_fn).  Mixing mesh-committed and
+    single-device-committed params in one jit fails (seen on checkpoint
+    reload, where load re-commits to the saved layout); an explicit placement
+    also makes dist-checkpoint dedup see them correctly."""
+    if mesh is None:
+        return
+    from ..distributed.api import shard_layer
+
+    shard_layer(layer, mesh)
+
+
 def _constrain_hidden(x, mesh: Optional[ProcessMesh], sequence_parallel: bool):
     """Residual-stream constraint: batch over 'dp', optionally seq over 'mp'."""
     if mesh is None:
@@ -358,6 +371,7 @@ class LlamaForCausalLM(Layer):
                 [config.hidden_size, config.vocab_size], dtype=config.dtype,
                 default_initializer=Normal(0.0, config.initializer_range))
             _shard_param(self.lm_head, mesh, 1)
+        _place_all_params(self, mesh)
 
     def forward(self, input_ids, position_ids=None):
         out = self.llama(input_ids, position_ids)

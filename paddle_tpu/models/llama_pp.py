@@ -41,7 +41,8 @@ from ..distributed.mesh import ProcessMesh, get_mesh
 from ..distributed.placement import Replicate, Shard
 from ..distributed.api import shard_tensor
 from ..distributed.parallel.pipeline import pipeline_spmd_step
-from .llama import LlamaConfig, LlamaForCausalLM, attention_fn, mlp_fn
+from .llama import (LlamaConfig, LlamaForCausalLM, _place_all_params,
+                    attention_fn, mlp_fn)
 
 __all__ = ["LlamaForCausalLMPipe"]
 
@@ -125,6 +126,7 @@ class LlamaForCausalLMPipe(Layer):
                                        config.rope_theta)
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+        _place_all_params(self, mesh)
 
     def _shard_replicated(self, p, mp_dim=None):
         mesh = self._mesh

@@ -1,0 +1,119 @@
+"""Distributed checkpoint tests (VERDICT item 7): shard files + metadata,
+replicated-shard dedup, cross-topology reload (save dp2 x mp4, load dp4 x mp2),
+async save, optimizer-state nesting.
+
+Reference: ``distributed/checkpoint/save_state_dict.py:145``,
+``load_state_dict.py``, ``metadata.py:20-43``.
+"""
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict
+
+
+def _mesh(dp, mp):
+    return dist.ProcessMesh(np.arange(8).reshape(dp, mp), ["dp", "mp"])
+
+
+def _make_state(mesh, val_seed=0):
+    rng = np.random.default_rng(val_seed)
+    w = paddle.to_tensor(rng.normal(size=(16, 32)).astype(np.float32))
+    b = paddle.to_tensor(rng.normal(size=(32,)).astype(np.float32))
+    ws = dist.shard_tensor(w, mesh, [dist.Replicate(), dist.Shard(1)])
+    bs = dist.shard_tensor(b, mesh, [dist.Replicate(), dist.Shard(0)])
+    return {"linear.weight": ws, "linear.bias": bs}
+
+
+def test_save_load_roundtrip_same_topology(tmp_path):
+    mesh = _mesh(2, 4)
+    state = _make_state(mesh)
+    ref_w = state["linear.weight"].numpy().copy()
+    save_state_dict(state, str(tmp_path))
+    assert os.path.exists(tmp_path / "metadata.pkl")
+
+    target = _make_state(mesh, val_seed=99)  # different values, same topology
+    load_state_dict(target, str(tmp_path))
+    np.testing.assert_allclose(target["linear.weight"].numpy(), ref_w, rtol=1e-6)
+
+
+def test_cross_topology_reload(tmp_path):
+    mesh_a = _mesh(2, 4)
+    state = _make_state(mesh_a)
+    ref_w = state["linear.weight"].numpy().copy()
+    ref_b = state["linear.bias"].numpy().copy()
+    save_state_dict(state, str(tmp_path))
+
+    mesh_b = _mesh(4, 2)  # different topology: dp4 x mp2
+    target = _make_state(mesh_b, val_seed=99)
+    load_state_dict(target, str(tmp_path))
+    np.testing.assert_allclose(target["linear.weight"].numpy(), ref_w, rtol=1e-6)
+    np.testing.assert_allclose(target["linear.bias"].numpy(), ref_b, rtol=1e-6)
+    # loaded tensors keep the TARGET sharding
+    assert "mp" in str(target["linear.weight"]._data.sharding.spec)
+
+
+def test_replicated_shard_dedup(tmp_path):
+    mesh = _mesh(2, 4)
+    state = _make_state(mesh)
+    save_state_dict(state, str(tmp_path))
+    # weight is replicated over dp (2x) and sharded over mp (4 ways): saved
+    # bytes must be ~1x the global tensor, not 2x
+    npz = np.load(tmp_path / "0_0.distcp.npz")
+    w_keys = [k for k in npz.files if k.startswith("linear.weight|")]
+    total = sum(int(np.prod(npz[k].shape)) for k in w_keys)
+    assert total == 16 * 32, f"dedup failed: saved {total} elements for a {16*32} tensor"
+    assert len(w_keys) == 4  # one chunk per mp shard
+
+
+def test_async_save(tmp_path):
+    mesh = _mesh(2, 4)
+    state = _make_state(mesh)
+    fut = save_state_dict(state, str(tmp_path), async_save=True)
+    assert fut.result(timeout=60) == str(tmp_path)
+    target = _make_state(mesh, val_seed=99)
+    load_state_dict(target, str(tmp_path))
+    np.testing.assert_allclose(target["linear.weight"].numpy(),
+                               state["linear.weight"].numpy(), rtol=1e-6)
+
+
+def test_nested_optimizer_state(tmp_path):
+    mesh = _mesh(2, 4)
+    inner = _make_state(mesh)
+    state = {"model": {k: v for k, v in inner.items()},
+             "step": paddle.to_tensor(np.asarray(7, np.int32))}
+    save_state_dict(state, str(tmp_path))
+    target = {"model": _make_state(mesh, val_seed=99),
+              "step": paddle.to_tensor(np.asarray(0, np.int32))}
+    load_state_dict(target, str(tmp_path))
+    assert int(target["step"].numpy()) == 7
+    np.testing.assert_allclose(target["model"]["linear.bias"].numpy(),
+                               inner["linear.bias"].numpy(), rtol=1e-6)
+
+
+def test_bfloat16_roundtrip(tmp_path):
+    mesh = _mesh(2, 4)
+    w = paddle.to_tensor(np.random.default_rng(1).normal(size=(8, 16)).astype(np.float32),
+                         dtype="bfloat16")
+    ws = dist.shard_tensor(w, mesh, [dist.Replicate(), dist.Shard(1)])
+    save_state_dict({"w": ws}, str(tmp_path))
+    target = {"w": dist.shard_tensor(paddle.zeros([8, 16], dtype="bfloat16"), mesh,
+                                     [dist.Replicate(), dist.Shard(1)])}
+    load_state_dict(target, str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(target["w"]._data, dtype=np.float32),
+                                  np.asarray(ws._data, dtype=np.float32))
+
+
+def test_missing_tensor_raises(tmp_path):
+    mesh = _mesh(2, 4)
+    save_state_dict(_make_state(mesh), str(tmp_path))
+    target = {"nonexistent": paddle.zeros([4])}
+    with pytest.raises(KeyError, match="nonexistent"):
+        load_state_dict(target, str(tmp_path))

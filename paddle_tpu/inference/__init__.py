@@ -1,0 +1,110 @@
+"""Inference API: Config + Predictor over AOT-exported programs.
+
+Counterpart of the reference's ``paddle.inference``
+(``fluid/inference/api/analysis_predictor.cc:427`` AnalysisPredictor,
+``paddle_infer::Config``).  The analysis/fusion pass pipeline and TensorRT
+engine collapse into XLA AOT compilation: the artifact produced by
+``paddle_tpu.jit.save`` IS the optimized program; the predictor binds IO
+tensors and runs it (ZeroCopyRun role).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..jit import load as _jit_load
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """Reference-shaped ``paddle.inference.Config``."""
+
+    def __init__(self, prog_file: Optional[str] = None, params_file: Optional[str] = None):
+        # reference passes (model_path, params_path); our artifact is a single
+        # prefix — accept either style
+        self._prefix = None
+        if prog_file is not None:
+            self._prefix = prog_file
+            for suffix in (".jaxir", ".pdmodel.json", ".pdmodel"):
+                if self._prefix.endswith(suffix):
+                    self._prefix = self._prefix[: -len(suffix)]
+        self._device = "tpu"
+
+    def set_prog_file(self, path):
+        self.__init__(path)
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"  # accelerator path
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self):
+        pass  # XLA owns buffer assignment
+
+    def switch_ir_optim(self, flag=True):
+        pass  # the artifact is already compiled
+
+    def model_dir(self):
+        return self._prefix
+
+
+class _IOHandle:
+    """Zero-copy-ish IO tensor handle (reference ZeroCopyTensor)."""
+
+    def __init__(self):
+        self._value = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = jnp.asarray(arr)
+
+    def reshape(self, shape):
+        pass  # shapes come from the bound value
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def share_external_data(self, tensor):
+        self._value = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        if config._prefix is None:
+            raise ValueError("Config has no model path")
+        self._fn = _jit_load(config._prefix)
+        n_inputs = len(self._fn.meta["inputs"])
+        self._inputs = {f"input_{i}": _IOHandle() for i in range(n_inputs)}
+        self._outputs: List[_IOHandle] = []
+
+    def get_input_names(self):
+        return list(self._inputs.keys())
+
+    def get_input_handle(self, name) -> _IOHandle:
+        return self._inputs[name]
+
+    def run(self):
+        args = [h._value for h in self._inputs.values()]
+        out = self._fn(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = []
+        for o in outs:
+            h = _IOHandle()
+            h._value = o._data if isinstance(o, Tensor) else jnp.asarray(o)
+            self._outputs.append(h)
+        return True
+
+    def get_output_names(self):
+        return [f"output_{i}" for i in range(len(self._outputs))]
+
+    def get_output_handle(self, name) -> _IOHandle:
+        return self._outputs[int(name.split("_")[-1])]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

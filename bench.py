@@ -81,6 +81,29 @@ DEFAULTS = {  # preset -> (batch, seq, steps)
 }
 
 
+def _probe_accelerator(timeout: float = 120.0) -> bool:
+    """Check in a THROWAWAY SUBPROCESS whether the accelerator backend comes up.
+
+    A wedged TPU plugin can hang ``jax.devices()`` forever (not just raise), so
+    an in-process try/except is not enough: the probe must be killable. If the
+    child fails or times out we fall back to CPU and still print the JSON line —
+    a CPU number beats no number.
+    """
+    import os
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout,
+            env={k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"},
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+    return proc.returncode == 0 and proc.stdout.strip() not in ("", "cpu")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", default=None, choices=["tiny", "small", "base"])
@@ -90,12 +113,19 @@ def main():
     ap.add_argument("--seq", type=int, default=None)
     args = ap.parse_args()
 
-    import jax
+    fallback = False
+    if args.device != "tpu" and (args.device == "cpu" or not _probe_accelerator()):
+        fallback = args.device != "cpu"
+        import jax
 
-    if args.device == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
+
     backend = jax.default_backend()
-    on_tpu = backend not in ("cpu",)
+    if fallback:
+        backend = "cpu-fallback"
+    on_tpu = backend not in ("cpu", "cpu-fallback")
     preset = args.preset or ("base" if on_tpu else "tiny")
 
     import numpy as np
@@ -141,10 +171,10 @@ def main():
     achieved = tokens_per_sec * flops_per_token
 
     dev_kind = jax.devices()[0].device_kind
-    peak = None
-    for k, v in PEAK_FLOPS.items():
-        if dev_kind.startswith(k):
-            peak = v
+    # longest matching prefix wins: "TPU v5 lite" must hit the v5e entry
+    # (197e12), not the later bare "TPU v5" (459e12) key
+    matches = [k for k in PEAK_FLOPS if dev_kind.startswith(k)]
+    peak = PEAK_FLOPS[max(matches, key=len)] if matches else None
     if on_tpu and peak is None:
         peak = 197e12  # conservative default
     mfu = achieved / peak if peak else 0.0

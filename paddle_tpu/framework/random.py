@@ -17,17 +17,25 @@ import jax
 
 
 class Generator:
-    """A splittable PRNG stream."""
+    """A splittable PRNG stream.
+
+    Key construction is lazy: ``jax.random.key`` initializes the JAX backend, and
+    ``import paddle_tpu`` must never do that (a wedged accelerator plugin would
+    hang every import, including the pure process-management launcher). The key is
+    built on first use instead. Mirrors the fake-device CI philosophy of the
+    reference (``paddle/phi/backends/custom/fake_cpu_device.h``): framework code
+    paths must not require live hardware.
+    """
 
     def __init__(self, seed: int = 0):
         self._seed = seed
-        self._key = jax.random.key(seed)
+        self._key = None  # built lazily on first use
         self._lock = threading.Lock()
 
     def manual_seed(self, seed: int) -> "Generator":
         with self._lock:
             self._seed = seed
-            self._key = jax.random.key(seed)
+            self._key = None
         return self
 
     @property
@@ -36,11 +44,16 @@ class Generator:
 
     def next_key(self):
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
             self._key, sub = jax.random.split(self._key)
             return sub
 
     def get_state(self):
-        return self._key
+        with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
+            return self._key
 
     def set_state(self, key) -> None:
         with self._lock:

@@ -36,13 +36,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...framework.tensor import Tensor
-from ..collective import barrier, get_rank
+from ..collective import barrier, get_rank, get_world_size
 from ..mesh import ProcessMesh
 from ..placement import named_sharding
 
 __all__ = ["save_state_dict", "load_state_dict", "Metadata", "LocalTensorMetadata"]
 
 _METADATA_FILE = "metadata.pkl"
+
+# path -> last async-save future; a new save into the same path waits for it
+_INFLIGHT: Dict[str, Future] = {}
 
 
 class LocalTensorMetadata:
@@ -149,43 +152,109 @@ def save_state_dict(state_dict, path: str, process_group=None, coordinator_rank:
         if chunks:
             meta.add(name, global_shape, arr.dtype, chunks)
 
-    def _write():
+    world = get_world_size()
+
+    def _merge_and_commit():
+        merged = Metadata()
+        for fn in sorted(os.listdir(path)):
+            # require the .pkl suffix: a crash between tmp-write and os.replace
+            # leaves a truncated .pkl.tmp behind that must never be merged
+            if not (fn.startswith("metadata_part_") and fn.endswith(".pkl")):
+                continue
+            with open(os.path.join(path, fn), "rb") as f:
+                part_meta = pickle.load(f)
+            for tname, info in part_meta.state_dict_metadata.items():
+                if tname in merged.state_dict_metadata:
+                    merged.state_dict_metadata[tname]["chunks"].extend(info["chunks"])
+                else:
+                    merged.state_dict_metadata[tname] = dict(info)
+        # atomic commit: readers must never see a partially-written manifest
+        tmp = os.path.join(path, _METADATA_FILE + ".tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(merged, f)
+        os.replace(tmp, os.path.join(path, _METADATA_FILE))
+
+    def _write_local():
         np.savez(os.path.join(path, file_name), **payload)
-        # merge metadata across processes: every rank writes its own partial
-        # manifest; the coordinator merges (single-process: trivial)
         part = os.path.join(path, f"metadata_part_{rank}.pkl")
-        with open(part, "wb") as f:
+        tmp = part + ".tmp"
+        with open(tmp, "wb") as f:
             pickle.dump(meta, f)
-        barrier()
-        if rank == coordinator_rank:
-            merged = Metadata()
-            for fn in sorted(os.listdir(path)):
-                if not fn.startswith("metadata_part_"):
-                    continue
-                with open(os.path.join(path, fn), "rb") as f:
-                    part_meta = pickle.load(f)
-                for tname, info in part_meta.state_dict_metadata.items():
-                    if tname in merged.state_dict_metadata:
-                        merged.state_dict_metadata[tname]["chunks"].extend(info["chunks"])
-                    else:
-                        merged.state_dict_metadata[tname] = dict(info)
-            with open(os.path.join(path, _METADATA_FILE), "wb") as f:
-                pickle.dump(merged, f)
+        os.replace(tmp, part)
+
+    def _clear_stale_rendezvous():
+        """Coordinator removes EVERY part/manifest from any previous save into
+        this directory — the current world may be smaller than the one that
+        wrote them (elastic restart), and stale parts would otherwise satisfy
+        the part count and be merged into the manifest."""
+        for fn in os.listdir(path):
+            if fn.startswith("metadata_part_") or fn.startswith(_METADATA_FILE):
+                os.remove(os.path.join(path, fn))
+
+    # a still-in-flight async save into the same path would race with this
+    # save's cleanup; serialize per-path (each rank waits on its own prior
+    # future — ranks are symmetric, so this is collective-safe)
+    prev = _INFLIGHT.get(path)
+    if prev is not None and not prev.done():
+        prev.result()
 
     if not async_save:
-        _write()
+        # barrier #1: nobody writes until the coordinator cleared stale files;
+        # #2: all parts present before the merge; #3: manifest present before
+        # any rank returns (a rank could otherwise load a checkpoint whose
+        # metadata.pkl does not exist yet)
+        if rank == coordinator_rank:
+            _clear_stale_rendezvous()
+        barrier()
+        _write_local()
+        barrier()
+        if rank == coordinator_rank:
+            _merge_and_commit()
+        barrier()
         return None
+
+    # Async: NO collectives off the main thread (a barrier on a daemon thread
+    # can interleave with main-thread collectives in a different order across
+    # ranks — undefined behavior).  Rendezvous through the (shared) filesystem
+    # instead: the coordinator polls for all part manifests, everyone else
+    # polls for the committed metadata file.  Stale rendezvous files from a
+    # previous save into the same directory would satisfy the polls instantly,
+    # so the coordinator clears them ALL on the MAIN thread (where a barrier is
+    # safe) first; no rank's IO thread writes until every rank passed it.
+    if rank == coordinator_rank:
+        _clear_stale_rendezvous()
+    barrier()
 
     fut: Future = Future()
 
+    def _poll(predicate, what, timeout=600.0, interval=0.05):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while not predicate():
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"async checkpoint save timed out waiting for {what}")
+            time.sleep(interval)
+
     def runner():
         try:
-            _write()
+            _write_local()
+            if rank == coordinator_rank:
+                def all_parts():
+                    have = [fn for fn in os.listdir(path)
+                            if fn.startswith("metadata_part_") and fn.endswith(".pkl")]
+                    return len(have) >= world
+                _poll(all_parts, f"{world} metadata parts")
+                _merge_and_commit()
+            else:
+                _poll(lambda: os.path.exists(os.path.join(path, _METADATA_FILE)),
+                      "coordinator metadata commit")
             fut.set_result(path)
         except BaseException as e:  # pragma: no cover
             fut.set_exception(e)
 
     threading.Thread(target=runner, name="distcp-save", daemon=True).start()
+    _INFLIGHT[path] = fut
     return fut
 
 
@@ -233,15 +302,22 @@ def load_state_dict(state_dict, path: str, process_group=None, coordinator_rank:
             out[c.key] = _from_storage(files[c.file_name][c.key], dtype_name)
         return out
 
+    # (container, key) lets non-Tensor leaves be written back into the
+    # CALLER's dict — rebinding only a local would silently leave the caller
+    # holding stale arrays.  Flattening recurses like _unwrap_state on save.
     flat_targets = {}
-    for name, t in state_dict.items():
-        if isinstance(t, dict):
-            for sub, v in t.items():
-                flat_targets[f"{name}.{sub}"] = v
-        else:
-            flat_targets[name] = t
 
-    for name, target in flat_targets.items():
+    def _flatten_targets(d, prefix=""):
+        for name, t in d.items():
+            full = f"{prefix}{name}"
+            if isinstance(t, dict):
+                _flatten_targets(t, f"{full}.")
+            else:
+                flat_targets[full] = (d, name, t)
+
+    _flatten_targets(state_dict)
+
+    for name, (container, key_in_container, target) in flat_targets.items():
         if name not in meta.state_dict_metadata:
             raise KeyError(f"tensor {name!r} not present in checkpoint {path}")
         info = meta.state_dict_metadata[name]
@@ -262,7 +338,7 @@ def load_state_dict(state_dict, path: str, process_group=None, coordinator_rank:
         if isinstance(target, Tensor):
             target._data = new_arr
         else:
-            flat_targets[name] = new_arr
+            container[key_in_container] = new_arr
     for f in files.values():
         f.close()
     return state_dict

@@ -141,10 +141,16 @@ def distributed_model(model):
     from ..parallel.pipeline import PipelineLayer, PipelineParallel
 
     hcg = get_hybrid_communicate_group()
-    if hcg is not None and hcg.get_pipe_parallel_world_size() > 1 and (
-        isinstance(model, PipelineLayer) or PipelineParallel._is_pipeline_capable(model)
-    ):
-        return PipelineParallel(model, hcg)
+    if hcg is not None and hcg.get_pipe_parallel_world_size() > 1:
+        if PipelineParallel._is_pipeline_capable(model):
+            return PipelineParallel(model, hcg, strategy=fleet._strategy)
+        # ANY model without a pipeline forward would silently train
+        # unpipelined under pp_degree > 1 — fail here with the remedy
+        raise ValueError(
+            f"pp_degree > 1 but {type(model).__name__} runs sequentially. Build a "
+            "pipeline-capable model (e.g. models.llama_pp.LlamaForCausalLMPipe, "
+            "or any model composing distributed.parallel.pipeline."
+            "pipeline_spmd_step with stacked stage params).")
     return model
 
 

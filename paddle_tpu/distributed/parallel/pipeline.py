@@ -183,6 +183,7 @@ class PipelineParallel(Layer):
         super().__init__()
         self._layers = layers
         self._hcg = hcg
+        self._strategy = strategy  # pipeline_configs drives n_microbatches/schedule
         self._compiled = None
         self._compiled_key = None
         pp_degree = hcg.get_pipe_parallel_world_size() if hcg is not None else 1

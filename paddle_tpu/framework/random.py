@@ -78,12 +78,32 @@ def seed(s: int) -> Generator:
     return _DEFAULT
 
 
+_warned_traced_eager_key = False
+
+try:  # private jax API; degrade to no warning if it moves
+    from jax._src.core import trace_state_clean as _trace_state_clean
+except Exception:  # pragma: no cover
+    _trace_state_clean = None
+
+
 def next_key():
     """Fresh PRNG key for one random op."""
+    global _warned_traced_eager_key
     if _TRACED:
         key, sub = jax.random.split(_TRACED[-1][0])
         _TRACED[-1][0] = key
         return sub
+    if (not _warned_traced_eager_key and _trace_state_clean is not None
+            and not _trace_state_clean()):
+        _warned_traced_eager_key = True
+        import warnings
+
+        warnings.warn(
+            "a PRNG key was drawn during jit tracing without rng_guard: the "
+            "key becomes a compile-time constant, so every call of the "
+            "compiled function reuses identical randomness. Thread a key "
+            "functionally (TrainStep/to_static do this automatically).",
+            stacklevel=2)
     return _DEFAULT.next_key()
 
 

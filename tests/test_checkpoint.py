@@ -117,3 +117,32 @@ def test_missing_tensor_raises(tmp_path):
     target = {"nonexistent": paddle.zeros([4])}
     with pytest.raises(KeyError, match="nonexistent"):
         load_state_dict(target, str(tmp_path))
+
+
+def test_raw_array_leaves_written_back_in_place(tmp_path):
+    """Non-Tensor (raw jax array) leaves must be written back into the
+    CALLER's dict, including nested dicts (load contract: in place)."""
+    import jax.numpy as jnp
+
+    state = {"w": paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3)),
+             "opt": {"m": paddle.to_tensor(np.full((2, 3), 5.0, np.float32))}}
+    save_state_dict(state, str(tmp_path))
+    target = {"w": jnp.zeros((2, 3), jnp.float32),
+              "opt": {"m": jnp.zeros((2, 3), jnp.float32)}}
+    load_state_dict(target, str(tmp_path))
+    np.testing.assert_allclose(np.asarray(target["w"]), state["w"].numpy())
+    np.testing.assert_allclose(np.asarray(target["opt"]["m"]), 5.0)
+
+
+def test_resave_same_directory_async(tmp_path):
+    """A second async_save into the same directory must not rendezvous on the
+    previous save's stale part/manifest files."""
+    state = {"x": paddle.to_tensor(np.asarray([1.0], np.float32))}
+    fut = save_state_dict(state, str(tmp_path), async_save=True)
+    assert fut.result(timeout=60) == str(tmp_path)
+    state2 = {"x": paddle.to_tensor(np.asarray([2.0], np.float32))}
+    fut2 = save_state_dict(state2, str(tmp_path), async_save=True)
+    assert fut2.result(timeout=60) == str(tmp_path)
+    target = {"x": paddle.to_tensor(np.asarray([0.0], np.float32))}
+    load_state_dict(target, str(tmp_path))
+    np.testing.assert_allclose(target["x"].numpy(), [2.0])

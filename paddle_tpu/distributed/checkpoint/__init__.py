@@ -192,11 +192,14 @@ def save_state_dict(state_dict, path: str, process_group=None, coordinator_rank:
                 os.remove(os.path.join(path, fn))
 
     # a still-in-flight async save into the same path would race with this
-    # save's cleanup; serialize per-path (each rank waits on its own prior
-    # future — ranks are symmetric, so this is collective-safe)
+    # save's cleanup; serialize per-path: each rank waits on its own prior
+    # future, THEN a barrier — the coordinator must not clear rendezvous files
+    # until EVERY rank's previous save settled (a slow rank could still be
+    # polling for the manifest the clear would delete)
     prev = _INFLIGHT.get(path)
     if prev is not None and not prev.done():
         prev.result()
+    barrier()
 
     if not async_save:
         # barrier #1: nobody writes until the coordinator cleared stale files;

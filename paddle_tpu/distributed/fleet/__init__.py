@@ -46,7 +46,9 @@ class DistributedStrategy:
             "mp_configs": {},
             "pp_configs": {},
         }
-        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        # accumulate_steps deliberately ABSENT by default: present (any value
+        # >= 1) means an explicit microbatch-count override in train_batch
+        self.pipeline_configs = {"micro_batch_size": 1}
         self.amp = False
         self.amp_configs = {}
         self.recompute = False

@@ -31,7 +31,8 @@ from ...framework.dispatch import unwrap, wrap
 from ...framework.tensor import Tensor
 from ...nn.layers import Layer, LayerList
 
-__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel", "pipeline_spmd_step"]
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
+           "pipeline_spmd_step", "pipeline_1f1b_step", "pipeline_vpp_step"]
 
 
 class LayerDesc:
@@ -166,6 +167,251 @@ def pipeline_spmd_step(block_fn: Callable, n_stages: int, n_micro: int, axis_nam
     return schedule
 
 
+def _varying(x, axis_name):
+    """Mark an array (or pytree) varying over the manual axis for stable scan
+    carry typing (JAX vma).  Idempotent: leaves already varying (e.g. derived
+    from P('pp') shard_map inputs) pass through."""
+    def mark(a):
+        try:
+            return jax.lax.pcast(a, (axis_name,), to="varying")
+        except ValueError:
+            return a
+
+    return jax.tree.map(mark, x)
+
+
+def pipeline_1f1b_step(first_fn, block_fn, last_fn, n_stages, n_micro,
+                       axis_name: str = "pp"):
+    """Compiled 1F1B: forward and backward INTERLEAVED in one scan, with the
+    reference's 1F1B activation bound — at most ``2*n_stages`` stashed
+    microbatch inputs per device, independent of ``n_micro`` (the autodiff
+    GPipe schedule stashes ``n_micro + n_stages - 1``).
+
+    Reference: ``fleet/meta_parallel/pipeline_parallel.py:575``
+    (``forward_backward_pipeline`` — warmup fwd steps, steady 1F1B, cooldown).
+    TPU-native: the whole thing is ONE differentiable-free program — the vjp is
+    hand-rolled per round, so gradients accumulate in the scan carry and each
+    stage's residual stash is a fixed ring buffer.
+
+    - ``first_fn(first_params, data_m) -> x``: builds stage-0 input for one
+      microbatch (e.g. embedding lookup); its vjp accumulates ``g_first``.
+    - ``block_fn(stage_params_local, x, *extra) -> y``: one stage body on its
+      local ``[1, ...]`` param shard.
+    - ``last_fn(last_params, y, data_m) -> loss_m``: last-stage head + loss for
+      one microbatch.  Scale it by ``1/n_micro`` so the summed loss and the
+      accumulated grads match the global-mean loss.
+
+    Returns ``schedule(stage_params, first_params, last_params, micro_data,
+    *extra) -> (loss, g_stage, g_first, g_last)`` for use inside ``shard_map``
+    manual over ``axis_name``; ``loss``/``g_first``/``g_last`` are psummed
+    (replicated) over the pp axis, ``g_stage`` stays per-stage.
+
+    Schedule timing (synchronous half-steps; S = n_stages, M = n_micro):
+    round r does a fwd sub-step of microbatch ``r - s`` at stage s and a bwd
+    sub-step of microbatch ``r - (2S - 2 - s)``; the last stage seeds the
+    backward for microbatch m in the SAME round its forward completes — the
+    1F1B property.  In-flight microbatches per stage <= 2(S - 1 - s) + 1,
+    bounded by the ``2S`` ring-buffer slots.
+    """
+    S, M = n_stages, n_micro
+    if S < 2:
+        raise ValueError("pipeline_1f1b_step needs n_stages >= 2")
+    K = 2 * S              # stash ring-buffer slots (max in-flight 2(S-1)+1)
+    R = M + 2 * (S - 1)    # rounds
+
+    def schedule(stage_params, first_params, last_params, micro_data, *extra):
+        stage = jax.lax.axis_index(axis_name)
+        data0 = jax.tree.map(lambda a: a[0], micro_data)
+        x_shape = jax.eval_shape(first_fn, first_params, data0)
+        act0 = jnp.zeros(x_shape.shape, x_shape.dtype)
+        # vjp w.r.t. an UNVARYING value auto-inserts a psum over the manual
+        # axis (broadcast fwd -> psum bwd) — and that psum would sit inside a
+        # lax.cond branch only SOME stages take, deadlocking the others.  Cast
+        # the shared params varying up front so every grad stays local; the
+        # single explicit psum happens after the scan, on all stages alike.
+        first_params = _varying(first_params, axis_name)
+        last_params = _varying(last_params, axis_name)
+
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+        zero_g_stage = jax.tree.map(jnp.zeros_like, stage_params)
+        zero_g_first = jax.tree.map(jnp.zeros_like, first_params)
+        zero_g_last = jax.tree.map(jnp.zeros_like, last_params)
+
+        carry0 = (
+            _varying(act0, axis_name),                      # fwd message
+            _varying(act0, axis_name),                      # bwd (grad) message
+            _varying(jnp.zeros((K,) + x_shape.shape, x_shape.dtype), axis_name),
+            _varying(zero_g_stage, axis_name),
+            _varying(zero_g_first, axis_name),
+            _varying(zero_g_last, axis_name),
+            _varying(jnp.zeros((), jnp.float32), axis_name),  # loss sum
+        )
+
+        def pick(md, idx):
+            return jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, idx, 0, keepdims=False), md)
+
+        def round_step(carry, r):
+            fwd_msg, bwd_msg, stash, g_stage, g_first, g_last, loss_sum = carry
+
+            # ---------- forward sub-step: microbatch fm = r - stage ----------
+            fm = r - stage
+            f_active = (fm >= 0) & (fm < M)
+            fm_c = jnp.clip(fm, 0, M - 1)
+            data_f = pick(micro_data, fm_c)
+            x_in = jax.lax.cond(
+                stage == 0,
+                lambda: _varying(first_fn(first_params, data_f).astype(act0.dtype),
+                                 axis_name),
+                lambda: fwd_msg)
+            y = block_fn(stage_params, x_in, *extra)
+            stash = jnp.where(
+                f_active,
+                jax.lax.dynamic_update_index_in_dim(stash, x_in, fm_c % K, 0),
+                stash)
+            fwd_msg = jax.lax.ppermute(
+                jnp.where(f_active, y, jnp.zeros_like(y)), axis_name, fwd_perm)
+
+            # ---------- backward sub-step: bm = r - (2S - 2 - stage) ----------
+            bm = r - (2 * S - 2 - stage)
+            b_active = (bm >= 0) & (bm < M)
+            bm_c = jnp.clip(bm, 0, M - 1)
+            data_b = pick(micro_data, bm_c)
+            x_m = jax.lax.dynamic_index_in_dim(stash, bm_c % K, 0, keepdims=False)
+            y_m, blk_vjp = jax.vjp(
+                lambda sp, xx: block_fn(sp, xx, *extra), stage_params, x_m)
+
+            # last stage seeds the chain: loss + head vjp (cond: only the
+            # owning stage pays for the vocab matmul)
+            def seed_last():
+                def loss_of(lp, yy):
+                    return last_fn(lp, yy, data_b)
+                loss_m, (g_lp, gy) = jax.value_and_grad(loss_of, argnums=(0, 1))(
+                    last_params, y_m)
+                return _varying(
+                    (loss_m.astype(jnp.float32), g_lp, gy.astype(y_m.dtype)),
+                    axis_name)
+
+            loss_m, g_last_m, gy = jax.lax.cond(
+                stage == S - 1,
+                seed_last,
+                lambda: (_varying(jnp.zeros((), jnp.float32), axis_name),
+                         _varying(zero_g_last, axis_name), bwd_msg))
+
+            g_sp_m, gx = blk_vjp(gy)
+
+            # first stage folds the input grad into first_fn's params
+            def seed_first(gxx):
+                _, first_vjp = jax.vjp(lambda fp: first_fn(fp, data_b), first_params)
+                (g_fp,) = first_vjp(gxx.astype(x_shape.dtype))
+                return _varying(g_fp, axis_name)
+
+            g_first_m = jax.lax.cond(
+                stage == 0, seed_first,
+                lambda _gx: _varying(zero_g_first, axis_name), gx)
+
+            mask = b_active
+            maskf = mask.astype(jnp.float32)
+            g_stage = jax.tree.map(
+                lambda acc, g: acc + jnp.where(mask, g, jnp.zeros_like(g)),
+                g_stage, g_sp_m)
+            g_first = jax.tree.map(
+                lambda acc, g: acc + jnp.where(mask, g, jnp.zeros_like(g)),
+                g_first, g_first_m)
+            g_last = jax.tree.map(
+                lambda acc, g: acc + jnp.where(mask, g, jnp.zeros_like(g)),
+                g_last, g_last_m)
+            loss_sum = loss_sum + maskf * loss_m
+            bwd_msg = jax.lax.ppermute(
+                jnp.where(mask, gx, jnp.zeros_like(gx)), axis_name, bwd_perm)
+
+            return (fwd_msg, bwd_msg, stash, g_stage, g_first, g_last, loss_sum), None
+
+        carry, _ = jax.lax.scan(round_step, carry0, jnp.arange(R))
+        _, _, _, g_stage, g_first, g_last, loss_sum = carry
+        # only stage 0 / S-1 hold nonzero shared grads and loss; psum
+        # replicates them so out_specs can be P()
+        loss = jax.lax.psum(loss_sum, axis_name)
+        g_first = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_first)
+        g_last = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_last)
+        return loss, g_stage, g_first, g_last
+
+    return schedule
+
+
+def pipeline_vpp_step(block_fn, n_stages, n_micro, virtual_pp_degree,
+                      axis_name: str = "pp", remat: bool = True):
+    """Compiled interleaved (circular) virtual-pipeline forward — the
+    Megatron-VPP equivalent (reference ``PipelineParallelWithInterleave``,
+    ``pipeline_parallel.py:1174``).
+
+    Each device hosts ``V = virtual_pp_degree`` chunks of
+    ``layers_per_stage / V`` layers; virtual stage ``k = j*S + s`` (chunk j on
+    device s).  Microbatches are admitted in windows of S and loop the ring V
+    times; the ``(S-1 -> 0)`` ppermute wrap carries chunk j's output into
+    chunk j+1.  Per tick every device runs ONE chunk, so the pipeline-fill
+    bubble is ``S - 1`` CHUNK-ticks instead of GPipe's ``S - 1`` STAGE-ticks —
+    the bubble shrinks by V.  Total ticks: ``n_micro * V + S - 1``.
+
+    Backward is autodiff through the scan (F-then-B); the carry stash grows
+    with total ticks, so this trades memory for bubble — use the 1F1B schedule
+    when memory binds.
+
+    ``block_fn(chunk_params, x, *extra) -> y`` runs ONE chunk (chunk_params
+    leaves have the ``[Lps_v, ...]`` layout, local pp axis already stripped).
+    ``stage_params`` passed to the returned schedule carry ``[1, V, Lps_v, ...]``
+    leaves.  Requires ``n_micro % n_stages == 0`` (reference interleave
+    requires ``accumulate_steps % pp_degree == 0`` likewise).
+
+    Returns ``schedule(stage_params, micro_inputs, *extra) -> [1, n_micro, ...]``
+    (last row of the global ``[pp, ...]`` output holds the result).
+    """
+    S, M, V = n_stages, n_micro, virtual_pp_degree
+    if M % S != 0:
+        raise ValueError(
+            f"circular VPP needs n_micro ({M}) divisible by n_stages ({S})")
+    if remat:
+        block_fn = jax.checkpoint(block_fn)
+    T = M * V + S - 1
+
+    def schedule(stage_params, micro_inputs, *extra):
+        stage = jax.lax.axis_index(axis_name)
+        mb_shape = micro_inputs.shape[1:]
+        state0 = _varying(jnp.zeros(mb_shape, micro_inputs.dtype), axis_name)
+        out0 = _varying(jnp.zeros((M,) + mb_shape, micro_inputs.dtype), axis_name)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            u = t - stage                       # this device's slot clock
+            active = (u >= 0) & (u < M * V)
+            uc = jnp.clip(u, 0, M * V - 1)
+            w = uc // (S * V)                   # admission window
+            p = uc % (S * V)
+            j = p // S                          # chunk (virtual stage row)
+            m = w * S + p % S                   # microbatch
+            chunk = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a[0], j, 0, keepdims=False),
+                stage_params)
+            fresh = jax.lax.dynamic_index_in_dim(micro_inputs, m, 0, keepdims=False)
+            x_in = jnp.where((stage == 0) & (j == 0), fresh, state)
+            y = block_fn(chunk, x_in, *extra)
+            state = jnp.where(active, y, state)
+            emit = active & (stage == S - 1) & (j == V - 1)
+            outputs = jnp.where(
+                emit, jax.lax.dynamic_update_index_in_dim(outputs, state, m, 0),
+                outputs)
+            state = jax.lax.ppermute(state, axis_name, perm)
+            return (state, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(tick, (state0, out0), jnp.arange(T))
+        return outputs[None]
+
+    return schedule
+
+
 class PipelineParallel(Layer):
     """Runtime wrapper chosen by ``fleet.distributed_model`` (reference
     ``pipeline_parallel.py:255``).  ``train_batch`` compiles the full pipeline
@@ -203,7 +449,28 @@ class PipelineParallel(Layer):
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
 
+    def _pipeline_configs(self):
+        pc = {}
+        if self._strategy is not None:
+            pc = getattr(self._strategy, "pipeline_configs", None) or {}
+        return pc
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None, loss_fn=None):
+        """Compile + run one pipeline training step.
+
+        ``strategy.pipeline_configs`` drives the schedule (reference:
+        ``fleet/meta_parallel/pipeline_parallel.py`` train_batch +
+        ``passes/pipeline_scheduler_pass``):
+
+        - ``accumulate_steps``: number of microbatches — when PRESENT (any
+          value >= 1) it overrides the model's ``n_micro``; when absent the
+          model's own setting stands.  GPipe bubble fraction is
+          (pp-1)/(n_micro+pp-1), so raise this above pp_degree;
+        - ``schedule``: ``"FThenB"`` (compiled GPipe, autodiff backward,
+          default), ``"1F1B"`` (manual-vjp interleaved schedule, activation
+          stash bounded by 2*pp microbatches), or ``"VPP"`` (circular virtual
+          stages — model must be built with ``virtual_pp_degree > 1``).
+        """
         from ...jit import TrainStep
 
         if scaler is not None and getattr(scaler, "_enable", False):
@@ -211,15 +478,47 @@ class PipelineParallel(Layer):
                 "GradScaler inside the compiled pipeline step is not supported; "
                 "bf16 training on TPU needs no loss scaling")
         inputs, labels = data
-        cache_key = (id(optimizer), id(loss_fn))
+        pc = self._pipeline_configs()
+        schedule = str(pc.get("schedule", "FThenB"))
+        acc = int(pc["accumulate_steps"]) if "accumulate_steps" in pc else 0
+        model = self._layers
+        if acc >= 1 and getattr(model, "n_micro", None) not in (None, acc):
+            model.n_micro = acc          # invalidate compiled schedules
+            model._fwd_jit = None
+            if hasattr(model, "_manual_fn"):
+                model._manual_fn = None
+            self._compiled = None
+        if schedule.upper() == "VPP" and getattr(model, "virtual_pp_degree", 1) <= 1:
+            raise ValueError(
+                "pipeline_configs schedule='VPP' needs the model built with "
+                "virtual_pp_degree > 1 (e.g. LlamaForCausalLMPipe(cfg, "
+                "virtual_pp_degree=2))")
+
+        cache_key = (id(optimizer), id(loss_fn), schedule, acc)
         if self._compiled is None or self._compiled_key != cache_key:
-            if loss_fn is not None:
-                lf = loss_fn
-            elif hasattr(self._layers, "compute_loss"):
-                lf = lambda model, x, y: model.compute_loss(model(x), y)
+            if schedule.upper() == "1F1B":
+                if loss_fn is not None:
+                    raise ValueError(
+                        "schedule='1F1B' hand-rolls its vjp with the model's "
+                        "built-in next-token loss (build_manual_train_fn); a "
+                        "custom loss_fn would be silently ignored — use "
+                        "schedule='FThenB' with it instead")
+                if not hasattr(model, "build_manual_train_fn"):
+                    raise ValueError(
+                        f"schedule='1F1B' needs {type(model).__name__}."
+                        "build_manual_train_fn (see LlamaForCausalLMPipe)")
+                if model._manual_fn is None:
+                    model._manual_fn = model.build_manual_train_fn()
+                self._compiled = TrainStep(model, None, optimizer,
+                                           grads_fn=model._manual_fn)
             else:
-                lf = lambda model, x, y: self._layers._loss_fn(model(x), y)
-            self._compiled = TrainStep(self._layers, lf, optimizer)
+                if loss_fn is not None:
+                    lf = loss_fn
+                elif hasattr(model, "compute_loss"):
+                    lf = lambda model, x, y: model.compute_loss(model(x), y)
+                else:
+                    lf = lambda model, x, y: self._layers._loss_fn(model(x), y)
+                self._compiled = TrainStep(model, lf, optimizer)
             self._compiled_key = cache_key
         loss = self._compiled(inputs, labels)
         if lr_scheduler is not None:

@@ -172,7 +172,10 @@ class TrainStep:
         loss = step(x, y)                     # updates model params in place
     """
 
-    def __init__(self, model, loss_fn, optimizer, donate: bool = True):
+    def __init__(self, model, loss_fn, optimizer, donate: bool = True, grads_fn=None):
+        """``grads_fn(params, buffers, *args) -> (loss, grads)`` replaces the
+        default ``jax.value_and_grad`` over ``loss_fn`` when given — used by
+        schedules that hand-roll their vjp (compiled 1F1B pipeline)."""
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -190,7 +193,10 @@ class TrainStep:
                     loss = self.loss_fn(model, *t_args)
                 return unwrap(loss)
 
-            loss, grads = jax.value_and_grad(loss_of)(params)
+            if grads_fn is not None:
+                loss, grads = grads_fn(params, buffers, *args)
+            else:
+                loss, grads = jax.value_and_grad(loss_of)(params)
             if grad_clip is not None:
                 flat = [(None, g) for g in jax.tree.leaves(grads)]
                 clipped = [g for _, g in grad_clip(flat)]

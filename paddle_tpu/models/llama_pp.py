@@ -40,7 +40,8 @@ from ..nn.layers import Layer
 from ..distributed.mesh import ProcessMesh, get_mesh
 from ..distributed.placement import Replicate, Shard
 from ..distributed.api import shard_tensor
-from ..distributed.parallel.pipeline import pipeline_spmd_step
+from ..distributed.parallel.pipeline import (pipeline_1f1b_step, pipeline_spmd_step,
+                                             pipeline_vpp_step)
 from .llama import (LlamaConfig, LlamaForCausalLM, _place_all_params,
                     attention_fn, mlp_fn)
 
@@ -69,7 +70,7 @@ class LlamaForCausalLMPipe(Layer):
     """
 
     def __init__(self, config: LlamaConfig, mesh: Optional[ProcessMesh] = None,
-                 n_microbatches: Optional[int] = None):
+                 n_microbatches: Optional[int] = None, virtual_pp_degree: int = 1):
         super().__init__()
         self.config = config
         mesh = mesh if mesh is not None else get_mesh()
@@ -82,9 +83,15 @@ class LlamaForCausalLMPipe(Layer):
             raise ValueError(f"num_hidden_layers={L} not divisible by pp={pp}")
         self.pp = pp
         self.layers_per_stage = L // pp
+        if self.layers_per_stage % virtual_pp_degree != 0:
+            raise ValueError(
+                f"layers_per_stage={self.layers_per_stage} not divisible by "
+                f"virtual_pp_degree={virtual_pp_degree}")
+        self.virtual_pp_degree = virtual_pp_degree
         self.n_micro = n_microbatches or max(pp, 1)
         self._pipeline_capable = True
         self._fwd_jit = None
+        self._manual_fn = None
 
         H = config.hidden_size
         h, hk, d = config.num_attention_heads, config.kv_heads, config.head_dim
@@ -154,8 +161,19 @@ class LlamaForCausalLMPipe(Layer):
             stacks["gate_up_w"].append(_np.asarray(layer.mlp.gate_up_proj._data))
             stacks["down_w"].append(_np.asarray(layer.mlp.down_proj._data))
         Lps = self.layers_per_stage
+        # row [s, q] holds global layer (j*pp + s)*Lps_v + i with (j, i) =
+        # divmod(q, Lps_v): plain stages for V=1, circular interleave otherwise
+        # (chunk j on device s is virtual stage j*pp + s)
+        V = self.virtual_pp_degree
+        Lps_v = Lps // V
+        order = _np.empty((self.pp, Lps), dtype=_np.int64)
+        for s in range(self.pp):
+            for q in range(Lps):
+                j, i = divmod(q, Lps_v)
+                order[s, q] = (j * self.pp + s) * Lps_v + i
         for name, arrs in stacks.items():
-            stacked = _np.stack(arrs).reshape((self.pp, Lps) + arrs[0].shape)
+            stacked = _np.stack(arrs)[order.reshape(-1)].reshape(
+                (self.pp, Lps) + arrs[0].shape)
             getattr(self, name).set_value(stacked)
         self.norm_w.set_value(Tensor(model.llama.norm.weight._data))
         if model.lm_head is not None:
@@ -165,24 +183,56 @@ class LlamaForCausalLMPipe(Layer):
         return self
 
     # -- forward -------------------------------------------------------------
+    def _layers_scan_fn(self, remat: bool = False):
+        """Pure (layer_stack, x, cos, sin) -> x scanning decoder layers; the
+        shared body of every schedule (layer_stack leaves: [n, ...]).  With
+        ``remat`` each layer is a ``jax.checkpoint`` boundary, so a vjp over
+        the stack saves only per-layer inputs (the 1F1B stash contract)."""
+        cfg = self.config
+        block = _decoder_block
+        if remat:
+            block = jax.checkpoint(
+                lambda lp, xc, cos, sin: _decoder_block(lp, xc, cos, sin, cfg))
+
+        def run(stack, x, cos, sin):
+            def layer_step(xc, lp):
+                if remat:
+                    return block(lp, xc, cos, sin), None
+                return _decoder_block(lp, xc, cos, sin, cfg), None
+
+            xc, _ = jax.lax.scan(layer_step, x, stack)
+            return xc
+
+        return run
+
     def _build_fwd(self):
         """One jitted forward, built once and cached (re-jitting per call
         would recompile the whole multi-device pipeline every step)."""
         cfg = self.config
         mesh = self._mesh
-        pp, n_micro = self.pp, self.n_micro
+        pp, n_micro, V = self.pp, self.n_micro, self.virtual_pp_degree
+        run_layers = self._layers_scan_fn()
 
-        def stage_fn(stage_params, x, cos, sin):
-            """Run this stage's layers_per_stage decoder layers."""
-            def layer_step(xc, lp):
-                return _decoder_block(lp, xc, cos, sin, cfg), None
+        if V > 1:
+            Lps_v = self.layers_per_stage // V
 
-            # stage_params leaves: [1, Lps, ...] (local pp shard) -> scan over Lps
-            local = jax.tree.map(lambda a: a[0], stage_params)
-            xc, _ = jax.lax.scan(layer_step, x, local)
-            return xc
+            def chunk_fn(chunk_params, x, cos, sin):
+                # chunk_params leaves: [Lps_v, ...] (one virtual stage)
+                return run_layers(chunk_params, x, cos, sin)
 
-        schedule = pipeline_spmd_step(stage_fn, pp, n_micro, axis_name="pp", remat=True)
+            schedule = pipeline_vpp_step(chunk_fn, pp, n_micro, V,
+                                         axis_name="pp", remat=True)
+
+            def reshape_stage(a):
+                return a.reshape((pp, V, Lps_v) + a.shape[2:])
+        else:
+            def stage_fn(stage_params, x, cos, sin):
+                local = jax.tree.map(lambda a: a[0], stage_params)
+                return run_layers(local, x, cos, sin)
+
+            schedule = pipeline_spmd_step(stage_fn, pp, n_micro,
+                                          axis_name="pp", remat=True)
+            reshape_stage = None
 
         def fwd(ids, embed, ln1, qkv, o, ln2, gate_up, down, norm_w, head, cos, sin):
             B, S = ids.shape
@@ -191,6 +241,8 @@ class LlamaForCausalLMPipe(Layer):
             micro = x.reshape(n_micro, mb, S, cfg.hidden_size)
             stacked = {"ln1": ln1, "qkv": qkv, "o": o, "ln2": ln2,
                        "gate_up": gate_up, "down": down}
+            if reshape_stage is not None:
+                stacked = jax.tree.map(reshape_stage, stacked)
             sm = jax.shard_map(
                 schedule,
                 mesh=mesh.jax_mesh,
@@ -207,6 +259,79 @@ class LlamaForCausalLMPipe(Layer):
         # jit is required around shard_map even on the eager path; cached so
         # repeat calls hit jit's compile cache (keyed on shapes)
         return jax.jit(fwd)
+
+    # -- compiled 1F1B: manual-vjp train grads ------------------------------
+    def build_manual_train_fn(self, ignore_index: int = -100):
+        """Returns ``fn(params, buffers, ids, labels) -> (loss, grads)`` running
+        the compiled 1F1B schedule (``pipeline_1f1b_step``): fwd/bwd interleaved,
+        per-device activation stash bounded by 2*pp microbatches regardless of
+        ``n_micro``.  Loss/grads match ``compute_loss`` exactly: per-microbatch
+        token-NLL sums are scaled by the precomputed global ``1/mask_count``.
+        Plugs into ``jit.TrainStep(grads_fn=...)``.
+        """
+        cfg = self.config
+        mesh = self._mesh
+        pp, n_micro = self.pp, self.n_micro
+        if self.virtual_pp_degree > 1:
+            raise NotImplementedError(
+                "1F1B with virtual stages (interleaved 1F1B) is not implemented; "
+                "use schedule='1F1B' with virtual_pp_degree=1 or schedule='VPP'")
+        run_layers = self._layers_scan_fn(remat=True)
+
+        def block_fn(stage_params, x, cos, sin):
+            local = jax.tree.map(lambda a: a[0], stage_params)
+            return run_layers(local, x, cos, sin)
+
+        def first_fn(fp, data_m):
+            ids_m = data_m[0]
+            return jnp.take(fp["embed"], ids_m, axis=0)
+
+        def last_fn(lp, y, data_m):
+            labels_m, inv_count = data_m[1], data_m[2]
+            x = rms_mod._rms_norm_ref(y, lp["norm"], cfg.rms_norm_eps)
+            logits = x @ lp["head"].astype(x.dtype)
+            lg = logits[:, :-1, :].astype(jnp.float32)
+            lb = labels_m[:, 1:]
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            nll = -jnp.take_along_axis(logp, lb[..., None], axis=-1)[..., 0]
+            mask = (lb != ignore_index).astype(jnp.float32)
+            return jnp.sum(nll * mask) * inv_count
+
+        schedule = pipeline_1f1b_step(first_fn, block_fn, last_fn, pp, n_micro,
+                                      axis_name="pp")
+
+        def manual_fn(params, buffers, ids, labels):
+            B, S = ids.shape
+            mb = B // n_micro
+            stacked = {"ln1": params["ln1_w"], "qkv": params["qkv_w"],
+                       "o": params["o_w"], "ln2": params["ln2_w"],
+                       "gate_up": params["gate_up_w"], "down": params["down_w"]}
+            first = {"embed": params["embed_tokens"]}
+            last = {"norm": params["norm_w"], "head": params["lm_head"]}
+            # global mask count known up front -> exact global-mean normalization
+            inv_count = 1.0 / jnp.maximum(
+                jnp.sum((labels[:, 1:] != ignore_index).astype(jnp.float32)), 1.0)
+            inv_b = jnp.broadcast_to(inv_count, (n_micro,))
+            micro = (ids.reshape(n_micro, mb, S), labels.reshape(n_micro, mb, S), inv_b)
+            cos, sin = buffers["rope_cos"], buffers["rope_sin"]
+            P = PartitionSpec
+            sm = jax.shard_map(
+                schedule,
+                mesh=mesh.jax_mesh,
+                in_specs=(jax.tree.map(lambda _: P("pp"), stacked),
+                          P(), P(), P(), P(), P()),
+                out_specs=(P(), jax.tree.map(lambda _: P("pp"), stacked), P(), P()),
+                axis_names={"pp"},
+            )
+            loss, g_stage, g_first, g_last = sm(stacked, first, last, micro, cos, sin)
+            grads = {"ln1_w": g_stage["ln1"], "qkv_w": g_stage["qkv"],
+                     "o_w": g_stage["o"], "ln2_w": g_stage["ln2"],
+                     "gate_up_w": g_stage["gate_up"], "down_w": g_stage["down"],
+                     "embed_tokens": g_first["embed"],
+                     "norm_w": g_last["norm"], "lm_head": g_last["head"]}
+            return loss, grads
+
+        return manual_fn
 
     def forward(self, input_ids):
         ids_t = input_ids if isinstance(input_ids, Tensor) else Tensor(np.asarray(input_ids))

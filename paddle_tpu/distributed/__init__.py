@@ -22,6 +22,7 @@ from .api import (  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from . import parallel  # noqa: F401
+from . import sharding  # noqa: F401
 from .parallel import (  # noqa: F401
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear, VocabParallelEmbedding,
 )

@@ -193,16 +193,15 @@ def shard_layer(layer, process_mesh: ProcessMesh, shard_fn: Optional[Callable] =
     return layer
 
 
-def _zero1_state_placements(p, mesh: ProcessMesh, shard_axes) -> list:
-    """ZeRO-1 placement for one optimizer-state buffer of param ``p``: keep the
-    param's own sharding and ADDITIONALLY shard over the dp/sharding axes
-    (reference ``GroupShardedOptimizerStage2`` semantics: each dp rank owns a
-    1/dp slice of every moment/master buffer)."""
-    base = list(p._dist_attr[1]) if p._dist_attr is not None else [Replicate()] * mesh.ndim
+def _extend_with_dp_shard(base: list, shape, mesh: ProcessMesh, shard_axes) -> list:
+    """Extend ``base`` placements with Shard entries over the dp/sharding mesh
+    axes, picking the largest not-yet-sharded tensor dim divisible by each
+    axis size (reference ``GroupShardedOptimizerStage2`` 1/dp ownership)."""
+    base = list(base)
     while len(base) < mesh.ndim:
         base.append(Replicate())
     taken = {pl.dim for pl in base if isinstance(pl, Shard)}
-    shape = list(p.shape)
+    shape = list(shape)
     for mesh_dim in shard_axes:
         if not isinstance(base[mesh_dim], Replicate):
             continue
@@ -219,20 +218,75 @@ def _zero1_state_placements(p, mesh: ProcessMesh, shard_axes) -> list:
     return base
 
 
-def shard_optimizer(optimizer, shard_fn=None, mesh: Optional[ProcessMesh] = None):
-    """ZeRO-1 optimizer-state sharding (reference api.py:1591 + ShardingStage1;
-    ``fleet/meta_parallel/sharding/group_sharded_optimizer_stage2.py:53``).
+def _zero1_state_placements(p, mesh: ProcessMesh, shard_axes) -> list:
+    """ZeRO-1 placement for one optimizer-state buffer of param ``p``: keep the
+    param's own sharding and ADDITIONALLY shard over the dp/sharding axes."""
+    base = list(p._dist_attr[1]) if p._dist_attr is not None else [Replicate()] * mesh.ndim
+    return _extend_with_dp_shard(base, p.shape, mesh, shard_axes)
 
-    Every moment/master-weight buffer is placed with the param's sharding PLUS
-    a shard over the dp/sharding mesh axes, so per-device optimizer-state
-    bytes shrink by the dp degree.  The optimizer update is elementwise per
-    buffer, so XLA runs each shard's update locally; the updated master weight
-    is re-placed into the param's own placement on write-back — the
-    reduce-scatter/all-gather pattern of ZeRO, planned by GSPMD.
 
+def _placements_from_array(arr, mesh: ProcessMesh) -> list:
+    """Recover per-mesh-dim placements from a concrete array's NamedSharding
+    (unnamed axes -> Replicate)."""
+    base = [Replicate()] * mesh.ndim
+    spec = getattr(getattr(arr, "sharding", None), "spec", None)
+    if spec is None:
+        return base
+    for d, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, (tuple, list)) else (entry,)
+        for nm in names:
+            if nm in mesh.dim_names:
+                base[mesh.dim_names.index(nm)] = Shard(d)
+    return base
+
+
+def _restrict_to_shape(base: list, shape) -> list:
+    """Drop Shard entries referencing dims a (smaller) buffer doesn't have —
+    e.g. scalar slots of a matrix param."""
+    out = []
+    for pl in base:
+        if isinstance(pl, Shard) and (pl.dim >= len(shape) or shape[pl.dim] <= 1):
+            out.append(Replicate())
+        else:
+            out.append(pl)
+    return out
+
+
+def _pin_sharding(v, shd):
+    """Pin a sharding on a concrete array (device_put) or a traced value
+    (with_sharding_constraint) alike."""
+    if isinstance(v, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(v, shd)
+    return jax.device_put(v, shd)
+
+
+def shard_optimizer(optimizer, shard_fn=None, mesh: Optional[ProcessMesh] = None,
+                    stage: int = 1):
+    """ZeRO-stage sharding over the dp/'sharding' mesh axes (reference
+    api.py:1591 ShardingStage1/2/3 + ``fleet/meta_parallel/sharding/
+    group_sharded_optimizer_stage2.py:53``, ``group_sharded_stage3.py:85``).
+
+    - ``stage=1``: every moment/master buffer is placed with the param's own
+      sharding PLUS a shard over dp — per-device optimizer-state bytes shrink
+      by the dp degree.
+    - ``stage=2``: additionally pins the GRADIENTS to the same dp-sharded
+      layout inside the compiled update, so XLA reduce-scatters them over dp
+      instead of all-reducing (the reference's grad-segmenting stage 2).
+    - ``stage=3``: additionally re-places the PARAMETERS themselves dp-sharded;
+      GSPMD all-gathers each weight at use in forward/backward and frees it
+      after — gather-on-use without the reference's pre/post-forward hooks or
+      1MB segmenting (``group_sharded_stage3.py:139``), because sharding specs
+      express it declaratively.
+
+    Both the eager update path and the ``functional()`` path used by
+    ``jit.TrainStep`` are wrapped; call this BEFORE constructing TrainStep.
     ``shard_fn(param, state_name, mesh) -> placements`` overrides the default
     placement per state buffer.
     """
+    if stage not in (1, 2, 3):
+        raise ValueError(f"stage must be 1, 2 or 3, got {stage}")
     mesh = mesh or get_mesh()
     if mesh is None:
         raise ValueError("shard_optimizer needs a mesh (pass mesh= or set one via fleet.init)")
@@ -240,11 +294,21 @@ def shard_optimizer(optimizer, shard_fn=None, mesh: Optional[ProcessMesh] = None
     if not shard_axes:
         shard_axes = [0]
 
+    if stage >= 3:
+        # FSDP: the weights themselves live dp-sharded from now on
+        for p in optimizer._parameter_list:
+            if not getattr(p, "trainable", True):
+                continue
+            placements = _zero1_state_placements(p, mesh, shard_axes)
+            shard_tensor(p, mesh, placements)
+
     def _state_sharding(p, state_name, v):
         placements = (shard_fn(p, state_name, mesh) if shard_fn is not None
-                      else _zero1_state_placements(p, mesh, shard_axes))
+                      else _restrict_to_shape(
+                          _zero1_state_placements(p, mesh, shard_axes), v.shape))
         return named_sharding(mesh, placements, v.ndim)
 
+    # ---- eager path (Optimizer.step over the parameter list) ----------------
     orig_build = optimizer._build_update_fn
 
     def build_with_shardings():
@@ -252,31 +316,95 @@ def shard_optimizer(optimizer, shard_fn=None, mesh: Optional[ProcessMesh] = None
         params = optimizer._parameter_list
 
         def wrapped(params_data, grads, states, lr, step):
+            if stage >= 2:
+                grads = [
+                    g if g is None else _pin_sharding(g, _state_sharding(p, "grad", g))
+                    for p, g in zip(params, grads)
+                ]
             new_params, new_states = fn(params_data, grads, states, lr, step)
             out_p = []
             for p, np_ in zip(params, new_params):
-                if p._dist_attr is not None and not isinstance(np_, jax.core.Tracer):
+                if p._dist_attr is not None:
                     m, pl = p._dist_attr
-                    np_ = jax.device_put(np_, named_sharding(m, pl, np_.ndim))
+                    np_ = _pin_sharding(np_, named_sharding(m, pl, np_.ndim))
                 out_p.append(np_)
             # pin state shardings so the ZeRO layout survives the jitted update
             out_s = []
             for p, s in zip(params, new_states):
-                out_s.append({
-                    k: (v if isinstance(v, jax.core.Tracer) else jax.device_put(v, _state_sharding(p, k, v)))
-                    for k, v in s.items()
-                })
+                out_s.append({k: _pin_sharding(v, _state_sharding(p, k, v))
+                              for k, v in s.items()})
             return out_p, out_s
 
         return wrapped
 
     optimizer._build_update_fn = build_with_shardings
     optimizer._jitted_update = None  # drop any pre-wrap compiled update
-    # shard any existing/initial state now: per-device state bytes shrink by dp
-    optimizer._ensure_state()
-    for p, slots in zip(optimizer._parameter_list, optimizer._state):
+    # Re-place state that ALREADY exists (e.g. mid-training adoption).  Fresh
+    # state is NOT materialized here: TrainStep builds its own via
+    # functional(), and eagerly allocating a second dp-sharded copy of the
+    # moments/master weights would double the resident state this feature
+    # exists to shrink.  The eager path's first update pins the layout via
+    # the wrapped fn's output placement.
+    for p, slots in zip(optimizer._parameter_list, optimizer._state or []):
         for k, v in slots.items():
             slots[k] = jax.device_put(v, _state_sharding(p, k, v))
+
+    # ---- functional path (jit.TrainStep) ------------------------------------
+    # TrainStep builds its own state via functional()'s init_fn, so the ZeRO
+    # layout must be applied THERE, and the update must re-pin it (the round-2
+    # gap: state re-placement only happened in eager).
+    orig_functional = optimizer.functional
+    # name -> sharding, captured when init_fn runs on the concrete params
+    param_shd: dict = {}
+    grad_shd: dict = {}
+    state_shd: dict = {}
+
+    def _leaf_shardings(name, p_arr, slots):
+        base = _placements_from_array(p_arr, mesh)
+        if stage >= 3:
+            base = _extend_with_dp_shard(base, p_arr.shape, mesh, shard_axes)
+        param_shd[name] = named_sharding(mesh, base, p_arr.ndim)
+        ext = _extend_with_dp_shard(base, p_arr.shape, mesh, shard_axes)
+        grad_shd[name] = named_sharding(mesh, ext, p_arr.ndim)
+        out = {}
+        for k, v in slots.items():
+            pl = _restrict_to_shape(ext, v.shape)
+            out[k] = named_sharding(mesh, pl, v.ndim)
+        state_shd[name] = out
+        return out
+
+    def functional_sharded():
+        init_fn, update_fn = orig_functional()
+
+        def init2(params):
+            state = init_fn(params)
+            placed = {}
+            for name, slots in state.items():
+                shds = _leaf_shardings(name, params[name], slots)
+                placed[name] = {k: _pin_sharding(v, shds[k]) for k, v in slots.items()}
+            return placed
+
+        def update2(params, grads, state, lr, step):
+            if stage >= 2 and grad_shd:
+                grads = {
+                    name: _pin_sharding(g, grad_shd[name])
+                    if name in grad_shd and hasattr(g, "ndim") else g
+                    for name, g in grads.items()
+                }
+            new_p, new_s = update_fn(params, grads, state, lr, step)
+            if param_shd:
+                new_p = {name: _pin_sharding(v, param_shd[name]) if name in param_shd else v
+                         for name, v in new_p.items()}
+                new_s = {name: ({k: _pin_sharding(v, state_shd[name][k])
+                                 for k, v in slots.items()}
+                                if name in state_shd else slots)
+                         for name, slots in new_s.items()}
+            return new_p, new_s
+
+        return init2, update2
+
+    optimizer.functional = functional_sharded
+    optimizer._zero_stage = stage
     return optimizer
 
 

@@ -480,6 +480,10 @@ class PipelineParallel(Layer):
         inputs, labels = data
         pc = self._pipeline_configs()
         schedule = str(pc.get("schedule", "FThenB"))
+        if schedule.upper() not in ("FTHENB", "GPIPE", "1F1B", "VPP"):
+            raise ValueError(
+                f"unknown pipeline schedule {schedule!r}; choose FThenB (GPipe), "
+                "1F1B, or VPP — a typo must not silently fall back to FThenB")
         acc = int(pc["accumulate_steps"]) if "accumulate_steps" in pc else 0
         model = self._layers
         if acc >= 1 and getattr(model, "n_micro", None) not in (None, acc):

@@ -189,16 +189,13 @@ class LlamaForCausalLMPipe(Layer):
         ``remat`` each layer is a ``jax.checkpoint`` boundary, so a vjp over
         the stack saves only per-layer inputs (the 1F1B stash contract)."""
         cfg = self.config
-        block = _decoder_block
+        body = lambda lp, xc, cos, sin: _decoder_block(lp, xc, cos, sin, cfg)
         if remat:
-            block = jax.checkpoint(
-                lambda lp, xc, cos, sin: _decoder_block(lp, xc, cos, sin, cfg))
+            body = jax.checkpoint(body)
 
         def run(stack, x, cos, sin):
             def layer_step(xc, lp):
-                if remat:
-                    return block(lp, xc, cos, sin), None
-                return _decoder_block(lp, xc, cos, sin, cfg), None
+                return body(lp, xc, cos, sin), None
 
             xc, _ = jax.lax.scan(layer_step, x, stack)
             return xc
@@ -302,6 +299,9 @@ class LlamaForCausalLMPipe(Layer):
 
         def manual_fn(params, buffers, ids, labels):
             B, S = ids.shape
+            if B % n_micro != 0:
+                raise ValueError(
+                    f"batch {B} not divisible by n_microbatches {n_micro}")
             mb = B // n_micro
             stacked = {"ln1": params["ln1_w"], "qkv": params["qkv_w"],
                        "o": params["o_w"], "ln2": params["ln2_w"],

@@ -58,22 +58,19 @@ def test_shard_optimizer_state_bytes_shrink(mesh8):
         dist.shard_tensor(p, mesh8, [dist.Replicate(), dist.Replicate()])
     opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=layer.parameters())
     opt = dist.shard_optimizer(opt, mesh=mesh8)
-    # moment buffers for the (16,32) weight must be sharded over dp (2x shrink)
-    w_slots = opt._state[0]
-    m = w_slots["m"]
-    total = m.nbytes
-    local = max(s.data.nbytes for s in m.addressable_shards)
-    assert local <= total // 2, f"optimizer state not sharded: local={local} total={total}"
-    # and a step still trains correctly
+    # a step trains correctly and leaves the moment buffers dp-sharded
+    # (state materializes lazily — no duplicate resident copy before use)
     x = paddle.to_tensor(np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32))
     loss = (layer(x) ** 2).mean()
     loss.backward()
     before = layer.weight.numpy().copy()
     opt.step()
     assert not np.allclose(before, layer.weight.numpy())
-    # ZeRO layout survives the update
-    m2 = opt._state[0]["m"]
-    assert max(s.data.nbytes for s in m2.addressable_shards) <= total // 2
+    # moment buffers for the (16,32) weight are sharded over dp (2x shrink)
+    m = opt._state[0]["m"]
+    total = m.nbytes
+    local = max(s.data.nbytes for s in m.addressable_shards)
+    assert local <= total // 2, f"optimizer state not sharded: local={local} total={total}"
 
 
 def test_distributed_optimizer_wrap():

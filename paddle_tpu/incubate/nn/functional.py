@@ -18,7 +18,8 @@ from ...kernels import rope as _rope
 from ...kernels import swiglu as _swiglu
 
 __all__ = ["fused_rms_norm", "fused_layer_norm", "swiglu", "fused_rotary_position_embedding",
-           "fused_bias_act", "fused_linear", "fused_dropout_add"]
+           "fused_bias_act", "fused_linear", "fused_dropout_add",
+           "masked_multihead_attention", "block_multihead_attention"]
 
 
 def _t(v):
@@ -102,3 +103,34 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train", name=
     from ...nn import functional as F
 
     return F.dropout(x, p, training=training, mode=mode) + y
+
+
+def masked_multihead_attention(q, k_cache, v_cache, lengths, sm_scale=None):
+    """Single-token decode attention over a dense KV cache (reference
+    ``incubate/nn/functional/masked_multihead_attention.py`` / the decode-MHA
+    CUDA kernel).  q: [B, 1, H, D]; caches [B, C, Hk, D]; lengths [B] int32."""
+    from ...kernels import decode_attention as _da
+
+    def f(qq, kk, vv):
+        return _da.masked_multihead_attention(
+            qq, kk, vv, lengths._data if isinstance(lengths, Tensor) else lengths,
+            sm_scale=sm_scale)
+
+    return apply_op("masked_multihead_attention", f,
+                    (_t(q), _t(k_cache), _t(v_cache)), {})
+
+
+def block_multihead_attention(q, k_blocks, v_blocks, block_table, lengths, sm_scale=None):
+    """Paged (block) KV-cache decode attention (reference
+    ``incubate/nn/functional/block_multihead_attention.py`` /
+    ``block_multi_head_attention_kernel.cu``)."""
+    from ...kernels import decode_attention as _da
+
+    raw = lambda v: v._data if isinstance(v, Tensor) else v
+
+    def f(qq, kk, vv):
+        return _da.paged_attention(qq, kk, vv, raw(block_table), raw(lengths),
+                                   sm_scale=sm_scale)
+
+    return apply_op("block_multihead_attention", f,
+                    (_t(q), _t(k_blocks), _t(v_blocks)), {})

@@ -1,0 +1,214 @@
+"""Decode-time attention: KV-cache attention, decode-MHA Pallas kernel, paged attention.
+
+Counterparts of the reference's LLM-inference fused kernels:
+
+- ``masked_multihead_attention`` — decode attention over a dense KV cache
+  (``paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu``,
+  Python API ``incubate/nn/functional/masked_multihead_attention.py``).
+- ``block_multi_head_attention`` — paged KV-cache attention
+  (``paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu``,
+  Python API ``incubate/nn/functional/block_multihead_attention.py``).
+
+TPU-native design, not a port:
+
+- The cache is a dense ``[B, capacity, kv_heads, head_dim]`` ring written with
+  ``lax.dynamic_update_slice`` (static shapes keep XLA happy; the reference
+  grows CUDA buffers instead).
+- Prefill attends with an absolute-position causal mask; decode (S=1) is a
+  Pallas online-softmax kernel over the cache with a length mask — a GQA GEMV
+  that is HBM-bandwidth-bound, so the kernel's job is to stream K/V exactly
+  once (the reference's kernel splits over cache chunks the same way).
+- The paged layout keeps fixed-size blocks addressed by a per-sequence block
+  table; the gather is XLA ``take`` over the block axis (the reference walks
+  the table inside the CUDA kernel).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# XLA reference paths
+# ---------------------------------------------------------------------------
+
+def cached_attention_reference(q, k_cache, v_cache, offset, sm_scale: Optional[float] = None):
+    """Attention of a chunk against the (already updated) KV cache.
+
+    q: ``[B, S, H, D]`` at absolute positions ``offset .. offset+S``;
+    k_cache/v_cache: ``[B, C, Hk, D]``.  Causal against absolute positions:
+    row ``i`` sees cache slots ``j <= offset + i``.  Returns ``[B, S, H, D]``.
+    """
+    B, S, h, d = q.shape
+    C, hk = k_cache.shape[1], k_cache.shape[2]
+    rep = h // hk
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32).reshape(B, S, hk, rep, d)
+    s = jnp.einsum("bsgrd,bcgd->bgrsc", qf, k_cache.astype(jnp.float32)) * sm_scale
+    q_pos = offset + jnp.arange(S)
+    mask = jnp.arange(C)[None, :] <= q_pos[:, None]  # [S, C]
+    s = jnp.where(mask[None, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bgrsc,bcgd->bsgrd", p, v_cache.astype(jnp.float32))
+    return o.reshape(B, S, h, d).astype(q.dtype)
+
+
+def _decode_reference(q, k_cache, v_cache, lengths, sm_scale: float):
+    """Single-step decode with per-sequence lengths. q: [B, 1, H, D]; lengths: [B]."""
+    B, _, h, d = q.shape
+    C, hk = k_cache.shape[1], k_cache.shape[2]
+    rep = h // hk
+    qf = q.astype(jnp.float32).reshape(B, 1, hk, rep, d)
+    s = jnp.einsum("bsgrd,bcgd->bgrsc", qf, k_cache.astype(jnp.float32)) * sm_scale
+    mask = jnp.arange(C)[None, :] < lengths[:, None]  # [B, C]
+    s = jnp.where(mask[:, None, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bgrsc,bcgd->bsgrd", p, v_cache.astype(jnp.float32))
+    return o.reshape(B, 1, h, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas decode kernel (masked_multihead_attention role)
+# ---------------------------------------------------------------------------
+
+def _pallas_decode(q, k_cache, v_cache, lengths, sm_scale: float,
+                   block_k: int = 128, interpret: bool = False):
+    """q: [B, 1, H, D]; caches [B, C, Hk, D]; lengths: [B] int32.
+
+    Grid over (B * Hk); each program streams that head's cache once, carrying
+    online-softmax stats for its ``rep = H/Hk`` query rows.  Only blocks below
+    the live length are visited.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, _, h, d = q.shape
+    C, hk = k_cache.shape[1], k_cache.shape[2]
+    rep = h // hk
+    n_k = C // block_k
+
+    qr = q.reshape(B, hk, rep, d).reshape(B * hk, rep, d)
+    kr = jnp.swapaxes(k_cache, 1, 2).reshape(B * hk, C, d)
+    vr = jnp.swapaxes(v_cache, 1, 2).reshape(B * hk, C, d)
+    # per-program live length, scalar-prefetched into SMEM (Mosaic rejects
+    # sub-(8,128) VMEM blocks; SMEM is where control scalars belong anyway)
+    len_r = jnp.broadcast_to(lengths.astype(jnp.int32)[:, None], (B, hk)).reshape(B * hk)
+
+    def kernel(len_ref, q_ref, k_ref, v_ref, o_ref):
+        qb = q_ref[0].astype(jnp.float32)  # [rep, d]
+        L = len_ref[pl.program_id(0)]
+
+        def body(ki, carry):
+            acc, m_prev, l_prev = carry
+            kb = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+            s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * sm_scale
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (rep, block_k), 1)
+            s = jnp.where(k_pos < L, s, NEG_INF)
+            m_cur = jnp.max(s, axis=1)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1)
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            return acc, m_new, l_new
+
+        acc0 = jnp.zeros((rep, d), jnp.float32)
+        m0 = jnp.full((rep,), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((rep,), jnp.float32)
+        hi = jnp.minimum((L + block_k - 1) // block_k, n_k)
+        acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B * hk,),
+            in_specs=[
+                pl.BlockSpec((1, rep, d), lambda b, *_: (b, 0, 0)),
+                pl.BlockSpec((1, C, d), lambda b, *_: (b, 0, 0)),
+                pl.BlockSpec((1, C, d), lambda b, *_: (b, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, rep, d), lambda b, *_: (b, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * hk, rep, d), q.dtype),
+        interpret=interpret,
+    )(len_r, qr, kr, vr)
+    return out.reshape(B, hk, rep, d).reshape(B, 1, h, d)
+
+
+def masked_multihead_attention(q, k_cache, v_cache, lengths, sm_scale: Optional[float] = None,
+                               interpret: bool = False):
+    """Single-token decode attention over a dense KV cache.
+
+    q: ``[B, 1, H, D]``; caches ``[B, C, Hk, D]``; ``lengths`` ``[B]`` int32
+    (number of valid cache slots per sequence, INCLUDING the current token,
+    which must already be written to the cache).  Reference role:
+    ``masked_multihead_attention_kernel.cu``.
+    """
+    from . import use_pallas
+
+    B, S, h, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    if lengths.ndim == 0:
+        lengths = jnp.broadcast_to(lengths[None], (B,))
+    C = k_cache.shape[1]
+    kernel_ok = S == 1 and d in (64, 128, 256) and C % 128 == 0
+    if (use_pallas() or interpret) and kernel_ok:
+        return _pallas_decode(q, k_cache, v_cache, lengths, sm_scale, interpret=interpret)
+    return _decode_reference(q, k_cache, v_cache, lengths, sm_scale)
+
+
+# ---------------------------------------------------------------------------
+# Paged (block) KV cache — block_multi_head_attention role
+# ---------------------------------------------------------------------------
+
+def paged_attention(q, k_blocks, v_blocks, block_table, lengths,
+                    sm_scale: Optional[float] = None):
+    """Decode attention over a paged KV cache.
+
+    q: ``[B, 1, H, D]``; ``k_blocks/v_blocks``: ``[num_blocks, bs, Hk, D]``
+    global block pools; ``block_table``: ``[B, max_blocks]`` int32 (physical
+    block id per logical block; unused entries may be any valid id — they are
+    masked by ``lengths``); ``lengths``: ``[B]`` valid token count per seq.
+    """
+    nb, bs, hk, d = k_blocks.shape
+    B = q.shape[0]
+    # gather each sequence's logical cache: [B, max_blocks, bs, hk, d] -> [B, C, hk, d]
+    k = jnp.take(k_blocks, block_table, axis=0).reshape(B, -1, hk, d)
+    v = jnp.take(v_blocks, block_table, axis=0).reshape(B, -1, hk, d)
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    return masked_multihead_attention(q, k, v, lengths, sm_scale=sm_scale)
+
+
+def write_paged_kv(k_blocks, v_blocks, block_table, lengths, k_new, v_new):
+    """Append one token's K/V per sequence into the paged pools.
+
+    k_new/v_new: ``[B, 1, Hk, D]``.  The target physical slot for sequence b is
+    block ``block_table[b, lengths[b] // bs]``, offset ``lengths[b] % bs``.
+    Returns updated (k_blocks, v_blocks).  Scatter via ``.at[]`` — XLA lowers
+    to an in-place dynamic-update when the buffer is donated.
+    """
+    nb, bs, hk, d = k_blocks.shape
+    B = k_new.shape[0]
+    lengths = jnp.asarray(lengths, jnp.int32)
+    phys = jnp.take_along_axis(block_table, (lengths // bs)[:, None], axis=1)[:, 0]  # [B]
+    slot = lengths % bs
+    k_blocks = k_blocks.at[phys, slot].set(k_new[:, 0])
+    v_blocks = v_blocks.at[phys, slot].set(v_new[:, 0])
+    return k_blocks, v_blocks

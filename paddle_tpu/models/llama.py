@@ -118,6 +118,11 @@ def llama3_70b_config(**overrides) -> LlamaConfig:
 # sharding helpers
 # ---------------------------------------------------------------------------
 
+def _raw(x):
+    """Tensor-or-array -> raw jax array (cache pytrees may arrive either way)."""
+    return x._data if isinstance(x, Tensor) else x
+
+
 def _mesh_axis(mesh: Optional[ProcessMesh], name: str) -> Optional[int]:
     if mesh is None or name not in mesh.dim_names:
         return None
@@ -207,6 +212,40 @@ def attention_fn(hidden, w_qkv, w_o, cos, sin, cfg: LlamaConfig, position_ids=No
     return o.reshape(B, S, h * d) @ w_o.astype(hidden.dtype)
 
 
+def cached_attention_fn(hidden, w_qkv, w_o, k_cache, v_cache, cos, sin, offset,
+                        cfg: LlamaConfig):
+    """Incremental GQA attention with a KV cache (the ``use_cache`` path).
+
+    ``hidden``: the S-token chunk at absolute positions ``offset..offset+S``
+    (S = prompt length at prefill, 1 per decode step).  Writes the chunk's K/V
+    into the cache at ``offset`` (``dynamic_update_slice``; offset may be a
+    traced scalar so one compiled program serves every decode step), then
+    attends against the cache: the decode-MHA Pallas kernel for S=1, the
+    absolute-causal XLA path otherwise.  Reference role:
+    ``block_multi_head_attention_kernel.cu`` / ``masked_multihead_attention``.
+    """
+    from ..kernels import decode_attention as da
+
+    h, hk, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    B, S, _ = hidden.shape
+    qkv = hidden @ w_qkv.astype(hidden.dtype)
+    q, k, v = jnp.split(qkv, [h * d, (h + hk) * d], axis=-1)
+    q = q.reshape(B, S, h, d)
+    k = k.reshape(B, S, hk, d)
+    v = v.reshape(B, S, hk, d)
+    pos = offset + jnp.arange(S)[None, :]  # [1, S] broadcasts over batch
+    pos = jnp.broadcast_to(pos, (B, S))
+    q, k = rope_mod.apply_rope(q, k, cos, sin, pos)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, offset, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, offset, 0, 0))
+    if S == 1:
+        o = da.masked_multihead_attention(q, k_cache, v_cache, offset + 1)
+    else:
+        o = da.cached_attention_reference(q, k_cache, v_cache, offset)
+    out = o.reshape(B, S, h * d) @ w_o.astype(hidden.dtype)
+    return out, k_cache, v_cache
+
+
 def mlp_fn(hidden, w_gate_up, w_down, intermediate_size: int):
     """Pure SwiGLU MLP over raw arrays with fused gate_up matmul."""
     gu = hidden @ w_gate_up.astype(hidden.dtype)
@@ -234,8 +273,21 @@ class LlamaAttention(Layer):
         _shard_param(self.qkv_proj, mesh, 1)
         _shard_param(self.o_proj, mesh, 0)
 
-    def forward(self, x, cos, sin, position_ids=None):
+    def forward(self, x, cos, sin, position_ids=None, cache=None):
         cfg = self.config
+
+        if cache is not None:
+            k_c, v_c, offset = cache
+
+            def attn_cached(hidden, w_qkv, w_o, kc, vc, cos_t, sin_t):
+                return cached_attention_fn(hidden, w_qkv, w_o, kc, vc, cos_t, sin_t,
+                                           offset, cfg)
+
+            out, nk, nv = apply_op(
+                "masked_multihead_attention", attn_cached,
+                (x, self.qkv_proj, self.o_proj, Tensor(k_c), Tensor(v_c), cos, sin),
+                {}, num_outputs=3)
+            return out, (nk._data, nv._data)
 
         def attn(hidden, w_qkv, w_o, cos_t, sin_t):
             return attention_fn(hidden, w_qkv, w_o, cos_t, sin_t, cfg, position_ids)
@@ -288,11 +340,18 @@ class LlamaDecoderLayer(Layer):
         self._mesh = mesh
         self._sp = config.sequence_parallel
 
-    def forward(self, x, cos, sin, position_ids=None):
+    def forward(self, x, cos, sin, position_ids=None, cache=None):
         """MoE configs return ``(x, aux_loss)`` so the router's load-balancing
         loss flows FUNCTIONALLY through jit/checkpoint boundaries; dense
-        configs return just ``x``."""
-        h = self.self_attn(self.input_layernorm(x), cos, sin, position_ids)
+        configs return just ``x``.  With ``cache`` (a ``(k, v, offset)``
+        triple of raw arrays) the layer runs incrementally and appends the
+        updated ``(k, v)`` pair to its return value."""
+        if cache is not None:
+            h, new_kv = self.self_attn(self.input_layernorm(x), cos, sin,
+                                       position_ids, cache=cache)
+        else:
+            h = self.self_attn(self.input_layernorm(x), cos, sin, position_ids)
+            new_kv = None
         x = x + h
         x = _constrain_hidden(x, self._mesh, self._sp)
         if self._is_moe:
@@ -302,6 +361,10 @@ class LlamaDecoderLayer(Layer):
             aux = None
         x = x + h
         x = _constrain_hidden(x, self._mesh, self._sp)
+        if new_kv is not None:
+            if self._is_moe:
+                return x, aux, new_kv
+            return x, new_kv
         if self._is_moe:
             return x, aux
         return x
@@ -325,14 +388,45 @@ class LlamaModel(Layer):
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
-    def forward(self, input_ids, position_ids=None):
+    def init_cache(self, batch_size: int, max_len: int, dtype=None):
+        """Zero KV cache: ``{"kv": ((k, v), ...) per layer, "offset": int32}``.
+
+        ``max_len`` is rounded up to a multiple of 128 so the decode-MHA
+        Pallas kernel's block shapes always apply (extra slots are never
+        attended — the length mask covers them).
+        """
+        cfg = self.config
+        max_len = (max_len + 127) // 128 * 128
+        dt = jnp.dtype(dtype) if dtype is not None else jnp.dtype(cfg.dtype)
+        shape = (batch_size, max_len, cfg.kv_heads, cfg.head_dim)
+        kv = tuple((jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                   for _ in range(cfg.num_hidden_layers))
+        return {"kv": kv, "offset": jnp.asarray(0, jnp.int32)}
+
+    def forward(self, input_ids, position_ids=None, cache=None):
         """Returns the final hidden states; for MoE configs returns
-        ``(hidden, aux_loss_total)``."""
+        ``(hidden, aux_loss_total)``.  With ``cache`` (from :meth:`init_cache`)
+        runs incrementally and additionally returns the updated cache."""
         x = F.embedding(input_ids, self.embed_tokens)
         x = _constrain_hidden(x, self._mesh, self.config.sequence_parallel)
         cos, sin = self.rope_cos, self.rope_sin
         is_moe = self.config.moe_num_experts > 1
         aux_total = None
+        if cache is not None:
+            offset = _raw(cache["offset"])
+            new_kv = []
+            for layer, (k_c, v_c) in zip(self.layers, cache["kv"]):
+                out = layer(x, cos, sin, cache=(_raw(k_c), _raw(v_c), offset))
+                *rest, kv = out
+                x, aux_total = self._merge_aux(rest[0] if len(rest) == 1 else tuple(rest),
+                                               aux_total, is_moe)
+                new_kv.append(kv)
+            seq = input_ids.shape[1]
+            new_cache = {"kv": tuple(new_kv),
+                         "offset": offset + jnp.asarray(seq, jnp.int32)}
+            if is_moe:
+                return self.norm(x), aux_total, new_cache
+            return self.norm(x), new_cache
         if self.config.recompute:
             from ..distributed.fleet.recompute import recompute as _rc
             for layer in self.layers:
@@ -373,8 +467,17 @@ class LlamaForCausalLM(Layer):
             _shard_param(self.lm_head, mesh, 1)
         _place_all_params(self, mesh)
 
-    def forward(self, input_ids, position_ids=None):
-        out = self.llama(input_ids, position_ids)
+    def init_cache(self, batch_size: int, max_len: int, dtype=None):
+        return self.llama.init_cache(batch_size, max_len, dtype)
+
+    def forward(self, input_ids, position_ids=None, cache=None):
+        """Returns logits; with ``cache`` returns ``(logits, new_cache)``
+        (the reference's ``use_cache=True`` contract)."""
+        out = self.llama(input_ids, position_ids, cache=cache)
+        new_cache = None
+        if cache is not None:
+            *out_rest, new_cache = out
+            out = out_rest[0] if len(out_rest) == 1 else tuple(out_rest)
         if self.config.moe_num_experts > 1:
             x, self._moe_aux = out  # consumed by compute_loss in the SAME trace
         else:
@@ -388,12 +491,15 @@ class LlamaForCausalLM(Layer):
             def head_tied(hidden, e):
                 return hidden @ e.T.astype(hidden.dtype)
 
-            return apply_op("lm_head", head_tied, (x, emb), {})
+            logits = apply_op("lm_head", head_tied, (x, emb), {})
+        else:
+            def head(hidden, wh):
+                return hidden @ wh.astype(hidden.dtype)
 
-        def head(hidden, wh):
-            return hidden @ wh.astype(hidden.dtype)
-
-        return apply_op("lm_head", head, (x, w), {})
+            logits = apply_op("lm_head", head, (x, w), {})
+        if cache is not None:
+            return logits, new_cache
+        return logits
 
     def compute_loss(self, logits, labels, ignore_index: int = -100):
         """Next-token CE in fp32 over (possibly vocab-sharded) logits —
@@ -415,3 +521,131 @@ class LlamaForCausalLM(Layer):
             # must run in the same trace, which TrainStep's loss_fn does)
             loss = loss + self.config.moe_aux_loss_weight * self._moe_aux
         return loss
+
+    # ------------------------------------------------------------------
+    # generation (the reference's model.generate / llm inference loop over
+    # block_multi_head_attention + masked_multihead_attention kernels)
+    # ------------------------------------------------------------------
+
+    def _build_generate_pure(self, B, P, max_new, do_sample, temperature, top_k,
+                             top_p, eos):
+        """Pure fn (params, buffers, ids[B,P], key) -> ids[B, P+max_new]:
+        prefill with cache, then ``lax.scan`` over single-token decode steps —
+        ONE compiled program for the whole generation."""
+        from ..jit import functional_call
+
+        model = self
+        total = P + max_new
+        neg_inf = -1e30
+
+        def sample_next(logits, key, done):
+            if do_sample:
+                lg = logits / max(temperature, 1e-6)
+                if top_k and top_k > 0:
+                    kth = jnp.sort(lg, axis=-1)[:, -int(top_k)][:, None]
+                    lg = jnp.where(lg < kth, neg_inf, lg)
+                if top_p < 1.0:
+                    srt = jnp.sort(lg, axis=-1)[:, ::-1]
+                    probs = jax.nn.softmax(srt, axis=-1)
+                    csum = jnp.cumsum(probs, axis=-1)
+                    keep = (csum - probs) < top_p  # always keeps the top token
+                    thresh = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1, keepdims=True)
+                    lg = jnp.where(lg < thresh, neg_inf, lg)
+                tok = jax.random.categorical(key, lg, axis=-1)
+            else:
+                tok = jnp.argmax(logits, axis=-1)
+            tok = tok.astype(jnp.int32)
+            if eos is not None:
+                tok = jnp.where(done, jnp.asarray(eos, jnp.int32), tok)
+            return tok
+
+        def step(params, buffers, ids_chunk, cache):
+            logits, cache = functional_call(model, params, buffers, ids_chunk, cache=cache)
+            return logits[:, -1, :].astype(jnp.float32), cache
+
+        def pure(params, buffers, ids, key):
+            cache = model.init_cache(B, total)
+            last, cache = step(params, buffers, ids, cache)
+            key, sub = jax.random.split(key)
+            done = jnp.zeros((B,), bool)
+            tok = sample_next(last, sub, done)
+            if eos is not None:
+                done = done | (tok == eos)
+
+            def body(carry, _):
+                cache, tok, done, key = carry
+                last, cache = step(params, buffers, tok[:, None], cache)
+                key, sub = jax.random.split(key)
+                nxt = sample_next(last, sub, done)
+                if eos is not None:
+                    ndone = done | (nxt == eos)
+                else:
+                    ndone = done
+                return (cache, nxt, ndone, key), nxt
+
+            if max_new > 1:
+                _, toks = jax.lax.scan(body, (cache, tok, done, key), None,
+                                       length=max_new - 1)
+                gen = jnp.concatenate([tok[:, None], jnp.swapaxes(toks, 0, 1)], axis=1)
+            else:
+                gen = tok[:, None]
+            return jnp.concatenate([ids, gen], axis=1)
+
+        return pure
+
+    def generate(self, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
+                 temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None):
+        """Autoregressive generation (greedy or temperature/top-k/top-p
+        sampling).  Returns ``[B, P + max_new_tokens]`` int32 ids; sequences
+        that hit ``eos_token_id`` are padded with it.  Compiled once per
+        (shape, sampling-config) signature."""
+        from ..framework import random as rnd
+
+        ids = jnp.asarray(_raw(input_ids), jnp.int32)
+        B, P = ids.shape
+        sig = (B, P, int(max_new_tokens), bool(do_sample), float(temperature),
+               int(top_k), float(top_p), eos_token_id)
+        fns = getattr(self, "_generate_fns", None)
+        if fns is None:
+            fns = self._generate_fns = {}
+        fn = fns.get(sig)
+        if fn is None:
+            fn = fns[sig] = jax.jit(self._build_generate_pure(*sig))
+        params = {n: p._data for n, p in self.named_parameters()}
+        buffers = {n: b._data for n, b in self.named_buffers()}
+        return Tensor(fn(params, buffers, ids, rnd.next_key()))
+
+    def export_generate(self, path: str, batch_size: int, prompt_len: int,
+                        max_new_tokens: int, eos_token_id: Optional[int] = None):
+        """AOT-export a greedy-decode program as a ``jit.save``-style artifact
+        (``.jaxir`` + ``.pdiparams`` + ``.pdmodel.json``) so
+        ``paddle_tpu.jit.load`` / ``inference.Predictor`` can serve generation
+        (the reference's exported-inference-program + AnalysisPredictor flow)."""
+        import json
+
+        from jax import export as jax_export
+
+        from ..framework.io import save as _save
+
+        pure = self._build_generate_pure(batch_size, prompt_len, int(max_new_tokens),
+                                         False, 1.0, 0, 1.0, eos_token_id)
+
+        def g(params, buffers, ids):
+            return pure(params, buffers, ids, jax.random.key(0))
+
+        params = {n: p._data for n, p in self.named_parameters()}
+        buffers = {n: b._data for n, b in self.named_buffers()}
+        ids_struct = jax.ShapeDtypeStruct((batch_size, prompt_len), jnp.int32)
+        exported = jax_export.export(jax.jit(g))(
+            jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params),
+            jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), buffers),
+            ids_struct)
+        with open(path + ".jaxir", "wb") as f:
+            f.write(exported.serialize())
+        _save({"params": {k: np.asarray(v) for k, v in params.items()},
+               "buffers": {k: np.asarray(v) for k, v in buffers.items()}},
+              path + ".pdiparams")
+        with open(path + ".pdmodel.json", "w") as f:
+            json.dump({"inputs": [{"shape": [batch_size, prompt_len], "dtype": "int32"}],
+                       "format": "jax.export.stablehlo"}, f)

@@ -30,7 +30,8 @@ from ..api import shard_tensor
 from ..mesh import ProcessMesh, get_mesh
 from ..placement import Replicate, Shard
 
-__all__ = ["ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding", "ParallelCrossEntropy"]
+__all__ = ["ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+           "ParallelCrossEntropy", "c_softmax_with_cross_entropy"]
 
 
 def _mp_mesh(mesh: Optional[ProcessMesh]) -> ProcessMesh:
@@ -144,12 +145,55 @@ class VocabParallelEmbedding(Layer):
         return F.embedding(x, self.weight)
 
 
+def _ce_no_gather(lg, lb):
+    """Per-token CE over raw arrays, computed WITHOUT gathering the vocab dim.
+
+    The reductions (max, sum-exp, target pick) run over the vocab axis; when
+    logits are vocab-sharded, XLA partitions each into a local reduction plus
+    an allreduce of ``[...,]``-shaped partials.  The target logit is picked by
+    a one-hot CONTRACTION — the materialization-free pattern the reference's
+    ``c_softmax_with_cross_entropy`` CUDA kernel implements by hand
+    (``mp_ops.py:414``).  ``F.cross_entropy``'s hard-label path uses the same
+    contraction at the Tensor level; this raw-array variant exists for traced
+    loss fns (``LlamaForCausalLM.compute_loss``) that run on jnp values.
+
+    Out-of-range labels (e.g. an ignore_index) one_hot to an all-zero row, so
+    they contribute ``lse`` — callers mask ignored rows themselves.
+    """
+    lg = lg.astype(jnp.float32)
+    V = lg.shape[-1]
+    m = jax.lax.stop_gradient(jnp.max(lg, axis=-1))
+    lse = m + jnp.log(jnp.sum(jnp.exp(lg - m[..., None]), axis=-1))
+    onehot = jax.nn.one_hot(lb, V, dtype=lg.dtype)
+    target = jnp.sum(lg * onehot, axis=-1)
+    return lse - target
+
+
+def c_softmax_with_cross_entropy(logits, label, group=None, return_softmax=False,
+                                 ignore_index: int = -100):
+    """Softmax-CE over vocab-(mp-)sharded logits (reference
+    ``fleet/layers/mpu/mp_ops.py:414`` signature: loss shaped like the
+    ``[..., 1]`` label; optionally also returns the softmax).
+
+    ``group`` is accepted for API parity and unused: the cross-shard max/sum
+    reductions are inserted by GSPMD from the logits' sharding, so there is no
+    explicit comm group to pick.  Delegates to ``F.softmax_with_cross_entropy``
+    whose hard-label path already uses the no-gather one-hot contraction
+    (property verified by HLO inspection in tests/test_parallel_ce.py).
+    """
+    logits = logits if isinstance(logits, Tensor) else Tensor(logits)
+    label = label if isinstance(label, Tensor) else Tensor(label)
+    return F.softmax_with_cross_entropy(logits, label, ignore_index=ignore_index,
+                                        return_softmax=return_softmax)
+
+
 class ParallelCrossEntropy(Layer):
     """CE over vocab-sharded logits (reference ``mp_layers.py:744``).
 
-    GSPMD computes log_softmax over the sharded axis with the needed
-    cross-shard max/sum reductions — the hand-written
-    ``c_softmax_with_cross_entropy`` kernel collapses into annotation.
+    The computation keeps the ``[B, S, V]`` logits sharded: local max/sum-exp
+    + psum over 'mp', one-hot contraction for the target logit — GSPMD inserts
+    the scalar allreduces; no all-gather (tests/test_parallel_ce.py inspects
+    the partitioned HLO).
     """
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
@@ -157,4 +201,4 @@ class ParallelCrossEntropy(Layer):
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
-        return F.cross_entropy(input, label, reduction="none", ignore_index=self.ignore_index)
+        return c_softmax_with_cross_entropy(input, label, ignore_index=self.ignore_index)

@@ -502,15 +502,18 @@ class LlamaForCausalLM(Layer):
         return logits
 
     def compute_loss(self, logits, labels, ignore_index: int = -100):
-        """Next-token CE in fp32 over (possibly vocab-sharded) logits —
-        the ParallelCrossEntropy role; GSPMD handles the sharded softmax."""
+        """Next-token CE in fp32 over (possibly vocab-sharded) logits — the
+        ParallelCrossEntropy role.  Uses the no-gather
+        ``c_softmax_with_cross_entropy`` pattern (one-hot contraction instead
+        of take_along_axis) so mp-sharded logits are never all-gathered."""
+        from ..distributed.parallel.mp_layers import _ce_no_gather
+
         lb_full = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
 
         def ce(lg):
-            lg = lg[:, :-1, :].astype(jnp.float32)
+            lg = lg[:, :-1, :]
             lb = lb_full[:, 1:]
-            logp = jax.nn.log_softmax(lg, axis=-1)
-            nll = -jnp.take_along_axis(logp, lb[..., None], axis=-1)[..., 0]
+            nll = _ce_no_gather(lg, lb)
             mask = (lb != ignore_index).astype(jnp.float32)
             return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 

@@ -20,12 +20,14 @@ import numpy as np
 from ..collective import get_rank, get_world_size, init_parallel_env
 from ..mesh import ProcessMesh, get_mesh, set_global_mesh
 from . import topology as tp_mod
+from .elastic import ELASTIC_EXIT_CODE, CheckpointManager
 from .recompute import recompute
 from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode
 
 __all__ = ["init", "DistributedStrategy", "get_hybrid_communicate_group", "fleet",
            "distributed_model", "distributed_optimizer", "HybridParallelOptimizer",
-           "HybridCommunicateGroup", "CommunicateTopology", "ParallelMode", "recompute"]
+           "HybridCommunicateGroup", "CommunicateTopology", "ParallelMode", "recompute",
+           "CheckpointManager", "ELASTIC_EXIT_CODE"]
 
 
 class DistributedStrategy:

@@ -1,0 +1,112 @@
+"""Elastic training: checkpoint-based auto-resume (the training-side half).
+
+Counterpart of the reference's elastic stack: the launcher relaunches a dead
+training process (``fleet/elastic/manager.py:125`` watch->relaunch,
+``ELASTIC_EXIT_CODE=101``); this module makes the relaunch RESUME instead of
+restart — periodic sharded checkpoints plus load-latest-on-start, the intent
+of ``incubate/checkpoint/auto_checkpoint``.
+
+Usage (the loop a relaunched process can re-enter at any point)::
+
+    step_fn = paddle.jit.TrainStep(model, loss_fn, opt)
+    mgr = fleet.CheckpointManager(ckpt_dir, keep=2)
+    start = mgr.resume(step_fn)            # 0 on a fresh start
+    for i in range(start, total_steps):
+        loss = step_fn(*batch(i))
+        if (i + 1) % save_every == 0:
+            mgr.save(i + 1, step_fn)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import sys
+from typing import Optional
+
+from ..checkpoint import load_state_dict, save_state_dict
+from ..collective import barrier, get_rank
+
+__all__ = ["CheckpointManager", "ELASTIC_EXIT_CODE"]
+
+# reference fleet/elastic/__init__.py:33
+ELASTIC_EXIT_CODE = 101
+
+_STEP_DIR = re.compile(r"^step_(\d+)$")
+_MANIFEST = "metadata.pkl"
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints under one directory, newest-wins resume.
+
+    Each save lands in ``<root>/step_<N>``; the checkpoint's own atomically-
+    committed ``metadata.pkl`` is the completion marker, so a save killed
+    mid-write is invisible to :meth:`resume`.  ``keep`` complete checkpoints
+    are retained (older ones pruned by the coordinator after a successful
+    save) so resume can fall back if the newest fails to read.
+    """
+
+    def __init__(self, root: str, keep: int = 2):
+        self.root = root
+        self.keep = max(1, int(keep))
+        os.makedirs(root, exist_ok=True)
+
+    def _dir(self, step: int) -> str:
+        return os.path.join(self.root, f"step_{step:08d}")
+
+    def complete_steps(self):
+        """Step numbers with a committed manifest, ascending."""
+        steps = []
+        for fn in os.listdir(self.root):
+            m = _STEP_DIR.match(fn)
+            if m and os.path.exists(os.path.join(self.root, fn, _MANIFEST)):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest(self) -> Optional[int]:
+        steps = self.complete_steps()
+        return steps[-1] if steps else None
+
+    @staticmethod
+    def _state_of(target):
+        """TrainStep -> its state dict; plain dicts pass through."""
+        if hasattr(target, "state_dict") and not isinstance(target, dict):
+            return target.state_dict()
+        return target
+
+    def save(self, step: int, target, async_save: bool = False):
+        """Save ``target`` (a ``jit.TrainStep`` or a state dict) as step ``step``."""
+        sd = self._state_of(target)
+        fut = save_state_dict(sd, self._dir(step), async_save=async_save)
+        if not async_save:
+            self._prune()
+        return fut
+
+    def _prune(self):
+        steps = self.complete_steps()
+        if get_rank() == 0:
+            for s in steps[:-self.keep]:
+                shutil.rmtree(self._dir(s), ignore_errors=True)
+        barrier()
+
+    def resume(self, target) -> int:
+        """Load the newest readable checkpoint into ``target`` IN PLACE.
+
+        Returns the step to continue from (0 if no checkpoint).  A checkpoint
+        that fails to read (e.g. files lost with a preempted host) falls back
+        to the previous one — the reference relaunch loop's behavior of
+        retrying from the last intact save.
+        """
+        for step in reversed(self.complete_steps()):
+            sd = self._state_of(target)
+            try:
+                load_state_dict(sd, self._dir(step))
+            except Exception as e:  # fall back to an older complete save
+                print(f"[elastic] checkpoint step {step} unreadable ({e}); "
+                      "falling back", file=sys.stderr)
+                continue
+            if hasattr(target, "set_state_dict") and not isinstance(target, dict):
+                target.set_state_dict(sd)
+            return step
+        return 0

@@ -220,6 +220,44 @@ class TrainStep:
             p._data = self._params[n]
         return Tensor(loss)
 
+    # -- checkpoint/resume surface (used by fleet.CheckpointManager) --------
+
+    def state_dict(self):
+        """Flat dict of everything a resume needs: params, optimizer-state
+        leaves (path-keyed — ``opt['<param>']['<slot>']`` — so a positional
+        shift can never load one layer's moments into another), the numeric
+        LR-scheduler fields, and the step counter."""
+        from ..optimizer.lr import LRScheduler
+
+        flat = {f"param.{n}": a for n, a in self._params.items()}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(self._opt_state)[0]:
+            flat[f"opt{jax.tree_util.keystr(path)}"] = leaf
+        flat["step"] = jnp.asarray(self._step, jnp.int32)
+        if isinstance(self.optimizer._lr, LRScheduler):
+            # numeric fields only (last_epoch, last_lr, plateau counters...);
+            # strings/config are rebuilt by the resuming process's constructor
+            for k, v in self.optimizer._lr.state_dict().items():
+                if isinstance(v, (bool, int, float)):
+                    flat[f"lr_sched.{k}"] = jnp.asarray(v)
+        return flat
+
+    def set_state_dict(self, flat):
+        from ..optimizer.lr import LRScheduler
+
+        self._params = {n: flat[f"param.{n}"] for n in self._params}
+        paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(self._opt_state)
+        leaves = [flat[f"opt{jax.tree_util.keystr(p)}"] for p, _ in paths_leaves]
+        self._opt_state = jax.tree.unflatten(treedef, leaves)
+        self._step = int(flat["step"])
+        if isinstance(self.optimizer._lr, LRScheduler):
+            sched = self.optimizer._lr
+            for k, cur in sched.state_dict().items():
+                fk = f"lr_sched.{k}"
+                if fk in flat and isinstance(cur, (bool, int, float)):
+                    sched.__dict__[k] = type(cur)(flat[fk])
+        for n, p in self.model.named_parameters():
+            p._data = self._params[n]
+
 
 def save(layer, path, input_spec=None, **configs):
     """AOT-export a Layer (reference ``paddle.jit.save`` -> inference program;

@@ -56,6 +56,7 @@ from . import profiler  # noqa: F401
 from . import incubate  # noqa: F401
 from . import static  # noqa: F401
 from . import device  # noqa: F401
+from . import distribution  # noqa: F401
 from . import linalg as _linalg_ns  # noqa: F401
 from . import fft  # noqa: F401
 

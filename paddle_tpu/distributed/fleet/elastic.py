@@ -50,6 +50,7 @@ class CheckpointManager:
     def __init__(self, root: str, keep: int = 2):
         self.root = root
         self.keep = max(1, int(keep))
+        self._last_async = None
         os.makedirs(root, exist_ok=True)
 
     def _dir(self, step: int) -> str:
@@ -77,9 +78,18 @@ class CheckpointManager:
 
     def save(self, step: int, target, async_save: bool = False):
         """Save ``target`` (a ``jit.TrainStep`` or a state dict) as step ``step``."""
+        # settle the previous async save on the MAIN thread (pruning from the
+        # IO thread would race its filesystem rendezvous), then prune — this
+        # bounds retention for async users too (at most keep+1 on disk)
+        if self._last_async is not None:
+            self._last_async.result()
+            self._last_async = None
+        self._prune()
         sd = self._state_of(target)
         fut = save_state_dict(sd, self._dir(step), async_save=async_save)
-        if not async_save:
+        if async_save:
+            self._last_async = fut
+        else:
             self._prune()
         return fut
 
@@ -90,23 +100,60 @@ class CheckpointManager:
                 shutil.rmtree(self._dir(s), ignore_errors=True)
         barrier()
 
+    @staticmethod
+    def _copy_containers(d):
+        """Copy the dict STRUCTURE (leaves shared) so a load that dies midway
+        cannot leave the caller's dict partially overwritten."""
+        return {k: CheckpointManager._copy_containers(v) if isinstance(v, dict) else v
+                for k, v in d.items()}
+
+    @staticmethod
+    def _write_back(dst, src):
+        for k, v in src.items():
+            if isinstance(v, dict):
+                _ = CheckpointManager._write_back(dst[k], v)
+            else:
+                dst[k] = v
+        return dst
+
     def resume(self, target) -> int:
         """Load the newest readable checkpoint into ``target`` IN PLACE.
 
         Returns the step to continue from (0 if no checkpoint).  A checkpoint
         that fails to read (e.g. files lost with a preempted host) falls back
         to the previous one — the reference relaunch loop's behavior of
-        retrying from the last intact save.
+        retrying from the last intact save.  The target is only mutated after
+        a load fully succeeds.
         """
+        from ...framework.tensor import Tensor
+
+        is_plain = isinstance(target, dict) or not hasattr(target, "state_dict")
         for step in reversed(self.complete_steps()):
             sd = self._state_of(target)
+            work = self._copy_containers(sd) if is_plain else sd
+            # Tensor leaves are mutated in place by load_state_dict; snapshot
+            # their storage so a half-failed load can be rolled back
+            snap = []
+
+            def _collect(d):
+                for v in d.values():
+                    if isinstance(v, dict):
+                        _collect(v)
+                    elif isinstance(v, Tensor):
+                        snap.append((v, v._data))
+
+            _collect(work)
             try:
-                load_state_dict(sd, self._dir(step))
+                load_state_dict(work, self._dir(step))
             except Exception as e:  # fall back to an older complete save
+                for t, old in snap:
+                    t._data = old
                 print(f"[elastic] checkpoint step {step} unreadable ({e}); "
                       "falling back", file=sys.stderr)
                 continue
-            if hasattr(target, "set_state_dict") and not isinstance(target, dict):
-                target.set_state_dict(sd)
+            if is_plain:
+                self._write_back(target, work)
+            elif hasattr(target, "set_state_dict"):
+                target.set_state_dict(work)
             return step
         return 0

@@ -1,0 +1,975 @@
+"""``paddle.distribution`` — probability distributions, transforms, KL registry.
+
+Counterpart of the reference's ``python/paddle/distribution/`` (9.3k LoC,
+30+ distributions; ``kl.py`` dispatch registry, ``transform.py`` bijectors).
+
+TPU-native design: every method is a pure jnp computation over the
+distribution's parameter arrays — ``sample`` draws through the framework's
+functional PRNG (``framework.random``), so distributions compose with
+``jax.jit``/``TrainStep`` tracing like any other op.  Shapes follow the
+reference convention: ``sample(shape)`` prepends ``shape`` to the broadcast
+batch shape.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as rnd
+from ..framework.dispatch import apply_op
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+    "Exponential", "Gamma", "Beta", "Dirichlet", "Laplace", "LogNormal",
+    "Gumbel", "Cauchy", "Geometric", "Poisson", "Binomial", "Multinomial",
+    "Chi2", "StudentT", "Independent", "TransformedDistribution",
+    "kl_divergence", "register_kl",
+    "Transform", "AffineTransform", "ExpTransform", "SigmoidTransform",
+    "TanhTransform", "PowerTransform", "ChainTransform", "SoftmaxTransform",
+]
+
+
+def _arr(v, dtype=jnp.float32):
+    if isinstance(v, Tensor):
+        a = v._data
+    else:
+        a = jnp.asarray(v)
+    if jnp.issubdtype(a.dtype, jnp.integer) or a.dtype == jnp.bool_:
+        a = a.astype(dtype)
+    return a
+
+
+def _shape(shape) -> Tuple[int, ...]:
+    if shape is None:
+        return ()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _wrap(a):
+    return Tensor(a, stop_gradient=True)
+
+
+def _taped(name, entries, fn, *value_tensors):
+    """Run ``fn(*values)`` with each entry's raw array rebound onto its owner,
+    through ``apply_op`` so the EAGER TAPE records the op — gradients flow back
+    to the distribution's (or transform's) original parameter Tensors.
+
+    ``entries``: [(owner, attr_name, Tensor)] — the differentiable parameters;
+    ``value_tensors``: extra leading Tensor args passed through to ``fn``.
+    """
+    tensors = tuple(value_tensors) + tuple(t for _, _, t in entries)
+    n_vals = len(value_tensors)
+
+    def f(*raw):
+        vals = raw[:n_vals]
+        old = [(o, a, getattr(o, a)) for o, a, _ in entries]
+        for (o, a, _), r in zip(entries, raw[n_vals:]):
+            setattr(o, a, r)
+        try:
+            return fn(*vals)
+        finally:
+            for o, a, v in old:
+                setattr(o, a, v)
+
+    return apply_op(name, f, tensors, {})
+
+
+class _Parameterized:
+    """Mixin: registers differentiable parameters so methods can tape them."""
+
+    def _param(self, name, value, dtype=jnp.float32):
+        if not hasattr(self, "_tparams"):
+            self._tparams = {}
+        a = _arr(value, dtype)
+        t = value if isinstance(value, Tensor) and value._data is a else Tensor(a)
+        self._tparams[name] = t
+        setattr(self, name, a)
+        return a
+
+    def _tparam_entries(self):
+        return [(self, n, t) for n, t in getattr(self, "_tparams", {}).items()]
+
+
+class Distribution(_Parameterized):
+    """Base class (reference ``distribution/distribution.py``)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = _shape(batch_shape)
+        self._event_shape = _shape(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def _name(self, method):
+        return f"{type(self).__name__}.{method}"
+
+    # subclasses implement _sample(key, shape) / _log_prob(value) on raw arrays
+    def sample(self, shape=()):
+        """Draw (non-reparameterized) samples; gradients do not flow."""
+        out = self._sample(rnd.next_key(), _shape(shape))
+        return _wrap(jax.lax.stop_gradient(out))
+
+    def rsample(self, shape=()):
+        """Reparameterized samples (gradients flow to the parameters)."""
+        key, shp = rnd.next_key(), _shape(shape)
+        return _taped(self._name("rsample"), self._tparam_entries(),
+                      lambda: self._rsample(key, shp))
+
+    def _rsample(self, key, shape):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement rsample")
+
+    def _sample(self, key, shape):
+        return self._rsample(key, shape)
+
+    def _taped_value_op(self, method, value, fn):
+        v = _arr(value)
+        if isinstance(value, Tensor) and jnp.issubdtype(v.dtype, jnp.floating):
+            # differentiable w.r.t. the value too (flows, score functions)
+            return _taped(self._name(method), self._tparam_entries(), fn, value)
+        return _taped(self._name(method), self._tparam_entries(), lambda: fn(v))
+
+    def log_prob(self, value):
+        return self._taped_value_op("log_prob", value, self._log_prob)
+
+    def prob(self, value):
+        return self._taped_value_op("prob", value,
+                                    lambda v: jnp.exp(self._log_prob(v)))
+
+    def entropy(self):
+        return _taped(self._name("entropy"), self._tparam_entries(), self._entropy)
+
+    def _entropy(self):
+        raise NotImplementedError(f"{type(self).__name__} does not implement entropy")
+
+    @property
+    def mean(self):
+        return _taped(self._name("mean"), self._tparam_entries(), self._mean)
+
+    @property
+    def variance(self):
+        return _taped(self._name("variance"), self._tparam_entries(), self._variance)
+
+    def kl_divergence(self, other) -> Tensor:
+        return kl_divergence(self, other)
+
+
+# ---------------------------------------------------------------------------
+# continuous
+# ---------------------------------------------------------------------------
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._param("loc", loc)
+        self._param("scale", scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def _rsample(self, key, shape):
+        shp = shape + self.batch_shape
+        eps = jax.random.normal(key, shp, jnp.float32)
+        return self.loc + self.scale * eps
+
+    def _log_prob(self, x):
+        var = self.scale ** 2
+        return -((x - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi)
+
+    def _entropy(self):
+        return jnp.broadcast_to(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+                                self.batch_shape)
+
+    def _mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape)
+
+    def _variance(self):
+        return jnp.broadcast_to(self.scale ** 2, self.batch_shape)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._param("loc", loc)
+        self._param("scale", scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def _rsample(self, key, shape):
+        shp = shape + self.batch_shape
+        eps = jax.random.normal(key, shp, jnp.float32)
+        return jnp.exp(self.loc + self.scale * eps)
+
+    def _log_prob(self, x):
+        lx = jnp.log(x)
+        var = self.scale ** 2
+        return (-((lx - self.loc) ** 2) / (2 * var) - jnp.log(self.scale)
+                - 0.5 * math.log(2 * math.pi) - lx)
+
+    def _entropy(self):
+        return jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale) + self.loc,
+            self.batch_shape)
+
+    def _mean(self):
+        return jnp.exp(self.loc + self.scale ** 2 / 2)
+
+    def _variance(self):
+        s2 = self.scale ** 2
+        return (jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self._param("low", low)
+        self._param("high", high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def _rsample(self, key, shape):
+        shp = shape + self.batch_shape
+        u = jax.random.uniform(key, shp, jnp.float32)
+        return self.low + (self.high - self.low) * u
+
+    def _log_prob(self, x):
+        inside = (x >= self.low) & (x < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return jnp.where(inside, lp, -jnp.inf)
+
+    def _entropy(self):
+        return jnp.broadcast_to(jnp.log(self.high - self.low), self.batch_shape)
+
+    def _mean(self):
+        return jnp.broadcast_to((self.low + self.high) / 2, self.batch_shape)
+
+    def _variance(self):
+        return jnp.broadcast_to((self.high - self.low) ** 2 / 12, self.batch_shape)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._param("loc", loc)
+        self._param("scale", scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def _rsample(self, key, shape):
+        shp = shape + self.batch_shape
+        return jax.random.laplace(key, shp, jnp.float32) * self.scale + self.loc
+
+    def _log_prob(self, x):
+        return -jnp.abs(x - self.loc) / self.scale - jnp.log(2 * self.scale)
+
+    def _entropy(self):
+        return jnp.broadcast_to(1 + jnp.log(2 * self.scale), self.batch_shape)
+
+    def _mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape)
+
+    def _variance(self):
+        return jnp.broadcast_to(2 * self.scale ** 2, self.batch_shape)
+
+
+class Gumbel(Distribution):
+    _EULER = 0.5772156649015329
+
+    def __init__(self, loc, scale, name=None):
+        self._param("loc", loc)
+        self._param("scale", scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def _rsample(self, key, shape):
+        shp = shape + self.batch_shape
+        return jax.random.gumbel(key, shp, jnp.float32) * self.scale + self.loc
+
+    def _log_prob(self, x):
+        z = (x - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def _entropy(self):
+        return jnp.broadcast_to(jnp.log(self.scale) + 1 + self._EULER, self.batch_shape)
+
+    def _mean(self):
+        return jnp.broadcast_to(self.loc + self._EULER * self.scale, self.batch_shape)
+
+    def _variance(self):
+        return jnp.broadcast_to((math.pi ** 2 / 6) * self.scale ** 2, self.batch_shape)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._param("loc", loc)
+        self._param("scale", scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def _rsample(self, key, shape):
+        shp = shape + self.batch_shape
+        return jax.random.cauchy(key, shp, jnp.float32) * self.scale + self.loc
+
+    def _log_prob(self, x):
+        z = (x - self.loc) / self.scale
+        return -jnp.log(math.pi * self.scale * (1 + z ** 2))
+
+    def _entropy(self):
+        return jnp.broadcast_to(jnp.log(4 * math.pi * self.scale), self.batch_shape)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self._param("rate", rate)
+        super().__init__(self.rate.shape)
+
+    def _rsample(self, key, shape):
+        shp = shape + self.batch_shape
+        return jax.random.exponential(key, shp, jnp.float32) / self.rate
+
+    def _log_prob(self, x):
+        return jnp.log(self.rate) - self.rate * x
+
+    def _entropy(self):
+        return 1.0 - jnp.log(self.rate)
+
+    def _mean(self):
+        return 1.0 / self.rate
+
+    def _variance(self):
+        return 1.0 / self.rate ** 2
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self._param("concentration", concentration)
+        self._param("rate", rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape, self.rate.shape))
+
+    def _rsample(self, key, shape):
+        shp = shape + self.batch_shape
+        return jax.random.gamma(key, jnp.broadcast_to(self.concentration, shp)) / self.rate
+
+    def _log_prob(self, x):
+        a, b = self.concentration, self.rate
+        return a * jnp.log(b) + (a - 1) * jnp.log(x) - b * x - jax.scipy.special.gammaln(a)
+
+    def _entropy(self):
+        a, b = self.concentration, self.rate
+        return a - jnp.log(b) + jax.scipy.special.gammaln(a) + (1 - a) * jax.scipy.special.digamma(a)
+
+    def _mean(self):
+        return self.concentration / self.rate
+
+    def _variance(self):
+        return self.concentration / self.rate ** 2
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        df = _arr(df)
+        self.df = df
+        super().__init__(df / 2, jnp.asarray(0.5, jnp.float32))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self._param("alpha", alpha)
+        self._param("beta", beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    def _rsample(self, key, shape):
+        shp = shape + self.batch_shape
+        return jax.random.beta(key, jnp.broadcast_to(self.alpha, shp),
+                               jnp.broadcast_to(self.beta, shp))
+
+    def _log_prob(self, x):
+        a, b = self.alpha, self.beta
+        return ((a - 1) * jnp.log(x) + (b - 1) * jnp.log1p(-x)
+                - (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                   - jax.scipy.special.gammaln(a + b)))
+
+    def _entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return (lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                + (a + b - 2) * dg(a + b))
+
+    def _mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    def _variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s ** 2 * (s + 1))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self._param("concentration", concentration)
+        super().__init__(self.concentration.shape[:-1], self.concentration.shape[-1:])
+
+    def _rsample(self, key, shape):
+        shp = shape + self.batch_shape + self.event_shape
+        return jax.random.dirichlet(key, jnp.broadcast_to(self.concentration, shp))
+
+    def _log_prob(self, x):
+        a = self.concentration
+        lnorm = jnp.sum(jax.scipy.special.gammaln(a), -1) - jax.scipy.special.gammaln(jnp.sum(a, -1))
+        return jnp.sum((a - 1) * jnp.log(x), -1) - lnorm
+
+    def _entropy(self):
+        a = self.concentration
+        a0 = jnp.sum(a, -1)
+        k = a.shape[-1]
+        dg = jax.scipy.special.digamma
+        lnorm = jnp.sum(jax.scipy.special.gammaln(a), -1) - jax.scipy.special.gammaln(a0)
+        return (lnorm + (a0 - k) * dg(a0) - jnp.sum((a - 1) * dg(a), -1))
+
+    def _mean(self):
+        return self.concentration / jnp.sum(self.concentration, -1, keepdims=True)
+
+    def _variance(self):
+        a = self.concentration
+        a0 = jnp.sum(a, -1, keepdims=True)
+        m = a / a0
+        return m * (1 - m) / (a0 + 1)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self._param("df", df)
+        self._param("loc", loc)
+        self._param("scale", scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape, self.scale.shape))
+
+    def _rsample(self, key, shape):
+        shp = shape + self.batch_shape
+        return jax.random.t(key, jnp.broadcast_to(self.df, shp)) * self.scale + self.loc
+
+    def _log_prob(self, x):
+        df, mu, s = self.df, self.loc, self.scale
+        z = (x - mu) / s
+        return (jax.scipy.special.gammaln((df + 1) / 2) - jax.scipy.special.gammaln(df / 2)
+                - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+
+    def _mean(self):
+        return jnp.where(self.df > 1, jnp.broadcast_to(self.loc, self.batch_shape), jnp.nan)
+
+    def _variance(self):
+        v = self.scale ** 2 * self.df / (self.df - 2)
+        return jnp.where(self.df > 2, jnp.broadcast_to(v, self.batch_shape), jnp.nan)
+
+
+# ---------------------------------------------------------------------------
+# discrete
+# ---------------------------------------------------------------------------
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self._param("probs", probs)
+        super().__init__(self.probs.shape)
+
+    def _sample(self, key, shape):
+        shp = shape + self.batch_shape
+        return jax.random.bernoulli(key, jnp.broadcast_to(self.probs, shp)).astype(jnp.float32)
+
+    def _log_prob(self, x):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return x * jnp.log(p) + (1 - x) * jnp.log1p(-p)
+
+    def _entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+    def _mean(self):
+        return self.probs
+
+    def _variance(self):
+        return self.probs * (1 - self.probs)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self._param("probs", probs)
+        super().__init__(self.probs.shape)
+
+    def _sample(self, key, shape):
+        shp = shape + self.batch_shape
+        u = jax.random.uniform(key, shp, jnp.float32, minval=1e-7)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-self.probs))
+
+    def _log_prob(self, x):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return x * jnp.log1p(-p) + jnp.log(p)
+
+    def _entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return -((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p
+
+    def _mean(self):
+        return (1 - self.probs) / self.probs
+
+    def _variance(self):
+        return (1 - self.probs) / self.probs ** 2
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self._param("rate", rate)
+        super().__init__(self.rate.shape)
+
+    def _sample(self, key, shape):
+        shp = shape + self.batch_shape
+        return jax.random.poisson(key, jnp.broadcast_to(self.rate, shp)).astype(jnp.float32)
+
+    def _log_prob(self, x):
+        return x * jnp.log(self.rate) - self.rate - jax.scipy.special.gammaln(x + 1)
+
+    def _mean(self):
+        return self.rate
+
+    def _variance(self):
+        return self.rate
+
+
+class Categorical(Distribution):
+    """Over the last axis of ``logits`` (reference accepts logits)."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("Categorical needs logits or probs")
+        # register the SOURCE parameter (not the normalized form) so eager
+        # gradients flow back through the normalization to the caller's Tensor
+        if logits is not None:
+            self._param("_src_logits", logits)
+            self._from_logits = True
+        else:
+            self._param("_src_probs", probs)
+            self._from_logits = False
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def logits(self):
+        if self._from_logits:
+            return jax.nn.log_softmax(self._src_logits, axis=-1)
+        p = self._src_probs
+        return jnp.log(jnp.clip(p / jnp.sum(p, -1, keepdims=True), 1e-30))
+
+    @property
+    def probs(self):
+        return _wrap(jnp.exp(self.logits))
+
+    def _sample(self, key, shape):
+        shp = shape + self.batch_shape
+        return jax.random.categorical(key, self.logits, shape=shp).astype(jnp.int32)
+
+    def _log_prob(self, x):
+        idx = x.astype(jnp.int32)
+        return jnp.take_along_axis(self.logits, idx[..., None], axis=-1)[..., 0]
+
+    def _entropy(self):
+        p = jnp.exp(self.logits)
+        return -jnp.sum(p * self.logits, -1)
+
+    def _mean(self):
+        return jnp.full(self.batch_shape, jnp.nan)
+
+    def _variance(self):
+        return jnp.full(self.batch_shape, jnp.nan)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self._param("total_count", total_count)
+        self._param("probs", probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape, self.probs.shape))
+
+    def _sample(self, key, shape):
+        shp = shape + self.batch_shape
+        n = int(np.max(np.asarray(self.total_count)))
+        u = jax.random.uniform(key, (n,) + shp, jnp.float32)
+        counts = jnp.arange(n).reshape((n,) + (1,) * len(shp))
+        draws = (u < self.probs) & (counts < self.total_count)
+        return jnp.sum(draws.astype(jnp.float32), axis=0)
+
+    def _log_prob(self, x):
+        n, p = self.total_count, jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        logc = (jax.scipy.special.gammaln(n + 1) - jax.scipy.special.gammaln(x + 1)
+                - jax.scipy.special.gammaln(n - x + 1))
+        return logc + x * jnp.log(p) + (n - x) * jnp.log1p(-p)
+
+    def _mean(self):
+        return self.total_count * self.probs
+
+    def _variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self._param("probs", probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    def _sample(self, key, shape):
+        shp = shape + self.batch_shape
+        logits = jnp.log(jnp.clip(self.probs, 1e-30))
+        draws = jax.random.categorical(key, logits, shape=(self.total_count,) + shp)
+        k = self.probs.shape[-1]
+        return jnp.sum(jax.nn.one_hot(draws, k), axis=0)
+
+    def _log_prob(self, x):
+        p = jnp.clip(self.probs, 1e-30)
+        logc = (jax.scipy.special.gammaln(jnp.sum(x, -1) + 1)
+                - jnp.sum(jax.scipy.special.gammaln(x + 1), -1))
+        return logc + jnp.sum(x * jnp.log(p), -1)
+
+    def _mean(self):
+        return self.total_count * self.probs
+
+    def _variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+
+class Independent(Distribution):
+    """Reinterpret the rightmost ``reinterpreted_batch_rank`` batch dims as
+    event dims (log_prob sums over them).  Reference ``independent.py``."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - self.rank],
+                         bs[len(bs) - self.rank:] + base.event_shape)
+
+    def _tparam_entries(self):
+        return self.base._tparam_entries()
+
+    def _rsample(self, key, shape):
+        return self.base._rsample(key, shape)
+
+    def _sample(self, key, shape):
+        return self.base._sample(key, shape)
+
+    def _log_prob(self, x):
+        lp = self.base._log_prob(x)
+        return jnp.sum(lp, axis=tuple(range(-self.rank, 0)))
+
+    def _entropy(self):
+        return jnp.sum(self.base._entropy(), axis=tuple(range(-self.rank, 0)))
+
+    def _mean(self):
+        return self.base._mean()
+
+    def _variance(self):
+        return self.base._variance()
+
+
+# ---------------------------------------------------------------------------
+# transforms (reference transform.py)
+# ---------------------------------------------------------------------------
+
+class Transform(_Parameterized):
+    def _apply_taped(self, method, value, fn):
+        vt = value if isinstance(value, Tensor) else Tensor(_arr(value))
+        return _taped(f"{type(self).__name__}.{method}", self._tparam_entries(), fn, vt)
+
+    def forward(self, x):
+        return self._apply_taped("forward", x, self._forward)
+
+    def inverse(self, y):
+        return self._apply_taped("inverse", y, self._inverse)
+
+    def forward_log_det_jacobian(self, x):
+        return self._apply_taped("fldj", x, self._fldj)
+
+    def inverse_log_det_jacobian(self, y):
+        return self._apply_taped("ildj", y, lambda v: -self._fldj(self._inverse(v)))
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self._param("loc", loc)
+        self._param("scale", scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self._param("power", power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-7, 1 - 1e-7))
+
+    def _fldj(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(jnp.clip(y, 1e-30))
+
+    def _fldj(self, x):
+        raise NotImplementedError("softmax is not a bijection on R^n")
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+
+    def _tparam_entries(self):
+        return [e for t in self.transforms for e in t._tparam_entries()]
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through a chain of transforms."""
+
+    def __init__(self, base: Distribution, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def _tparam_entries(self):
+        return (self.base._tparam_entries()
+                + [e for t in self.transforms for e in t._tparam_entries()])
+
+    def _rsample(self, key, shape):
+        x = self.base._rsample(key, shape)
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _sample(self, key, shape):
+        x = self.base._sample(key, shape)
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _log_prob(self, y):
+        x = y
+        ldj = 0.0
+        for t in reversed(self.transforms):
+            x_prev = t._inverse(x)
+            ldj = ldj + t._fldj(x_prev)
+            x = x_prev
+        return self.base._log_prob(x) - ldj
+
+
+# ---------------------------------------------------------------------------
+# KL registry (reference kl.py: register_kl / kl_divergence dispatch)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(p_cls: Type, q_cls: Type):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def _kl_fn(p: Distribution, q: Distribution):
+    """Closest-match dispatch on (type(p), type(q)) walking each MRO
+    (reference ``kl.py`` dispatch semantics)."""
+    matches = []
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            matches.append((pc, qc, fn))
+    if not matches:
+        raise NotImplementedError(
+            f"no KL(p || q) registered for ({type(p).__name__}, {type(q).__name__})")
+
+    def specificity(m):
+        pc, qc, _ = m
+        return (len(pc.__mro__), len(qc.__mro__))
+
+    return max(matches, key=specificity)[2]
+
+
+def _kl_raw(p, q):
+    """Raw-array KL; registered fns that recurse (e.g. Independent) call THIS,
+    not kl_divergence, so the computation stays inside one tape node."""
+    return _kl_fn(p, q)(p, q)
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    fn = _kl_fn(p, q)  # raises NotImplementedError eagerly, outside the trace
+    # taped over BOTH distributions' parameters so eager backward works
+    # (e.g. a VAE's KL(q(z|x) || N(0,1)) term)
+    entries = p._tparam_entries() + q._tparam_entries()
+    return _taped("kl_divergence", entries, lambda: fn(p, q))
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    inside = (q.low <= p.low) & (p.high <= q.high)
+    kl = jnp.log((q.high - q.low) / (p.high - p.low))
+    return jnp.where(inside, kl, jnp.inf)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qp = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return pp * (jnp.log(pp) - jnp.log(qp)) + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    pr = jnp.exp(p.logits)
+    return jnp.sum(pr * (p.logits - q.logits), -1)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    r = q.rate / p.rate
+    return jnp.log(p.rate) - jnp.log(q.rate) + r - 1
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    dg = jax.scipy.special.digamma
+    gl = jax.scipy.special.gammaln
+    return ((p.concentration - q.concentration) * dg(p.concentration)
+            - gl(p.concentration) + gl(q.concentration)
+            + q.concentration * (jnp.log(p.rate) - jnp.log(q.rate))
+            + p.concentration * (q.rate / p.rate - 1))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    r = p.scale / q.scale
+    t = jnp.abs(p.loc - q.loc) / q.scale
+    return -jnp.log(r) + r * jnp.exp(-jnp.abs(p.loc - q.loc) / p.scale) + t - 1
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    dg = jax.scipy.special.digamma
+    gl = jax.scipy.special.gammaln
+
+    def lbeta(a, b):
+        return gl(a) + gl(b) - gl(a + b)
+
+    s_p = p.alpha + p.beta
+    return (lbeta(q.alpha, q.beta) - lbeta(p.alpha, p.beta)
+            + (p.alpha - q.alpha) * dg(p.alpha)
+            + (p.beta - q.beta) * dg(p.beta)
+            + (q.alpha - p.alpha + q.beta - p.beta) * dg(s_p))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    dg = jax.scipy.special.digamma
+    gl = jax.scipy.special.gammaln
+    a, b = p.concentration, q.concentration
+    a0 = jnp.sum(a, -1)
+    return (gl(a0) - jnp.sum(gl(a), -1)
+            - jax.scipy.special.gammaln(jnp.sum(b, -1)) + jnp.sum(gl(b), -1)
+            + jnp.sum((a - b) * (dg(a) - dg(a0)[..., None]), -1))
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    return p.rate * (jnp.log(p.rate) - jnp.log(q.rate)) - p.rate + q.rate
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geom_geom(p, q):
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qp = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return (jnp.log(pp) - jnp.log(qp)
+            + (1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+
+
+@register_kl(Independent, Independent)
+def _kl_independent(p, q):
+    if p.rank != q.rank:
+        raise NotImplementedError("Independent KL needs equal reinterpreted ranks")
+    return jnp.sum(_kl_raw(p.base, q.base), axis=tuple(range(-p.rank, 0)))

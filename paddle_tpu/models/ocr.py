@@ -1,0 +1,222 @@
+"""PP-OCR-style text detection + recognition recipe (BASELINE configs[3]).
+
+Counterparts of PaddleOCR's PP-OCRv4 pair driven through the reference
+framework's conv/fusion path:
+
+- :class:`DBNet` — DB (Differentiable Binarization) text detector: conv-bn
+  backbone, FPN neck, shrink-map head; loss = BCE + dice (the DB paper's
+  simplified loss).  Exercises the conv+bn fusion patterns the reference's
+  inference pass library targets (``fluid/framework/ir`` conv_bn_fuse etc.) —
+  on TPU, XLA performs those fusions on the jitted program.
+- :class:`CRNN` — CTC recognizer: conv stages collapsing height, BiGRU over
+  width, CTC head (reference ``warpctc`` op -> our lax.scan CTC in
+  ``F.ctc_loss``).
+
+Shapes follow the NCHW convention of ``paddle.vision``.  Both models are
+deliberately width-scalable (``base_channels``) so the same classes serve the
+test-scale and the bench-scale configs.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..ops.manipulation import concat, reshape, transpose
+
+__all__ = ["DBNet", "CRNN", "db_loss", "ocr_det_tiny", "ocr_det_base",
+           "ocr_rec_tiny", "ocr_rec_base"]
+
+
+class ConvBNLayer(nn.Layer):
+    """conv + bn + relu — the unit the reference's conv_bn fusion passes target."""
+
+    def __init__(self, in_ch, out_ch, kernel=3, stride=1, groups=1, act=True):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
+                              padding=kernel // 2, groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_ch)
+        self.act = act
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return F.relu(x) if self.act else x
+
+
+class _Stage(nn.Layer):
+    def __init__(self, in_ch, out_ch, n_blocks, stride):
+        super().__init__()
+        blocks = [ConvBNLayer(in_ch, out_ch, stride=stride)]
+        blocks += [ConvBNLayer(out_ch, out_ch) for _ in range(n_blocks - 1)]
+        self.blocks = nn.Sequential(*blocks)
+
+    def forward(self, x):
+        return self.blocks(x)
+
+
+class DBBackbone(nn.Layer):
+    """4-stage conv-bn backbone: strides 4/8/16/32 feature pyramid."""
+
+    def __init__(self, in_ch=3, base=16, blocks=(2, 2, 2, 2)):
+        super().__init__()
+        self.stem = ConvBNLayer(in_ch, base, stride=2)
+        chs = [base, base * 2, base * 4, base * 8]
+        self.stages = nn.LayerList([
+            _Stage(base if i == 0 else chs[i - 1], chs[i], blocks[i], stride=2)
+            for i in range(4)
+        ])
+        self.out_channels = chs
+
+    def forward(self, x) -> List:
+        x = self.stem(x)
+        feats = []
+        for stage in self.stages:
+            x = stage(x)
+            feats.append(x)
+        return feats
+
+
+class DBFPN(nn.Layer):
+    """Top-down FPN: lateral 1x1 + upsample-add, concat at stride 4."""
+
+    def __init__(self, in_channels: Sequence[int], out_ch=64):
+        super().__init__()
+        self.lateral = nn.LayerList([
+            ConvBNLayer(c, out_ch, kernel=1, act=False) for c in in_channels])
+        self.smooth = nn.LayerList([
+            ConvBNLayer(out_ch, out_ch // 4) for _ in in_channels])
+        self.out_channels = out_ch
+
+    def forward(self, feats):
+        laterals = [lat(f) for lat, f in zip(self.lateral, feats)]
+        for i in range(len(laterals) - 1, 0, -1):
+            # upsample to the EXACT lateral size (scale_factor=2 overshoots
+            # when a stage's input had odd spatial dims)
+            up = F.interpolate(laterals[i], size=laterals[i - 1].shape[2:],
+                               mode="nearest")
+            laterals[i - 1] = laterals[i - 1] + up
+        outs = []
+        target = laterals[0].shape[2:]
+        for sm, lat in zip(self.smooth, laterals):
+            o = sm(lat)
+            if tuple(o.shape[2:]) != tuple(target):
+                o = F.interpolate(o, size=target, mode="nearest")
+            outs.append(o)
+        return concat(outs, axis=1)
+
+
+class DBHead(nn.Layer):
+    """Shrink-probability head: conv -> deconv x2 -> sigmoid map at input res."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.conv1 = ConvBNLayer(in_ch, in_ch // 4)
+        self.up1 = nn.Conv2DTranspose(in_ch // 4, in_ch // 4, 2, stride=2)
+        self.bn1 = nn.BatchNorm2D(in_ch // 4)
+        self.up2 = nn.Conv2DTranspose(in_ch // 4, 1, 2, stride=2)
+
+    def forward(self, x):
+        x = self.conv1(x)
+        x = F.relu(self.bn1(self.up1(x)))
+        return F.sigmoid(self.up2(x))
+
+
+class DBNet(nn.Layer):
+    """DB text detector: returns the shrink probability map [B, 1, H, W]."""
+
+    def __init__(self, in_ch=3, base=16, fpn_ch=64, blocks=(2, 2, 2, 2)):
+        super().__init__()
+        self.backbone = DBBackbone(in_ch, base, blocks)
+        self.neck = DBFPN(self.backbone.out_channels, fpn_ch)
+        self.head = DBHead(fpn_ch)
+
+    def forward(self, images):
+        h, w = images.shape[2], images.shape[3]
+        if h % 4 or w % 4:
+            # the head's two 2x deconvs reconstruct exactly 4x the stride-4
+            # map; other sizes would return a map mismatching the input
+            raise ValueError(f"DBNet input H/W must be multiples of 4, got {h}x{w}")
+        return self.head(self.neck(self.backbone(images)))
+
+
+def db_loss(pred, gt, eps: float = 1e-6):
+    """DB shrink-map loss: BCE + dice (paper's loss without the border maps)."""
+    def f(p, g):
+        p32 = p.astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        p32 = jnp.clip(p32, eps, 1.0 - eps)
+        bce = -(g32 * jnp.log(p32) + (1 - g32) * jnp.log(1 - p32)).mean()
+        inter = (p32 * g32).sum()
+        dice = 1.0 - 2.0 * inter / (p32.sum() + g32.sum() + eps)
+        return bce + dice
+
+    from ..framework.dispatch import apply_op
+    from ..framework.tensor import Tensor
+
+    return apply_op("db_loss", f,
+                    (pred if isinstance(pred, Tensor) else Tensor(pred),
+                     gt if isinstance(gt, Tensor) else Tensor(gt)), {})
+
+
+class CRNN(nn.Layer):
+    """CTC recognizer: conv stages (height collapses), BiGRU over width,
+    per-timestep class logits [B, W', num_classes] (CTC blank = 0)."""
+
+    def __init__(self, num_classes, in_ch=3, base=16, hidden=48, img_h=32):
+        super().__init__()
+        self.convs = nn.Sequential(
+            ConvBNLayer(in_ch, base), nn.MaxPool2D(2, 2),            # H/2, W/2
+            ConvBNLayer(base, base * 2), nn.MaxPool2D(2, 2),         # H/4, W/4
+            ConvBNLayer(base * 2, base * 4),
+            nn.MaxPool2D(kernel_size=(2, 1), stride=(2, 1)),         # H/8, W/4
+            ConvBNLayer(base * 4, base * 4),
+            nn.MaxPool2D(kernel_size=(2, 1), stride=(2, 1)),         # H/16, W/4
+        )
+        feat_h = img_h // 16
+        self.rnn = nn.GRU(base * 4 * feat_h, hidden, direction="bidirect")
+        self.fc = nn.Linear(2 * hidden, num_classes)
+
+    def forward(self, images):
+        x = self.convs(images)                      # [B, C, h, W']
+        B, C, h, W = x.shape
+        x = transpose(x, [0, 3, 1, 2])            # [B, W', C, h]
+        x = reshape(x, [B, W, C * h])
+        x, _ = self.rnn(x)
+        return self.fc(x)                           # [B, W', num_classes]
+
+    def compute_loss(self, logits, labels, label_lengths):
+        B, T = logits.shape[0], logits.shape[1]
+        input_lengths = jnp.full((B,), T, jnp.int32)
+        # F.ctc_loss expects [T, B, C] log-probs-to-be (softmaxed internally)
+        lg = transpose(logits, [1, 0, 2])
+        return F.ctc_loss(lg, labels, input_lengths, label_lengths, blank=0)
+
+
+def ocr_det_tiny(**kw):
+    """CPU/CI scale."""
+    cfg = dict(base=8, fpn_ch=16, blocks=(1, 1, 1, 1))
+    cfg.update(kw)
+    return DBNet(**cfg)
+
+
+def ocr_det_base(**kw):
+    """Bench scale (PP-OCRv4-det-ish capacity)."""
+    cfg = dict(base=24, fpn_ch=96, blocks=(2, 2, 2, 2))
+    cfg.update(kw)
+    return DBNet(**cfg)
+
+
+def ocr_rec_tiny(num_classes=64, **kw):
+    cfg = dict(base=8, hidden=32)
+    cfg.update(kw)
+    return CRNN(num_classes, **cfg)
+
+
+def ocr_rec_base(num_classes=6625, **kw):
+    """PP-OCRv4-rec-ish: full Chinese charset head."""
+    cfg = dict(base=32, hidden=96)
+    cfg.update(kw)
+    return CRNN(num_classes, **cfg)

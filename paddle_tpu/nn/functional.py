@@ -398,12 +398,12 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
         else:
             window, strides = (1, 1) + tuple(ks), (1, 1) + tuple(st)
             pads = pd if isinstance(pd, str) else [(0, 0), (0, 0)] + pd
-        s = jax.lax.reduce_window(a, jnp.asarray(0.0, a.dtype), jax.lax.add, window, strides, pads)
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
         if divisor_override:
             return s / divisor_override
         if exclusive and not isinstance(pads, str):
             ones = jnp.ones_like(a)
-            cnt = jax.lax.reduce_window(ones, jnp.asarray(0.0, a.dtype), jax.lax.add, window, strides, pads)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
             return s / cnt
         return s / float(np.prod(ks))
 
@@ -429,11 +429,11 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
     def f(a):
         window, strides = (1, 1) + tuple(ks), (1, 1) + tuple(st)
         pads = pd if isinstance(pd, str) else [(0, 0), (0, 0)] + pd
-        s = jax.lax.reduce_window(a, jnp.asarray(0.0, a.dtype), jax.lax.add, window, strides, pads)
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
         if divisor_override:
             return s / divisor_override
         if exclusive and not isinstance(pads, str):
-            cnt = jax.lax.reduce_window(jnp.ones_like(a), jnp.asarray(0.0, a.dtype), jax.lax.add, window, strides, pads)
+            cnt = jax.lax.reduce_window(jnp.ones_like(a), 0.0, jax.lax.add, window, strides, pads)
             return s / cnt
         return s / float(np.prod(ks))
 
@@ -455,7 +455,9 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
         else:
             window, strides = (1, 1) + tuple(ks), (1, 1) + tuple(st)
             pads = pd if isinstance(pd, str) else [(0, 0), (0, 0)] + pd
-        neg = jnp.asarray(-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min, a.dtype)
+        # init value must be a PYTHON scalar: an array init defeats JAX's
+        # monoid detection, losing reduce_window_max's autodiff rule
+        neg = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else int(jnp.iinfo(a.dtype).min)
         return jax.lax.reduce_window(a, neg, jax.lax.max, window, strides, pads)
 
     out = unary_op("max_pool2d", f, x)
@@ -504,8 +506,7 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
     def f(a):
         window, strides = (1, 1) + tuple(ks), (1, 1) + tuple(st)
         pads = pd if isinstance(pd, str) else [(0, 0), (0, 0)] + pd
-        neg = jnp.asarray(-jnp.inf, a.dtype)
-        return jax.lax.reduce_window(a, neg, jax.lax.max, window, strides, pads)
+        return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, window, strides, pads)
 
     return unary_op("max_pool3d", f, x)
 

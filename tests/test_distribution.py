@@ -1,0 +1,220 @@
+"""paddle.distribution: moments/log_prob vs closed forms, sampling sanity,
+KL registry, transforms (reference ``test/distribution`` style)."""
+
+import math
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+class TestMomentsAndLogProb:
+    def test_normal(self):
+        d = D.Normal(1.0, 2.0)
+        assert _np(d.mean) == pytest.approx(1.0)
+        assert _np(d.variance) == pytest.approx(4.0)
+        # log N(x=1.0 | 1, 2) = -log(2*sqrt(2pi))
+        assert _np(d.log_prob(1.0)) == pytest.approx(-math.log(2 * math.sqrt(2 * math.pi)))
+        assert _np(d.entropy()) == pytest.approx(0.5 * math.log(2 * math.pi * math.e * 4))
+
+    def test_uniform(self):
+        d = D.Uniform(0.0, 4.0)
+        assert _np(d.mean) == pytest.approx(2.0)
+        assert _np(d.variance) == pytest.approx(16 / 12)
+        assert _np(d.log_prob(1.0)) == pytest.approx(-math.log(4))
+        assert _np(d.log_prob(5.0)) == -np.inf
+
+    def test_bernoulli_categorical_agree(self):
+        p = 0.3
+        b = D.Bernoulli(p)
+        c = D.Categorical(probs=np.asarray([1 - p, p]))
+        assert _np(b.log_prob(1.0)) == pytest.approx(float(_np(c.log_prob(1))), abs=1e-6)
+        assert _np(b.entropy()) == pytest.approx(float(_np(c.entropy())), abs=1e-6)
+
+    def test_gamma_beta_exponential(self):
+        g = D.Gamma(3.0, 2.0)
+        assert _np(g.mean) == pytest.approx(1.5)
+        assert _np(g.variance) == pytest.approx(0.75)
+        from scipy import stats
+
+        assert _np(g.log_prob(1.3)) == pytest.approx(stats.gamma.logpdf(1.3, 3.0, scale=0.5), abs=1e-5)
+        bt = D.Beta(2.0, 5.0)
+        assert _np(bt.log_prob(0.3)) == pytest.approx(stats.beta.logpdf(0.3, 2, 5), abs=1e-5)
+        e = D.Exponential(2.0)
+        assert _np(e.log_prob(0.7)) == pytest.approx(stats.expon.logpdf(0.7, scale=0.5), abs=1e-5)
+
+    def test_poisson_binomial_multinomial(self):
+        from scipy import stats
+
+        po = D.Poisson(3.0)
+        assert _np(po.log_prob(2.0)) == pytest.approx(stats.poisson.logpmf(2, 3.0), abs=1e-5)
+        bi = D.Binomial(10.0, 0.4)
+        assert _np(bi.log_prob(3.0)) == pytest.approx(stats.binom.logpmf(3, 10, 0.4), abs=1e-5)
+        mu = D.Multinomial(4, np.asarray([0.2, 0.3, 0.5]))
+        x = np.asarray([1.0, 1.0, 2.0])
+        assert _np(mu.log_prob(x)) == pytest.approx(
+            stats.multinomial.logpmf(x, 4, [0.2, 0.3, 0.5]), abs=1e-5)
+
+    def test_dirichlet(self):
+        from scipy import stats
+
+        conc = np.asarray([1.5, 2.5, 3.0])
+        d = D.Dirichlet(conc)
+        x = np.asarray([0.2, 0.3, 0.5])
+        assert _np(d.log_prob(x)) == pytest.approx(stats.dirichlet.logpdf(x, conc), abs=1e-4)
+        np.testing.assert_allclose(_np(d.mean), conc / conc.sum(), rtol=1e-6)
+
+
+class TestSampling:
+    def test_sample_moments(self):
+        paddle.seed(0)
+        d = D.Normal(np.asarray([0.0, 3.0]), np.asarray([1.0, 0.5]))
+        s = _np(d.sample([20000]))
+        assert s.shape == (20000, 2)
+        np.testing.assert_allclose(s.mean(0), [0.0, 3.0], atol=0.05)
+        np.testing.assert_allclose(s.std(0), [1.0, 0.5], atol=0.05)
+
+    def test_categorical_frequencies(self):
+        paddle.seed(1)
+        probs = np.asarray([0.1, 0.6, 0.3])
+        d = D.Categorical(probs=probs)
+        s = _np(d.sample([30000]))
+        freq = np.bincount(s.astype(int), minlength=3) / len(s)
+        np.testing.assert_allclose(freq, probs, atol=0.02)
+
+    def test_rsample_grad_flows(self):
+        """rsample is reparameterized: d/dmu E[x] = 1."""
+        import jax
+        import jax.numpy as jnp
+
+        def g(mu):
+            d = D.Normal(mu, 1.0)
+            return jnp.mean(d._rsample(jax.random.key(0), (256,)))
+
+        grad = jax.grad(g)(jnp.asarray(0.5))
+        assert float(grad) == pytest.approx(1.0, abs=1e-5)
+
+    def test_gamma_beta_sample_means(self):
+        paddle.seed(2)
+        g = _np(D.Gamma(3.0, 2.0).sample([20000]))
+        assert g.mean() == pytest.approx(1.5, abs=0.05)
+        b = _np(D.Beta(2.0, 5.0).sample([20000]))
+        assert b.mean() == pytest.approx(2 / 7, abs=0.02)
+
+
+class TestEagerAutograd:
+    """Distribution ops must record on the eager tape (review finding r3)."""
+
+    def test_rsample_backward_to_params(self):
+        paddle.seed(5)
+        mu = paddle.to_tensor(np.asarray(0.5, np.float32), stop_gradient=False)
+        s = D.Normal(mu, 1.0).rsample([64])
+        loss = s.sum()
+        loss.backward()
+        # d/dmu sum(mu + eps) = 64
+        assert float(_np(mu.grad)) == pytest.approx(64.0, abs=1e-4)
+
+    def test_log_prob_backward_to_params_and_value(self):
+        mu = paddle.to_tensor(np.asarray(1.0, np.float32), stop_gradient=False)
+        x = paddle.to_tensor(np.asarray(2.0, np.float32), stop_gradient=False)
+        lp = D.Normal(mu, 1.0).log_prob(x)
+        lp.backward()
+        # dlogp/dmu = (x-mu) = 1; dlogp/dx = -(x-mu) = -1
+        assert float(_np(mu.grad)) == pytest.approx(1.0, abs=1e-6)
+        assert float(_np(x.grad)) == pytest.approx(-1.0, abs=1e-6)
+
+    def test_kl_backward(self):
+        mu = paddle.to_tensor(np.asarray(1.0, np.float32), stop_gradient=False)
+        kl = D.kl_divergence(D.Normal(mu, 1.0), D.Normal(0.0, 1.0))
+        kl.backward()
+        # KL = mu^2/2 -> dKL/dmu = mu
+        assert float(_np(mu.grad)) == pytest.approx(1.0, abs=1e-6)
+
+    def test_transform_backward(self):
+        scale = paddle.to_tensor(np.asarray(3.0, np.float32), stop_gradient=False)
+        t = D.AffineTransform(0.0, scale)
+        y = t.forward(paddle.to_tensor(np.asarray(2.0, np.float32)))
+        y.backward()
+        assert float(_np(scale.grad)) == pytest.approx(2.0, abs=1e-6)
+
+    def test_entropy_backward(self):
+        sig = paddle.to_tensor(np.asarray(2.0, np.float32), stop_gradient=False)
+        h = D.Normal(0.0, sig).entropy()
+        h.backward()
+        # dH/dsigma = 1/sigma
+        assert float(_np(sig.grad)) == pytest.approx(0.5, abs=1e-6)
+
+
+class TestKL:
+    def test_normal_kl_closed_form_vs_mc(self):
+        p = D.Normal(0.0, 1.0)
+        q = D.Normal(1.0, 2.0)
+        kl = float(_np(D.kl_divergence(p, q)))
+        want = math.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        assert kl == pytest.approx(want, abs=1e-6)
+
+    def test_categorical_kl(self):
+        p = D.Categorical(probs=np.asarray([0.5, 0.5]))
+        q = D.Categorical(probs=np.asarray([0.9, 0.1]))
+        kl = float(_np(D.kl_divergence(p, q)))
+        want = 0.5 * math.log(0.5 / 0.9) + 0.5 * math.log(0.5 / 0.1)
+        assert kl == pytest.approx(want, abs=1e-6)
+
+    def test_kl_zero_for_identical(self):
+        for d in (D.Gamma(2.0, 3.0), D.Beta(2.0, 2.0), D.Laplace(0.0, 1.0),
+                  D.Exponential(1.5), D.Poisson(2.0), D.Geometric(0.3)):
+            kl = float(_np(D.kl_divergence(d, d)))
+            assert kl == pytest.approx(0.0, abs=1e-6), type(d).__name__
+
+    def test_unregistered_raises(self):
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(0.0, 1.0), D.Gamma(1.0, 1.0))
+
+    def test_independent_kl_sums(self):
+        base_p = D.Normal(np.zeros(4, np.float32), np.ones(4, np.float32))
+        base_q = D.Normal(np.ones(4, np.float32), np.ones(4, np.float32))
+        kl_ind = float(_np(D.kl_divergence(D.Independent(base_p, 1),
+                                           D.Independent(base_q, 1))))
+        kl_sum = float(np.sum(_np(D.kl_divergence(base_p, base_q))))
+        assert kl_ind == pytest.approx(kl_sum, abs=1e-6)
+
+
+class TestTransforms:
+    def test_affine_roundtrip_and_ldj(self):
+        t = D.AffineTransform(2.0, 3.0)
+        x = np.asarray([0.5, -1.0], np.float32)
+        y = _np(t.forward(x))
+        np.testing.assert_allclose(y, 2.0 + 3.0 * x)
+        np.testing.assert_allclose(_np(t.inverse(y)), x, rtol=1e-6)
+        np.testing.assert_allclose(_np(t.forward_log_det_jacobian(x)), np.log(3.0))
+
+    def test_lognormal_equals_transformed_normal(self):
+        ln = D.LogNormal(0.3, 0.7)
+        td = D.TransformedDistribution(D.Normal(0.3, 0.7), D.ExpTransform())
+        for v in (0.5, 1.0, 2.3):
+            assert float(_np(ln.log_prob(v))) == pytest.approx(
+                float(_np(td.log_prob(v))), abs=1e-5)
+
+    def test_tanh_transform_log_prob_integrates(self):
+        """log_prob of tanh(Normal) matches numeric change-of-variables."""
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0), D.TanhTransform())
+        y = 0.5
+        x = np.arctanh(y)
+        want = (-(x ** 2) / 2 - 0.5 * math.log(2 * math.pi)) - math.log(1 - y ** 2)
+        assert float(_np(td.log_prob(y))) == pytest.approx(want, abs=1e-5)
+
+    def test_chain(self):
+        t = D.ChainTransform([D.AffineTransform(0.0, 2.0), D.ExpTransform()])
+        x = np.asarray(0.3, np.float32)
+        y = _np(t.forward(x))
+        assert y == pytest.approx(math.exp(0.6), abs=1e-6)
+        assert _np(t.inverse(y)) == pytest.approx(0.3, abs=1e-6)
+        # ldj = log(2) + 2x
+        assert _np(t.forward_log_det_jacobian(x)) == pytest.approx(math.log(2) + 0.6, abs=1e-5)

@@ -150,6 +150,44 @@ def test_resume_restores_exact_train_state(tmp_path):
     np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
 
 
+def test_async_save_prunes(tmp_path):
+    """async_save must not accumulate checkpoints without bound."""
+    paddle.seed(4)
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=model.parameters())
+
+    def loss_fn(m, x):
+        return (m(x) ** 2).mean()
+
+    step_fn = paddle.jit.TrainStep(model, loss_fn, opt)
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    for i in range(5):
+        step_fn(x)
+        mgr.save(i + 1, step_fn, async_save=True)
+    if mgr._last_async is not None:
+        mgr._last_async.result()
+    assert len(mgr.complete_steps()) <= 3  # keep + the in-flight one
+
+
+def test_resume_all_corrupt_leaves_plain_dict_untouched(tmp_path):
+    """If every checkpoint is unreadable, the caller's dict must be unchanged."""
+    import jax.numpy as jnp
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    sd = {"w": jnp.ones((2, 2)), "nested": {"b": jnp.zeros((3,))}}
+    mgr.save(1, dict(sd))
+    # corrupt it
+    d = os.path.join(str(tmp_path / "ck"), "step_00000001")
+    for f in os.listdir(d):
+        if f.endswith(".npz"):
+            open(os.path.join(d, f), "wb").write(b"junk")
+    orig_w, orig_b = sd["w"], sd["nested"]["b"]
+    assert mgr.resume(sd) == 0
+    assert sd["w"] is orig_w
+    assert sd["nested"]["b"] is orig_b
+
+
 def test_resume_restores_lr_scheduler(tmp_path):
     """An elastic resume must continue the LR schedule, not restart warmup."""
     from paddle_tpu.optimizer.lr import StepDecay

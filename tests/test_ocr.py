@@ -1,0 +1,105 @@
+"""OCR det+rec recipe (BASELINE configs[3]): shapes + a few training steps on
+synthetic data, after the reference's model-level test style (loss must drop)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.ocr import (
+    CRNN,
+    DBNet,
+    db_loss,
+    ocr_det_tiny,
+    ocr_rec_tiny,
+)
+
+
+def _det_batch(b=2, size=64, seed=0):
+    """Synthetic 'text' rectangles: image = noise + bright boxes, gt = box mask."""
+    rng = np.random.default_rng(seed)
+    img = rng.normal(0, 0.3, size=(b, 3, size, size)).astype(np.float32)
+    gt = np.zeros((b, 1, size, size), np.float32)
+    for i in range(b):
+        x0, y0 = rng.integers(4, size // 2, 2)
+        w, h = rng.integers(8, size // 3, 2)
+        img[i, :, y0:y0 + h, x0:x0 + w] += 1.5
+        gt[i, 0, y0:y0 + h, x0:x0 + w] = 1.0
+    return paddle.to_tensor(img), paddle.to_tensor(gt)
+
+
+class TestDet:
+    def test_output_shape_full_resolution(self):
+        paddle.seed(0)
+        det = ocr_det_tiny()
+        img, _ = _det_batch()
+        out = det(img)
+        assert tuple(out.shape) == (2, 1, 64, 64)
+        vals = np.asarray(out.numpy())
+        assert vals.min() >= 0.0 and vals.max() <= 1.0  # sigmoid map
+
+    def test_non_multiple_of_32_sizes(self):
+        """FPN upsampling must handle odd intermediate sizes (48 = 16*3)."""
+        paddle.seed(0)
+        det = ocr_det_tiny()
+        img = paddle.to_tensor(np.zeros((1, 3, 48, 48), np.float32))
+        out = det(img)
+        assert tuple(out.shape) == (1, 1, 48, 48)
+        with pytest.raises(ValueError, match="multiples of 4"):
+            det(paddle.to_tensor(np.zeros((1, 3, 46, 46), np.float32)))
+
+    def test_training_reduces_db_loss(self):
+        paddle.seed(0)
+        det = ocr_det_tiny()
+        opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                        parameters=det.parameters())
+
+        def loss_fn(m, img, gt):
+            return db_loss(m(img), gt)
+
+        step = paddle.jit.TrainStep(det, loss_fn, opt)
+        img, gt = _det_batch()
+        losses = [float(step(img, gt).numpy()) for _ in range(20)]
+        assert losses[-1] < losses[0] * 0.7, losses
+
+
+class TestRec:
+    def test_logits_shape(self):
+        paddle.seed(1)
+        rec = ocr_rec_tiny(num_classes=40)
+        img = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(2, 3, 32, 96)).astype(np.float32))
+        lg = rec(img)
+        assert tuple(lg.shape) == (2, 24, 40)  # W/4 timesteps
+
+    def test_ctc_training_reduces_loss(self):
+        paddle.seed(1)
+        rec = ocr_rec_tiny(num_classes=16)
+        opt = paddle.optimizer.Adam(learning_rate=3e-3, parameters=rec.parameters())
+        rng = np.random.default_rng(3)
+        img = paddle.to_tensor(rng.normal(size=(2, 3, 32, 64)).astype(np.float32))
+        labels = paddle.to_tensor(rng.integers(1, 16, size=(2, 5)).astype(np.int32))
+        lab_len = paddle.to_tensor(np.asarray([5, 3], np.int32))
+
+        def loss_fn(m, img):
+            return m.compute_loss(m(img), labels, lab_len)
+
+        step = paddle.jit.TrainStep(rec, loss_fn, opt)
+        losses = [float(step(img).numpy()) for _ in range(15)]
+        assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_bench_ocr_preset_cpu():
+    """The driver-facing bench path must emit a sane JSON line on CPU."""
+    import json
+    import subprocess
+    import sys
+
+    r = subprocess.run([sys.executable, "bench.py", "--preset", "ocr", "--device", "cpu",
+                        "--steps", "2"],
+                       capture_output=True, text=True, timeout=300, cwd="/root/repo")
+    assert r.returncode == 0, r.stderr
+    line = [ln for ln in r.stdout.splitlines() if ln.startswith("{")][-1]
+    out = json.loads(line)
+    assert out["metric"] == "ocr_det_train_images_per_sec"
+    assert out["value"] > 0
+    assert np.isfinite(out["first_loss"]) and np.isfinite(out["last_loss"])

@@ -162,7 +162,8 @@ def _bench_ocr(jax, paddle, backend, on_tpu, args):
         step_fn._params, step_fn._buffers, step_fn._opt_state,
         jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.int32), rnd.next_key(),
         (img._data, gt._data))
-    cost = lowered.compile().cost_analysis()
+    # HLO-level cost on the Lowered object — avoids a second backend compile
+    cost = lowered.cost_analysis()
     step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
 
     images_per_sec = batch * steps / dt

@@ -80,11 +80,12 @@ class CheckpointManager:
         """Save ``target`` (a ``jit.TrainStep`` or a state dict) as step ``step``."""
         # settle the previous async save on the MAIN thread (pruning from the
         # IO thread would race its filesystem rendezvous), then prune — this
-        # bounds retention for async users too (at most keep+1 on disk)
+        # bounds retention for async users too (at most keep+1 on disk); the
+        # sync path prunes after its own save instead, so no extra barrier
         if self._last_async is not None:
             self._last_async.result()
             self._last_async = None
-        self._prune()
+            self._prune()
         sd = self._state_of(target)
         fut = save_state_dict(sd, self._dir(step), async_save=async_save)
         if async_save:

@@ -1,0 +1,22 @@
+"""``paddle.hapi`` — the Keras-like high-level ``Model`` API.
+
+Counterpart of the reference's ``python/paddle/hapi/model.py:1472``
+(``Model.fit/evaluate/predict``) and ``callbacks.py``.
+
+TPU-native difference: ``fit`` drives ONE compiled program per training step
+(``paddle_tpu.jit.TrainStep`` — fwd+bwd+optimizer fused by XLA), where the
+reference dispatches per-op through its dygraph runtime; evaluate/predict use
+a cached jitted forward.
+"""
+
+from .callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRSchedulerCallback,
+    ModelCheckpoint,
+    ProgBarLogger,
+)
+from .model import Model, summary  # noqa: F401
+
+__all__ = ["Model", "summary", "Callback", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRSchedulerCallback"]

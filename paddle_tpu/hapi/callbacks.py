@@ -1,0 +1,169 @@
+"""hapi callbacks (reference ``python/paddle/hapi/callbacks.py``)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Optional
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRSchedulerCallback"]
+
+
+class Callback:
+    """Base callback: hooks around fit/epoch/batch (reference ``Callback``)."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = dict(params)
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks, model, params):
+        self.callbacks = list(callbacks)
+        for c in self.callbacks:
+            c.set_model(model)
+            c.set_params(params)
+
+    def call(self, hook, *args, **kwargs):
+        for c in self.callbacks:
+            getattr(c, hook)(*args, **kwargs)
+
+
+class ProgBarLogger(Callback):
+    """Per-epoch textual progress (role of the reference's ProgBarLogger)."""
+
+    def __init__(self, log_freq: int = 10, verbose: int = 2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+        if self.verbose:
+            print(f"Epoch {epoch + 1}/{self.params.get('epochs', '?')}", file=sys.stderr)
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose >= 2 and (step + 1) % self.log_freq == 0:
+            items = " - ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
+                               if isinstance(v, float))
+            print(f"  step {step + 1}/{self.params.get('steps', '?')} - {items}",
+                  file=sys.stderr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            items = " - ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
+                               if isinstance(v, float))
+            print(f"  epoch done in {dt:.1f}s - {items}", file=sys.stderr)
+
+
+class ModelCheckpoint(Callback):
+    """Save every ``save_freq`` epochs into ``save_dir`` (reference semantics:
+    ``<dir>/<epoch>`` prefix + a ``final`` save at train end)."""
+
+    def __init__(self, save_freq: int = 1, save_dir: Optional[str] = None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    """Stop when ``monitor`` stops improving (reference EarlyStopping)."""
+
+    def __init__(self, monitor: str = "loss", mode: str = "min", patience: int = 0,
+                 min_delta: float = 0.0, baseline=None, save_best_model: bool = False):
+        super().__init__()
+        self.monitor = monitor
+        self.mode = mode
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        self.wait = 0
+        self.best = baseline  # an epoch only counts if it beats the baseline
+        self.stopped_epoch = None
+
+    def _better(self, cur, ref):
+        if self.mode == "max":
+            return cur > ref + self.min_delta
+        return cur < ref - self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if self.best is None or self._better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+            if self.save_best_model:
+                save_dir = self.params.get("save_dir")
+                if save_dir:
+                    self.model.save(os.path.join(save_dir, "best_model"))
+            return
+        self.wait += 1
+        if self.wait > self.patience:
+            self.stopped_epoch = epoch
+            self.model.stop_training = True
+
+
+class LRSchedulerCallback(Callback):
+    """Step an LR scheduler once per epoch (reference LRScheduler callback)."""
+
+    def __init__(self, by_step: bool = False):
+        super().__init__()
+        self.by_step = by_step
+
+    def _sched(self):
+        from ..optimizer.lr import LRScheduler
+
+        lr = getattr(self.model._optimizer, "_lr", None)
+        return lr if isinstance(lr, LRScheduler) else None
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step and (s := self._sched()) is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self.by_step and (s := self._sched()) is not None:
+            s.step()

@@ -1,0 +1,315 @@
+"""hapi ``Model``: fit/evaluate/predict over the compiled TrainStep.
+
+Reference: ``python/paddle/hapi/model.py:1472`` (``fit``), ``:1679``
+(``evaluate``), ``:1783`` (``predict``), ``summary``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..framework.dispatch import unwrap, wrap
+from ..framework.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..jit import TrainStep, _get_state, functional_call
+from ..metric import Metric
+from ..nn.layers import Layer
+from .callbacks import Callback, CallbackList, ProgBarLogger
+
+__all__ = ["Model", "summary"]
+
+
+def _to_loader(data, batch_size, shuffle, drop_last=False, num_workers=0):
+    if data is None or isinstance(data, DataLoader):
+        return data
+    if isinstance(data, Dataset):
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+    raise TypeError(f"expected Dataset or DataLoader, got {type(data)}")
+
+
+def _split_batch(batch, n_inputs):
+    if isinstance(batch, (list, tuple)):
+        ins = tuple(batch[:n_inputs])
+        labels = tuple(batch[n_inputs:])
+    else:
+        ins, labels = (batch,), ()
+    return ins, labels
+
+
+class Model:
+    """High-level training/eval/inference wrapper around a ``nn.Layer``.
+
+    Usage (reference-shaped)::
+
+        model = hapi.Model(network)
+        model.prepare(optimizer, paddle.nn.CrossEntropyLoss(), metric.Accuracy())
+        model.fit(train_dataset, epochs=2, batch_size=32)
+        model.evaluate(val_dataset)
+        model.predict(test_dataset)
+    """
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs_spec = inputs if inputs is None or isinstance(inputs, (list, tuple)) else [inputs]
+        self._labels_spec = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self._eval_fn = None
+        self.stop_training = False
+
+    # -- setup --------------------------------------------------------------
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m!r} is not a paddle_tpu.metric.Metric")
+        self._train_step = None  # rebuilt lazily (optimizer may have changed)
+        return self
+
+    def _loss_value(self, outputs, labels):
+        loss = self._loss(outputs, *labels)
+        if isinstance(loss, (list, tuple)):
+            loss = sum(loss[1:], loss[0])
+        return loss
+
+    def _build_train_step(self, n_inputs):
+        def loss_fn(net, *batch):
+            ins, labels = batch[:n_inputs], batch[n_inputs:]
+            return self._loss_value(net(*ins), labels)
+
+        return TrainStep(self.network, loss_fn, self._optimizer)
+
+    def _forward_jitted(self, ins):
+        """Eval-mode forward (dropout off, BN running stats): the network is
+        flipped to eval for the trace AND for every call, so the cached jit is
+        always an eval-mode program."""
+        net = self.network
+        was_training = net.training
+        net.eval()
+        try:
+            if self._eval_fn is None:
+                def pure(params, buffers, ins):
+                    return functional_call(net, params, buffers, *ins)
+
+                self._eval_fn = jax.jit(pure)
+            params, buffers = _get_state(net)
+            return wrap(self._eval_fn(params, buffers, unwrap(tuple(ins))))
+        finally:
+            if was_training:
+                net.train()
+
+    # -- batch-level API (reference train_batch/eval_batch/predict_batch) ---
+
+    def train_batch(self, inputs, labels=None):
+        ins = tuple(inputs) if isinstance(inputs, (list, tuple)) else (inputs,)
+        labels = (tuple(labels) if isinstance(labels, (list, tuple)) else (labels,)) \
+            if labels is not None else ()
+        self.network.train()  # the TrainStep trace must be a train-mode program
+        if self._train_step is None:
+            self._train_step = self._build_train_step(len(ins))
+        loss = self._train_step(*ins, *labels)
+        return float(np.asarray(loss._data))
+
+    def eval_batch(self, inputs, labels=None):
+        ins = tuple(inputs) if isinstance(inputs, (list, tuple)) else (inputs,)
+        labels = (tuple(labels) if isinstance(labels, (list, tuple)) else (labels,)) \
+            if labels is not None else ()
+        outputs = self._forward_jitted(ins)
+        loss = self._loss_value(outputs, labels) if self._loss is not None else None
+        for m in self._metrics:
+            m.update(*_as_list(m.compute(outputs, *labels)))
+        return float(np.asarray(loss._data)) if loss is not None else None
+
+    def predict_batch(self, inputs):
+        ins = tuple(inputs) if isinstance(inputs, (list, tuple)) else (inputs,)
+        return self._forward_jitted(ins)
+
+    # -- loops --------------------------------------------------------------
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        assert self._optimizer is not None and self._loss is not None, \
+            "call prepare(optimizer, loss) before fit()"
+        # accumulate_grad_batches: concatenate k consecutive batches and run
+        # ONE compiled step — for mean-reduced losses this equals k-step grad
+        # accumulation, and a bigger batch is the better program on TPU anyway
+        acc = max(1, int(accumulate_grad_batches))
+        loader = _to_loader(train_data, batch_size, shuffle, drop_last, num_workers)
+        eval_loader = _to_loader(eval_data, batch_size, False)
+        cbs = list(callbacks or [])
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
+            cbs.insert(0, ProgBarLogger(log_freq, verbose))
+        if save_dir is not None:
+            from .callbacks import ModelCheckpoint
+
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        try:
+            steps = (len(loader) + acc - 1) // acc
+        except TypeError:
+            steps = None
+        cblist = CallbackList(cbs, self, {"epochs": epochs, "steps": steps,
+                                          "verbose": verbose, "save_dir": save_dir})
+        self.stop_training = False
+        history = {"loss": []}
+        cblist.call("on_train_begin")
+        it_count = 0
+
+        def _accumulated(it):
+            """Yield batches, concatenating groups of ``acc`` along axis 0."""
+            if acc == 1:
+                yield from it
+                return
+            import jax.numpy as jnp
+
+            group = []
+            for b in it:
+                group.append(b)
+                if len(group) == acc:
+                    yield [Tensor(jnp.concatenate([unwrap(g[i]) for g in group]))
+                           for i in range(len(group[0]))]
+                    group = []
+            if group:
+                yield [Tensor(jnp.concatenate([unwrap(g[i]) for g in group]))
+                       for i in range(len(group[0]))]
+
+        for epoch in range(epochs):
+            cblist.call("on_epoch_begin", epoch)
+            epoch_losses = []
+            for step, batch in enumerate(_accumulated(loader)):
+                cblist.call("on_train_batch_begin", step)
+                ins, labels = _split_batch(batch, self._n_inputs(batch))
+                loss = self.train_batch(ins, labels)
+                epoch_losses.append(loss)
+                cblist.call("on_train_batch_end", step, {"loss": loss})
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    self.stop_training = True
+                    break
+            logs = {"loss": float(np.mean(epoch_losses)) if epoch_losses else float("nan")}
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            history["loss"].append(logs["loss"])
+            cblist.call("on_epoch_end", epoch, logs)
+            if self.stop_training:
+                break
+        cblist.call("on_train_end", {"loss": history["loss"]})
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = _to_loader(eval_data, batch_size, False, num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        seen = 0
+        for batch in loader:
+            ins, labels = _split_batch(batch, self._n_inputs(batch))
+            loss = self.eval_batch(ins, labels)
+            if loss is not None:
+                losses.append(loss)
+            seen += int(unwrap(ins[0]).shape[0])
+            if num_samples is not None and seen >= num_samples:
+                break
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            res = m.accumulate()
+            names = m.name() if isinstance(m.name(), (list, tuple)) else [m.name()]
+            vals = res if isinstance(res, (list, tuple)) else [res]
+            for n, v in zip(names, vals):
+                logs[n] = float(v)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = _to_loader(test_data, batch_size, False, num_workers=num_workers)
+        outs = []
+        for batch in loader:
+            ins, _ = _split_batch(batch, self._n_inputs(batch))
+            out = self.predict_batch(ins)
+            outs.append([np.asarray(t._data) for t in _as_list(out)])
+        n_out = len(outs[0]) if outs else 0
+        grouped = [[b[i] for b in outs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g, axis=0) for g in grouped]
+        return grouped
+
+    def _n_inputs(self, batch):
+        """Without an ``inputs`` spec, everything but the last batch element is
+        input (the reference's common (x, label) dataset convention; predict
+        data shaped the same way simply has its labels ignored)."""
+        if self._inputs_spec is not None:
+            return len(self._inputs_spec)
+        if not isinstance(batch, (list, tuple)) or len(batch) <= 1:
+            return 1
+        return len(batch) - 1
+
+    # -- persistence & introspection ---------------------------------------
+
+    def save(self, path, training=True):
+        from ..framework.io import save as _save
+
+        state = {"model": self.network.state_dict()}
+        if training and self._optimizer is not None:
+            state["optimizer"] = self._optimizer.state_dict()
+        _save(state, path + ".pdparams")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as _load
+
+        state = _load(path + ".pdparams")
+        self.network.set_state_dict(state["model"])
+        if not reset_optimizer and self._optimizer is not None and "optimizer" in state:
+            self._optimizer.set_state_dict(state["optimizer"])
+        self._train_step = None
+        self._eval_fn = None
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network)
+
+
+def _as_list(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v]
+
+
+def summary(network: Layer, input_size=None, dtypes=None):
+    """Parameter-count summary (reference ``hapi.summary`` role): prints a
+    per-layer table, returns ``{'total_params': N, 'trainable_params': N}``."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in network.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max((len(r[0]) for r in rows), default=10) + 2
+    print(f"{'Layer (param)':<{width}}{'Shape':<24}{'Params':>12}")
+    print("-" * (width + 36))
+    for name, shape, n in rows:
+        print(f"{name:<{width}}{str(shape):<24}{n:>12,}")
+    print("-" * (width + 36))
+    print(f"Total params: {total:,}  (trainable: {trainable:,})")
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,168 @@
+"""hapi Model.fit/evaluate/predict + callbacks (reference hapi/model.py:1472)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import hapi, metric
+from paddle_tpu.io import TensorDataset
+
+
+def _cls_dataset(n=128, dim=8, classes=3, seed=0):
+    """Linearly separable synthetic classification data."""
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(dim, classes))
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    y = (x @ w + 0.1 * rng.normal(size=(n, classes))).argmax(-1).astype(np.int64)
+    return TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+
+
+def _build():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 3))
+    model = hapi.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), metric.Accuracy())
+    return model
+
+
+class TestFit:
+    def test_fit_reduces_loss_and_history(self):
+        model = _build()
+        ds = _cls_dataset()
+        hist = model.fit(ds, epochs=8, batch_size=32, verbose=0)
+        assert len(hist["loss"]) == 8
+        assert hist["loss"][-1] < hist["loss"][0] * 0.5
+
+    def test_fit_with_eval_data(self):
+        model = _build()
+        hist = model.fit(_cls_dataset(), eval_data=_cls_dataset(seed=1),
+                         epochs=2, batch_size=32, verbose=0)
+        assert len(hist["loss"]) == 2
+
+    def test_evaluate_metrics(self):
+        model = _build()
+        model.fit(_cls_dataset(), epochs=5, batch_size=32, verbose=0)
+        logs = model.evaluate(_cls_dataset(), batch_size=32, verbose=0)
+        assert "loss" in logs and "acc" in logs
+        assert logs["acc"] > 0.8  # separable data: must actually learn
+
+    def test_predict(self):
+        model = _build()
+        outs = model.predict(_cls_dataset(n=40), batch_size=16, stack_outputs=True)
+        assert len(outs) == 1
+        assert outs[0].shape == (40, 3)
+
+    def test_num_iters_stops_early(self):
+        model = _build()
+        hist = model.fit(_cls_dataset(), epochs=10, batch_size=32, verbose=0,
+                         num_iters=3)
+        assert len(hist["loss"]) == 1  # stopped inside the first epoch
+
+
+class TestCallbacks:
+    def test_model_checkpoint_and_load(self, tmp_path):
+        model = _build()
+        model.fit(_cls_dataset(), epochs=2, batch_size=32, verbose=0,
+                  save_dir=str(tmp_path))
+        assert os.path.exists(str(tmp_path / "final.pdparams"))
+        preds_before = model.predict(_cls_dataset(n=8), batch_size=8,
+                                     stack_outputs=True)[0]
+
+        model2 = _build()
+        model2.load(str(tmp_path / "final"))
+        preds_after = model2.predict(_cls_dataset(n=8), batch_size=8,
+                                     stack_outputs=True)[0]
+        np.testing.assert_allclose(preds_after, preds_before, rtol=1e-5, atol=1e-6)
+
+    def test_early_stopping(self):
+        model = _build()
+        stopper = hapi.EarlyStopping(monitor="loss", mode="min", patience=0,
+                                     min_delta=100.0)  # nothing counts as improving
+        hist = model.fit(_cls_dataset(), epochs=10, batch_size=32, verbose=0,
+                         callbacks=[stopper])
+        assert len(hist["loss"]) == 2  # best set at epoch 0, stop after epoch 1
+        assert stopper.stopped_epoch == 1
+
+    def test_lr_scheduler_callback(self):
+        from paddle_tpu.optimizer.lr import StepDecay
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 3))
+        sched = StepDecay(learning_rate=0.1, step_size=1, gamma=0.5)
+        opt = paddle.optimizer.SGD(learning_rate=sched, parameters=net.parameters())
+        model = hapi.Model(net)
+        model.prepare(opt, nn.CrossEntropyLoss())
+        model.fit(_cls_dataset(), epochs=3, batch_size=64, verbose=0,
+                  callbacks=[hapi.LRSchedulerCallback()])
+        assert opt.get_lr() == pytest.approx(0.1 * 0.5 ** 3)
+
+    def test_custom_callback_hooks_fire(self):
+        events = []
+
+        class Probe(hapi.Callback):
+            def on_train_begin(self, logs=None):
+                events.append("train_begin")
+
+            def on_epoch_begin(self, epoch, logs=None):
+                events.append(f"epoch_begin_{epoch}")
+
+            def on_train_batch_end(self, step, logs=None):
+                events.append("batch")
+
+            def on_train_end(self, logs=None):
+                events.append("train_end")
+
+        model = _build()
+        model.fit(_cls_dataset(n=64), epochs=2, batch_size=32, verbose=0,
+                  callbacks=[Probe()])
+        assert events[0] == "train_begin" and events[-1] == "train_end"
+        assert events.count("batch") == 4  # 2 epochs x 2 steps
+        assert "epoch_begin_1" in events
+
+
+class TestModes:
+    def test_predict_uses_eval_mode_dropout_off(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 32), nn.Dropout(0.5), nn.Linear(32, 3))
+        model = hapi.Model(net)
+        ds = _cls_dataset(n=16)
+        a = model.predict(ds, batch_size=16, stack_outputs=True)[0]
+        b = model.predict(ds, batch_size=16, stack_outputs=True)[0]
+        np.testing.assert_array_equal(a, b)  # no stochastic mask
+        # matches a manual eval-mode forward
+        net.eval()
+        x = paddle.to_tensor(np.asarray([ds[i][0].numpy() for i in range(16)]))
+        want = np.asarray(net(x).numpy())
+        np.testing.assert_allclose(a, want, rtol=1e-5, atol=1e-6)
+        # and fit() after predict still trains in train mode
+        net.train()
+        assert net.training
+
+    def test_accumulate_grad_batches_equals_big_batch(self):
+        ds = _cls_dataset(n=64)
+        m1 = _build()
+        h1 = m1.fit(ds, epochs=2, batch_size=16, shuffle=False, verbose=0,
+                    accumulate_grad_batches=2)
+        m2 = _build()
+        h2 = m2.fit(ds, epochs=2, batch_size=32, shuffle=False, verbose=0)
+        np.testing.assert_allclose(h1["loss"], h2["loss"], rtol=1e-5, atol=1e-6)
+
+    def test_early_stopping_baseline(self):
+        model = _build()
+        stopper = hapi.EarlyStopping(monitor="loss", mode="min", patience=0,
+                                     baseline=1e-9)  # unreachable
+        hist = model.fit(_cls_dataset(), epochs=5, batch_size=32, verbose=0,
+                         callbacks=[stopper])
+        assert len(hist["loss"]) == 1  # first epoch can't beat baseline -> stop
+
+
+def test_summary_counts_params(capsys):
+    net = nn.Sequential(nn.Linear(8, 4), nn.Linear(4, 2))
+    info = hapi.summary(net)
+    assert info["total_params"] == 8 * 4 + 4 + 4 * 2 + 2
+    out = capsys.readouterr().out
+    assert "Total params" in out

@@ -69,14 +69,22 @@ class GradNode:
         "num_outputs",
         "out_avals",
         "name",
+        "fwd_fn",
+        "out_multi",
     )
 
-    def __init__(self, vjp_fn, inputs, num_outputs, out_avals, name=""):
+    def __init__(self, vjp_fn, inputs, num_outputs, out_avals, name="", fwd_fn=None,
+                 out_multi=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list[Tensor]
         self.num_outputs = num_outputs
         self.out_avals = out_avals  # list[(shape, dtype)] for zero-filling
         self.name = name
+        # the op's forward callable over raw arrays — needed by create_graph
+        # backward, which re-derives the vjp THROUGH the tape (higher-order)
+        self.fwd_fn = fwd_fn
+        # whether fwd_fn returns a tuple (vjp cotangent structure must match)
+        self.out_multi = num_outputs > 1 if out_multi is None else out_multi
 
     def __repr__(self):
         return f"<GradNode {self.name} n_in={len(self.inputs)} n_out={self.num_outputs}>"
@@ -93,6 +101,66 @@ def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None, retain_
     ones (or ``grad_tensors``), accumulates into leaf ``Tensor.grad``, frees the
     graph unless ``retain_graph``.
     """
+    return _backward_impl(tensors, grad_tensors, retain_graph, False, None)
+
+
+def _taped_vjp(node: GradNode, cot_tensors):
+    """create_graph backward step: re-derive this op's vjp THROUGH the tape.
+
+    The original ``vjp_fn`` closes over the primals as constants, so taping it
+    would only differentiate w.r.t. the cotangents — second derivatives w.r.t.
+    the primals (the whole point of double grad) would be lost.  Instead the
+    op's ``fwd_fn`` is re-vjp'd inside a taped op whose inputs are BOTH the
+    primals and the cotangents; ``apply_op`` then records a GradNode for the
+    backward itself, recursively enabling any order.
+    """
+    from .dispatch import apply_op
+
+    if node.fwd_fn is None:
+        raise NotImplementedError(
+            f"create_graph=True through op '{node.name}' (a custom-vjp PyLayer "
+            "node with no retained forward); implement its backward with taped "
+            "ops or use the compiled path (jax.grad composition)")
+    n_in = len(node.inputs)
+
+    def bwd_fn(*args):
+        primals, cots = args[:n_in], args[n_in:]
+        # int/bool outputs take float0 cotangents under jax.vjp (their taped
+        # placeholder is an f32 zero that never influences anything)
+        cots = tuple(
+            c if jnp.issubdtype(dt, jnp.floating) or jnp.issubdtype(dt, jnp.complexfloating)
+            else np.zeros(shape, jax.dtypes.float0)
+            for c, (shape, dt) in zip(cots, node.out_avals))
+        _, vjp = jax.vjp(node.fwd_fn, *primals)
+        gs = vjp(tuple(cots) if node.out_multi else cots[0])
+        # float0 (int/bool primal) grads can't live in Tensors; zero-fill —
+        # they are skipped by the stop_gradient routing anyway
+        gs = tuple(
+            jnp.zeros(p.shape, jnp.float32) if _is_float0(g) else g
+            for g, p in zip(gs, primals))
+        return gs if n_in > 1 else gs[0]
+
+    from .dispatch import amp_state
+
+    # first-order backward never passes through _amp_cast; the taped backward
+    # must not either (an O2 policy would silently cast second-order grads)
+    prev_amp = amp_state.enabled
+    amp_state.enabled = False
+    try:
+        outs = apply_op(f"grad_{node.name}", bwd_fn,
+                        tuple(node.inputs) + tuple(cot_tensors), {},
+                        num_outputs=n_in)
+    finally:
+        amp_state.enabled = prev_amp
+    return outs if isinstance(outs, tuple) else (outs,)
+
+
+def _backward_impl(tensors: Sequence, grad_tensors: Optional[Sequence],
+                   retain_graph: bool, create_graph: bool, sink: Optional[dict]):
+    """Shared engine.  With ``create_graph`` every cotangent is a TENSOR and
+    every vjp runs through ``apply_op`` (see ``_taped_vjp``), so the produced
+    gradients carry their own graph; ``sink`` (id(tensor) -> Tensor) collects
+    leaf grads instead of the raw ``.grad`` field in that mode."""
     from .tensor import Tensor  # local import to avoid cycle
 
     tensors = list(tensors)
@@ -103,15 +171,27 @@ def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None, retain_
     # Seed cotangents per (node, out_index); leaf roots accumulate directly.
     node_cots: dict = {}
 
+    def _leaf(t: Tensor, g):
+        if create_graph and sink is not None:
+            gt = g if isinstance(g, Tensor) else Tensor(g)
+            prev = sink.get(id(t))
+            sink[id(t)] = gt if prev is None else prev + gt
+        else:
+            t._accumulate_grad(g._data if isinstance(g, Tensor) else g)
+
     def _seed(t: Tensor, g):
         if g is None:
             g = jnp.ones(t.shape, dtype=t.dtype)
-        elif isinstance(g, Tensor):
+            if create_graph:
+                g = Tensor(g)
+        elif isinstance(g, Tensor) and not create_graph:
             g = g._data
+        elif not isinstance(g, Tensor) and create_graph:
+            g = Tensor(jnp.asarray(g))
         node = t._grad_node
         if node is None:
             if not t.stop_gradient:
-                t._accumulate_grad(g)
+                _leaf(t, g)
             return
         slots = node_cots.setdefault(id(node), [None] * node.num_outputs)
         slots[t._out_index] = g if slots[t._out_index] is None else slots[t._out_index] + g
@@ -156,6 +236,10 @@ def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None, retain_
                 shape, dt = node.out_avals[i]
                 if jnp.issubdtype(dt, jnp.floating) or jnp.issubdtype(dt, jnp.complexfloating):
                     s = jnp.zeros(shape, dtype=dt)
+                    if create_graph:
+                        s = Tensor(s)
+                elif create_graph:
+                    s = Tensor(jnp.zeros(shape, dtype=jnp.float32))  # placeholder
                 else:
                     # integer/bool outputs take float0 cotangents under jax.vjp
                     s = np.zeros(shape, dtype=jax.dtypes.float0)
@@ -165,7 +249,10 @@ def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None, retain_
                 "trying to backward through a graph a second time: "
                 "set retain_graph=True on the first backward"
             )
-        in_grads = node.vjp_fn(tuple(cots) if node.num_outputs > 1 else cots[0])
+        if create_graph:
+            in_grads = _taped_vjp(node, cots)
+        else:
+            in_grads = node.vjp_fn(tuple(cots) if node.out_multi else cots[0])
         if not isinstance(in_grads, (tuple, list)):
             in_grads = (in_grads,)
         for inp, g in zip(node.inputs, in_grads):
@@ -174,10 +261,15 @@ def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None, retain_
             for hook in inp._hooks:
                 out = hook(g)
                 if out is not None:
-                    g = out._data if isinstance(out, Tensor) else out
+                    if create_graph:
+                        # cotangents are Tensors here; a hook returning a raw
+                        # array is wrapped (its own computation isn't taped)
+                        g = out if isinstance(out, Tensor) else Tensor(jnp.asarray(out))
+                    else:
+                        g = out._data if isinstance(out, Tensor) else out
             child = inp._grad_node
             if child is None:
-                inp._accumulate_grad(g)
+                _leaf(inp, g)
             else:
                 cslots = node_cots.setdefault(id(child), [None] * child.num_outputs)
                 j = inp._out_index
@@ -203,10 +295,26 @@ def grad(
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if create_graph:
-        raise NotImplementedError(
-            "create_graph=True is not supported on the eager tape; "
-            "use paddle_tpu.jit / jax.grad composition for higher-order grads"
-        )
+        # higher-order: the backward itself runs through the tape (every vjp
+        # is a taped op — see _taped_vjp), so the returned grads have graphs
+        # and can be backward()'d / grad()'d again.  Reference: the prim/
+        # composite double-grad system (``fluid/primitive``, ``incubate/autograd``).
+        sink: dict = {}
+        with enable_grad():  # the caller asked for a graph; override no_grad
+            _backward_impl(outputs, grad_outputs,
+                           retain_graph=True if retain_graph is None else bool(retain_graph),
+                           create_graph=True, sink=sink)
+        results = []
+        for t in inputs:
+            g = sink.get(id(t))
+            if g is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "one of the input tensors received no gradient; pass allow_unused=True")
+                results.append(None)
+            else:
+                results.append(g)
+        return results
     # Save and clear the raw grad field on the requested inputs, run backward,
     # collect.  The raw ``_grad`` (jax.Array) is saved, not the ``.grad``
     # property (a Tensor wrapper), so the finally-restore keeps the field a

@@ -114,6 +114,8 @@ def apply_op(
                 len(out_list),
                 [(o.shape, o.dtype) for o in out_list],
                 name=name,
+                fwd_fn=call,
+                out_multi=multi,
             )
             results = []
             for i, o in enumerate(out_list):

@@ -1,0 +1,131 @@
+"""Higher-order autodiff on the eager tape (``create_graph=True``).
+
+Reference capability: the prim/composite double-grad system
+(``fluid/primitive``, ``incubate/autograd``); here the backward itself runs
+through the tape (every vjp is a taped op), enabling any order.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _scalar(v):
+    return paddle.to_tensor(np.asarray(v, np.float32), stop_gradient=False)
+
+
+class TestDoubleGrad:
+    def test_second_and_third_order_polynomial(self):
+        x = _scalar(2.0)
+        y = x ** 3
+        g1, = paddle.grad(y, x, create_graph=True)
+        assert float(g1.numpy()) == pytest.approx(12.0)
+        g2, = paddle.grad(g1, x, create_graph=True)
+        assert float(g2.numpy()) == pytest.approx(12.0)
+        g3, = paddle.grad(g2, x)
+        assert float(g3.numpy()) == pytest.approx(6.0)
+
+    def test_composite_second_order(self):
+        x = _scalar(0.5)
+        y = paddle.sin(x) * paddle.exp(x)
+        g1, = paddle.grad(y, x, create_graph=True)
+        g2, = paddle.grad(g1, x)
+        want = 2 * np.cos(0.5) * np.exp(0.5)  # d2/dx2 sin(x)e^x
+        assert float(g2.numpy()) == pytest.approx(want, rel=1e-5)
+
+    def test_gradient_penalty_backward(self):
+        """WGAN-GP style: backward through a loss built from a taped grad."""
+        x = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32), stop_gradient=False)
+        y = (x ** 2).sum()
+        gx, = paddle.grad(y, x, create_graph=True)  # 2x
+        penalty = (gx ** 2).sum()  # 4x^2
+        penalty.backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()), [8.0, 16.0], rtol=1e-6)
+
+    def test_through_layers(self):
+        """Hessian-vector-ish: grad of grad through Linear+activation."""
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(3, 8), nn.Tanh(), nn.Linear(8, 1))
+        x = paddle.to_tensor(np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32),
+                             stop_gradient=False)
+        y = net(x).sum()
+        gx, = paddle.grad(y, x, create_graph=True)
+        gsum = gx.sum()
+        ggx, = paddle.grad(gsum, x)
+        # numeric check of sum-of-Hessian-rows via finite differences
+        eps = 1e-3
+        x_np = np.asarray(x.numpy())
+
+        def g_of(x_arr):
+            xt = paddle.to_tensor(x_arr.astype(np.float32), stop_gradient=False)
+            yt = net(xt).sum()
+            g, = paddle.grad(yt, xt)
+            return np.asarray(g.numpy())
+
+        i, j = 1, 2
+        e = np.zeros_like(x_np)
+        e[i, j] = eps
+        fd = (g_of(x_np + e).sum() - g_of(x_np - e).sum()) / (2 * eps)
+        assert float(np.asarray(ggx.numpy())[i, j]) == pytest.approx(fd, abs=2e-2)
+
+    def test_mixed_partials(self):
+        x = _scalar(1.5)
+        z = _scalar(0.5)
+        y = x * x * z  # d2y/dxdz = 2x = 3
+        gx, = paddle.grad(y, x, create_graph=True)
+        gxz, = paddle.grad(gx, z)
+        assert float(gxz.numpy()) == pytest.approx(3.0)
+
+    def test_first_order_unaffected(self):
+        """create_graph path must not disturb plain backward results."""
+        x = _scalar(3.0)
+        (x ** 2).backward()
+        assert float(x.grad.numpy()) == pytest.approx(6.0)
+
+    def test_hook_returning_raw_array(self):
+        """Hooks following the raw-array convention must not crash create_graph."""
+        import jax.numpy as jnp
+
+        x = _scalar(2.0)
+        y = x * x
+        y.register_hook(lambda g: jnp.asarray(g._data if hasattr(g, "_data") else g) * 2)
+        z = y * 3
+        g1, = paddle.grad(z, x, create_graph=True)
+        # dz/dy = 3, hook doubles it -> 6; dy/dx = 2x=4 -> 24
+        assert float(g1.numpy()) == pytest.approx(24.0)
+
+    def test_create_graph_under_no_grad(self):
+        """An explicit create_graph request overrides an ambient no_grad."""
+        x = _scalar(2.0)
+        y = x ** 3
+        with paddle.no_grad():
+            g1, = paddle.grad(y, x, create_graph=True)
+        g2, = paddle.grad(g1, x)
+        assert float(g2.numpy()) == pytest.approx(12.0)
+
+    def test_amp_does_not_cast_taped_backward(self):
+        from paddle_tpu import amp
+
+        x = _scalar(2.0)
+        y = x ** 3
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            g1, = paddle.grad(y, x, create_graph=True)
+        assert str(g1.dtype).endswith("float32")
+
+    def test_single_tuple_output_op_backward(self):
+        """Ops whose fn returns a 1-tuple must backward in both paths."""
+        x = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        (m,) = paddle.meshgrid(x)
+        (m * m).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()), [2.0, 4.0, 6.0])
+
+    def test_grad_of_grad_with_allow_unused(self):
+        x = _scalar(1.0)
+        z = _scalar(2.0)
+        y = x ** 2
+        gx, = paddle.grad(y, x, create_graph=True)
+        out = paddle.grad(gx, z, allow_unused=True)
+        assert out[0] is None

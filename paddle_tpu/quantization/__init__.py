@@ -1,0 +1,323 @@
+"""``paddle.quantization`` — PTQ + QAT (simulated int8).
+
+Counterpart of the reference's ``python/paddle/quantization/`` (QuantConfig,
+PTQ/QAT entry classes, observers in ``observers/``, fake quanters in
+``quanters/``).
+
+TPU-native design: quantization is SIMULATED (fake-quant) — values are snapped
+to the int8 grid but kept in float, which is both what QAT needs (straight-
+through estimator) and what XLA fuses best; a deploy-time int8 path would
+export scales via ``convert``'d layers.  All quant math runs through the
+dispatch layer so QAT composes with the eager tape and ``TrainStep``.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import apply_op
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layers import Layer
+
+__all__ = [
+    "QuantConfig", "PTQ", "QAT", "quanted",
+    "AbsmaxObserver", "MovingAverageAbsmaxObserver",
+    "FakeQuanterWithAbsMax", "QuantedLinear", "QuantedConv2D",
+]
+
+
+def _absmax(x):
+    return jnp.max(jnp.abs(x))
+
+
+def _fake_quant(x, scale, qmax):
+    """Snap to the symmetric int grid at ``scale``; straight-through gradient."""
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * (s / qmax)
+    return x + jax.lax.stop_gradient(q - x)
+
+
+# ---------------------------------------------------------------------------
+# observers (PTQ calibration) & quanters (QAT)
+# ---------------------------------------------------------------------------
+
+class AbsmaxObserver(Layer):
+    """Tracks the running max(|x|) over calibration batches
+    (reference ``observers/abs_max.py``)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def forward(self, x):
+        self._absmax = max(self._absmax,
+                           float(_absmax(x._data if isinstance(x, Tensor) else x)))
+        return x
+
+    def scale(self) -> float:
+        return self._absmax
+
+
+class MovingAverageAbsmaxObserver(AbsmaxObserver):
+    """EMA of per-batch absmax (reference ``moving_average_abs_max``)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+        self._seen = False
+
+    def forward(self, x):
+        cur = float(_absmax(x._data if isinstance(x, Tensor) else x))
+        if not self._seen:
+            self._absmax, self._seen = cur, True
+        else:
+            self._absmax = self.moving_rate * self._absmax + (1 - self.moving_rate) * cur
+        return x
+
+
+class FakeQuanterWithAbsMax(Layer):
+    """QAT quanter: dynamic per-tensor absmax scale + STE rounding
+    (reference ``quanters/abs_max.py`` FakeQuanterWithAbsMaxObserverLayer)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._qmax = float(2 ** (quant_bits - 1) - 1)
+
+    def forward(self, x):
+        qmax = self._qmax
+
+        def f(a):
+            return _fake_quant(a, jax.lax.stop_gradient(_absmax(a)), qmax)
+
+        return apply_op("fake_quant_absmax", f,
+                        (x if isinstance(x, Tensor) else Tensor(x),), {})
+
+
+# ---------------------------------------------------------------------------
+# quantized layers
+# ---------------------------------------------------------------------------
+
+class _QuantedBase(Layer):
+    """Wraps a float layer; fake-quants weight + activations.
+
+    Custom quanters (``QuantConfig.activation/weight`` factories) take over
+    the respective path when provided; otherwise the built-in absmax
+    fake-quant runs (dynamic scale, or the fixed scales PTQ.convert bakes in).
+    """
+
+    def __init__(self, float_layer: Layer, quant_bits: int = 8,
+                 act_scale: Optional[float] = None, weight_scale: Optional[float] = None,
+                 dynamic_act: bool = True, act_quanter=None, weight_quanter=None):
+        super().__init__()
+        self._float = float_layer
+        self.quant_bits = quant_bits
+        self._qmax = float(2 ** (quant_bits - 1) - 1)
+        self.act_scale = act_scale
+        self.weight_scale = weight_scale
+        self.dynamic_act = dynamic_act
+        self.act_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def _q(self, t, scale):
+        qmax = self._qmax
+
+        def f(a):
+            s = jax.lax.stop_gradient(_absmax(a)) if scale is None else \
+                jnp.asarray(scale, jnp.float32)
+            return _fake_quant(a, s, qmax)
+
+        return apply_op("fake_quant", f, (t,), {})
+
+    def _q_weight(self, w):
+        if self.weight_quanter is not None:
+            return self.weight_quanter(w)
+        return self._q(w, self.weight_scale)
+
+    def _q_act(self, x):
+        if self.act_quanter is not None:
+            return self.act_quanter(x)
+        if self.act_scale is not None:
+            return self._q(x, self.act_scale)
+        if self.dynamic_act:
+            return self._q(x, None)
+        return x
+
+    @property
+    def weight(self):
+        return self._float.weight
+
+    @property
+    def bias(self):
+        return self._float.bias
+
+
+class QuantedLinear(_QuantedBase):
+    """(reference ``nn/quant/qat/linear.py`` QuantedLinear role)."""
+
+    def forward(self, x):
+        xq = self._q_act(x if isinstance(x, Tensor) else Tensor(x))
+        wq = self._q_weight(self._float.weight)
+        return F.linear(xq, wq, self._float.bias)
+
+
+class QuantedConv2D(_QuantedBase):
+    def forward(self, x):
+        fl = self._float
+        xq = self._q_act(x if isinstance(x, Tensor) else Tensor(x))
+        wq = self._q_weight(fl.weight)
+        return F.conv2d(xq, wq, fl.bias, stride=fl.stride, padding=fl.padding,
+                        dilation=fl.dilation, groups=fl.groups,
+                        data_format=fl.data_format)
+
+
+def quanted(layer: Layer, **kw) -> Layer:
+    from ..nn.conv import Conv2D
+    from ..nn.common_layers import Linear
+
+    if isinstance(layer, Linear):
+        return QuantedLinear(layer, **kw)
+    if isinstance(layer, Conv2D):
+        return QuantedConv2D(layer, **kw)
+    raise TypeError(f"no quantized version for {type(layer).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# config + entry points
+# ---------------------------------------------------------------------------
+
+class QuantConfig:
+    """Which layers to quantize and how (reference ``config.py``).
+
+    ``activation``/``weight`` are observer/quanter FACTORIES (classes or
+    zero-arg callables); ``None`` means the built-in int8 absmax fake-quant.
+    ``add_type_config`` narrows quantization to specific layer types, with
+    optional per-type quanter overrides.
+    """
+
+    def __init__(self, activation=None, weight=None, quant_bits: int = 8):
+        self.activation = activation
+        self.weight = weight
+        self.quant_bits = quant_bits
+        self._type_configs: Dict[Type, dict] = {}
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        for t in layer_types:
+            self._type_configs[t] = {"activation": activation, "weight": weight}
+        return self
+
+    def _quantizable(self, layer) -> bool:
+        from ..nn.common_layers import Linear
+        from ..nn.conv import Conv2D
+
+        if self._type_configs:
+            return isinstance(layer, tuple(self._type_configs))
+        return isinstance(layer, (Linear, Conv2D))
+
+    def _quanters_for(self, layer):
+        """(act_quanter, weight_quanter) instances for this layer, honoring
+        per-type overrides then the global factories."""
+        act, wt = self.activation, self.weight
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                act = cfg["activation"] or act
+                wt = cfg["weight"] or wt
+                break
+        def mk(f):
+            if f is None or isinstance(f, Layer):
+                return f  # already an instance (Layers are callable; don't invoke)
+            return f()
+
+        return mk(act), mk(wt)
+
+
+def _replace_sublayers(root: Layer, predicate, build):
+    """Swap matching sublayers in the ``_sub_layers`` registry (where both
+    attribute access and iteration resolve); returns number replaced."""
+    n = 0
+    for name, child in list(root._sub_layers.items()):
+        if predicate(child):
+            root._sub_layers[name] = build(child)
+            n += 1
+        elif isinstance(child, Layer):
+            n += _replace_sublayers(child, predicate, build)
+    return n
+
+
+class QAT:
+    """Quantization-aware training: swap quantizable layers for fake-quant
+    versions; train as usual (reference ``qat.py`` QAT.quantize)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def _build(self, l):
+        act_q, wt_q = self.config._quanters_for(l)
+        return quanted(l, quant_bits=self.config.quant_bits,
+                       act_quanter=act_q, weight_quanter=wt_q)
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        m = model if inplace else copy.deepcopy(model)
+        if self.config._quantizable(m):
+            # a bare quantizable layer has no parent registry to swap in
+            return self._build(m)
+        _replace_sublayers(m, self.config._quantizable, self._build)
+        return m
+
+
+class PTQ:
+    """Post-training quantization: observe activations over calibration data,
+    then ``convert`` to fixed-scale quantized layers (reference ``ptq.py``)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def _observe(self, l):
+        obs_factory = self.config.activation or MovingAverageAbsmaxObserver
+
+        class _Observed(Layer):
+            def __init__(self, inner):
+                super().__init__()
+                self.observer = obs_factory() if callable(obs_factory) else obs_factory
+                self.inner = inner
+
+            def forward(self, x):
+                return self.inner(self.observer(x))
+
+        return _Observed(l)
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        m = model if inplace else copy.deepcopy(model)
+        if self.config._quantizable(m):
+            return self._observe(m)
+        _replace_sublayers(m, self.config._quantizable, self._observe)
+        return m
+
+    def _convert_one(self, l):
+        inner = l.inner
+        w = inner.weight._data
+        _, wt_q = self.config._quanters_for(inner)
+        return quanted(inner, quant_bits=self.config.quant_bits,
+                       act_scale=l.observer.scale(),
+                       weight_scale=float(_absmax(w)),
+                       dynamic_act=False, weight_quanter=wt_q)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        m = model if inplace else copy.deepcopy(model)
+
+        def is_observed(l):
+            return type(l).__name__ == "_Observed"
+
+        if is_observed(m):
+            return self._convert_one(m)
+        _replace_sublayers(m, is_observed, self._convert_one)
+        return m

@@ -1,0 +1,327 @@
+"""``paddle.sparse`` — COO/CSR sparse tensors.
+
+Counterpart of the reference's ``python/paddle/sparse/`` (5.6k LoC) backed by
+``phi/kernels/sparse/``.
+
+TPU-native design: storage is plain arrays (COO: ``indices [ndim, nnz]`` +
+``values [nnz]``; CSR: ``crows/cols/values``), compute lowers through
+``jax.experimental.sparse.BCOO`` or explicit scatter/gather — both jit- and
+autodiff-friendly, so sparse ops record on the eager tape exactly like dense
+ops (gradients flow to ``values`` and to dense operands).  Note that on TPU
+truly sparse kernels rarely beat dense MXU matmuls unless sparsity is extreme;
+the value of this API is model-porting parity (the reference's sparse conv /
+graph workloads), not raw FLOPs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..framework.dispatch import apply_op
+from ..framework.tensor import Tensor
+from . import nn  # noqa: F401
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor", "sparse_csr_tensor",
+    "is_sparse_coo", "is_sparse_csr", "add", "subtract", "multiply", "divide",
+    "matmul", "relu", "sum", "transpose", "nn",
+]
+
+
+def _t(v):
+    return v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
+
+
+def _raw(v):
+    return v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+
+class SparseCooTensor:
+    """COO tensor: ``indices [ndim, nnz]`` (reference layout), ``values [nnz]``."""
+
+    def __init__(self, indices, values, shape):
+        self._indices = jnp.asarray(_raw(indices), jnp.int32)
+        self._values = _t(values)
+        self.shape = tuple(int(s) for s in shape)
+
+    # -- reference surface ---------------------------------------------------
+    def indices(self) -> Tensor:
+        return Tensor(self._indices)
+
+    def values(self) -> Tensor:
+        return self._values
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._indices.shape[1])
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    def _bcoo(self, vals_raw):
+        return jsparse.BCOO((vals_raw, self._indices.T), shape=self.shape)
+
+    def to_dense(self) -> Tensor:
+        idx = self._indices
+
+        def f(vals):
+            out = jnp.zeros(self.shape, vals.dtype)
+            return out.at[tuple(idx)].add(vals)
+
+        return apply_op("sparse_coo_to_dense", f, (self._values,), {})
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if len(self.shape) != 2:
+            raise ValueError("to_sparse_csr supports 2-D tensors")
+        # sort by (row, col) then build crows by bincount; the value reorder
+        # runs through the tape so CSR conversion preserves gradients
+        rows, cols = np.asarray(self._indices[0]), np.asarray(self._indices[1])
+        order = jnp.asarray(np.lexsort((cols, rows)))
+        vals = apply_op("coo_to_csr_values", lambda v: v[order], (self._values,), {})
+        counts = np.bincount(rows[np.asarray(order)], minlength=self.shape[0])
+        crows = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+        return SparseCsrTensor(crows, cols[np.asarray(order)], vals, self.shape)
+
+    def transpose(self, perm=(1, 0)) -> "SparseCooTensor":
+        perm = list(perm)
+        new_idx = self._indices[jnp.asarray(perm)]
+        new_shape = tuple(self.shape[p] for p in perm)
+        return SparseCooTensor(new_idx, self._values, new_shape)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR tensor: ``crows [rows+1]``, ``cols [nnz]``, ``values [nnz]``."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(_raw(crows), jnp.int32)
+        self._cols = jnp.asarray(_raw(cols), jnp.int32)
+        self._values = _t(values)
+        self.shape = tuple(int(s) for s in shape)
+
+    def crows(self) -> Tensor:
+        return Tensor(self._crows)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._cols)
+
+    def values(self) -> Tensor:
+        return self._values
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._cols.shape[0])
+
+    def _row_indices(self):
+        counts = np.diff(np.asarray(self._crows))
+        return jnp.asarray(np.repeat(np.arange(len(counts)), counts), jnp.int32)
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None) -> SparseCooTensor:
+        idx = jnp.stack([self._row_indices(), self._cols])
+        return SparseCooTensor(idx, self._values, self.shape)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+# ---------------------------------------------------------------------------
+# creation / predicates (reference paddle.sparse.sparse_coo_tensor etc.)
+# ---------------------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCooTensor:
+    idx = jnp.asarray(_raw(indices), jnp.int32)
+    vals = _raw(values)
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=1)))
+    t = Tensor(vals, stop_gradient=stop_gradient)
+    return SparseCooTensor(idx, t, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCsrTensor:
+    vals = _raw(values)
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    t = Tensor(vals, stop_gradient=stop_gradient)
+    return SparseCsrTensor(crows, cols, t, shape)
+
+
+def is_sparse_coo(x) -> bool:
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_csr(x) -> bool:
+    return isinstance(x, SparseCsrTensor)
+
+
+def _as_coo(x) -> SparseCooTensor:
+    if isinstance(x, SparseCooTensor):
+        return x
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    raise TypeError(f"expected a sparse tensor, got {type(x)}")
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+
+def _same_pattern(a: SparseCooTensor, b: SparseCooTensor) -> bool:
+    return (a.shape == b.shape and a._indices.shape == b._indices.shape
+            and bool(jnp.all(a._indices == b._indices)))
+
+
+def _map_values(x, fn, name):
+    """Apply ``fn`` to the values (taped), preserving the input's format."""
+    coo = _as_coo(x)
+    out = apply_op(name, fn, (coo._values,), {})
+    res = SparseCooTensor(coo._indices, out, coo.shape)
+    return res.to_sparse_csr() if is_sparse_csr(x) else res
+
+
+def _ew(name, a, b, fn):
+    """Elementwise sparse-sparse: fast path for identical patterns, BCOO-sum
+    union fallback for different ones (add/subtract only).  CSR inputs come
+    back CSR (format preserved like the reference)."""
+    both_csr = is_sparse_csr(a) and is_sparse_csr(b)
+    a, b = _as_coo(a), _as_coo(b)
+    if a.shape != b.shape:
+        raise ValueError(f"{name}: operand shapes differ: {a.shape} vs {b.shape}")
+
+    def _restore(res):
+        return res.to_sparse_csr() if both_csr else res
+
+    if _same_pattern(a, b):
+        out = apply_op(name, fn, (a._values, b._values), {})
+        return _restore(SparseCooTensor(a._indices, out, a.shape))
+    if fn is not _ADD and fn is not _SUB:
+        raise ValueError(f"{name} on different sparsity patterns is not supported "
+                         "(convert to_dense() first)")
+    # union of patterns via concatenation + dedup (sum_duplicates)
+    idx_a, idx_b = a._indices, b._indices
+
+    def f(va, vb):
+        vb2 = -vb if fn is _SUB else vb
+        m = jsparse.BCOO((jnp.concatenate([va, vb2]),
+                          jnp.concatenate([idx_a.T, idx_b.T])), shape=a.shape)
+        m = m.sum_duplicates(nse=idx_a.shape[1] + idx_b.shape[1])
+        return m.data, m.indices
+
+    vals, idx = apply_op(name, f, (a._values, b._values), {}, num_outputs=2)
+    return _restore(SparseCooTensor(idx._data.T, vals, a.shape))
+
+
+_ADD = lambda x, y: x + y
+_SUB = lambda x, y: x - y
+
+
+def add(x, y, name=None):
+    return _ew("sparse_add", x, y, _ADD)
+
+
+def subtract(x, y, name=None):
+    return _ew("sparse_subtract", x, y, _SUB)
+
+
+def multiply(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return _map_values(x, lambda v: v * y, "sparse_scale")
+    return _ew("sparse_multiply", x, y, lambda a, b: a * b)
+
+
+def divide(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return multiply(x, 1.0 / y)
+    return _ew("sparse_divide", x, y, lambda a, b: a / b)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (reference ``paddle.sparse.matmul``).
+
+    Lowers through ``jax.experimental.sparse.BCOO`` — XLA turns it into
+    gather/segment-sum; gradients flow to both the sparse values and the
+    dense operand.
+    """
+    sp = _as_coo(x)
+    yt = _t(y)
+    idx = sp._indices
+
+    def f(vals, d):
+        m = jsparse.BCOO((vals, idx.T), shape=sp.shape)
+        return m @ d
+
+    return apply_op("sparse_matmul", f, (sp._values, yt), {})
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense evaluated ONLY at ``mask``'s nonzero positions
+    (reference ``paddle.sparse.masked_matmul``)."""
+    mask = _as_coo(mask)
+    xt, yt = _t(x), _t(y)
+    rows, cols = mask._indices[0], mask._indices[1]
+
+    def f(a, b):
+        # gather the needed rows/cols: out[k] = a[rows[k], :] . b[:, cols[k]]
+        return jnp.einsum("kd,kd->k", a[rows, :], b[:, cols].T)
+
+    vals = apply_op("sparse_masked_matmul", f, (xt, yt), {})
+    return SparseCooTensor(mask._indices, vals, mask.shape)
+
+
+def relu(x, name=None):
+    return _map_values(x, jax.nn.relu, "sparse_relu")
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    coo = _as_coo(x)
+    if axis is None:
+        return apply_op("sparse_sum", lambda v: jnp.sum(v), (coo._values,), {})
+    idx, shape = coo._indices, coo.shape
+
+    def f(vals):
+        dense = jnp.zeros(shape, vals.dtype).at[tuple(idx)].add(vals)
+        return jnp.sum(dense, axis=axis, keepdims=keepdim)
+
+    return apply_op("sparse_sum", f, (coo._values,), {})
+
+
+def transpose(x, perm, name=None):
+    return _as_coo(x).transpose(perm)

@@ -1,0 +1,82 @@
+"""``paddle.sparse.nn`` — layers over sparse tensors (reference
+``python/paddle/sparse/nn/``: activations, sparse linear subset).
+
+Every activation maps the values through ``sparse._map_values`` (taped,
+format-preserving) — one shared path instead of per-class plumbing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layers import Layer
+
+__all__ = ["ReLU", "LeakyReLU", "Softmax", "Linear"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from . import _map_values
+
+        return _map_values(x, jax.nn.relu, "sparse_relu")
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        from . import _map_values
+
+        slope = self._slope
+        return _map_values(x, lambda v: jax.nn.leaky_relu(v, slope),
+                           "sparse_leaky_relu")
+
+
+class Softmax(Layer):
+    """Row-wise softmax over a 2-D sparse tensor's present entries
+    (reference ``sparse.nn.Softmax`` semantics)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        if axis != -1:
+            raise ValueError("sparse Softmax supports axis=-1 (rows)")
+
+    def forward(self, x):
+        from . import _as_coo, _map_values
+
+        coo = _as_coo(x)
+        rows = coo._indices[0]
+        n_rows = coo.shape[0]
+
+        def f(vals):
+            row_max = jnp.full((n_rows,), -jnp.inf, vals.dtype).at[rows].max(vals)
+            e = jnp.exp(vals - row_max[rows])
+            denom = jnp.zeros((n_rows,), vals.dtype).at[rows].add(e)
+            return e / denom[rows]
+
+        return _map_values(x, f, "sparse_softmax")
+
+
+class Linear(Layer):
+    """y = sparse_x @ W + b (dense output)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn.initializer import XavierUniform
+
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr,
+                                            default_initializer=XavierUniform())
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        from . import matmul
+
+        out = matmul(x, self.weight)
+        if self.bias is not None:
+            out = out + self.bias
+        return out

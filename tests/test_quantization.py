@@ -1,0 +1,153 @@
+"""paddle.quantization: fake-quant math, QAT training, PTQ calibrate+convert
+(reference ``test/quantization`` style)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (
+    QAT,
+    PTQ,
+    AbsmaxObserver,
+    FakeQuanterWithAbsMax,
+    MovingAverageAbsmaxObserver,
+    QuantConfig,
+    QuantedConv2D,
+    QuantedLinear,
+)
+
+
+class TestQuantMath:
+    def test_fake_quant_snaps_to_grid(self):
+        q = FakeQuanterWithAbsMax(quant_bits=8)
+        x = paddle.to_tensor(np.linspace(-2, 2, 1001).astype(np.float32))
+        out = np.asarray(q(x).numpy())
+        # all values on the 127-level symmetric grid of scale 2.0
+        grid = np.round(out / (2.0 / 127))
+        np.testing.assert_allclose(out, grid * (2.0 / 127), atol=1e-6)
+        assert len(np.unique(out)) <= 255
+        # quantization error bounded by half a step
+        assert np.max(np.abs(out - np.asarray(x.numpy()))) <= (2.0 / 127) / 2 + 1e-6
+
+    def test_ste_gradient_passthrough(self):
+        q = FakeQuanterWithAbsMax()
+        x = paddle.to_tensor(np.asarray([0.3, -0.7], np.float32), stop_gradient=False)
+        q(x).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()), [1.0, 1.0])
+
+    def test_observers(self):
+        obs = AbsmaxObserver()
+        obs(paddle.to_tensor(np.asarray([1.0, -3.0], np.float32)))
+        obs(paddle.to_tensor(np.asarray([2.0], np.float32)))
+        assert obs.scale() == pytest.approx(3.0)
+        ema = MovingAverageAbsmaxObserver(moving_rate=0.5)
+        ema(paddle.to_tensor(np.asarray([4.0], np.float32)))
+        ema(paddle.to_tensor(np.asarray([2.0], np.float32)))
+        assert ema.scale() == pytest.approx(3.0)  # 0.5*4 + 0.5*2
+
+
+class TestQAT:
+    def test_quantize_swaps_layers(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        qnet = QAT(QuantConfig()).quantize(net)
+        kinds = [type(l).__name__ for l in qnet]
+        assert kinds == ["QuantedLinear", "ReLU", "QuantedLinear"]
+        # original untouched (not inplace)
+        assert type(net[0]).__name__ == "Linear"
+
+    def test_qat_trains(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        qnet = QAT(QuantConfig()).quantize(net, inplace=True)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=qnet.parameters())
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(32, 8)).astype(np.float32))
+        y = paddle.to_tensor(rng.normal(size=(32, 1)).astype(np.float32))
+
+        def loss_fn(m, x, y):
+            return ((m(x) - y) ** 2).mean()
+
+        step = paddle.jit.TrainStep(qnet, loss_fn, opt)
+        losses = [float(step(x, y).numpy()) for _ in range(30)]
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_conv_quantization(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU())
+        qnet = QAT(QuantConfig()).quantize(net)
+        assert type(qnet[0]).__name__ == "QuantedConv2D"
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(2, 3, 8, 8)).astype(np.float32))
+        out_q = np.asarray(qnet(x).numpy())
+        out_f = np.asarray(net(x).numpy())
+        assert out_q.shape == out_f.shape
+        # int8 fake-quant stays close to the float layer
+        assert np.max(np.abs(out_q - out_f)) < 0.15 * np.max(np.abs(out_f))
+
+
+class TestPTQ:
+    def test_calibrate_then_convert(self):
+        paddle.seed(1)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        # AbsmaxObserver = true max (no EMA clipping) for a tight error bound
+        ptq = PTQ(QuantConfig(activation=AbsmaxObserver))
+        observed = ptq.quantize(net)
+        rng = np.random.default_rng(1)
+        for _ in range(5):
+            observed(paddle.to_tensor(rng.normal(size=(16, 8)).astype(np.float32)))
+        converted = ptq.convert(observed)
+        names = [type(l).__name__ for l in converted]
+        assert names == ["QuantedLinear", "ReLU", "QuantedLinear"]
+        # fixed scales recorded from calibration
+        assert converted[0].act_scale is not None and converted[0].act_scale > 0
+        assert converted[0].weight_scale is not None
+        # outputs close to float model on in-distribution data
+        x = paddle.to_tensor(rng.normal(size=(16, 8)).astype(np.float32))
+        out_q = np.asarray(converted(x).numpy())
+        out_f = np.asarray(net(x).numpy())
+        assert np.max(np.abs(out_q - out_f)) < 0.2 * (np.max(np.abs(out_f)) + 1e-6)
+
+    def test_bare_layer_quantize_not_a_noop(self):
+        lin = nn.Linear(4, 4)
+        q = QAT(QuantConfig()).quantize(lin)
+        assert type(q).__name__ == "QuantedLinear"
+
+    def test_custom_quanter_factories_are_used(self):
+        calls = []
+
+        class Probe(FakeQuanterWithAbsMax):
+            def __init__(self):
+                super().__init__()
+                calls.append("made")
+
+            def forward(self, x):
+                calls.append("fwd")
+                return super().forward(x)
+
+        net = nn.Sequential(nn.Linear(4, 4))
+        q = QAT(QuantConfig(activation=Probe, weight=Probe)).quantize(net)
+        assert calls.count("made") == 2
+        q(paddle.to_tensor(np.ones((2, 4), np.float32)))
+        assert "fwd" in calls
+
+    def test_nhwc_conv_data_format_preserved(self):
+        paddle.seed(3)
+        conv = nn.Conv2D(3, 4, 3, padding=1, data_format="NHWC")
+        q = QAT(QuantConfig()).quantize(conv)
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(2, 8, 8, 3)).astype(np.float32))
+        out_q = np.asarray(q(x).numpy())
+        out_f = np.asarray(conv(x).numpy())
+        assert out_q.shape == out_f.shape == (2, 8, 8, 4)
+
+    def test_observed_model_is_float_exact(self):
+        paddle.seed(2)
+        net = nn.Linear(4, 4)
+        wrapped = nn.Sequential(net)
+        ptq = PTQ(QuantConfig())
+        observed = ptq.quantize(wrapped)
+        x = paddle.to_tensor(np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(observed(x).numpy()),
+                                   np.asarray(wrapped(x).numpy()), rtol=1e-6)

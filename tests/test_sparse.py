@@ -1,0 +1,178 @@
+"""paddle.sparse COO/CSR: construction, conversion, ops, autograd
+(reference ``test/legacy_test`` sparse suites + ``python/paddle/sparse``)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _coo_example():
+    # [[0, 2, 0], [3, 0, 4]]
+    indices = np.asarray([[0, 1, 1], [1, 0, 2]], np.int32)
+    values = np.asarray([2.0, 3.0, 4.0], np.float32)
+    return sparse.sparse_coo_tensor(indices, values, [2, 3])
+
+
+class TestConstruction:
+    def test_coo_to_dense(self):
+        sp = _coo_example()
+        assert sp.nnz == 3 and sp.shape == (2, 3)
+        want = np.asarray([[0, 2, 0], [3, 0, 4]], np.float32)
+        np.testing.assert_array_equal(np.asarray(sp.to_dense().numpy()), want)
+
+    def test_infer_shape(self):
+        sp = sparse.sparse_coo_tensor(np.asarray([[0, 2]]), np.asarray([1.0, 5.0]))
+        assert sp.shape == (3,)
+
+    def test_csr_roundtrip(self):
+        sp = _coo_example()
+        csr = sp.to_sparse_csr()
+        assert sparse.is_sparse_csr(csr)
+        np.testing.assert_array_equal(np.asarray(csr.crows().numpy()), [0, 1, 3])
+        np.testing.assert_array_equal(np.asarray(csr.cols().numpy()), [1, 0, 2])
+        np.testing.assert_array_equal(np.asarray(csr.to_dense().numpy()),
+                                      np.asarray(sp.to_dense().numpy()))
+        coo2 = csr.to_sparse_coo()
+        np.testing.assert_array_equal(np.asarray(coo2.to_dense().numpy()),
+                                      np.asarray(sp.to_dense().numpy()))
+
+    def test_sparse_csr_tensor_direct(self):
+        csr = sparse.sparse_csr_tensor([0, 1, 3], [1, 0, 2], [2.0, 3.0, 4.0], [2, 3])
+        want = np.asarray([[0, 2, 0], [3, 0, 4]], np.float32)
+        np.testing.assert_array_equal(np.asarray(csr.to_dense().numpy()), want)
+
+
+class TestOps:
+    def test_add_same_pattern(self):
+        a, b = _coo_example(), _coo_example()
+        c = sparse.add(a, b)
+        np.testing.assert_array_equal(np.asarray(c.to_dense().numpy()),
+                                      2 * np.asarray(a.to_dense().numpy()))
+
+    def test_add_different_patterns(self):
+        a = _coo_example()
+        b = sparse.sparse_coo_tensor(np.asarray([[0], [0]]), np.asarray([7.0]), [2, 3])
+        c = sparse.add(a, b)
+        want = np.asarray(a.to_dense().numpy()) + np.asarray(b.to_dense().numpy())
+        np.testing.assert_array_equal(np.asarray(c.to_dense().numpy()), want)
+
+    def test_subtract_multiply(self):
+        a = _coo_example()
+        d = sparse.subtract(a, sparse.multiply(a, 0.5))
+        np.testing.assert_allclose(np.asarray(d.to_dense().numpy()),
+                                   0.5 * np.asarray(a.to_dense().numpy()))
+
+    def test_matmul_dense(self):
+        sp = _coo_example()
+        rng = np.random.default_rng(0)
+        d = paddle.to_tensor(rng.normal(size=(3, 4)).astype(np.float32))
+        out = sparse.matmul(sp, d)
+        want = np.asarray(sp.to_dense().numpy()) @ np.asarray(d.numpy())
+        np.testing.assert_allclose(np.asarray(out.numpy()), want, rtol=1e-5, atol=1e-6)
+
+    def test_csr_matmul(self):
+        csr = _coo_example().to_sparse_csr()
+        d = paddle.to_tensor(np.eye(3, dtype=np.float32))
+        out = csr @ d
+        np.testing.assert_array_equal(np.asarray(out.numpy()),
+                                      np.asarray(csr.to_dense().numpy()))
+
+    def test_masked_matmul(self):
+        rng = np.random.default_rng(1)
+        a = paddle.to_tensor(rng.normal(size=(2, 5)).astype(np.float32))
+        b = paddle.to_tensor(rng.normal(size=(5, 3)).astype(np.float32))
+        mask = _coo_example()  # pattern only
+        out = sparse.masked_matmul(a, b, mask)
+        full = np.asarray(a.numpy()) @ np.asarray(b.numpy())
+        dense = np.asarray(out.to_dense().numpy())
+        idx = np.asarray(mask.indices().numpy())
+        for k in range(mask.nnz):
+            i, j = idx[0, k], idx[1, k]
+            assert dense[i, j] == pytest.approx(full[i, j], abs=1e-5)
+        # masked-out entries are zero
+        assert dense[0, 0] == 0.0
+
+    def test_relu_and_softmax(self):
+        sp = sparse.sparse_coo_tensor(np.asarray([[0, 0, 1], [0, 1, 2]]),
+                                      np.asarray([-1.0, 2.0, -3.0]), [2, 3])
+        r = sparse.relu(sp)
+        np.testing.assert_array_equal(np.asarray(r.values().numpy()), [0.0, 2.0, 0.0])
+        sm = sparse.nn.Softmax()(sp)
+        vals = np.asarray(sm.values().numpy())
+        # row 0 has entries [-1, 2]; row 1 has [-3] -> softmax over present entries
+        want0 = np.exp([-1.0, 2.0]) / np.exp([-1.0, 2.0]).sum()
+        np.testing.assert_allclose(vals[:2], want0, rtol=1e-5)
+        assert vals[2] == pytest.approx(1.0)
+
+    def test_sum_and_transpose(self):
+        sp = _coo_example()
+        assert float(sparse.sum(sp).numpy()) == pytest.approx(9.0)
+        t = sparse.transpose(sp, [1, 0])
+        np.testing.assert_array_equal(np.asarray(t.to_dense().numpy()),
+                                      np.asarray(sp.to_dense().numpy()).T)
+
+
+class TestAutograd:
+    def test_matmul_grad_to_values_and_dense(self):
+        sp = _coo_example()
+        sp.values().stop_gradient = False
+        rng = np.random.default_rng(0)
+        d = paddle.to_tensor(rng.normal(size=(3, 2)).astype(np.float32),
+                             stop_gradient=False)
+        out = sparse.matmul(sp, d)
+        out.sum().backward()
+        # d(sum)/d(values[k]) = sum_j dense[col_k, j]
+        dn = np.asarray(d.numpy())
+        idx = np.asarray(sp.indices().numpy())
+        want_vals = dn[idx[1]].sum(-1)
+        np.testing.assert_allclose(np.asarray(sp.values().grad.numpy()), want_vals,
+                                   rtol=1e-5)
+        # d(sum)/d(dense[i, j]) = sum of sparse column i
+        sp_dense = np.asarray(sp.to_dense().numpy())
+        np.testing.assert_allclose(np.asarray(d.grad.numpy()),
+                                   np.broadcast_to(sp_dense.sum(0)[:, None], (3, 2)),
+                                   rtol=1e-5)
+
+    def test_csr_conversion_preserves_gradients(self):
+        sp = _coo_example()
+        sp.values().stop_gradient = False
+        csr = sp.to_sparse_csr()
+        csr.to_dense().sum().backward()
+        np.testing.assert_allclose(np.asarray(sp.values().grad.numpy()), [1.0, 1.0, 1.0])
+
+    def test_axis_sum_has_gradient(self):
+        sp = _coo_example()
+        sp.values().stop_gradient = False
+        out = sparse.sum(sp, axis=0)
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(sp.values().grad.numpy()), [1.0, 1.0, 1.0])
+
+    def test_add_shape_mismatch_raises(self):
+        a = _coo_example()
+        b = sparse.sparse_coo_tensor(np.asarray([[3], [4]]), np.asarray([7.0]), [4, 5])
+        with pytest.raises(ValueError, match="shapes differ"):
+            sparse.add(a, b)
+
+    def test_csr_elementwise_preserves_format(self):
+        a = _coo_example().to_sparse_csr()
+        b = _coo_example().to_sparse_csr()
+        c = sparse.add(a, b)
+        assert sparse.is_sparse_csr(c)
+        np.testing.assert_array_equal(np.asarray(c.crows().numpy()), [0, 1, 3])
+
+    def test_sparse_linear_trains(self):
+        paddle.seed(0)
+        lin = sparse.nn.Linear(3, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+        sp = _coo_example()
+        first = None
+        for _ in range(10):
+            loss = (lin(sp) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss.numpy())
+        assert float(loss.numpy()) < first * 0.5

@@ -1,0 +1,24 @@
+"""``paddle.distributed.auto_tuner`` — search over hybrid-parallel configs.
+
+Counterpart of the reference's ``python/paddle/distributed/auto_tuner/``
+(``tuner.py`` AutoTuner, ``search.py`` GridSearch, ``prune.py`` rules,
+``cost_model.py``/``memory_cost_model.py``, ``recorder.py``).
+
+TPU-native differences: candidates are factorizations of the CHIP count into
+``dp x mp x pp x sharding`` (one mesh, no NCCL ring planning); the memory
+model budgets HBM per chip (params + optimizer state + activations with the
+remat knob); the cost model scores MXU time + ICI collective time.  The tuner
+proposes configs; measurements come either from the analytic model or from a
+caller-supplied runner (the reference launches real subprocess trials — here
+a runner can jit one step on a simulated mesh or the real slice).
+"""
+
+from .prune import DEFAULT_PRUNES, prune_config
+from .recorder import HistoryRecorder
+from .search import GridSearch, default_candidates
+from .tuner import AutoTuner
+from .cost_model import estimate_memory_gb, estimate_step_time_ms
+
+__all__ = ["AutoTuner", "GridSearch", "HistoryRecorder", "default_candidates",
+           "prune_config", "DEFAULT_PRUNES", "estimate_memory_gb",
+           "estimate_step_time_ms"]

@@ -1,0 +1,83 @@
+"""Analytic memory + step-time models (reference ``cost_model.py`` /
+``memory_cost_model.py``), sized for transformer LMs on TPU.
+
+These are RANKING models: absolute numbers are rough, but the ordering over
+configs (what the tuner needs) tracks the real trade-offs — MXU time shrinks
+with mp*pp*dp, TP allreduces ride ICI, the PP bubble grows with pp/n_micro,
+remat trades ~30% compute for activation memory.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+def _model_params(tuner_cfg: Dict) -> float:
+    h = tuner_cfg.get("hidden_size", 4096)
+    L = tuner_cfg.get("num_layers", 32)
+    V = tuner_cfg.get("vocab_size", 32000)
+    inter = tuner_cfg.get("intermediate_size", 4 * h)
+    per_layer = 4 * h * h + 3 * h * inter  # qkv+o (approx) + swiglu
+    return L * per_layer + 2 * V * h
+
+
+def estimate_memory_gb(cfg: Dict, tuner_cfg: Dict) -> float:
+    """Per-chip HBM: bf16 params + fp32 master/moments + activations."""
+    P = _model_params(tuner_cfg)
+    mp, pp = cfg["mp_degree"], cfg["pp_degree"]
+    sh = cfg["sharding_degree"]
+    stage = cfg.get("sharding_stage", 1)
+    p_shard = P / (mp * pp)
+    if stage >= 3:
+        p_shard /= sh
+    param_bytes = 2.0 * p_shard
+    # AdamW: fp32 master + m + v = 12 bytes/param, sharded from stage 1 on
+    opt_bytes = 12.0 * (P / (mp * pp)) / max(sh, 1)
+    h = tuner_cfg.get("hidden_size", 4096)
+    s = tuner_cfg.get("seq_len", 2048)
+    L = tuner_cfg.get("num_layers", 32)
+    mb = cfg["micro_batch_size"]
+    # ~16*h bytes/token/layer of bf16 activations (qkv, attn out, mlp, norms);
+    # remat keeps only layer boundaries (~2*h)
+    act_per_token_layer = (2.0 if cfg.get("use_recompute") else 16.0) * h
+    act_bytes = mb * s * act_per_token_layer * (L / pp) / mp
+    if pp > 1:
+        act_bytes *= min(pp, _n_micro(cfg, tuner_cfg))  # in-flight microbatches
+    return (param_bytes + opt_bytes + act_bytes) / 1e9
+
+
+def _n_micro(cfg: Dict, tuner_cfg: Dict) -> int:
+    gbs = tuner_cfg.get("global_batch_size", cfg["micro_batch_size"])
+    dp = cfg["dp_degree"] * cfg["sharding_degree"]
+    return max(1, (gbs // max(dp, 1)) // cfg["micro_batch_size"])
+
+
+def estimate_step_time_ms(cfg: Dict, tuner_cfg: Dict) -> float:
+    """MXU time + TP allreduce time + PP bubble + remat overhead."""
+    P = _model_params(tuner_cfg)
+    s = tuner_cfg.get("seq_len", 2048)
+    gbs = tuner_cfg.get("global_batch_size", 8)
+    n = int(tuner_cfg["num_devices"])
+    peak = tuner_cfg.get("peak_flops", 197e12)
+    ici_bw = tuner_cfg.get("ici_bandwidth", 9e10)  # bytes/s per link
+
+    tokens = gbs * s
+    flops = 6.0 * P * tokens
+    if cfg.get("use_recompute"):
+        flops *= 4.0 / 3.0  # one extra forward
+    mfu = 0.5 / (1 + 0.05 * (cfg["mp_degree"] - 1))  # TP efficiency falloff
+    compute_s = flops / (n * peak * mfu)
+
+    # TP: 2 allreduces/layer of [mb, s, h] bf16 over the mp group
+    comm_s = 0.0
+    if cfg["mp_degree"] > 1:
+        h = tuner_cfg.get("hidden_size", 4096)
+        L = tuner_cfg.get("num_layers", 32)
+        vol = 2.0 * cfg["micro_batch_size"] * s * h * 2 * L * _n_micro(cfg, tuner_cfg)
+        comm_s += vol * 2 * (cfg["mp_degree"] - 1) / cfg["mp_degree"] / ici_bw
+
+    t = compute_s + comm_s
+    if cfg["pp_degree"] > 1:
+        bubble = (cfg["pp_degree"] - 1) / max(_n_micro(cfg, tuner_cfg), 1)
+        t *= 1.0 + bubble
+    return t * 1e3

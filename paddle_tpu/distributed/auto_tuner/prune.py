@@ -1,0 +1,80 @@
+"""Config pruning rules (reference ``prune.py``: registered ``@register_prune``
+functions returning True when a config is invalid)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+DEFAULT_PRUNES: List[Callable] = []
+
+
+def register_prune(fn):
+    DEFAULT_PRUNES.append(fn)
+    return fn
+
+
+@register_prune
+def prune_by_device_product(cfg, tuner_cfg) -> Optional[str]:
+    n = int(tuner_cfg["num_devices"])
+    prod = (cfg["dp_degree"] * cfg["mp_degree"] * cfg["pp_degree"]
+            * cfg["sharding_degree"])
+    if prod != n:
+        return f"dp*mp*pp*sharding = {prod} != num_devices {n}"
+    return None
+
+
+@register_prune
+def prune_by_mp_divisibility(cfg, tuner_cfg) -> Optional[str]:
+    mp = cfg["mp_degree"]
+    for key in ("hidden_size", "num_attention_heads", "vocab_size"):
+        v = tuner_cfg.get(key)
+        if v is not None and v % mp != 0:
+            return f"{key} {v} not divisible by mp {mp}"
+    return None
+
+
+@register_prune
+def prune_by_pp_layers(cfg, tuner_cfg) -> Optional[str]:
+    layers = tuner_cfg.get("num_layers")
+    if layers is not None and layers % cfg["pp_degree"] != 0:
+        return f"num_layers {layers} not divisible by pp {cfg['pp_degree']}"
+    return None
+
+
+@register_prune
+def prune_by_batch(cfg, tuner_cfg) -> Optional[str]:
+    gbs = tuner_cfg.get("global_batch_size")
+    if gbs is None:
+        return None
+    dp = cfg["dp_degree"] * cfg["sharding_degree"]
+    if gbs % dp != 0:
+        return f"global batch {gbs} not divisible by dp*sharding {dp}"
+    per_dp = gbs // dp
+    if per_dp % cfg["micro_batch_size"] != 0:
+        return f"per-dp batch {per_dp} not divisible by micro batch {cfg['micro_batch_size']}"
+    n_micro = per_dp // cfg["micro_batch_size"]
+    if cfg["pp_degree"] > 1 and n_micro < cfg["pp_degree"]:
+        return f"{n_micro} microbatches < pp {cfg['pp_degree']} (bubble-bound)"
+    return None
+
+
+@register_prune
+def prune_by_memory(cfg, tuner_cfg) -> Optional[str]:
+    limit = tuner_cfg.get("max_mem_usage_gb")
+    if limit is None:
+        return None
+    from .cost_model import estimate_memory_gb
+
+    gb = estimate_memory_gb(cfg, tuner_cfg)
+    if gb > limit:
+        return f"estimated {gb:.1f} GB > limit {limit} GB"
+    return None
+
+
+def prune_config(cfg: Dict, tuner_cfg: Dict) -> Optional[str]:
+    """First failing rule's reason, or None when the config is valid."""
+    for rule in DEFAULT_PRUNES:
+        reason = rule(cfg, tuner_cfg)
+        if reason is not None:
+            return reason
+    return None

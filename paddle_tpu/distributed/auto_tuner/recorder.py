@@ -1,0 +1,53 @@
+"""Trial history + best-config selection (reference ``recorder.py``)."""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Dict, List, Optional, Tuple
+
+
+class HistoryRecorder:
+    def __init__(self, metric: str = "step_time_ms", mode: str = "min"):
+        self.metric = metric
+        self.mode = mode
+        self.history: List[Dict] = []
+
+    def add_cfg(self, **record):
+        self.history.append(dict(record))
+
+    def get_best(self) -> Tuple[Optional[Dict], bool]:
+        """(best_record, err) — err True when no trial succeeded (reference
+        ``recorder.get_best`` contract)."""
+        ok = [r for r in self.history
+              if r.get(self.metric) is not None and not r.get("error", False)]
+        if not ok:
+            return None, True
+        best = (min if self.mode == "min" else max)(ok, key=lambda r: r[self.metric])
+        return best, False
+
+    def store_history(self, path: str = "./history.csv"):
+        if not self.history:
+            return
+        keys = sorted({k for r in self.history for k in r})
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            for r in self.history:
+                w.writerow(r)
+
+    def load_history(self, path: str = "./history.csv"):
+        if not os.path.exists(path):
+            return
+        with open(path, newline="") as f:
+            for row in csv.DictReader(f):
+                parsed = {}
+                for k, v in row.items():
+                    if v in ("True", "False"):  # bools must survive the round-trip
+                        parsed[k] = v == "True"
+                        continue
+                    try:
+                        parsed[k] = float(v) if "." in v or "e" in v.lower() else int(v)
+                    except (ValueError, TypeError):
+                        parsed[k] = v
+                self.history.append(parsed)

@@ -1,0 +1,69 @@
+"""Candidate generation + grid search (reference ``search.py``/``utils.py``)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+from .prune import prune_config
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(tuner_cfg: Dict) -> Dict[str, List[int]]:
+    """Per-axis candidate lists (reference ``utils.default_candidates``):
+    every divisor of the chip count for each parallel degree, micro-batch
+    divisors of the per-dp batch."""
+    n = int(tuner_cfg["num_devices"])
+
+    def pick(key, default):
+        v = tuner_cfg.get(key)
+        # `is None` (not truthiness): use_recompute=False / degree pins of 0
+        # are explicit user choices, not requests for the default list
+        return default if v is None else v
+
+    cand = {
+        "dp_degree": pick("dp_degree", _divisors(n)),
+        "mp_degree": pick("mp_degree", _divisors(n)),
+        "pp_degree": pick("pp_degree", _divisors(n)),
+        "sharding_degree": pick("sharding_degree", _divisors(n)),
+        "sharding_stage": pick("sharding_stage", [1]),
+        "micro_batch_size": pick("micro_batch_size",
+                                 _divisors(int(tuner_cfg.get("global_batch_size", n)))),
+        "use_recompute": pick("use_recompute", [False, True]),
+    }
+    return {k: (v if isinstance(v, list) else [v]) for k, v in cand.items()}
+
+
+class GridSearch:
+    """Exhaustive product of the candidate lists, pruned (reference
+    ``GridSearch.search_once`` semantics: next unseen valid config)."""
+
+    def __init__(self, tuner_cfg: Dict):
+        self.tuner_cfg = tuner_cfg
+        cand = tuner_cfg["candidates"]
+        keys = list(cand)
+        combos = []
+        for vals in itertools.product(*(cand[k] for k in keys)):
+            cfg = dict(zip(keys, vals))
+            if prune_config(cfg, tuner_cfg) is None:
+                combos.append(cfg)
+        # stable, cheapest-first order by the analytic cost model
+        from .cost_model import estimate_step_time_ms
+
+        combos.sort(key=lambda c: estimate_step_time_ms(c, tuner_cfg))
+        self._queue = combos
+        self._pos = 0
+
+    @property
+    def all_configs(self) -> List[Dict]:
+        return list(self._queue)
+
+    def search_once(self, history: Optional[List[Dict]] = None) -> Optional[Dict]:
+        if self._pos >= len(self._queue):
+            return None
+        cfg = dict(self._queue[self._pos])
+        self._pos += 1
+        return cfg

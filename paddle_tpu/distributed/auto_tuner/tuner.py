@@ -1,0 +1,65 @@
+"""AutoTuner entry point (reference ``tuner.py``)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from .cost_model import estimate_memory_gb, estimate_step_time_ms
+from .recorder import HistoryRecorder
+from .search import GridSearch, default_candidates
+
+
+class AutoTuner:
+    """Propose-measure-record loop over hybrid-parallel configs.
+
+    ``tuner_cfg`` keys (reference names): ``num_devices``, model dims
+    (``hidden_size``/``num_layers``/``vocab_size``/``seq_len``), ``global_batch_size``,
+    ``max_mem_usage_gb``, ``task_limit``, optional per-axis candidate lists
+    (``dp_degree``: [..] etc.), ``metric`` + ``mode``.
+
+    Usage::
+
+        tuner = AutoTuner({"num_devices": 8, "hidden_size": 1024, ...})
+        while (cfg := tuner.search_once()) is not None:
+            ms = measure(cfg)              # run a real step, or leave None to
+            tuner.add_cfg(cfg, step_time_ms=ms)   # fall back to the cost model
+        best, err = tuner.get_best()
+    """
+
+    def __init__(self, tuner_cfg: Dict):
+        self.tuner_cfg = dict(tuner_cfg)
+        self.task_limit = int(tuner_cfg.get("task_limit", 100))
+        self.cur_task_id = 0
+        cfg = dict(self.tuner_cfg)
+        cfg["candidates"] = default_candidates(cfg)
+        self.algo = GridSearch(cfg)
+        self.recorder = HistoryRecorder(metric=tuner_cfg.get("metric", "step_time_ms"),
+                                        mode=tuner_cfg.get("mode", "min"))
+
+    def search_once(self) -> Optional[Dict]:
+        if self.cur_task_id >= self.task_limit:
+            return None
+        cfg = self.algo.search_once(self.recorder.history)
+        if cfg is not None:
+            self.cur_task_id += 1
+        return cfg
+
+    def add_cfg(self, cfg: Dict, **metrics):
+        rec = dict(cfg)
+        rec.update(metrics)
+        if rec.get(self.recorder.metric) is None and self.recorder.metric == "step_time_ms":
+            # no measurement supplied: score with the analytic cost model
+            rec["step_time_ms"] = estimate_step_time_ms(cfg, self.tuner_cfg)
+            rec["estimated"] = True
+        rec.setdefault("mem_gb", estimate_memory_gb(cfg, self.tuner_cfg))
+        self.recorder.add_cfg(**rec)
+
+    def get_best(self):
+        return self.recorder.get_best()
+
+    # convenience: pure-analytic full sweep
+    def tune_analytic(self) -> Optional[Dict]:
+        while (cfg := self.search_once()) is not None:
+            self.add_cfg(cfg)
+        best, err = self.get_best()
+        return None if err else best

@@ -36,6 +36,19 @@ def _absmax(x):
     return jnp.max(jnp.abs(x))
 
 
+def _mk_quanter(f):
+    """Factory or instance -> a FRESH quanter/observer instance.
+
+    Layer instances are callable, so ``callable()`` can't distinguish a
+    factory; an instance is deep-copied per use (observers carry state that
+    must not be shared across layers)."""
+    if f is None:
+        return None
+    if isinstance(f, Layer):
+        return copy.deepcopy(f)
+    return f()
+
+
 def _fake_quant(x, scale, qmax):
     """Snap to the symmetric int grid at ``scale``; straight-through gradient."""
     s = jnp.maximum(scale, 1e-9)
@@ -232,21 +245,21 @@ class QuantConfig:
                 act = cfg["activation"] or act
                 wt = cfg["weight"] or wt
                 break
-        def mk(f):
-            if f is None or isinstance(f, Layer):
-                return f  # already an instance (Layers are callable; don't invoke)
-            return f()
-
-        return mk(act), mk(wt)
+        return _mk_quanter(act), _mk_quanter(wt)
 
 
 def _replace_sublayers(root: Layer, predicate, build):
-    """Swap matching sublayers in the ``_sub_layers`` registry (where both
-    attribute access and iteration resolve); returns number replaced."""
+    """Swap matching sublayers in BOTH the ``_sub_layers`` registry (what
+    iteration/parameters() resolve) and the instance ``__dict__`` (what a
+    ``self.fc(x)``-style forward resolves — instance attributes win over
+    ``__getattr__``); returns number replaced."""
     n = 0
     for name, child in list(root._sub_layers.items()):
         if predicate(child):
-            root._sub_layers[name] = build(child)
+            new = build(child)
+            root._sub_layers[name] = new
+            if root.__dict__.get(name) is child:
+                object.__setattr__(root, name, new)
             n += 1
         elif isinstance(child, Layer):
             n += _replace_sublayers(child, predicate, build)
@@ -287,7 +300,7 @@ class PTQ:
         class _Observed(Layer):
             def __init__(self, inner):
                 super().__init__()
-                self.observer = obs_factory() if callable(obs_factory) else obs_factory
+                self.observer = _mk_quanter(obs_factory)
                 self.inner = inner
 
             def forward(self, x):

@@ -235,18 +235,23 @@ def _ew(name, a, b, fn):
     if fn is not _ADD and fn is not _SUB:
         raise ValueError(f"{name} on different sparsity patterns is not supported "
                          "(convert to_dense() first)")
-    # union of patterns via concatenation + dedup (sum_duplicates)
-    idx_a, idx_b = a._indices, b._indices
+    # exact union pattern computed EAGERLY with numpy (indices are always
+    # concrete) — no sum_duplicates padding, so overlapping coordinates merge
+    # and the result's nnz/indices are exact; only the values are traced
+    lin_a = np.ravel_multi_index(np.asarray(a._indices), a.shape)
+    lin_b = np.ravel_multi_index(np.asarray(b._indices), b.shape)
+    uniq, inv = np.unique(np.concatenate([lin_a, lin_b]), return_inverse=True)
+    inv_a = jnp.asarray(inv[: len(lin_a)])
+    inv_b = jnp.asarray(inv[len(lin_a):])
+    union_idx = np.stack(np.unravel_index(uniq, a.shape)).astype(np.int32)
+    n_union = len(uniq)
 
     def f(va, vb):
-        vb2 = -vb if fn is _SUB else vb
-        m = jsparse.BCOO((jnp.concatenate([va, vb2]),
-                          jnp.concatenate([idx_a.T, idx_b.T])), shape=a.shape)
-        m = m.sum_duplicates(nse=idx_a.shape[1] + idx_b.shape[1])
-        return m.data, m.indices
+        out = jnp.zeros((n_union,), va.dtype).at[inv_a].add(va)
+        return out.at[inv_b].add(-vb if fn is _SUB else vb)
 
-    vals, idx = apply_op(name, f, (a._values, b._values), {}, num_outputs=2)
-    return _restore(SparseCooTensor(idx._data.T, vals, a.shape))
+    vals = apply_op(name, f, (a._values, b._values), {})
+    return _restore(SparseCooTensor(union_idx, vals, a.shape))
 
 
 _ADD = lambda x, y: x + y

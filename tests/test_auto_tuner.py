@@ -1,0 +1,143 @@
+"""auto_tuner: candidates, pruning, cost-model ranking, recorder, e2e
+(reference ``python/paddle/distributed/auto_tuner`` semantics)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_tuner import (
+    AutoTuner,
+    HistoryRecorder,
+    default_candidates,
+    estimate_memory_gb,
+    estimate_step_time_ms,
+    prune_config,
+)
+
+BASE = {
+    "num_devices": 8,
+    "hidden_size": 1024,
+    "num_layers": 8,
+    "vocab_size": 32000,
+    "num_attention_heads": 16,
+    "seq_len": 1024,
+    "global_batch_size": 16,
+}
+
+
+class TestPrune:
+    def test_device_product(self):
+        cfg = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2, "sharding_degree": 2,
+               "micro_batch_size": 1, "use_recompute": False}
+        assert "num_devices" in prune_config(cfg, BASE)  # product 16 != 8
+        cfg["sharding_degree"] = 1
+        assert prune_config(cfg, BASE) is None
+
+    def test_mp_divisibility(self):
+        cfg = {"dp_degree": 1, "mp_degree": 7, "pp_degree": 1, "sharding_degree": 1,
+               "micro_batch_size": 1, "use_recompute": False}
+        t = dict(BASE, num_devices=7)
+        assert "not divisible by mp" in prune_config(cfg, t)
+
+    def test_pp_layers(self):
+        cfg = {"dp_degree": 1, "mp_degree": 2, "pp_degree": 4, "sharding_degree": 1,
+               "micro_batch_size": 2, "use_recompute": False}
+        t = dict(BASE, num_layers=6)
+        assert "num_layers" in prune_config(cfg, t)
+
+    def test_microbatch_bubble(self):
+        cfg = {"dp_degree": 2, "mp_degree": 1, "pp_degree": 4, "sharding_degree": 1,
+               "micro_batch_size": 8, "use_recompute": False}
+        # per-dp batch 8, micro 8 -> 1 microbatch < pp 4
+        assert "bubble-bound" in prune_config(cfg, BASE)
+
+    def test_memory_prune(self):
+        cfg = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1, "sharding_degree": 1,
+               "sharding_stage": 1, "micro_batch_size": 2, "use_recompute": False}
+        t = dict(BASE, hidden_size=8192, num_layers=80, max_mem_usage_gb=16)
+        assert "GB > limit" in prune_config(cfg, t)
+
+
+class TestCostModel:
+    def test_memory_shrinks_with_sharding(self):
+        base_cfg = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                    "sharding_degree": 1, "sharding_stage": 1,
+                    "micro_batch_size": 2, "use_recompute": False}
+        m1 = estimate_memory_gb(base_cfg, BASE)
+        m8 = estimate_memory_gb(dict(base_cfg, sharding_degree=8), BASE)
+        assert m8 < m1
+
+    def test_recompute_cuts_activation_memory(self):
+        cfg = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1, "sharding_degree": 1,
+               "sharding_stage": 1, "micro_batch_size": 2, "use_recompute": False}
+        m_no = estimate_memory_gb(cfg, BASE)
+        m_rc = estimate_memory_gb(dict(cfg, use_recompute=True), BASE)
+        assert m_rc < m_no
+
+    def test_bubble_penalizes_pp(self):
+        t = dict(BASE, global_batch_size=8)
+        few_micro = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 8,
+                     "sharding_degree": 1, "micro_batch_size": 1, "use_recompute": False}
+        no_pp = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+                 "sharding_degree": 1, "micro_batch_size": 1, "use_recompute": False}
+        assert estimate_step_time_ms(few_micro, t) > estimate_step_time_ms(no_pp, t)
+
+
+class TestSearchAndTuner:
+    def test_all_candidates_valid(self):
+        tuner = AutoTuner(dict(BASE, task_limit=10000))
+        n = 0
+        while (cfg := tuner.search_once()) is not None:
+            n += 1
+            assert prune_config(cfg, BASE) is None
+        assert n > 10  # a real search space survived pruning
+
+    def test_task_limit(self):
+        tuner = AutoTuner(dict(BASE, task_limit=3))
+        seen = [tuner.search_once() for _ in range(5)]
+        assert sum(c is not None for c in seen) == 3
+
+    def test_measured_best_wins_over_estimates(self):
+        tuner = AutoTuner(dict(BASE, task_limit=5))
+        cfgs = []
+        while (cfg := tuner.search_once()) is not None:
+            cfgs.append(cfg)
+        for i, cfg in enumerate(cfgs):
+            tuner.add_cfg(cfg, step_time_ms=100.0 - i)  # last one is fastest
+        best, err = tuner.get_best()
+        assert not err
+        assert best["step_time_ms"] == pytest.approx(100.0 - (len(cfgs) - 1))
+        for k in ("dp_degree", "mp_degree", "pp_degree"):
+            assert best[k] == cfgs[-1][k]
+
+    def test_analytic_sweep_returns_valid_config(self):
+        t = dict(BASE, task_limit=10000, max_mem_usage_gb=16)
+        best = AutoTuner(t).tune_analytic()
+        assert best is not None
+        assert prune_config({k: best[k] for k in
+                             ("dp_degree", "mp_degree", "pp_degree", "sharding_degree",
+                              "micro_batch_size", "use_recompute")} |
+                            {"sharding_stage": best.get("sharding_stage", 1)}, t) is None
+        assert best["mem_gb"] <= 16
+
+    def test_failed_trials_excluded(self):
+        rec = HistoryRecorder()
+        rec.add_cfg(dp_degree=8, step_time_ms=50.0, error=True)
+        rec.add_cfg(dp_degree=4, step_time_ms=80.0)
+        best, err = rec.get_best()
+        assert not err and best["dp_degree"] == 4
+
+    def test_recorder_csv_roundtrip(self, tmp_path):
+        rec = HistoryRecorder()
+        rec.add_cfg(dp_degree=2, mp_degree=4, step_time_ms=12.5, error=False,
+                    use_recompute=True)
+        p = str(tmp_path / "history.csv")
+        rec.store_history(p)
+        rec2 = HistoryRecorder()
+        rec2.load_history(p)
+        best, err = rec2.get_best()
+        assert not err and best["step_time_ms"] == 12.5 and best["mp_degree"] == 4
+        assert best["error"] is False and best["use_recompute"] is True
+
+    def test_explicit_false_candidate_respected(self):
+        cand = default_candidates(dict(BASE, use_recompute=False))
+        assert cand["use_recompute"] == [False]

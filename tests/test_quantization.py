@@ -109,6 +109,39 @@ class TestPTQ:
         out_f = np.asarray(net(x).numpy())
         assert np.max(np.abs(out_q - out_f)) < 0.2 * (np.max(np.abs(out_f)) + 1e-6)
 
+    def test_attribute_access_forward_is_quantized(self):
+        """Models calling self.fc(x) (instance attr wins over __getattr__)
+        must run the QUANTIZED layer after quantize()."""
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        paddle.seed(0)
+        net = Net()
+        q = QAT(QuantConfig()).quantize(net)
+        assert type(q.fc).__name__ == "QuantedLinear"
+        x = paddle.to_tensor(np.random.default_rng(0).normal(size=(2, 4)).astype(np.float32))
+        out_q = np.asarray(q(x).numpy())
+        wq_only = np.asarray(q.fc(x).numpy())
+        np.testing.assert_array_equal(out_q, wq_only)
+
+    def test_ptq_accepts_observer_instance(self):
+        paddle.seed(0)
+        proto = MovingAverageAbsmaxObserver(moving_rate=0.99)
+        net = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 4))
+        observed = PTQ(QuantConfig(activation=proto)).quantize(net)
+        observed(paddle.to_tensor(np.ones((2, 4), np.float32)))
+        # each layer got its OWN deep copy, prototype untouched
+        assert observed[0].observer is not observed[1].observer
+        assert observed[0].observer is not proto
+        assert proto.scale() == 0.0
+        assert observed[0].observer.scale() > 0
+
     def test_bare_layer_quantize_not_a_noop(self):
         lin = nn.Linear(4, 4)
         q = QAT(QuantConfig()).quantize(lin)

@@ -156,6 +156,20 @@ class TestAutograd:
         with pytest.raises(ValueError, match="shapes differ"):
             sparse.add(a, b)
 
+    def test_add_overlapping_patterns_merges_exactly(self):
+        a = sparse.sparse_coo_tensor(np.asarray([[0], [1]]), np.asarray([2.0]), [2, 3])
+        b = sparse.sparse_coo_tensor(np.asarray([[0, 0], [1, 2]]),
+                                     np.asarray([5.0, 7.0]), [2, 3])
+        c = sparse.add(a, b)
+        assert c.nnz == 2  # (0,1) merged; no sum_duplicates padding entries
+        idx = np.asarray(c.indices().numpy())
+        assert idx.max() < 3  # no out-of-bounds padding coordinates
+        want = np.asarray(a.to_dense().numpy()) + np.asarray(b.to_dense().numpy())
+        np.testing.assert_array_equal(np.asarray(c.to_dense().numpy()), want)
+        # CSR restore of the union result is well-formed
+        csr = c.to_sparse_csr()
+        assert len(np.asarray(csr.crows().numpy())) == 3
+
     def test_csr_elementwise_preserves_format(self):
         a = _coo_example().to_sparse_csr()
         b = _coo_example().to_sparse_csr()

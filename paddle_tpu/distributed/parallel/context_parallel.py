@@ -30,6 +30,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec
 
 from ...framework.dispatch import apply_op
@@ -155,8 +156,10 @@ def ring_attention(q, k, v, mesh: Optional[ProcessMesh] = None, axis_name: str =
     if qd.shape[1] % cp != 0:
         raise ValueError(f"sequence length {qd.shape[1]} not divisible by {axis_name} degree {cp}")
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(qd.shape[-1])
-
-    fn = _build_ring_fn(mesh, axis_name, cp, causal, rep, float(scale))
+    # canonicalize to f32 for the compile-cache key: per-call recomputations of
+    # 1/sqrt(d) that differ in f64 lsbs must not double the cache entries (the
+    # kernel math runs in f32 anyway)
+    fn = _build_ring_fn(mesh, axis_name, cp, causal, rep, float(np.float32(scale)))
 
     if not any_tensor:
         return fn(qd, kd, vd)

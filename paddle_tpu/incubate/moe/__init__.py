@@ -231,7 +231,11 @@ class MoELayer(Layer):
         out, aux = apply_op("moe_dispatch", moe,
                             (x, self.gate_weight, self.w_gate_up, self.w_down),
                             {}, num_outputs=2)
-        self.aux_loss = aux
+        # logging mirror: ONLY in eager — a traced value would be a dead
+        # tracer after the compiled step (an attractive nuisance; recipes must
+        # thread the returned aux through the loss, as LlamaForCausalLM does)
+        if not isinstance(aux._data, jax.core.Tracer):
+            self.aux_loss = aux
         return out, aux
 
     def forward(self, x):

@@ -288,10 +288,13 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, int
 # ---------------------------------------------------------------------------
 
 def flash_attention(q, k, v, causal: bool = False, mask=None, sm_scale: Optional[float] = None,
-                    interpret: bool = False):
+                    interpret: bool = False, block_q: int = 512, block_k: int = 512):
     """Memory-efficient attention. q,k,v: [B, S, H, D] jax arrays.
 
     ``interpret=True`` forces the Pallas kernel in interpreter mode (CPU CI).
+    Block sizes are clamped to the sequence lengths; 512x512 measured fastest
+    on v5e at seq 2048 (6.8ms vs 11.9ms at 128x128 for one fwd+bwd layer —
+    PERF.md).
     """
     from . import use_pallas
 
@@ -307,17 +310,22 @@ def flash_attention(q, k, v, causal: bool = False, mask=None, sm_scale: Optional
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
 
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
     kernel_shapes_ok = (
         mask is None
         and D in (64, 128, 256)
-        and Sq % 128 == 0
-        and Sk % 128 == 0
+        and Sq % block_q == 0
+        and Sk % block_k == 0
+        and block_q % 128 == 0
+        and block_k % 128 == 0
     )
     if interpret and not kernel_shapes_ok:
         raise ValueError(
             "flash_attention(interpret=True) requires kernel-compatible shapes "
-            f"(mask=None, D in 64/128/256, S % 128 == 0); got D={D}, Sq={Sq}, Sk={Sk}")
+            f"(mask=None, D in 64/128/256, S % block == 0); got D={D}, Sq={Sq}, Sk={Sk}")
     pallas_ok = (use_pallas() or interpret) and kernel_shapes_ok
     if pallas_ok:
-        return _pallas_flash(q, k, v, causal, sm_scale, interpret=interpret)
+        return _pallas_flash(q, k, v, causal, sm_scale,
+                             block_q=block_q, block_k=block_k, interpret=interpret)
     return _attention_reference(q, k, v, causal, mask, sm_scale)

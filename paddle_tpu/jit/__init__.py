@@ -29,7 +29,8 @@ from ..framework.autograd import no_grad
 from ..framework.dispatch import unwrap, wrap
 from ..framework.tensor import Parameter, Tensor
 
-__all__ = ["to_static", "not_to_static", "TrainStep", "functional_call", "ignore_module", "save", "load"]
+__all__ = ["to_static", "not_to_static", "TrainStep", "functional_call", "ignore_module",
+           "save", "load", "bucketed"]
 
 
 @contextlib.contextmanager
@@ -145,6 +146,114 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
 
     if function is not None:
         return decorate(function)
+    return decorate
+
+
+def bucketed(fn=None, *, axes, buckets=None, pad_value=0, out_axes=None):
+    """Shape-bucketing wrapper: pad dynamic axes up to the next bucket so XLA
+    compiles once per BUCKET instead of once per shape.
+
+    This is the framework's dynamic-shape policy (the role of the reference's
+    symbolic-shape machinery, ``pir/include/dialect/shape`` — on TPU, static
+    shapes + bucketing beat true dynamic shapes, which defeat MXU tiling).
+
+    - ``axes``: list of ``(arg_index, axis)`` pairs to bucket (e.g. the batch
+      dim of arg 0 and the seq dim of arg 1).
+    - ``buckets``: ascending sizes to round up into; default powers of two.
+    - ``pad_value``: fill for padded slots (mask semantics are the caller's —
+      e.g. pad token ids with an ignore/pad id).
+    - ``out_axes``: explicit output slicing as ``(out_axis, arg_index,
+      in_axis)`` triples applied to every output leaf.  Without it, each
+      output's FIRST axis matching a padded bucket size is cut back (leading-
+      batch convention); two bucketed axes landing on the same bucket from
+      different lengths is ambiguous and raises.
+
+    Usable as a decorator::
+
+        @jit.bucketed(axes=[(0, 0)])
+        def predict(x): ...
+    """
+
+    def decorate(f):
+        static = StaticFunction(f) if not isinstance(f, StaticFunction) else f
+
+        def next_bucket(n: int) -> int:
+            if buckets is not None:
+                for b in sorted(buckets):
+                    if b >= n:
+                        return int(b)
+                raise ValueError(f"size {n} exceeds the largest bucket {max(buckets)}")
+            b = 1
+            while b < n:
+                b *= 2
+            return b
+
+        @functools.wraps(f if not isinstance(f, StaticFunction) else f._target)
+        def wrapper(*args, **kwargs):
+            args = list(args)
+            pads = []  # (arg_index, in_axis, bucket, original)
+            for i, ax in axes:
+                t = args[i]
+                raw = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+                n = int(raw.shape[ax])
+                b = next_bucket(n)
+                if b != n:
+                    widths = [(0, 0)] * raw.ndim
+                    widths[ax] = (0, b - n)
+                    raw = jnp.pad(raw, widths, constant_values=pad_value)
+                    args[i] = Tensor(raw) if isinstance(t, Tensor) else raw
+                pads.append((i, ax, b, n))
+            out = static(*args, **kwargs)
+
+            bucket_orig: Dict[int, int] = {}
+            for _, _, b, n in pads:
+                if b == n:
+                    continue
+                if out_axes is None and b in bucket_orig and bucket_orig[b] != n:
+                    raise ValueError(
+                        f"ambiguous output slicing: two bucketed axes padded to "
+                        f"bucket {b} from different lengths "
+                        f"({bucket_orig[b]} and {n}); pass out_axes=[...]")
+                bucket_orig[b] = n
+
+            def unslice(o):
+                if isinstance(o, dict):
+                    return {k: unslice(v) for k, v in o.items()}
+                if isinstance(o, (list, tuple)):
+                    return type(o)(unslice(v) for v in o)
+                raw = o._data if isinstance(o, Tensor) else o
+                if not hasattr(raw, "shape"):
+                    return o
+                idx = [slice(None)] * raw.ndim
+                cut = False
+                if out_axes is not None:
+                    for oax, i, iax in out_axes:
+                        for pi, pax, b, n in pads:
+                            if pi == i and pax == iax and b != n:
+                                idx[oax] = slice(0, n)
+                                cut = True
+                else:
+                    # leading-batch convention: the FIRST axis matching each
+                    # padded bucket is the one that was padded; later axes of
+                    # the same size (e.g. a feature dim that happens to equal
+                    # the bucket) are left alone
+                    remaining = dict(bucket_orig)
+                    for d, size in enumerate(raw.shape):
+                        if size in remaining:
+                            idx[d] = slice(0, remaining.pop(size))
+                            cut = True
+                if not cut:
+                    return o
+                sliced = raw[tuple(idx)]
+                return Tensor(sliced) if isinstance(o, Tensor) else sliced
+
+            return unslice(out)
+
+        wrapper._static = static
+        return wrapper
+
+    if fn is not None:
+        return decorate(fn)
     return decorate
 
 

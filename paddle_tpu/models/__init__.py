@@ -14,3 +14,11 @@ from .llama import (  # noqa: F401
     llama3_8b_config,
     llama3_70b_config,
 )
+from . import ernie  # noqa: F401
+from . import ocr  # noqa: F401
+from .ernie import (  # noqa: F401
+    ErnieConfig,
+    ErnieForSequenceClassification,
+    ErnieModel,
+    ernie_tiny_config,
+)

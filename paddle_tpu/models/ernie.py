@@ -1,0 +1,108 @@
+"""ERNIE-style encoder for text classification (BASELINE configs[0]).
+
+Counterpart of the ERNIE-tiny text-classification recipe the driver names as
+the correctness/loss-parity config (single-host, eager mode).  The model is a
+BERT-family bidirectional encoder — token + position + segment embeddings,
+post-LN transformer encoder stack (the ERNIE/BERT convention), tanh pooler
+over [CLS], classification head — built from the framework's own
+``nn.TransformerEncoder`` so the recipe exercises the stock layer library
+rather than bespoke modules.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForSequenceClassification",
+           "ernie_tiny_config"]
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 18000
+    hidden_size: int = 312          # ERNIE-tiny width
+    num_hidden_layers: int = 4
+    num_attention_heads: int = 12
+    intermediate_size: int = 1248
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+
+
+def ernie_tiny_config(**overrides) -> ErnieConfig:
+    """ERNIE-tiny hyperparameters ARE the dataclass defaults (312/4/12/1248)."""
+    return ErnieConfig(**overrides)
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        seq = input_ids.shape[1]
+        pos = Tensor(jnp.arange(seq, dtype=jnp.int32)[None, :])
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            x = x + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class ErnieModel(nn.Layer):
+    """Embeddings + encoder + pooler (returns (sequence_output, pooled))."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation="gelu",
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            normalize_before=False)  # post-LN (BERT/ERNIE convention)
+        self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_hidden_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        if attention_mask is not None:
+            # [B, S] 1/0 -> additive [B, 1, 1, S] mask
+            m = attention_mask._data if isinstance(attention_mask, Tensor) else attention_mask
+            add = Tensor(((1.0 - m[:, None, None, :].astype(jnp.float32)) * -1e9))
+            x = self.encoder(x, src_mask=add)
+        else:
+            x = self.encoder(x)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    """Pooled [CLS] -> dropout -> linear classifier (the text-cls recipe)."""
+
+    def __init__(self, cfg: ErnieConfig, num_classes: int = 2):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+        self.num_classes = num_classes
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+    def compute_loss(self, logits, labels):
+        return F.cross_entropy(logits, labels)

@@ -1,0 +1,108 @@
+"""jit.bucketed: shape-bucketing policy (the symbolic-shape role —
+SURVEY §2.2 row 12: pad/bucket instead of dynamic shapes on TPU)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_buckets_limit_recompiles():
+    traces = []
+
+    @paddle.jit.bucketed(axes=[(0, 0)])
+    def f(x):
+        traces.append(x.shape[0])  # appended once per TRACE, not per call
+        return (x * 2).sum(axis=-1)
+
+    for b in (3, 5, 7, 8):
+        out = f(paddle.to_tensor(np.ones((b, 4), np.float32)))
+        assert tuple(out.shape) == (b,)
+        np.testing.assert_allclose(np.asarray(out.numpy()), np.full(b, 8.0))
+    assert traces == [4, 8]  # two compiles (buckets 4 and 8) served 4 calls
+
+    f(paddle.to_tensor(np.ones((9, 4), np.float32)))
+    assert traces == [4, 8, 16]  # next bucket -> one more compile
+
+
+def test_explicit_buckets_and_overflow():
+    @paddle.jit.bucketed(axes=[(0, 0)], buckets=[4, 12])
+    def f(x):
+        return x + 1
+
+    out = f(paddle.to_tensor(np.zeros((5, 2), np.float32)))
+    assert tuple(out.shape) == (5, 2)
+    with pytest.raises(ValueError, match="largest bucket"):
+        f(paddle.to_tensor(np.zeros((13, 2), np.float32)))
+
+
+def test_multi_axis_bucketing():
+    @paddle.jit.bucketed(axes=[(0, 0), (0, 1)])
+    def f(x):
+        return x.sum()  # padding contributes 0
+
+    x = np.ones((3, 5), np.float32)
+    out = f(paddle.to_tensor(x))
+    assert float(out.numpy()) == pytest.approx(15.0)
+
+
+def test_output_feature_dim_equal_to_bucket_untouched():
+    """Linear(4, 8) with batch padded to 8: only the FIRST matching axis
+    (the batch) is sliced — the 8-wide feature dim must survive."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8))
+
+    @paddle.jit.bucketed(axes=[(0, 0)])
+    def predict(x):
+        return net(x)
+
+    x = np.random.default_rng(0).normal(size=(5, 4)).astype(np.float32)
+    out = np.asarray(predict(paddle.to_tensor(x)).numpy())
+    assert out.shape == (5, 8)
+    np.testing.assert_allclose(out, np.asarray(net(paddle.to_tensor(x)).numpy()),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_same_bucket_different_lengths_requires_out_axes():
+    @paddle.jit.bucketed(axes=[(0, 0), (0, 1)])
+    def ident(x):
+        return x
+
+    x = paddle.to_tensor(np.arange(30, dtype=np.float32).reshape(5, 6))
+    with pytest.raises(ValueError, match="ambiguous"):
+        ident(x)
+
+    @paddle.jit.bucketed(axes=[(0, 0), (0, 1)], out_axes=[(0, 0, 0), (1, 0, 1)])
+    def ident2(x):
+        return x
+
+    out = np.asarray(ident2(x).numpy())
+    assert out.shape == (5, 6)
+    np.testing.assert_array_equal(out, np.arange(30, dtype=np.float32).reshape(5, 6))
+
+
+def test_dict_outputs_unsliced_recursively():
+    @paddle.jit.bucketed(axes=[(0, 0)])
+    def f(x):
+        return {"out": x * 2, "meta": {"double": x + x}}
+
+    x = paddle.to_tensor(np.ones((5, 3), np.float32))
+    out = f(x)
+    assert tuple(out["out"].shape) == (5, 3)
+    assert tuple(out["meta"]["double"].shape) == (5, 3)
+
+
+def test_pad_value_and_layer_forward():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 2))
+
+    @paddle.jit.bucketed(axes=[(0, 0)])
+    def predict(x):
+        return net(x)
+
+    x = np.random.default_rng(0).normal(size=(5, 4)).astype(np.float32)
+    out = np.asarray(predict(paddle.to_tensor(x)).numpy())
+    want = np.asarray(net(paddle.to_tensor(x)).numpy())
+    assert out.shape == (5, 2)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)

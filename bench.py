@@ -13,8 +13,10 @@ Presets:
            single 16GB v5e chip incl. fp32 AdamW state)
   ocr    — PP-OCRv4-style DBNet detector training (BASELINE configs[3]: the
            conv-heavy fusion-path recipe); images/s + MFU from XLA cost analysis
+  moe    — Qwen2-MoE/DeepSeekMoE-style Llama-MoE training (BASELINE configs[4]);
+           tokens/s + MFU from XLA cost analysis (routing makes 6P wrong)
 
-Usage: python bench.py [--preset tiny|small|base|ocr] [--device cpu|tpu]
+Usage: python bench.py [--preset tiny|small|base|ocr|moe] [--device cpu|tpu]
        [--steps N] [--batch B] [--seq S]
 """
 
@@ -117,6 +119,25 @@ def _peak_flops(jax, on_tpu):
     return dev_kind, peak
 
 
+def _step_flops_of(lowered) -> float:
+    """FLOPs of a lowered step: HLO-level analysis first (free), compiled
+    executable's analysis as fallback (the remote TPU plugin implements only
+    the latter; the program is already in the compile cache by bench time)."""
+    try:
+        cost = lowered.cost_analysis()
+        if cost and cost.get("flops"):
+            return float(cost["flops"])
+    except Exception:
+        pass
+    try:
+        cost = lowered.compile().cost_analysis()
+        if cost and cost.get("flops"):
+            return float(cost["flops"])
+    except Exception:
+        pass
+    return 0.0
+
+
 def _bench_ocr(jax, paddle, backend, on_tpu, args):
     """DBNet detector train step: images/s; FLOPs from XLA's cost analysis of
     the compiled program (convs don't have a tidy closed form like 6P)."""
@@ -160,9 +181,7 @@ def _bench_ocr(jax, paddle, backend, on_tpu, args):
         step_fn._params, step_fn._buffers, step_fn._opt_state,
         jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.int32), rnd.next_key(),
         (img._data, gt._data))
-    # HLO-level cost on the Lowered object — avoids a second backend compile
-    cost = lowered.cost_analysis()
-    step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
+    step_flops = _step_flops_of(lowered)
 
     images_per_sec = batch * steps / dt
     dev_kind, peak = _peak_flops(jax, on_tpu)
@@ -187,9 +206,85 @@ def _bench_ocr(jax, paddle, backend, on_tpu, args):
     }
 
 
+def _bench_moe(jax, paddle, backend, on_tpu, args):
+    """Llama-MoE train step (configs[4] shape: few dense layers' worth of
+    active params routed over many experts).  FLOPs from XLA cost analysis —
+    top-k routing makes the dense 6P closed form wrong."""
+    import numpy as np
+
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.models.llama import LlamaConfig
+
+    paddle.seed(0)
+    dtype = "bfloat16" if on_tpu else "float32"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=1408,
+                          num_hidden_layers=12, num_attention_heads=16,
+                          num_key_value_heads=8, max_position_embeddings=2048,
+                          dtype=dtype, moe_num_experts=8, moe_top_k=2)
+        batch, seq, steps = (args.batch or 4), (args.seq or 2048), (args.steps or 10)
+    else:
+        from paddle_tpu.models import llama_tiny_config
+
+        cfg = llama_tiny_config(dtype=dtype, moe_num_experts=4, moe_top_k=2)
+        batch, seq, steps = (args.batch or 2), (args.seq or 128), (args.steps or 3)
+    model = LlamaForCausalLM(cfg)
+    n_params = sum(p.size for p in model.parameters())
+    opt = paddle.optimizer.AdamW(learning_rate=3e-4, parameters=model.parameters())
+
+    def loss_fn(m, ids):
+        return m.compute_loss(m(ids), ids)
+
+    step_fn = paddle.jit.TrainStep(model, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32))
+
+    loss = step_fn(ids)
+    first_loss = float(np.asarray(loss._data))  # host read = true sync
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step_fn(ids)
+    last_loss = float(np.asarray(loss._data))
+    dt = time.perf_counter() - t0
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework import random as rnd
+
+    lowered = step_fn._jitted.lower(
+        step_fn._params, step_fn._buffers, step_fn._opt_state,
+        jnp.asarray(3e-4, jnp.float32), jnp.asarray(1, jnp.int32), rnd.next_key(),
+        (ids._data,))
+    step_flops = _step_flops_of(lowered)
+
+    tokens_per_sec = batch * seq * steps / dt
+    dev_kind, peak = _peak_flops(jax, on_tpu)
+    mfu = (step_flops * steps / dt / peak) if peak and step_flops else 0.0
+    return {
+        "metric": "llama_moe_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4) if peak else 0.0,
+        "mfu": round(mfu, 4),
+        "device": dev_kind,
+        "backend": backend,
+        "preset": "moe",
+        "params": n_params,
+        "experts": cfg.moe_num_experts,
+        "top_k": cfg.moe_top_k,
+        "batch": batch,
+        "seq_len": seq,
+        "steps": steps,
+        "step_time_ms": round(1000 * dt / steps, 2),
+        "first_loss": round(first_loss, 4),
+        "last_loss": round(last_loss, 4),
+        "step_flops": step_flops,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--preset", default=None, choices=["tiny", "small", "base", "ocr"])
+    ap.add_argument("--preset", default=None, choices=["tiny", "small", "base", "ocr", "moe"])
     ap.add_argument("--device", default=None, choices=["cpu", "tpu"])
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--batch", type=int, default=None)
@@ -217,6 +312,10 @@ def main():
 
     if preset == "ocr":
         result = _bench_ocr(jax, paddle, backend, on_tpu, args)
+        print(json.dumps(result))
+        return
+    if preset == "moe":
+        result = _bench_moe(jax, paddle, backend, on_tpu, args)
         print(json.dumps(result))
         return
 

@@ -7,3 +7,12 @@ from .mp_layers import (  # noqa: F401
     VocabParallelEmbedding,
     ParallelCrossEntropy,
 )
+from . import context_parallel  # noqa: F401
+from . import segment_parallel  # noqa: F401
+from . import sequence_parallel  # noqa: F401
+from .context_parallel import ring_attention  # noqa: F401
+from .segment_parallel import (  # noqa: F401
+    SegmentParallel,
+    segment_parallel_allreduce_grads,
+    split_sequence,
+)

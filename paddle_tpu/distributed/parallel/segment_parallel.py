@@ -1,0 +1,82 @@
+"""Segment parallelism: the 'sep' topology axis as a sequence-splitting wrapper.
+
+Counterpart of the reference's ``meta_parallel/segment_parallel.py:26``
+(``SegmentParallel`` wrapper) + the sep-group gradient allreduce
+(``fleet/utils/hybrid_parallel_util.py:254-267``) + the 4-direction p2p helper
+(``pp_utils/four_directions_p2p_communication.py``).
+
+TPU-native collapse: SEP is a SHARDING of the sequence dim over the 'sep'
+mesh axis —
+
+- the wrapper constrains activations to ``Shard(seq)`` over 'sep' (the
+  reference splits the batch's sequence by hand and exchanges halo segments
+  with p2p);
+- parameters stay replicated over 'sep', so XLA's backward inserts the
+  gradient allreduce the reference codes in ``hybrid_parallel_util.py`` —
+  there is no reducer to run;
+- cross-segment attention (the reason the reference needs 4-direction p2p)
+  is ``distributed.parallel.ring_attention`` — models whose attention calls
+  it compute EXACT global attention over the sharded sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...framework.dispatch import apply_op
+from ...framework.tensor import Tensor
+from ...nn.layers import Layer
+from ..mesh import ProcessMesh, get_mesh
+
+__all__ = ["SegmentParallel", "split_sequence", "segment_parallel_allreduce_grads"]
+
+
+def split_sequence(x, mesh: Optional[ProcessMesh] = None, seq_axis: int = 1,
+                   axis_name: str = "sep"):
+    """Constrain (or place) ``x``'s sequence dim sharded over the sep axis."""
+    mesh = mesh if mesh is not None else get_mesh()
+    if mesh is None or axis_name not in mesh.dim_names:
+        raise ValueError(f"split_sequence needs a mesh with a {axis_name!r} axis")
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    spec = [None] * len(t.shape)
+    spec[seq_axis] = axis_name
+    sharding = NamedSharding(mesh.jax_mesh, PartitionSpec(*spec))
+
+    def f(a):
+        if isinstance(a, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(a, sharding)
+        return jax.device_put(a, sharding)
+
+    return apply_op("sep_split_sequence", f, (t,), {})
+
+
+def segment_parallel_allreduce_grads(params, hcg=None):
+    """Reference-shaped no-op (``hybrid_parallel_util.py:254``): under GSPMD
+    the sep-axis gradient allreduce is inserted by XLA's backward for
+    replicated parameters — kept as API surface for ported training loops."""
+    return None
+
+
+class SegmentParallel(Layer):
+    """Wrap a model so its inputs run sequence-sharded over 'sep'
+    (reference ``SegmentParallel``, ``meta_parallel/segment_parallel.py:26``).
+
+    The wrapped model sees GLOBAL-shape tensors whose storage is sharded; any
+    attention inside should be ``ring_attention`` (exact) or will be computed
+    by GSPMD with its own collectives (correct, possibly slower).
+    """
+
+    def __init__(self, layers, hcg=None, strategy=None, seq_axis: int = 1,
+                 mesh: Optional[ProcessMesh] = None, axis_name: str = "sep"):
+        super().__init__()
+        self._layers = layers
+        self._seq_axis = seq_axis
+        self._axis_name = axis_name
+        self._mesh = mesh if mesh is not None else get_mesh()
+
+    def forward(self, x, *args, **kwargs):
+        x = split_sequence(x, self._mesh, self._seq_axis, self._axis_name)
+        return self._layers(x, *args, **kwargs)

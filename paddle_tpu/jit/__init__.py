@@ -74,15 +74,25 @@ def _get_state(layer):
 
 
 class StaticFunction:
-    """A compiled callable wrapping a Layer or plain function."""
+    """A compiled callable wrapping a Layer or plain function.
 
-    def __init__(self, fn_or_layer, input_spec=None, full_graph=True, backend=None):
+    Untraceable code (data-dependent Python control flow, host side effects —
+    what the reference's SOT bytecode tracer would fall back to dygraph on)
+    falls back to EAGER execution with a one-time warning instead of raising;
+    ``full_graph=True`` disables the fallback (trace errors propagate)."""
+
+    def __init__(self, fn_or_layer, input_spec=None, full_graph=False, backend=None):
         from ..nn.layers import Layer
 
         self._is_layer = isinstance(fn_or_layer, Layer)
         self._target = fn_or_layer
         self._jitted = None
         self._input_spec = input_spec
+        self._full_graph = full_graph
+        # input signatures whose trace failed — jax.jit retraces per
+        # signature, so a batch-1-only host branch must not de-optimize
+        # every other shape
+        self._fallback_sigs = set()
 
     def _build(self):
         if self._is_layer:
@@ -106,17 +116,51 @@ class StaticFunction:
 
             self._jitted = jax.jit(pure)
 
+    def _call_eager(self, args, kwargs):
+        # match the compiled path's ambient contexts: no tape, functional RNG
+        with no_grad(), rnd.rng_guard(rnd.next_key()):
+            out = self._target(*wrap(args), **wrap(kwargs))
+        if self._is_layer or isinstance(out, Tensor) or not hasattr(out, "dtype"):
+            return out
+        return wrap(out)
+
+    @staticmethod
+    def _signature(raw_args, raw_kwargs):
+        return tuple(
+            (tuple(a.shape), str(a.dtype)) if hasattr(a, "shape") else a
+            for a in jax.tree.leaves((raw_args, raw_kwargs)))
+
     def __call__(self, *args, **kwargs):
         if self._jitted is None:
             self._build()
         key = rnd.next_key()
         raw_args = unwrap(tuple(a if not isinstance(a, Tensor) else a for a in args))
         raw_kwargs = unwrap(kwargs)
-        if self._is_layer:
-            params, buffers = _get_state(self._target)
-            out = self._jitted(params, buffers, key, raw_args, raw_kwargs)
-        else:
-            out = self._jitted(key, raw_args, raw_kwargs)
+        sig = self._signature(raw_args, raw_kwargs) if self._fallback_sigs or not self._full_graph else None
+        if sig is not None and sig in self._fallback_sigs:
+            return self._call_eager(args, kwargs)
+        try:
+            if self._is_layer:
+                params, buffers = _get_state(self._target)
+                out = self._jitted(params, buffers, key, raw_args, raw_kwargs)
+            else:
+                out = self._jitted(key, raw_args, raw_kwargs)
+        except jax.errors.JAXTypeError as e:
+            # data-dependent control flow / host-value use inside the trace —
+            # the SOT-fallback situation; run THIS SIGNATURE eagerly from now
+            # on (other shapes may trace fine and stay compiled)
+            if self._full_graph:
+                raise
+            import warnings
+
+            name = getattr(self._target, "__name__", type(self._target).__name__)
+            warnings.warn(
+                f"to_static({name}): tracing failed ({type(e).__name__}); "
+                "falling back to EAGER execution for this input signature. Use "
+                "lax.cond/where-style control flow (or full_graph=True to "
+                "make this an error).", RuntimeWarning, stacklevel=2)
+            self._fallback_sigs.add(sig)
+            return self._call_eager(args, kwargs)
         return wrap(out)
 
     # paddle API surface
@@ -135,14 +179,15 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
     def decorate(fn):
         from ..nn.layers import Layer
 
+        full_graph = bool(kwargs.get("full_graph", False))
         if isinstance(fn, Layer):
-            static = StaticFunction(fn, input_spec)
+            static = StaticFunction(fn, input_spec, full_graph=full_graph)
             fn.forward_static = static
             # replace __call__ path: wrap forward
             orig_cls_call = fn.__call__
             fn._static_function = static
             return fn if kwargs.get("inplace", False) else static
-        return functools.wraps(fn)(StaticFunction(fn, input_spec))
+        return functools.wraps(fn)(StaticFunction(fn, input_spec, full_graph=full_graph))
 
     if function is not None:
         return decorate(function)

@@ -106,3 +106,83 @@ def test_pad_value_and_layer_forward():
     want = np.asarray(net(paddle.to_tensor(x)).numpy())
     assert out.shape == (5, 2)
     np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+class TestSOTFallback:
+    """to_static falls back to eager on untraceable code (the reference's SOT
+    bytecode tracer falls back to dygraph the same way)."""
+
+    def test_data_dependent_branch_falls_back(self):
+        calls = []
+
+        @paddle.jit.to_static
+        def f(x):
+            calls.append(1)
+            if float(x.sum().numpy()) > 0:  # concretizes a tracer
+                return x * 2
+            return x - 1
+
+        import warnings as w
+
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        with w.catch_warnings(record=True) as rec:
+            w.simplefilter("always")
+            out = f(x)
+            assert any("falling back to EAGER" in str(r.message) for r in rec)
+        np.testing.assert_allclose(np.asarray(out.numpy()), 2 * np.ones((2, 2)))
+        # negative branch works too (eager re-executes per call)
+        out2 = f(paddle.to_tensor(-np.ones((2, 2), np.float32)))
+        np.testing.assert_allclose(np.asarray(out2.numpy()), -2 * np.ones((2, 2)))
+
+    def test_full_graph_raises(self):
+        import jax
+
+        @paddle.jit.to_static(full_graph=True)
+        def f(x):
+            if float(x.sum()) > 0:  # concretizes a tracer
+                return x * 2
+            return x
+
+        with pytest.raises(jax.errors.JAXTypeError):
+            f(paddle.to_tensor(np.ones((2, 2), np.float32)))
+
+    def test_traceable_function_stays_compiled(self):
+        traces = []
+
+        @paddle.jit.to_static
+        def f(x):
+            traces.append(1)
+            return x * 3
+
+        for _ in range(3):
+            out = f(paddle.to_tensor(np.ones((2,), np.float32)))
+        assert len(traces) == 1  # compiled once, no fallback
+        np.testing.assert_allclose(np.asarray(out.numpy()), [3.0, 3.0])
+
+    def test_fallback_is_per_signature(self):
+        """One failing shape must not de-optimize other (traceable) shapes."""
+        traces = []
+
+        @paddle.jit.to_static
+        def f(x):
+            traces.append(x.shape[0])
+            if x.shape[0] == 1:  # static shape branch, but the body below
+                return x * float(x.sum().numpy())  # concretizes under trace
+            return x * 2
+
+        import warnings as w
+
+        big = paddle.to_tensor(np.ones((3, 2), np.float32))
+        np.testing.assert_allclose(np.asarray(f(big).numpy()), 2 * np.ones((3, 2)))
+        with w.catch_warnings(record=True):
+            w.simplefilter("always")
+            small = paddle.to_tensor(np.full((1, 2), 3.0, np.float32))
+            out = f(small)  # batch-1 falls back (value 6 * 3 = 18)
+        np.testing.assert_allclose(np.asarray(out.numpy()), np.full((1, 2), 18.0))
+        n_traces = len(traces)
+        # batch-3 calls keep using the COMPILED path: no new traces
+        np.testing.assert_allclose(np.asarray(f(big).numpy()), 2 * np.ones((3, 2)))
+        assert len(traces) == n_traces
+        # batch-1 stays eager (re-executes the python body each call)
+        f(small)
+        assert len(traces) == n_traces + 1

@@ -1,0 +1,64 @@
+"""SegmentParallel wrapper: 'sep'-axis sequence sharding
+(reference ``meta_parallel/segment_parallel.py:26`` semantics)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.parallel import SegmentParallel, split_sequence
+
+
+@pytest.fixture
+def sep_mesh():
+    return dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "sep"])
+
+
+def test_split_sequence_places_shards(sep_mesh):
+    x = paddle.to_tensor(np.random.default_rng(0).normal(size=(2, 8, 4)).astype(np.float32))
+    xs = split_sequence(x, sep_mesh)
+    spec = xs._data.sharding.spec
+    assert spec[1] == "sep"
+    np.testing.assert_array_equal(np.asarray(xs.numpy()), np.asarray(x.numpy()))
+
+
+def test_wrapper_forward_matches_unwrapped(sep_mesh):
+    paddle.seed(0)
+    inner = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.Linear(8, 4))
+    wrapped = SegmentParallel(inner, mesh=sep_mesh)
+    x = paddle.to_tensor(np.random.default_rng(1).normal(size=(2, 8, 4)).astype(np.float32))
+    out_w = np.asarray(wrapped(x).numpy())
+    out_p = np.asarray(inner(x).numpy())
+    np.testing.assert_allclose(out_w, out_p, rtol=1e-5, atol=1e-6)
+
+
+def test_gradients_flow_and_params_replicated(sep_mesh):
+    """Param grads must equal the single-device run (the allreduce-over-sep
+    the reference codes by hand comes from GSPMD here)."""
+    def build():
+        paddle.seed(2)
+        return nn.Linear(4, 4)
+
+    x_np = np.random.default_rng(3).normal(size=(2, 8, 4)).astype(np.float32)
+
+    plain = build()
+    loss_p = (plain(paddle.to_tensor(x_np)) ** 2).mean()
+    loss_p.backward()
+    g_plain = np.asarray(plain.weight.grad.numpy())
+
+    inner = build()
+    wrapped = SegmentParallel(inner, mesh=sep_mesh)
+    loss_w = (wrapped(paddle.to_tensor(x_np)) ** 2).mean()
+    loss_w.backward()
+    g_wrap = np.asarray(inner.weight.grad.numpy())
+    np.testing.assert_allclose(g_wrap, g_plain, rtol=1e-5, atol=1e-6)
+
+
+def test_requires_sep_axis():
+    mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+    x = paddle.to_tensor(np.zeros((2, 8, 4), np.float32))
+    with pytest.raises(ValueError, match="'sep' axis"):
+        split_sequence(x, mesh)

@@ -128,7 +128,21 @@ def _gather_rows(arr: np.ndarray) -> np.ndarray:
     """All processes' copies of ``arr``, stacked along axis 0 (world order)."""
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(arr))
+    from .watchdog import watch
+
+    with watch("process_allgather"):
+        return np.asarray(multihost_utils.process_allgather(arr))
+
+
+def _watched_broadcast(arr: np.ndarray, is_source: bool) -> np.ndarray:
+    """broadcast_one_to_all under the comm watchdog (it hangs the same way
+    the allgather does when a peer dies)."""
+    from jax.experimental import multihost_utils
+
+    from .watchdog import watch
+
+    with watch("broadcast"):
+        return multihost_utils.broadcast_one_to_all(arr, is_source=is_source)
 
 
 def _reduce_rows(rows: np.ndarray, op: str) -> np.ndarray:
@@ -209,7 +223,7 @@ def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
         return tensor
     from jax.experimental import multihost_utils
 
-    out = multihost_utils.broadcast_one_to_all(np.asarray(tensor._data), is_source=get_rank() == src)
+    out = _watched_broadcast(np.asarray(tensor._data), is_source=get_rank() == src)
     if _in_group(group):
         tensor._data = jnp.asarray(out)
     return tensor
@@ -234,7 +248,7 @@ def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group=None, sync_op=
                if tensor_list else np.zeros((len(ranks),) + tuple(tensor.shape), np.float32))
     from jax.experimental import multihost_utils
 
-    full = multihost_utils.broadcast_one_to_all(stacked, is_source=get_rank() == src)
+    full = _watched_broadcast(stacked, is_source=get_rank() == src)
     if _in_group(group):
         tensor._data = jnp.asarray(full[ranks.index(jax.process_index())])
     return tensor
@@ -275,7 +289,10 @@ def barrier(group=None):
         return
     from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    from .watchdog import watch
+
+    with watch("barrier"):
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
 
 
 def wait(tensor, group=None, use_calc_stream=True):

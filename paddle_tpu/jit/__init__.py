@@ -116,9 +116,11 @@ class StaticFunction:
 
             self._jitted = jax.jit(pure)
 
-    def _call_eager(self, args, kwargs):
+    def _call_eager(self, args, kwargs, key):
         # match the compiled path's ambient contexts: no tape, functional RNG
-        with no_grad(), rnd.rng_guard(rnd.next_key()):
+        # (reusing the already-drawn key keeps the seeded stream in sync with
+        # the compiled path: one key per call either way)
+        with no_grad(), rnd.rng_guard(key):
             out = self._target(*wrap(args), **wrap(kwargs))
         if self._is_layer or isinstance(out, Tensor) or not hasattr(out, "dtype"):
             return out
@@ -136,9 +138,9 @@ class StaticFunction:
         key = rnd.next_key()
         raw_args = unwrap(tuple(a if not isinstance(a, Tensor) else a for a in args))
         raw_kwargs = unwrap(kwargs)
-        sig = self._signature(raw_args, raw_kwargs) if self._fallback_sigs or not self._full_graph else None
-        if sig is not None and sig in self._fallback_sigs:
-            return self._call_eager(args, kwargs)
+        # signature check only once a fallback exists — the hot path stays free
+        if self._fallback_sigs and self._signature(raw_args, raw_kwargs) in self._fallback_sigs:
+            return self._call_eager(args, kwargs, key)
         try:
             if self._is_layer:
                 params, buffers = _get_state(self._target)
@@ -159,8 +161,8 @@ class StaticFunction:
                 "falling back to EAGER execution for this input signature. Use "
                 "lax.cond/where-style control flow (or full_graph=True to "
                 "make this an error).", RuntimeWarning, stacklevel=2)
-            self._fallback_sigs.add(sig)
-            return self._call_eager(args, kwargs)
+            self._fallback_sigs.add(self._signature(raw_args, raw_kwargs))
+            return self._call_eager(args, kwargs, key)
         return wrap(out)
 
     # paddle API surface

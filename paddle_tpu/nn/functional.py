@@ -1313,9 +1313,26 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
-    from ..ops.manipulation import unfold as _unfold
+    """im2col patch extraction (reference ``nn/functional/common.py`` unfold;
+    NOT the strided-view ``paddle.unfold(x, axis, size, step)``)."""
+    ks = int_list(kernel_sizes)
+    ks = ks * 2 if len(ks) == 1 else ks
+    st = int_list(strides)
+    st = st * 2 if len(st) == 1 else st
+    pd = int_list(paddings)
+    pd = pd * 2 if len(pd) == 1 else pd
+    dl = int_list(dilations)
+    dl = dl * 2 if len(dl) == 1 else dl
 
-    return _unfold(x, kernel_sizes, strides, paddings, dilations)
+    def f(a):
+        n, c, h, w = a.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=ks, window_strides=st,
+            padding=[(pd[0], pd[0]), (pd[1], pd[1])] if len(pd) == 2 else [(pd[0], pd[1]), (pd[2], pd[3])],
+            rhs_dilation=dl, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * ks[0] * ks[1], -1)
+
+    return unary_op("unfold", f, x)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None):

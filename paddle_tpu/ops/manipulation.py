@@ -27,7 +27,7 @@ __all__ = [
     "take_along_axis", "put_along_axis", "slice", "strided_slice", "crop", "pad",
     "unstack", "unbind", "repeat_interleave", "cast", "moveaxis", "swapaxes",
     "unique", "unique_consecutive", "nonzero", "as_complex", "as_real", "view", "view_as",
-    "unfold", "flatten_", "squeeze_", "unsqueeze_", "unflatten", "atleast_1d",
+    "unfold", "as_strided", "flatten_", "squeeze_", "unsqueeze_", "unflatten", "atleast_1d",
     "atleast_2d", "atleast_3d", "diag_embed", "index_fill", "select_scatter",
 ]
 
@@ -523,25 +523,59 @@ def as_real(x, name=None):
     return unary_op("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
 
 
-def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
-    ks = int_list(kernel_sizes)
-    ks = ks * 2 if len(ks) == 1 else ks
-    st = int_list(strides)
-    st = st * 2 if len(st) == 1 else st
-    pd = int_list(paddings)
-    pd = pd * 2 if len(pd) == 1 else pd
-    dl = int_list(dilations)
-    dl = dl * 2 if len(dl) == 1 else dl
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view (reference ``tensor/manipulation.py:6959`` over the
+    ``phi/kernels/stride`` kernels).
+
+    TPU-native: XLA arrays have no user-visible strides, so the view is a
+    GATHER over the flattened storage (out[i0, i1, ...] =
+    flat[offset + sum(i_k * stride_k)]).  Functionally equivalent incl.
+    OVERLAPPING windows; autodiff of the gather scatter-ADDS cotangents into
+    shared elements — the same gradient the reference's strided view gives.
+    """
+    shape = int_list(shape)
+    stride = int_list(stride)
+    if len(shape) != len(stride):
+        raise ValueError(f"shape rank {len(shape)} != stride rank {len(stride)}")
+    # static bounds check: JAX gather CLAMPS out-of-bounds indices silently,
+    # but the reference raises — and silent clamping returns garbage rows
+    max_index = offset + sum((s - 1) * st for s, st in zip(shape, stride) if s > 0)
+    n_elems = int(np.prod(x.shape)) if len(x.shape) else 1
+    if offset < 0 or (0 not in shape and max_index >= n_elems):
+        raise ValueError(
+            f"as_strided out of bounds: max flat index {max_index} (offset "
+            f"{offset}) on a tensor of {n_elems} elements")
 
     def f(a):
-        n, c, h, w = a.shape
-        patches = jax.lax.conv_general_dilated_patches(
-            a, filter_shape=ks, window_strides=st,
-            padding=[(pd[0], pd[0]), (pd[1], pd[1])] if len(pd) == 2 else [(pd[0], pd[1]), (pd[2], pd[3])],
-            rhs_dilation=dl, dimension_numbers=("NCHW", "OIHW", "NCHW"))
-        return patches.reshape(n, c * ks[0] * ks[1], -1)
+        flat = a.reshape(-1)
+        grids = jnp.meshgrid(
+            *[jnp.arange(s) * st for s, st in zip(shape, stride)], indexing="ij")
+        lin = sum(grids) + offset if grids else jnp.asarray(offset)
+        return flat[lin]
 
-    return unary_op("unfold", f, x)
+    return unary_op("as_strided", f, x)
+
+
+def unfold(x, axis, size, step, name=None):
+    """All ``size``-wide slices along ``axis`` at stride ``step``, stacked on a
+    NEW LAST dim (reference ``tensor/manipulation.py:7110`` — the strided VIEW
+    unfold; the im2col patch extractor is ``nn.functional.unfold``)."""
+    if step <= 0:
+        raise ValueError(f"unfold step must be positive, got {step}")
+    dim = x.shape[axis % len(x.shape)]
+    if size > dim:
+        raise ValueError(f"unfold size {size} exceeds dim {dim} of axis {axis}")
+
+    def f(a):
+        ax = axis % a.ndim
+        n_windows = (a.shape[ax] - size) // step + 1
+        idx = jnp.arange(n_windows)[:, None] * step + jnp.arange(size)[None, :]
+        out = jnp.take(a, idx.reshape(-1), axis=ax)
+        out = out.reshape(a.shape[:ax] + (n_windows, size) + a.shape[ax + 1:])
+        # windows dim stays at `ax`; the size dim moves to the END
+        return jnp.moveaxis(out, ax + 1, -1)
+
+    return unary_op("tensor_unfold", f, x)
 
 
 def atleast_1d(*inputs, name=None):

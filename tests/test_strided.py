@@ -1,0 +1,81 @@
+"""Strided views: as_strided / tensor unfold (reference phi/kernels/stride,
+tensor/manipulation.py:6959,7110)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_as_strided_matches_numpy():
+    x = paddle.to_tensor(np.arange(48, dtype=np.float32).reshape(2, 4, 6))
+    out = paddle.as_strided(x, [8, 6], [6, 1])
+    want = np.lib.stride_tricks.as_strided(
+        np.arange(48, dtype=np.float32), (8, 6), (6 * 4, 4))
+    np.testing.assert_array_equal(np.asarray(out.numpy()), want)
+
+
+def test_as_strided_offset_and_overlap():
+    x = paddle.to_tensor(np.arange(10, dtype=np.float32))
+    # overlapping windows: shape [4, 3], stride [2, 1], offset 1
+    out = np.asarray(paddle.as_strided(x, [4, 3], [2, 1], offset=1).numpy())
+    want = np.stack([np.arange(1 + 2 * i, 4 + 2 * i) for i in range(4)]).astype(np.float32)
+    np.testing.assert_array_equal(out, want)
+
+
+def test_as_strided_overlap_gradient_scatter_adds():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+    out = paddle.as_strided(x, [3, 2], [2, 1])  # rows [0,1],[2,3],[4,5]? no: stride 2 -> [0,1],[2,3],[4,5]
+    out2 = paddle.as_strided(x, [5, 2], [1, 1])  # overlapping: each inner elem reused
+    out2.sum().backward()
+    # element k appears in windows max(0, k-1)..min(k, 4): counts [1,2,2,2,2,1]
+    np.testing.assert_array_equal(np.asarray(x.grad.numpy()), [1, 2, 2, 2, 2, 1])
+
+
+def test_unfold_reference_example():
+    x = paddle.to_tensor(np.arange(9, dtype=np.float64))
+    out = np.asarray(paddle.unfold(x, 0, 2, 4).numpy())
+    np.testing.assert_array_equal(out, [[0.0, 1.0], [4.0, 5.0]])
+
+
+def test_unfold_middle_axis():
+    x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 6, 2))
+    out = paddle.unfold(x, 1, 3, 2)  # windows at 0, 2, 3 -> n=2? (6-3)//2+1 = 2
+    assert tuple(out.shape) == (2, 2, 2, 3)
+    np.testing.assert_array_equal(
+        np.asarray(out.numpy())[0, 0, 0], [0.0, 2.0, 4.0])  # x[0, 0:3, 0]
+
+
+def test_unfold_gradient():
+    x = paddle.to_tensor(np.ones(5, np.float32), stop_gradient=False)
+    paddle.unfold(x, 0, 3, 1).sum().backward()  # windows [0..2],[1..3],[2..4]
+    np.testing.assert_array_equal(np.asarray(x.grad.numpy()), [1, 2, 3, 2, 1])
+
+
+def test_as_strided_out_of_bounds_raises():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32))
+    with pytest.raises(ValueError, match="out of bounds"):
+        paddle.as_strided(x, [4, 3], [2, 1], offset=1)  # max index 9 on 6 elems
+
+
+def test_unfold_validation():
+    x = paddle.to_tensor(np.arange(5, dtype=np.float32))
+    with pytest.raises(ValueError, match="step must be positive"):
+        paddle.unfold(x, 0, 2, 0)
+    with pytest.raises(ValueError, match="exceeds dim"):
+        paddle.unfold(x, 0, 7, 1)
+
+
+def test_f_unfold_im2col_still_works():
+    """nn.functional.unfold keeps im2col semantics (regression: the top-level
+    rename must not break the patch extractor)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn import functional as F
+
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out = F.unfold(x, 2, strides=2)
+    assert tuple(out.shape) == (1, 4, 4)  # 2x2 patches at stride 2 -> 4 patches
+    np.testing.assert_array_equal(np.asarray(out.numpy())[0, :, 0], [0, 1, 4, 5])
+    layer = nn.Unfold(2, strides=2)
+    np.testing.assert_array_equal(np.asarray(layer(x).numpy()),
+                                  np.asarray(out.numpy()))

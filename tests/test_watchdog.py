@@ -1,0 +1,49 @@
+"""Comm watchdog (reference comm_task_manager.h:37 hang-detection role)."""
+
+import time
+
+import pytest
+
+from paddle_tpu.distributed import watchdog
+
+
+def test_fast_op_no_report(capsys):
+    with watchdog.watch("quick", timeout=1.0):
+        pass
+    time.sleep(0.05)
+    assert "comm-watchdog" not in capsys.readouterr().err
+
+
+def test_stuck_op_reports_and_calls_hook(capsys):
+    hits = []
+    with watchdog.watch("slow_barrier", timeout=0.1, on_timeout=hits.append) as dog:
+        time.sleep(0.4)
+    err = capsys.readouterr().err
+    assert "collective 'slow_barrier' stuck" in err
+    assert "test_watchdog" in err  # the waiting stack names this file
+    assert hits == ["slow_barrier"]
+    assert dog.timed_out
+
+
+def test_disabled_by_default():
+    with watchdog.watch("anything") as dog:
+        time.sleep(0.05)
+    assert dog is None  # no thread when no timeout configured
+
+
+def test_default_timeout_toggle(capsys):
+    watchdog.set_default_timeout(0.1)
+    try:
+        with watchdog.watch("global_to"):
+            time.sleep(0.3)
+        assert "global_to" in capsys.readouterr().err
+    finally:
+        watchdog.set_default_timeout(None)
+
+
+def test_interrupt_main_unblocks_stuck_caller():
+    """interrupt_main=True delivers KeyboardInterrupt into the blocked main
+    thread — the documented elastic-relaunch escape hatch."""
+    with pytest.raises(KeyboardInterrupt):
+        with watchdog.watch("dead_peer", timeout=0.1, interrupt_main=True):
+            time.sleep(5.0)  # simulates a hung collective

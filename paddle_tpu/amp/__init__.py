@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from ..framework import dispatch
 from ..framework.dtype import convert_dtype
+from . import debugging  # noqa: F401
 from ..framework.tensor import Tensor
 
 __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "is_auto_cast_enabled",
@@ -233,14 +234,4 @@ class GradScaler:
         self._bad_steps = state.get("bad_steps", 0)
 
 
-class debugging:
-    """Placeholder namespace mirroring ``paddle.amp.debugging`` (tensor checks)."""
 
-    @staticmethod
-    def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
-        import jax.numpy as jnp
-
-        bad = bool(jnp.any(~jnp.isfinite(tensor._data)))
-        if bad:
-            raise FloatingPointError(f"non-finite values in {op_type}:{var_name}")
-        return tensor

@@ -1,0 +1,101 @@
+"""AMP debugging tools (reference ``python/paddle/amp/debugging.py``):
+per-op dtype call statistics + numerics checking for mixed-precision runs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dispatch as _dispatch
+from ..framework.tensor import Tensor
+
+__all__ = ["enable_operator_stats_collection", "disable_operator_stats_collection",
+           "collect_operator_stats", "operator_stats", "check_numerics",
+           "TensorChecker"]
+
+
+def enable_operator_stats_collection():
+    """Start counting every dispatched op by (name, output dtype) — the
+    reference's low/mid-precision op audit for auto_cast tuning."""
+    _dispatch._OP_STATS = {}
+
+
+def disable_operator_stats_collection(print_table: bool = True):
+    """Stop collecting; optionally print the table. Returns the raw stats."""
+    stats = _dispatch._OP_STATS or {}
+    _dispatch._OP_STATS = None
+    if print_table and stats:
+        _print_table(stats)
+    return stats
+
+
+def operator_stats() -> Dict[Tuple[str, str], int]:
+    return dict(_dispatch._OP_STATS or {})
+
+
+def _print_table(stats):
+    by_op: Dict[str, Dict[str, int]] = {}
+    dtypes = set()
+    for (op, dt), n in stats.items():
+        by_op.setdefault(op, {})[dt] = by_op.setdefault(op, {}).get(dt, 0) + n
+        dtypes.add(dt)
+    cols = sorted(dtypes)
+    width = max(len(op) for op in by_op) + 2
+    print(f"{'op':<{width}}" + "".join(f"{c:>12}" for c in cols), file=sys.stderr)
+    for op in sorted(by_op):
+        row = "".join(f"{by_op[op].get(c, 0):>12}" for c in cols)
+        print(f"{op:<{width}}" + row, file=sys.stderr)
+
+
+@contextlib.contextmanager
+def collect_operator_stats(print_table: bool = True):
+    """``with collect_operator_stats(): ...`` (reference context form)."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection(print_table)
+
+
+def check_numerics(x, op_type: str = "", var_name: str = "", debug_mode="abort"):
+    """Count NaN/Inf in a tensor (reference ``check_numerics``).
+
+    ``debug_mode``: ``"abort"`` (reference default CHECK_NAN_INF_AND_ABORT —
+    raises FloatingPointError on any non-finite value) or ``"print"`` (report
+    to stderr only).  Returns ``(num_nan, num_inf)``.
+    """
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if not jnp.issubdtype(a.dtype, jnp.floating):
+        return 0, 0
+    n_nan = int(jnp.isnan(a).sum())
+    n_inf = int(jnp.isinf(a).sum())
+    if n_nan or n_inf:
+        msg = (f"[check_numerics] {op_type or 'tensor'}:{var_name} {n_nan} NaN, "
+               f"{n_inf} Inf in shape {tuple(a.shape)} {a.dtype}")
+        if debug_mode == "abort":
+            raise FloatingPointError(msg)
+        print(msg, file=sys.stderr)
+    return n_nan, n_inf
+
+
+class TensorChecker:
+    """Reference-shaped config object enabling a global NaN/Inf sweep via the
+    framework's sanitizer flag (``FLAGS_check_nan_inf`` role)."""
+
+    def __init__(self, enable: bool = True, debug_mode=None, output_dir=None):
+        self.enable = enable
+
+    def start_check_nan_inf(self):
+        from ..framework import flags
+
+        flags.set_flags({"check_nan_inf": bool(self.enable)})
+
+    def stop_check_nan_inf(self):
+        from ..framework import flags
+
+        flags.set_flags({"check_nan_inf": False})

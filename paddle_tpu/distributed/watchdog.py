@@ -62,6 +62,8 @@ class CommWatchdog:
     def _run(self):
         if self._done.wait(self.timeout):
             return
+        if self._done.is_set():  # finished at ~timeout: not stuck, no report
+            return
         self.timed_out = True
         elapsed = time.monotonic() - self.started_at
         frames = sys._current_frames().get(self._main.ident)
@@ -71,7 +73,9 @@ class CommWatchdog:
         print(msg, file=sys.stderr)
         if self.on_timeout is not None:
             self.on_timeout(self.op_name)
-        if self.interrupt_main:
+        if self.interrupt_main and not self._done.is_set():
+            # last-instant recheck: an op that completed while the report was
+            # printing must not take a stray KeyboardInterrupt later
             import _thread
 
             _thread.interrupt_main()  # KeyboardInterrupt in the blocked caller

@@ -36,6 +36,10 @@ class _AmpState:
 
 amp_state = _AmpState()
 
+# op-call stats collection (paddle.amp.debugging): None = off; a dict maps
+# (op_name, output_dtype) -> call count while a collection context is active
+_OP_STATS = None
+
 
 def _amp_cast(name: str, datas: tuple) -> tuple:
     """Per-op input casting under auto_cast (reference: eager_gen.py AMP template).
@@ -132,6 +136,11 @@ def apply_op(
 
     if flags.get_flag("check_nan_inf"):
         _check_nan_inf(name, [r._data for r in results])
+
+    if _OP_STATS is not None:
+        for r in results:
+            k = (name, str(r._data.dtype))
+            _OP_STATS[k] = _OP_STATS.get(k, 0) + 1
 
     if num_outputs == 1 and not multi:
         return results[0]

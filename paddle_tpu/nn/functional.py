@@ -1326,9 +1326,11 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
 
     def f(a):
         n, c, h, w = a.shape
+        # paddle's 4-int paddings are [top, left, bottom, right]; JAX wants
+        # per-spatial-dim (low, high): H=(top, bottom), W=(left, right)
         patches = jax.lax.conv_general_dilated_patches(
             a, filter_shape=ks, window_strides=st,
-            padding=[(pd[0], pd[0]), (pd[1], pd[1])] if len(pd) == 2 else [(pd[0], pd[1]), (pd[2], pd[3])],
+            padding=[(pd[0], pd[0]), (pd[1], pd[1])] if len(pd) == 2 else [(pd[0], pd[2]), (pd[1], pd[3])],
             rhs_dilation=dl, dimension_numbers=("NCHW", "OIHW", "NCHW"))
         return patches.reshape(n, c * ks[0] * ks[1], -1)
 

@@ -537,14 +537,17 @@ def as_strided(x, shape, stride, offset=0, name=None):
     stride = int_list(stride)
     if len(shape) != len(stride):
         raise ValueError(f"shape rank {len(shape)} != stride rank {len(stride)}")
-    # static bounds check: JAX gather CLAMPS out-of-bounds indices silently,
-    # but the reference raises — and silent clamping returns garbage rows
-    max_index = offset + sum((s - 1) * st for s, st in zip(shape, stride) if s > 0)
+    # static bounds check: JAX gather CLAMPS out-of-bounds indices (and WRAPS
+    # negatives) silently, but the reference raises — and either returns
+    # garbage rows.  Negative strides are legal as long as every index lands
+    # in [0, n_elems).
+    max_index = offset + sum((s - 1) * st for s, st in zip(shape, stride) if st > 0 and s > 0)
+    min_index = offset + sum((s - 1) * st for s, st in zip(shape, stride) if st < 0 and s > 0)
     n_elems = int(np.prod(x.shape)) if len(x.shape) else 1
-    if offset < 0 or (0 not in shape and max_index >= n_elems):
+    if 0 not in shape and (min_index < 0 or max_index >= n_elems):
         raise ValueError(
-            f"as_strided out of bounds: max flat index {max_index} (offset "
-            f"{offset}) on a tensor of {n_elems} elements")
+            f"as_strided out of bounds: flat index range [{min_index}, {max_index}] "
+            f"(offset {offset}) on a tensor of {n_elems} elements")
 
     def f(a):
         flat = a.reshape(-1)

@@ -4,7 +4,8 @@ from __future__ import annotations
 
 from .. import nn
 
-__all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152", "VGG", "vgg16"]
+__all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152", "VGG", "vgg16",
+           "MobileNetV3", "mobilenet_v3_small", "mobilenet_v3_large"]
 
 
 class LeNet(nn.Layer):
@@ -187,3 +188,130 @@ def _vgg_layers(cfg, batch_norm=False):
 def vgg16(pretrained=False, batch_norm=False, **kwargs):
     cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M", 512, 512, 512, "M"]
     return VGG(_vgg_layers(cfg, batch_norm), **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV3 (reference ``python/paddle/vision/models/mobilenetv3.py`` — the
+# PP-OCR backbone family: depthwise-separable convs, SE blocks, hardswish)
+# ---------------------------------------------------------------------------
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class SqueezeExcitation(nn.Layer):
+    def __init__(self, channels, reduced):
+        super().__init__()
+        self.fc1 = nn.Conv2D(channels, reduced, 1)
+        self.fc2 = nn.Conv2D(reduced, channels, 1)
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        s = F.adaptive_avg_pool2d(x, 1)
+        s = F.relu(self.fc1(s))
+        s = F.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class InvertedResidual(nn.Layer):
+    """expand (1x1) -> depthwise (kxk) -> [SE] -> project (1x1), residual when
+    stride 1 and channels match."""
+
+    def __init__(self, in_c, exp_c, out_c, kernel, stride, use_se, act):
+        super().__init__()
+        from ..nn import functional as F
+
+        self._residual = stride == 1 and in_c == out_c
+        self._act = F.hardswish if act == "hardswish" else F.relu
+        layers = []
+        if exp_c != in_c:
+            layers += [nn.Conv2D(in_c, exp_c, 1, bias_attr=False), nn.BatchNorm2D(exp_c)]
+        self.expand = nn.Sequential(*layers) if layers else None
+        self.dw = nn.Conv2D(exp_c, exp_c, kernel, stride=stride,
+                            padding=kernel // 2, groups=exp_c, bias_attr=False)
+        self.dw_bn = nn.BatchNorm2D(exp_c)
+        self.se = SqueezeExcitation(exp_c, _make_divisible(exp_c // 4)) if use_se else None
+        self.project = nn.Conv2D(exp_c, out_c, 1, bias_attr=False)
+        self.project_bn = nn.BatchNorm2D(out_c)
+
+    def forward(self, x):
+        out = x
+        if self.expand is not None:
+            out = self._act(self.expand(out))
+        out = self._act(self.dw_bn(self.dw(out)))
+        if self.se is not None:
+            out = self.se(out)
+        out = self.project_bn(self.project(out))
+        return x + out if self._residual else out
+
+
+# (kernel, exp, out, SE, act, stride) rows from the paper / reference config
+_MOBILENETV3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+_MOBILENETV3_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        in_c = _make_divisible(16 * scale)
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, in_c, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(in_c), nn.Hardswish())
+        blocks = []
+        for k, exp, out, se, act, s in config:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            blocks.append(InvertedResidual(in_c, exp_c, out_c, k, s, se, act))
+            in_c = out_c
+        self.blocks = nn.Sequential(*blocks)
+        last_exp = _make_divisible(config[-1][1] * scale)
+        self.head_conv = nn.Sequential(
+            nn.Conv2D(in_c, last_exp, 1, bias_attr=False),
+            nn.BatchNorm2D(last_exp), nn.Hardswish())
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        self.feat_channels = last_exp
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_exp, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        from ..nn import functional as F
+        from ..ops.manipulation import flatten
+
+        x = self.head_conv(self.blocks(self.stem(x)))
+        if self.with_pool:
+            x = F.adaptive_avg_pool2d(x, 1)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3(_MOBILENETV3_SMALL, last_channel=1024, scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3(_MOBILENETV3_LARGE, last_channel=1280, scale=scale, **kwargs)

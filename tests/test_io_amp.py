@@ -136,3 +136,56 @@ def test_profiler_record_event():
         paddle.randn([10]).sum()
     prof.stop()
     assert "my_op" in prof.summary()
+
+
+class TestAmpDebugging:
+    def test_operator_stats_collection(self, capsys):
+        from paddle_tpu.amp import debugging
+
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        with debugging.collect_operator_stats(print_table=False):
+            _ = x + x
+            _ = (x * 2).astype("bfloat16") if hasattr(x, "astype") else x * 2
+            stats = debugging.operator_stats()
+        assert any(op == "add" for op, _ in stats)
+        # collection is off outside the context
+        _ = x + x
+        assert debugging.operator_stats() == {}
+
+    def test_operator_stats_table_prints(self, capsys):
+        from paddle_tpu.amp import debugging
+
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        with debugging.collect_operator_stats():
+            _ = x * x
+        err = capsys.readouterr().err
+        assert "op" in err and "multiply" in err
+
+    def test_check_numerics(self, capsys):
+        from paddle_tpu.amp import debugging
+
+        bad = paddle.to_tensor(np.asarray([1.0, np.nan, np.inf, -np.inf], np.float32))
+        # reference default CHECK_NAN_INF_AND_ABORT: raises
+        with pytest.raises(FloatingPointError, match="probe"):
+            debugging.check_numerics(bad, "probe", "out")
+        # print mode reports and returns counts
+        n_nan, n_inf = debugging.check_numerics(bad, "probe", debug_mode="print")
+        assert (n_nan, n_inf) == (1, 2)
+        assert "probe" in capsys.readouterr().err
+        ok = paddle.to_tensor(np.ones(3, np.float32))
+        assert debugging.check_numerics(ok) == (0, 0)
+
+    def test_tensor_checker_toggles_flag(self):
+        from paddle_tpu.amp.debugging import TensorChecker
+        from paddle_tpu.framework import flags
+
+        tc = TensorChecker(enable=True)
+        tc.start_check_nan_inf()
+        try:
+            assert flags.get_flag("check_nan_inf")
+            bad = paddle.to_tensor(np.asarray([1.0], np.float32))
+            with pytest.raises(FloatingPointError):
+                _ = bad / paddle.to_tensor(np.asarray([0.0], np.float32)) * 0.0
+        finally:
+            tc.stop_check_nan_inf()
+        assert not flags.get_flag("check_nan_inf")

@@ -79,3 +79,26 @@ def test_f_unfold_im2col_still_works():
     layer = nn.Unfold(2, strides=2)
     np.testing.assert_array_equal(np.asarray(layer(x).numpy()),
                                   np.asarray(out.numpy()))
+
+
+def test_as_strided_negative_stride():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32))
+    out = np.asarray(paddle.as_strided(x, [3], [-1], offset=5).numpy())
+    np.testing.assert_array_equal(out, [5.0, 4.0, 3.0])  # reversed walk
+    with pytest.raises(ValueError, match="out of bounds"):
+        paddle.as_strided(x, [3], [-1])  # offset 0 -> index -2 would wrap
+
+
+def test_f_unfold_asymmetric_padding():
+    """4-int paddings are [top, left, bottom, right] (reference layout)."""
+    from paddle_tpu.nn import functional as F
+
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+    # pad H by 1 top+bottom, no W padding: output column count = 3x1 windows
+    out = F.unfold(x, [2, 2], strides=1, paddings=[1, 0, 1, 0])
+    assert tuple(out.shape) == (1, 4, 3)
+    got = np.asarray(out.numpy())[0]
+    # first window covers padded row + row0: values [0,0,0,1]
+    np.testing.assert_array_equal(got[:, 0], [0, 0, 0, 1])
+    # last window covers row1 + padded row
+    np.testing.assert_array_equal(got[:, 2], [2, 3, 0, 0])

@@ -1,0 +1,39 @@
+"""Vision model zoo additions (reference ``python/paddle/vision/models``)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+
+
+class TestMobileNetV3:
+    def test_forward_and_train(self):
+        from paddle_tpu.vision.models import mobilenet_v3_small
+
+        paddle.seed(0)
+        m = mobilenet_v3_small(num_classes=4, scale=0.5)
+        opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                        parameters=m.parameters())
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(4, 3, 32, 32)).astype(np.float32))
+        y = paddle.to_tensor(np.asarray([0, 1, 2, 3], np.int64))
+
+        import paddle_tpu.nn as nn
+
+        def loss_fn(mm, x, y):
+            return nn.CrossEntropyLoss()(mm(x), y)
+
+        step = paddle.jit.TrainStep(m, loss_fn, opt)
+        losses = [float(step(x, y).numpy()) for _ in range(8)]
+        assert losses[-1] < losses[0]
+
+    def test_backbone_mode(self):
+        from paddle_tpu.vision.models import mobilenet_v3_large
+
+        paddle.seed(1)
+        m = mobilenet_v3_large(num_classes=0, with_pool=False, scale=0.35)
+        x = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+        feat = m(x)
+        assert feat.shape[2] == 2 and feat.shape[3] == 2  # stride 32

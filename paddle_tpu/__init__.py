@@ -59,6 +59,8 @@ from . import device  # noqa: F401
 from . import distribution  # noqa: F401
 from . import sparse  # noqa: F401
 from . import quantization  # noqa: F401
+from . import geometric  # noqa: F401
+from . import text  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from . import linalg as _linalg_ns  # noqa: F401

@@ -28,7 +28,7 @@ __all__ = [
     "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
     "Exponential", "Gamma", "Beta", "Dirichlet", "Laplace", "LogNormal",
     "Gumbel", "Cauchy", "Geometric", "Poisson", "Binomial", "Multinomial",
-    "Chi2", "StudentT", "Independent", "TransformedDistribution",
+    "Chi2", "StudentT", "MultivariateNormal", "Independent", "TransformedDistribution",
     "kl_divergence", "register_kl",
     "Transform", "AffineTransform", "ExpTransform", "SigmoidTransform",
     "TanhTransform", "PowerTransform", "ChainTransform", "SoftmaxTransform",
@@ -463,6 +463,68 @@ class StudentT(Distribution):
     def _variance(self):
         v = self.scale ** 2 * self.df / (self.df - 2)
         return jnp.where(self.df > 2, jnp.broadcast_to(v, self.batch_shape), jnp.nan)
+
+
+class MultivariateNormal(Distribution):
+    """N(loc, covariance_matrix) (reference ``multivariate_normal.py``)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None, name=None):
+        self._param("loc", loc)
+        if (covariance_matrix is None) == (scale_tril is None):
+            raise ValueError("pass exactly one of covariance_matrix / scale_tril")
+        if covariance_matrix is not None:
+            self._param("covariance_matrix", covariance_matrix)
+            self._from_cov = True
+            mat_batch = self.covariance_matrix.shape[:-2]
+        else:
+            self._param("scale_tril", scale_tril)
+            self._from_cov = False
+            mat_batch = self.scale_tril.shape[:-2]
+        # batch shape broadcasts over ALL parameters (like every other dist):
+        # an unbatched loc with a batched covariance must batch the dist
+        batch = jnp.broadcast_shapes(self.loc.shape[:-1], mat_batch)
+        self.loc = jnp.broadcast_to(self.loc, batch + self.loc.shape[-1:])
+        super().__init__(batch, self.loc.shape[-1:])
+
+    def _tril(self):
+        batch = self.batch_shape
+        d = self.event_shape[0]
+        if self._from_cov:
+            L = jnp.linalg.cholesky(self.covariance_matrix)
+        else:
+            L = self.scale_tril
+        return jnp.broadcast_to(L, batch + (d, d))
+
+    def _rsample(self, key, shape):
+        shp = shape + self.batch_shape + self.event_shape
+        eps = jax.random.normal(key, shp, jnp.float32)
+        return self.loc + jnp.einsum("...ij,...j->...i", self._tril(), eps)
+
+    def _log_prob(self, x):
+        L = self._tril()
+        d = self.event_shape[0]
+        diff = x - self.loc
+        z = jax.scipy.linalg.solve_triangular(L, diff[..., None], lower=True)[..., 0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+        return (-0.5 * jnp.sum(z ** 2, -1) - half_logdet
+                - 0.5 * d * math.log(2 * math.pi))
+
+    def _entropy(self):
+        L = self._tril()
+        d = self.event_shape[0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+        return 0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet
+
+    def _mean(self):
+        # under taped rebinding self.loc may be the raw (unbroadcast) param
+        return jnp.broadcast_to(self.loc, self.batch_shape + self.event_shape)
+
+    def _variance(self):
+        if self._from_cov:  # diag(S) directly — no Cholesky needed
+            v = jnp.diagonal(self.covariance_matrix, axis1=-2, axis2=-1)
+            return jnp.broadcast_to(v, self.batch_shape + self.event_shape)
+        L = self._tril()
+        return jnp.sum(L ** 2, axis=-1)
 
 
 # ---------------------------------------------------------------------------
@@ -953,6 +1015,19 @@ def _kl_dirichlet_dirichlet(p, q):
     return (gl(a0) - jnp.sum(gl(a), -1)
             - jax.scipy.special.gammaln(jnp.sum(b, -1)) + jnp.sum(gl(b), -1)
             + jnp.sum((a - b) * (dg(a) - dg(a0)[..., None]), -1))
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    Lp, Lq = p._tril(), q._tril()
+    d = p.event_shape[0]
+    diff = q.loc - p.loc
+    # tr(Sq^-1 Sp) = ||Lq^-1 Lp||_F^2 ; maha = ||Lq^-1 diff||^2
+    M = jax.scipy.linalg.solve_triangular(Lq, Lp, lower=True)
+    z = jax.scipy.linalg.solve_triangular(Lq, diff[..., None], lower=True)[..., 0]
+    logdet = (jnp.sum(jnp.log(jnp.diagonal(Lq, axis1=-2, axis2=-1)), -1)
+              - jnp.sum(jnp.log(jnp.diagonal(Lp, axis1=-2, axis2=-1)), -1))
+    return logdet + 0.5 * (jnp.sum(M ** 2, (-2, -1)) + jnp.sum(z ** 2, -1) - d)
 
 
 @register_kl(Poisson, Poisson)

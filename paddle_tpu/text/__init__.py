@@ -1,0 +1,98 @@
+"""``paddle.text`` — text utilities (reference ``python/paddle/text/``:
+``viterbi_decode.py`` + dataset conveniences).
+
+TPU-native: the Viterbi DP runs as a ``lax.scan`` over time (compiles to one
+fused program; the reference has a dedicated ``viterbi_decode`` CUDA kernel).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import apply_op
+from ..framework.tensor import Tensor
+from ..nn.layers import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _raw(v):
+    return v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """Highest-scoring tag path per sequence (reference
+    ``text/viterbi_decode.py:31``).
+
+    potentials: ``[B, S, T]`` emissions; transition_params: ``[T, T]``;
+    lengths: ``[B]``.  Returns ``(scores [B], paths [B, S])`` — positions past
+    each sequence's length hold 0 (the reference pads the same way).
+    """
+    lengths_r = jnp.asarray(_raw(lengths), jnp.int32)
+
+    def f(pot, trans):
+        B, S, T = pot.shape
+        pot = pot.astype(jnp.float32)
+        trans = trans.astype(jnp.float32)
+        if include_bos_eos_tag:
+            # last tag = BOS, second-to-last = EOS (reference convention):
+            # sequences start from BOS and must end transitioning to EOS
+            start = pot[:, 0] + trans[T - 1][None, :]
+        else:
+            start = pot[:, 0]
+
+        def step(carry, inp):
+            alpha, t_idx = carry
+            emit = inp  # [B, T]
+            # scores[b, i, j] = alpha[b, i] + trans[i, j] + emit[b, j]
+            cand = alpha[:, :, None] + trans[None, :, :]
+            best_prev = jnp.argmax(cand, axis=1)  # [B, T]
+            alpha_new = jnp.max(cand, axis=1) + emit
+            # sequences already past their length keep their alpha frozen
+            active = (t_idx < lengths_r)[:, None]
+            alpha_out = jnp.where(active, alpha_new, alpha)
+            return (alpha_out, t_idx + 1), jnp.where(active, best_prev, -1)
+
+        (alpha, _), backptrs = jax.lax.scan(
+            step, (start, jnp.ones((), jnp.int32)), jnp.moveaxis(pot[:, 1:], 1, 0))
+        # backptrs: [S-1, B, T]
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, T - 2][None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)  # [B]
+
+        def walk(carry, bp_t):
+            tag, t_idx = carry
+            # bp_t: [B, T] backpointers for step t_idx (or -1 when inactive)
+            prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+            new_tag = jnp.where(prev >= 0, prev, tag).astype(jnp.int32)
+            return (new_tag, t_idx - 1), tag
+
+        (first_tag, _), rev_path = jax.lax.scan(
+            walk, (last_tag, jnp.asarray(S - 1, jnp.int32)), backptrs, reverse=True)
+        # rev_path[t] holds the tag at position t+1; prepend the first tag
+        path = jnp.concatenate([first_tag[:, None],
+                                jnp.moveaxis(rev_path, 0, 1)], axis=1)  # [B, S]
+        # zero out positions past each length (reference padding)
+        mask = jnp.arange(S)[None, :] < lengths_r[:, None]
+        return scores, jnp.where(mask, path, 0).astype(jnp.int64)
+
+    pt = potentials if isinstance(potentials, Tensor) else Tensor(_raw(potentials))
+    tr = transition_params if isinstance(transition_params, Tensor) else Tensor(_raw(transition_params))
+    return apply_op("viterbi_decode", f, (pt, tr), {}, num_outputs=2)
+
+
+class ViterbiDecoder(Layer):
+    """Layer form (reference ``text.ViterbiDecoder``)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) else Tensor(jnp.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -218,3 +218,63 @@ class TestTransforms:
         assert _np(t.inverse(y)) == pytest.approx(0.3, abs=1e-6)
         # ldj = log(2) + 2x
         assert _np(t.forward_log_det_jacobian(x)) == pytest.approx(math.log(2) + 0.6, abs=1e-5)
+
+
+class TestMultivariateNormal:
+    def _dist(self):
+        cov = np.asarray([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        return D.MultivariateNormal(np.asarray([1.0, -1.0], np.float32), cov), cov
+
+    def test_log_prob_vs_scipy(self):
+        from scipy import stats
+
+        d, cov = self._dist()
+        x = np.asarray([0.3, 0.7], np.float32)
+        want = stats.multivariate_normal.logpdf(x, [1.0, -1.0], cov)
+        assert float(_np(d.log_prob(x))) == pytest.approx(want, abs=1e-5)
+
+    def test_entropy_and_sampling(self):
+        from scipy import stats
+
+        paddle.seed(11)
+        d, cov = self._dist()
+        assert float(_np(d.entropy())) == pytest.approx(
+            stats.multivariate_normal([1.0, -1.0], cov).entropy(), abs=1e-5)
+        s = _np(d.sample([40000]))
+        np.testing.assert_allclose(s.mean(0), [1.0, -1.0], atol=0.05)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.06)
+
+    def test_kl_identical_zero_and_vs_mc(self):
+        d, cov = self._dist()
+        assert float(_np(D.kl_divergence(d, d))) == pytest.approx(0.0, abs=1e-6)
+        q = D.MultivariateNormal(np.zeros(2, np.float32), np.eye(2, dtype=np.float32))
+        kl = float(_np(D.kl_divergence(d, q)))
+        # closed form: 0.5*(tr(S) + mu^T mu - d - logdet S)
+        want = 0.5 * (np.trace(cov) + 2.0 - 2 - np.log(np.linalg.det(cov)))
+        assert kl == pytest.approx(want, abs=1e-5)
+
+    def test_scale_tril_form(self):
+        L = np.linalg.cholesky(np.asarray([[2.0, 0.5], [0.5, 1.0]])).astype(np.float32)
+        d = D.MultivariateNormal(np.zeros(2, np.float32), scale_tril=L)
+        d2, _ = self._dist()
+        x = np.asarray([0.1, 0.2], np.float32)
+        got = float(_np(d.log_prob(x)))
+        want = float(_np(D.MultivariateNormal(np.zeros(2, np.float32),
+                                              L @ L.T).log_prob(x)))
+        assert got == pytest.approx(want, abs=1e-5)
+
+    def test_batched_covariance_unbatched_loc(self):
+        covs = np.stack([np.eye(2), 2 * np.eye(2)]).astype(np.float32)
+        d = D.MultivariateNormal(np.zeros(2, np.float32), covs)
+        assert d.batch_shape == (2,)
+        paddle.seed(0)
+        s = _np(d.sample([3]))
+        assert s.shape == (3, 2, 2)
+        lp = _np(d.log_prob(np.zeros((2, 2), np.float32)))
+        assert lp.shape == (2,)
+        from scipy import stats
+
+        assert lp[1] == pytest.approx(
+            stats.multivariate_normal(np.zeros(2), 2 * np.eye(2)).logpdf(np.zeros(2)),
+            abs=1e-5)
+        np.testing.assert_allclose(_np(d.variance), [[1, 1], [2, 2]], rtol=1e-6)

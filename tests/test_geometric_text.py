@@ -1,0 +1,122 @@
+"""paddle.geometric segment ops + paddle.text viterbi_decode
+(reference ``python/paddle/geometric/math.py``, ``text/viterbi_decode.py``)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric, text
+
+
+class TestSegmentOps:
+    def test_segment_sum_mean_max_min(self):
+        data = paddle.to_tensor(np.asarray([[1., 2.], [3., 4.], [5., 6.], [7., 8.]], np.float32))
+        ids = np.asarray([0, 0, 1, 1])
+        np.testing.assert_array_equal(np.asarray(geometric.segment_sum(data, ids).numpy()),
+                                      [[4, 6], [12, 14]])
+        np.testing.assert_array_equal(np.asarray(geometric.segment_mean(data, ids).numpy()),
+                                      [[2, 3], [6, 7]])
+        np.testing.assert_array_equal(np.asarray(geometric.segment_max(data, ids).numpy()),
+                                      [[3, 4], [7, 8]])
+        np.testing.assert_array_equal(np.asarray(geometric.segment_min(data, ids).numpy()),
+                                      [[1, 2], [5, 6]])
+
+    def test_empty_segment_is_zero(self):
+        data = paddle.to_tensor(np.ones((2, 3), np.float32))
+        out = geometric.segment_max(data, np.asarray([0, 2]), num_segments=3)
+        np.testing.assert_array_equal(np.asarray(out.numpy())[1], [0, 0, 0])
+
+    def test_segment_sum_gradient(self):
+        data = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2),
+                                stop_gradient=False)
+        out = geometric.segment_sum(data, np.asarray([0, 1, 1]))
+        (out * paddle.to_tensor(np.asarray([[1., 2.], [3., 4.]], np.float32))).sum().backward()
+        np.testing.assert_array_equal(np.asarray(data.grad.numpy()),
+                                      [[1, 2], [3, 4], [3, 4]])
+
+
+class TestMessagePassing:
+    def test_send_u_recv_sum(self):
+        x = paddle.to_tensor(np.asarray([[1.], [2.], [4.]], np.float32))
+        src = np.asarray([0, 1, 2, 0])
+        dst = np.asarray([1, 2, 1, 2])
+        out = geometric.send_u_recv(x, src, dst, "sum")
+        # node1 <- x0 + x2 = 5; node2 <- x1 + x0 = 3
+        np.testing.assert_array_equal(np.asarray(out.numpy()), [[0], [5], [3]])
+
+    def test_send_u_recv_mean_out_size(self):
+        x = paddle.to_tensor(np.asarray([[2.], [4.]], np.float32))
+        out = geometric.send_u_recv(x, np.asarray([0, 1]), np.asarray([0, 0]),
+                                    "mean", out_size=4)
+        np.testing.assert_array_equal(np.asarray(out.numpy()), [[3], [0], [0], [0]])
+
+    def test_send_ue_recv(self):
+        x = paddle.to_tensor(np.asarray([[1.], [2.]], np.float32))
+        e = paddle.to_tensor(np.asarray([[10.], [20.]], np.float32))
+        out = geometric.send_ue_recv(x, e, np.asarray([0, 1]), np.asarray([1, 0]),
+                                     "add", "sum")
+        np.testing.assert_array_equal(np.asarray(out.numpy()), [[22], [11]])
+
+
+class TestViterbi:
+    def _np_viterbi(self, pot, trans, length, bos_eos):
+        """Brute force over all tag paths for one sequence."""
+        import itertools
+
+        T = pot.shape[-1]
+        best, best_path = -np.inf, None
+        for path in itertools.product(range(T), repeat=length):
+            s = pot[0, path[0]] + (trans[T - 1, path[0]] if bos_eos else 0.0)
+            for t in range(1, length):
+                s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+            if bos_eos:
+                s += trans[path[-1], T - 2]
+            if s > best:
+                best, best_path = s, path
+        return best, list(best_path)
+
+    @pytest.mark.parametrize("bos_eos", [False, True])
+    def test_matches_brute_force(self, bos_eos):
+        rng = np.random.default_rng(0)
+        B, S, T = 2, 5, 4
+        pot = rng.normal(size=(B, S, T)).astype(np.float32)
+        trans = rng.normal(size=(T, T)).astype(np.float32)
+        lengths = np.asarray([5, 3], np.int64)
+        scores, paths = text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lengths), include_bos_eos_tag=bos_eos)
+        scores = np.asarray(scores.numpy())
+        paths = np.asarray(paths.numpy())
+        for b in range(B):
+            want_s, want_p = self._np_viterbi(pot[b], trans, int(lengths[b]), bos_eos)
+            assert scores[b] == pytest.approx(want_s, abs=1e-4), b
+            np.testing.assert_array_equal(paths[b, :int(lengths[b])], want_p)
+            assert np.all(paths[b, int(lengths[b]):] == 0)
+
+    def test_layer_form(self):
+        rng = np.random.default_rng(1)
+        trans = rng.normal(size=(3, 3)).astype(np.float32)
+        dec = text.ViterbiDecoder(paddle.to_tensor(trans), include_bos_eos_tag=False)
+        pot = paddle.to_tensor(rng.normal(size=(1, 4, 3)).astype(np.float32))
+        scores, paths = dec(pot, paddle.to_tensor(np.asarray([4], np.int64)))
+        assert tuple(paths.shape) == (1, 4)
+
+
+class TestReviewRegressions:
+    def test_int_dtype_survives_segment_max(self):
+        data = paddle.to_tensor(np.asarray([[3], [7]], np.int32))
+        out = geometric.segment_max(data, np.asarray([0, 2]), num_segments=3)
+        arr = np.asarray(out.numpy())
+        assert arr.dtype == np.int32
+        np.testing.assert_array_equal(arr, [[3], [0], [7]])
+
+    def test_neg_inf_max_passes_through(self):
+        data = paddle.to_tensor(np.asarray([[-np.inf], [5.0]], np.float32))
+        out = np.asarray(geometric.segment_max(data, np.asarray([0, 1])).numpy())
+        assert out[0, 0] == -np.inf and out[1, 0] == 5.0
+
+    def test_send_ue_recv_bad_reduce_op_raises(self):
+        x = paddle.to_tensor(np.ones((2, 1), np.float32))
+        with pytest.raises(ValueError, match="reduce_op"):
+            geometric.send_ue_recv(x, x, np.asarray([0, 1]), np.asarray([0, 1]),
+                                   "add", "bogus")

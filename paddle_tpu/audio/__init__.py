@@ -1,0 +1,20 @@
+"""``paddle.audio`` — audio feature extraction.
+
+Counterpart of the reference's ``python/paddle/audio/`` (``features/layers.py``
+Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC, ``functional/window.py``,
+``functional/functional.py`` mel/dct helpers).
+
+TPU-native: framing is a strided gather, the STFT is ``jnp.fft.rfft`` over
+frames, mel/DCT are small matmuls — everything jit-compiles into one program
+(the reference routes through its fft + matmul kernels the same way).
+"""
+
+from . import functional  # noqa: F401
+from .features import (  # noqa: F401
+    MFCC,
+    LogMelSpectrogram,
+    MelSpectrogram,
+    Spectrogram,
+)
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

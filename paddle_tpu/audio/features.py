@@ -1,0 +1,137 @@
+"""Audio feature layers (reference ``python/paddle/audio/features/layers.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import apply_op
+from ..framework.tensor import Tensor
+from ..nn.layers import Layer
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _frames(x, n_fft: int, hop: int, center: bool, pad_mode: str):
+    """[..., T] -> [..., n_frames, n_fft] sliding windows."""
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    T = x.shape[-1]
+    n_frames = 1 + (T - n_fft) // hop
+    idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(n_fft)[None, :]
+    return x[..., idx]
+
+
+class Spectrogram(Layer):
+    """STFT magnitude^power: output [..., n_fft//2+1, n_frames]
+    (reference ``features/layers.py:47``)."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True, pad_mode: str = "reflect",
+                 dtype=None):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        if self.win_length > n_fft:
+            raise ValueError(f"win_length {self.win_length} must be <= n_fft {n_fft}")
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = AF.get_window(window, self.win_length)
+        if self.win_length < n_fft:  # center-pad the window to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            w = np.pad(w, (lpad, n_fft - self.win_length - lpad))
+        self._window = jnp.asarray(w)
+
+    def forward(self, x):
+        win, n_fft, hop = self._window, self.n_fft, self.hop_length
+        center, pad_mode, power = self.center, self.pad_mode, self.power
+
+        def f(a):
+            fr = _frames(a, n_fft, hop, center, pad_mode)  # [..., F, n_fft]
+            spec = jnp.fft.rfft(fr * win, axis=-1)  # [..., F, n_fft//2+1]
+            mag = jnp.abs(spec) ** power
+            return jnp.swapaxes(mag, -1, -2)  # [..., bins, frames]
+
+        return apply_op("spectrogram", f,
+                        (x if isinstance(x, Tensor) else Tensor(jnp.asarray(x)),), {})
+
+
+class MelSpectrogram(Layer):
+    """(reference ``features/layers.py:132``)"""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney", dtype=None):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                        power, center, pad_mode)
+        self._fbank = jnp.asarray(AF.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm))
+
+    def forward(self, x):
+        spec = self._spectrogram(x)
+        fb = self._fbank
+
+        def f(s):
+            return jnp.einsum("mf,...ft->...mt", fb, s)
+
+        return apply_op("mel_spectrogram", f, (spec,), {})
+
+
+class LogMelSpectrogram(Layer):
+    """(reference ``features/layers.py:239``)"""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype=None):
+        super().__init__()
+        self._mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window, power,
+                                   center, pad_mode, n_mels, f_min, f_max, htk, norm)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        mel = self._mel(x)
+        ref, amin, top_db = self.ref_value, self.amin, self.top_db
+        return apply_op("log_mel_spectrogram",
+                        lambda m: AF.power_to_db(m, ref, amin, top_db), (mel,), {})
+
+
+class MFCC(Layer):
+    """(reference ``features/layers.py:346``)"""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None, win_length: Optional[int] = None,
+                 window: str = "hann", power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False, norm: str = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype=None):
+        super().__init__()
+        if n_mfcc > n_mels:
+            raise ValueError(f"n_mfcc {n_mfcc} must be <= n_mels {n_mels}")
+        self._log_mel = LogMelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                          power, center, pad_mode, n_mels, f_min,
+                                          f_max, htk, norm, ref_value, amin, top_db)
+        self._dct = jnp.asarray(AF.create_dct(n_mfcc, n_mels))
+
+    def forward(self, x):
+        logmel = self._log_mel(x)
+        dct = self._dct
+
+        def f(m):
+            return jnp.einsum("mc,...mt->...ct", dct, m)
+
+        return apply_op("mfcc", f, (logmel,), {})

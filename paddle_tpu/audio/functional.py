@@ -1,0 +1,111 @@
+"""Audio functional helpers (reference ``python/paddle/audio/functional/``)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
+           "compute_fbank_matrix", "create_dct", "power_to_db"]
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True) -> np.ndarray:
+    """hann/hamming/blackman/bartlett/ones (reference ``window.py``).
+    ``fftbins=True`` gives the periodic variant used for STFT."""
+    n = win_length + 1 if fftbins else win_length
+    t = np.arange(n)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * t / (n - 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * t / (n - 1))
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * t / (n - 1))
+             + 0.08 * np.cos(4 * np.pi * t / (n - 1)))
+    elif window == "bartlett":
+        w = 1.0 - np.abs(2 * t / (n - 1) - 1)
+    elif window in ("ones", "rectangular", "boxcar"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return w[:win_length].astype(np.float32)
+
+
+def hz_to_mel(f, htk: bool = False):
+    f = np.asarray(f, np.float64)
+    if htk:
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+    # slaney scale (librosa/reference default)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+                    mels)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    mel = np.asarray(mel, np.float64)
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(mel >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (mel - min_log_mel)), freqs)
+
+
+def mel_frequencies(n_mels: int, f_min: float, f_max: float, htk: bool = False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney") -> np.ndarray:
+    """[n_mels, n_fft//2 + 1] triangular mel filterbank (reference
+    ``functional.compute_fbank_matrix``)."""
+    f_max = f_max if f_max is not None else sr / 2.0
+    n_freqs = n_fft // 2 + 1
+    fft_freqs = np.linspace(0, sr / 2.0, n_freqs)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fb = np.zeros((n_mels, n_freqs))
+    for m in range(n_mels):
+        lo, ctr, hi = mel_f[m], mel_f[m + 1], mel_f[m + 2]
+        up = (fft_freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - fft_freqs) / max(hi - ctr, 1e-10)
+        fb[m] = np.maximum(0.0, np.minimum(up, down))
+    if norm == "slaney":  # area normalization
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        fb *= enorm[:, None]
+    return fb.astype(np.float32)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho") -> np.ndarray:
+    """[n_mels, n_mfcc] DCT-II basis (reference ``functional.create_dct``)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    basis = np.cos(np.pi / n_mels * (n + 0.5) * k)  # [n_mfcc, n_mels]
+    if norm == "ortho":
+        basis[0] *= 1.0 / math.sqrt(n_mels)
+        basis[1:] *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    return basis.T.astype(np.float32)
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """10*log10 with ref/amin/top_db clamping (reference ``power_to_db``)."""
+    x = jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return log_spec

@@ -1,0 +1,137 @@
+"""``paddle.incubate.asp`` — 2:4 structured (N:M) sparsity.
+
+Counterpart of the reference's ``python/paddle/incubate/asp/`` (``asp.py``:
+``decorate``/``prune_model``, mask generation in ``utils.py``): prune weights
+to the best N-of-M pattern per group and keep them pruned through training by
+re-masking after every optimizer step.
+
+TPU-native note: TPUs have no sparse-tensor-core fast path, so the VALUE here
+is training models that deploy on 2:4 hardware (and the pruning/masking
+semantics for porting reference recipes) — masked weights are exact zeros and
+stay zero through optimization, matching the reference's workflow.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tensor import Tensor
+from ...nn.layers import Layer
+
+__all__ = ["create_mask", "check_mask_2d", "calculate_density", "prune_model",
+           "decorate", "OptimizerWithSparsityGuarantee", "reset_excluded_layers",
+           "set_excluded_layers"]
+
+_EXCLUDED: set = set()
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Exclude parameters from pruning: exact param name, or a layer-path
+    prefix at a dot boundary ("fc1" excludes "fc1.weight" but NOT
+    "fc10.weight" — the reference matches layer names exactly)."""
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def calculate_density(x) -> float:
+    """(reference ``utils.py:86``)"""
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(a)) / max(a.size, 1)
+
+
+def create_mask(weight, n: int = 2, m: int = 4):
+    """Best N-of-M mask along the LAST axis: keep the n largest-|w| of every
+    m consecutive elements (reference ``utils.py`` get_mask_2d_best for the
+    1D-grouped case)."""
+    a = np.asarray(weight._data if isinstance(weight, Tensor) else weight)
+    if a.shape[-1] % m != 0:
+        raise ValueError(f"last dim {a.shape[-1]} not divisible by m={m}")
+    groups = np.abs(a).reshape(-1, m)
+    order = np.argsort(-groups, axis=1)  # descending |w|
+    mask = np.zeros_like(groups, dtype=a.dtype)
+    np.put_along_axis(mask, order[:, :n], 1, axis=1)
+    return mask.reshape(a.shape)
+
+
+def check_mask_2d(mat, n: int = 2, m: int = 4) -> bool:
+    """True when every m-group along the last axis has at most n nonzeros
+    (reference ``utils.py`` check_sparsity role)."""
+    a = np.asarray(mat._data if isinstance(mat, Tensor) else mat)
+    if a.shape[-1] % m != 0:
+        return False
+    nz = (np.abs(a.reshape(-1, m)) > 0).sum(axis=1)
+    return bool(np.all(nz <= n))
+
+
+def _excluded(name: str) -> bool:
+    return any(name == ex or name.startswith(ex + ".") for ex in _EXCLUDED)
+
+
+def _prunable(name: str, p, m: int) -> bool:
+    if _excluded(name):
+        return False
+    # the reference prunes FC/conv weights: 2-D+ params with M-divisible last dim
+    return len(p.shape) >= 2 and p.shape[-1] % m == 0
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True) -> Dict[str, np.ndarray]:
+    """Prune every supported weight to N:M sparsity IN PLACE; returns the
+    masks keyed by parameter name (reference ``asp.py:319``)."""
+    masks: Dict[str, np.ndarray] = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p, m):
+            continue
+        mask = create_mask(p, n, m)
+        p._data = p._data * jnp.asarray(mask, p._data.dtype)
+        masks[name] = mask
+    if with_mask:
+        model._asp_masks = masks
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies the masks after every ``step`` so pruned weights stay zero
+    (reference ``asp.py:233`` decorate / OptimizerWithSparsityGuarantee)."""
+
+    def __init__(self, optimizer, model: Optional[Layer] = None,
+                 masks: Optional[Dict[str, np.ndarray]] = None):
+        if masks is not None and model is None:
+            raise ValueError("masks need a model to resolve parameter names; "
+                             "pass model= as well")
+        self._inner = optimizer
+        self._model = model
+        self._masks = masks
+
+    def _resolve(self):
+        masks = self._masks
+        if masks is None and self._model is not None:
+            masks = getattr(self._model, "_asp_masks", None)
+        return masks or {}
+
+    def step(self):
+        out = self._inner.step()
+        masks = self._resolve()
+        if masks and self._model is not None:
+            named = dict(self._model.named_parameters())
+            for name, mask in masks.items():
+                p = named.get(name)
+                if p is not None:
+                    p._data = p._data * jnp.asarray(mask, p._data.dtype)
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+def decorate(optimizer, model: Optional[Layer] = None) -> OptimizerWithSparsityGuarantee:
+    """Wrap an optimizer with the sparsity guarantee.  Pass the pruned model
+    (the reference resolves it from the global program; eager mode needs it
+    explicitly or via a later ``prune_model(model)`` storing ``_asp_masks``)."""
+    return OptimizerWithSparsityGuarantee(optimizer, model)

@@ -64,21 +64,19 @@ def viterbi_decode(potentials, transition_params, lengths,
         scores = jnp.max(alpha, axis=-1)
         last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)  # [B]
 
-        def walk(carry, bp_t):
-            tag, t_idx = carry
-            # bp_t: [B, T] backpointers for step t_idx (or -1 when inactive)
+        def walk(tag, bp_t):
+            # bp_t: [B, T] backpointers for this step (-1 when inactive)
             prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
             new_tag = jnp.where(prev >= 0, prev, tag).astype(jnp.int32)
-            return (new_tag, t_idx - 1), tag
+            return new_tag, tag
 
-        (first_tag, _), rev_path = jax.lax.scan(
-            walk, (last_tag, jnp.asarray(S - 1, jnp.int32)), backptrs, reverse=True)
+        first_tag, rev_path = jax.lax.scan(walk, last_tag, backptrs, reverse=True)
         # rev_path[t] holds the tag at position t+1; prepend the first tag
         path = jnp.concatenate([first_tag[:, None],
                                 jnp.moveaxis(rev_path, 0, 1)], axis=1)  # [B, S]
         # zero out positions past each length (reference padding)
         mask = jnp.arange(S)[None, :] < lengths_r[:, None]
-        return scores, jnp.where(mask, path, 0).astype(jnp.int64)
+        return scores, jnp.where(mask, path, 0).astype(jnp.int32)
 
     pt = potentials if isinstance(potentials, Tensor) else Tensor(_raw(potentials))
     tr = transition_params if isinstance(transition_params, Tensor) else Tensor(_raw(transition_params))

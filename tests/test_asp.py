@@ -1,0 +1,106 @@
+"""incubate.asp 2:4 structured sparsity (reference ``incubate/asp/asp.py``)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import asp
+
+
+class TestMasks:
+    def test_create_mask_keeps_largest(self):
+        w = np.asarray([[0.1, -0.9, 0.5, 0.2], [1.0, 0.0, -2.0, 0.3]], np.float32)
+        mask = asp.create_mask(w)
+        np.testing.assert_array_equal(mask, [[0, 1, 1, 0], [1, 0, 1, 0]])
+        assert asp.check_mask_2d(w * mask)
+        assert not asp.check_mask_2d(w)  # dense fails the 2:4 check
+
+    def test_density(self):
+        w = np.asarray([[1.0, 0.0], [0.0, 0.0]], np.float32)
+        assert asp.calculate_density(w) == pytest.approx(0.25)
+
+
+class TestPruneAndTrain:
+    def test_prune_model_halves_density(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        masks = asp.prune_model(net)
+        assert len(masks) == 2  # both Linear weights (biases excluded)
+        for _, p in net.named_parameters():
+            if len(p.shape) >= 2:
+                assert asp.calculate_density(p) == pytest.approx(0.5)
+                assert asp.check_mask_2d(p)
+
+    def test_sparsity_survives_training(self):
+        paddle.seed(1)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        asp.prune_model(net)
+        opt = asp.decorate(paddle.optimizer.Adam(learning_rate=1e-2,
+                                                 parameters=net.parameters()), net)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(16, 8)).astype(np.float32))
+        y = paddle.to_tensor(rng.normal(size=(16, 4)).astype(np.float32))
+        for _ in range(10):
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()  # forwarded to the inner optimizer
+        for _, p in net.named_parameters():
+            if len(p.shape) >= 2:
+                assert asp.check_mask_2d(p), "2:4 pattern lost during training"
+                assert asp.calculate_density(p) == pytest.approx(0.5)
+
+    def test_excluded_layers(self):
+        paddle.seed(2)
+        net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+        asp.set_excluded_layers(["0.weight"])
+        try:
+            masks = asp.prune_model(net)
+            assert len(masks) == 1
+            names = list(masks)
+            assert "1.weight" in names[0]
+        finally:
+            asp.reset_excluded_layers()
+
+
+class TestReviewRegressions:
+    def test_custom_m_pruning(self):
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(4, 6))  # last dim 6: 2:4 skips, 1:2 works
+        masks = asp.prune_model(net, n=1, m=2)
+        assert len(masks) == 1
+        assert asp.calculate_density(net[0].weight) == pytest.approx(0.5)
+        assert asp.check_mask_2d(net[0].weight, n=1, m=2)
+
+    def test_non_divisible_param_skipped_not_crashing(self):
+        paddle.seed(4)
+        net = nn.Sequential(nn.Linear(8, 4))  # last dim 4 not divisible by 8
+        masks = asp.prune_model(net, n=4, m=8)
+        assert masks == {}
+
+    def test_masks_without_model_rejected(self):
+        net = nn.Linear(4, 4)
+        masks = asp.prune_model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        with pytest.raises(ValueError, match="model"):
+            asp.OptimizerWithSparsityGuarantee(opt, masks=masks)
+
+    def test_exclusion_is_dot_boundary(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 4)
+                self.fc10 = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.fc10(self.fc1(x))
+
+        paddle.seed(5)
+        net = Net()
+        asp.set_excluded_layers(["fc1"])
+        try:
+            masks = asp.prune_model(net)
+            assert list(masks) == ["fc10.weight"]  # fc1 excluded, fc10 kept
+        finally:
+            asp.reset_excluded_layers()

@@ -1,0 +1,98 @@
+"""paddle.audio features (reference ``python/paddle/audio/features/layers.py``)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio
+from paddle_tpu.audio import functional as AF
+
+
+SR = 16000
+
+
+def _sine(freq, dur=0.5):
+    t = np.arange(int(SR * dur)) / SR
+    return np.sin(2 * np.pi * freq * t).astype(np.float32)
+
+
+class TestFunctional:
+    def test_windows(self):
+        hann = AF.get_window("hann", 8)
+        assert hann[0] == pytest.approx(0.0)
+        assert hann.shape == (8,)
+        np.testing.assert_allclose(AF.get_window("ones", 4), np.ones(4))
+        with pytest.raises(ValueError):
+            AF.get_window("bogus", 8)
+
+    def test_mel_hz_roundtrip(self):
+        f = np.asarray([0.0, 440.0, 1000.0, 4000.0])
+        np.testing.assert_allclose(AF.mel_to_hz(AF.hz_to_mel(f)), f, rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(AF.mel_to_hz(AF.hz_to_mel(f, htk=True), htk=True),
+                                   f, rtol=1e-6, atol=1e-6)
+
+    def test_fbank_shape_and_coverage(self):
+        fb = AF.compute_fbank_matrix(SR, 512, n_mels=40)
+        assert fb.shape == (40, 257)
+        assert np.all(fb >= 0)
+        assert np.all(fb.sum(axis=1) > 0)  # every filter covers some bins
+
+    def test_dct_orthonormal(self):
+        d = AF.create_dct(13, 40)  # [40, 13]
+        gram = d.T @ d
+        np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
+
+
+class TestLayers:
+    def test_spectrogram_peak_at_sine_bin(self):
+        n_fft = 512
+        spec = audio.Spectrogram(n_fft=n_fft, hop_length=128)
+        freq = 1000.0
+        out = np.asarray(spec(paddle.to_tensor(_sine(freq))).numpy())
+        assert out.shape[0] == n_fft // 2 + 1
+        peak_bin = out.mean(axis=1).argmax()
+        want_bin = round(freq * n_fft / SR)
+        assert abs(int(peak_bin) - want_bin) <= 1
+
+    def test_batched_input(self):
+        spec = audio.Spectrogram(n_fft=256, hop_length=128)
+        x = paddle.to_tensor(np.stack([_sine(500), _sine(2000)]))
+        out = np.asarray(spec(x).numpy())
+        assert out.shape[0] == 2 and out.shape[1] == 129
+
+    def test_mel_spectrogram_peak_moves_with_freq(self):
+        mel = audio.MelSpectrogram(sr=SR, n_fft=512, hop_length=128, n_mels=40)
+        lo = np.asarray(mel(paddle.to_tensor(_sine(300))).numpy()).mean(-1).argmax()
+        hi = np.asarray(mel(paddle.to_tensor(_sine(4000))).numpy()).mean(-1).argmax()
+        assert hi > lo
+
+    def test_log_mel_and_mfcc_shapes(self):
+        x = paddle.to_tensor(_sine(800))
+        logmel = audio.LogMelSpectrogram(sr=SR, n_fft=512, hop_length=256, n_mels=32)
+        lm = np.asarray(logmel(x).numpy())
+        assert lm.shape[0] == 32
+        mfcc = audio.MFCC(sr=SR, n_mfcc=13, n_fft=512, hop_length=256, n_mels=32)
+        mf = np.asarray(mfcc(x).numpy())
+        assert mf.shape[0] == 13
+        assert mf.shape[1] == lm.shape[1]
+
+    def test_mfcc_validates_n_mfcc(self):
+        with pytest.raises(ValueError, match="n_mfcc"):
+            audio.MFCC(n_mfcc=80, n_mels=64)
+
+    def test_spectrogram_jit_compatible(self):
+        spec = audio.Spectrogram(n_fft=256, hop_length=128)
+
+        @paddle.jit.to_static(full_graph=True)
+        def f(x):
+            return spec(x)
+
+        out = f(paddle.to_tensor(_sine(1000, 0.25)))
+        ref = spec(paddle.to_tensor(_sine(1000, 0.25)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), np.asarray(ref.numpy()),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_spectrogram_validates_win_length():
+    with pytest.raises(ValueError, match="win_length"):
+        audio.Spectrogram(n_fft=256, win_length=512)

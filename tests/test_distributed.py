@@ -40,18 +40,25 @@ def test_shard_tensor_r_and_s(mesh2d):
 
 
 @pytest.mark.parametrize("src,dst", [
+    # the reference's per-transition registry (reshard_function_registry.cc):
+    # r_to_s, s_to_r, s_to_s, same_status, plus nd_mesh compositions (both
+    # axes change at once).  p_to_r / p_to_s live in test_dist_semantics
+    # (Partial sources need dtensor_from_local construction).
     ("r", "s0"), ("s0", "r"), ("s0", "s1"), ("s1", "s0"), ("r", "r"),
+    ("r", "s0s1"), ("s0s1", "r"), ("s0s1", "s1s0"), ("s1s0", "s0s1"),
+    ("s0", "s0s1"), ("s0s1", "s1"),
 ])
 def test_reshard_transitions(mesh2d, src, dst):
     """The reshard matrix (reference: reshard_function_registry.cc transitions)."""
 
     def placements(code):
-        if code == "r":
-            return [dist.Replicate(), dist.Replicate()]
-        if code == "s0":
-            return [dist.Shard(0), dist.Replicate()]
-        if code == "s1":
-            return [dist.Shard(1), dist.Replicate()]
+        return {
+            "r": [dist.Replicate(), dist.Replicate()],
+            "s0": [dist.Shard(0), dist.Replicate()],
+            "s1": [dist.Shard(1), dist.Replicate()],
+            "s0s1": [dist.Shard(0), dist.Shard(1)],  # nd-mesh: both axes shard
+            "s1s0": [dist.Shard(1), dist.Shard(0)],
+        }[code]
 
     x = paddle.randn([8, 8])
     d = dist.shard_tensor(x, mesh2d, placements(src))

@@ -21,6 +21,7 @@ from .api import (  # noqa: F401
 )
 from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
+from . import rpc  # noqa: F401
 from . import parallel  # noqa: F401
 from . import sharding  # noqa: F401
 from .parallel import (  # noqa: F401

@@ -1,0 +1,250 @@
+"""``paddle.distributed.rpc`` — simple cross-process RPC.
+
+Counterpart of the reference's ``python/paddle/distributed/rpc/rpc.py``
+(``init_rpc``/``rpc_sync``/``rpc_async``/``shutdown`` over a brpc master).
+
+TPU-native scope: training-control RPC between launcher processes (eval
+coordination, custom data services) — NOT the tensor transport (tensors move
+over ICI/DCN inside compiled programs).  Transport is plain TCP + pickle:
+rank 0 hosts the worker-info registry (the brpc master's role); every worker
+runs a serve thread executing incoming calls.
+
+Only use within a trusted training cluster (pickle over sockets — the same
+trust model as the reference's brpc stack).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+__all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async", "get_worker_info",
+           "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    """(reference ``WorkerInfo``: name/rank/ip/port)"""
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_STATE: Dict[str, Any] = {"workers": None, "self": None, "server": None,
+                          "master": None}
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj)
+    sock.sendall(struct.pack("!I", len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket):
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("!I", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(65536, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class _Server:
+    """Per-worker serve loop: executes incoming (fn, args, kwargs) calls."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, name="rpc-server",
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+
+    def _handle(self, conn: socket.socket):
+        try:
+            with conn:
+                msg = _recv_msg(conn)
+                kind = msg.get("kind")
+                if kind == "call":
+                    try:
+                        out = msg["fn"](*msg.get("args", ()), **msg.get("kwargs", {}))
+                        reply = {"ok": True, "value": out}
+                    except BaseException as e:  # error travels back to the caller
+                        reply = {"ok": False, "error": e}
+                    try:
+                        _send_msg(conn, reply)
+                    except (pickle.PicklingError, TypeError, AttributeError) as e:
+                        # unpicklable result/exception: the caller must still
+                        # get a real error, not an opaque closed connection
+                        _send_msg(conn, {"ok": False, "error": RuntimeError(
+                            f"rpc reply not picklable: {e!r}; original reply "
+                            f"ok={reply['ok']}, repr={reply.get('value', reply.get('error'))!r}")})
+                elif kind == "register":  # master-only registry ops
+                    workers: Dict[str, WorkerInfo] = _STATE["registry"]
+                    info = msg["info"]
+                    workers[info.name] = info
+                    want = _STATE["world_size"]
+                    _send_msg(conn, {"ok": True, "complete": len(workers) >= want})
+                elif kind == "workers":
+                    _send_msg(conn, {"ok": True, "value": dict(_STATE["registry"])})
+                elif kind == "bye":  # shutdown rendezvous (reference barrier)
+                    byes: set = _STATE.setdefault("byes", set())
+                    byes.add(msg["name"])
+                    _send_msg(conn, {"ok": True,
+                                     "complete": len(byes) >= _STATE["world_size"]})
+        except ConnectionError:
+            pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _call_endpoint(ip: str, port: int, msg, timeout: float):
+    with socket.create_connection((ip, port), timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        _send_msg(sock, msg)
+        return _recv_msg(sock)
+
+
+def init_rpc(name: str, rank: Optional[int] = None, world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """Join the RPC group (reference ``rpc.py`` init_rpc).
+
+    rank 0 hosts the registry at ``master_endpoint`` (host:port; port may be 0
+    only for world_size 1).  Blocks until every worker registered.
+    """
+    import os
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) if world_size is None else world_size
+    server = _Server()
+    me = WorkerInfo(name, rank, server.host, server.port)
+    _STATE.update(server=server, self=me, world_size=world_size)
+
+    if world_size == 1:
+        _STATE["registry"] = {name: me}
+        _STATE["workers"] = {name: me}
+        return
+
+    host, port = (master_endpoint or "127.0.0.1:8813").rsplit(":", 1)
+    port = int(port)
+    _STATE["master_ep"] = (host, port)
+    if rank == 0:
+        _STATE["registry"] = {}
+        # a second server socket at the WELL-KNOWN endpoint for the registry
+        master = _Server(host, port)
+        _STATE["master"] = master
+        _STATE["registry"][name] = me
+    # every worker (incl. rank 0, already inserted) registers + polls
+    deadline = time.monotonic() + 300
+    while True:
+        try:
+            resp = _call_endpoint(host, port, {"kind": "register", "info": me}, 5.0)
+            if resp["complete"]:
+                break
+        except (ConnectionError, OSError):
+            pass  # master not up yet
+        if time.monotonic() > deadline:
+            raise TimeoutError("init_rpc: registration did not complete")
+        time.sleep(0.05)
+    workers = _call_endpoint(host, port, {"kind": "workers"}, 5.0)["value"]
+    _STATE["workers"] = workers
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    workers = _STATE["workers"] or {}
+    if name is None:
+        return _STATE["self"]
+    return workers[name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    return sorted((_STATE["workers"] or {}).values(), key=lambda w: w.rank)
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 60.0):
+    """Execute ``fn(*args, **kwargs)`` on worker ``to``; returns the result
+    (exceptions re-raise here — reference semantics)."""
+    info = get_worker_info(to)
+    resp = _call_endpoint(info.ip, info.port,
+                          {"kind": "call", "fn": fn, "args": tuple(args),
+                           "kwargs": dict(kwargs or {})}, timeout)
+    if not resp["ok"]:
+        raise resp["error"]
+    return resp["value"]
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout: float = 60.0) -> Future:
+    """Future-returning form (reference rpc_async; ``.wait()`` via
+    ``concurrent.futures.Future.result``)."""
+    fut: Future = Future()
+
+    def runner():
+        try:
+            fut.set_result(rpc_sync(to, fn, args, kwargs, timeout))
+        except BaseException as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=runner, daemon=True).start()
+    fut.wait = fut.result  # paddle surface: fut.wait()
+    return fut
+
+
+def shutdown(graceful: bool = True, timeout: float = 60.0):
+    """Stop serving.  With ``graceful`` (reference ``rpc.shutdown`` semantics)
+    this BARRIERS: the worker keeps serving until every worker announced
+    shutdown, so an early-finishing peer cannot strand in-flight calls."""
+    me: Optional[WorkerInfo] = _STATE.get("self")
+    master_ep = _STATE.get("master_ep")
+    if graceful and me is not None and _STATE.get("world_size", 1) > 1 and master_ep:
+        host, port = master_ep
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                resp = _call_endpoint(host, port, {"kind": "bye", "name": me.name}, 5.0)
+                if resp["complete"]:
+                    break
+            except (ConnectionError, OSError):
+                break  # master already gone: everyone else left
+            time.sleep(0.05)
+    for key in ("server", "master"):
+        srv = _STATE.get(key)
+        if srv is not None:
+            srv.stop()
+            _STATE[key] = None
+    _STATE["workers"] = None
+    _STATE["self"] = None
+    _STATE["master_ep"] = None
+    _STATE.pop("byes", None)

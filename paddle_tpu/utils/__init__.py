@@ -1,0 +1,3 @@
+"""Utilities (reference ``paddle/utils``): alignment harness etc."""
+
+from . import align  # noqa: F401

@@ -1,0 +1,179 @@
+"""Loss-curve / numerics alignment harness.
+
+Counterpart of the reference's accuracy-alignment tooling: align mode
+(``auto_parallel/api.py:3401`` ``in_auto_parallel_align_mode`` — fixed seeds +
+deterministic kernels), the Llama loss-parity suite
+(``test/auto_parallel/hybrid_strategy/semi_auto_llama_acc_align.py``), and the
+tensor-stat comparison tool (``auto_parallel/static/auto_align_tool.py``).
+
+Usage — run the SAME recipe under two configs (e.g. single-chip vs dp2mp2,
+fp32 vs bf16) and diff the dumps::
+
+    with align_mode():
+        rec = AlignRecorder("run_a.jsonl")
+        for step in range(n):
+            loss = train_step(batch)
+            rec.record(step, loss=loss, params=model.named_parameters())
+    report = compare_dumps("run_a.jsonl", "run_b.jsonl", rtol=1e-3)
+    assert report.aligned, report.first_divergence
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["align_mode", "in_align_mode", "tensor_stats", "AlignRecorder",
+           "AlignReport", "compare_dumps"]
+
+_ALIGN = False
+
+
+def in_align_mode() -> bool:
+    """(reference ``in_auto_parallel_align_mode``)"""
+    return _ALIGN
+
+
+@contextlib.contextmanager
+def align_mode(seed: int = 2024):
+    """Deterministic run context: fixed global seed + highest matmul precision
+    (TPU-default bf16-ish matmuls differ ~1e-3 from fp32; alignment runs must
+    remove that noise source)."""
+    import jax
+
+    from .. import seed as _set_seed
+
+    global _ALIGN
+    prev_prec = jax.config.jax_default_matmul_precision
+    prev_align = _ALIGN  # reentrant: restore, don't clear
+    _ALIGN = True
+    jax.config.update("jax_default_matmul_precision", "highest")
+    _set_seed(seed)
+    try:
+        yield
+    finally:
+        _ALIGN = prev_align
+        jax.config.update("jax_default_matmul_precision", prev_prec)
+
+
+def tensor_stats(t) -> Dict[str, float]:
+    """Compact fingerprint of a tensor: mean/std/absmax/l2 (the stats the
+    reference's align tool dumps per variable)."""
+    from ..framework.tensor import Tensor
+
+    a = np.asarray(t._data if isinstance(t, Tensor) else t, dtype=np.float64)
+    return {
+        "mean": float(a.mean()),
+        "std": float(a.std()),
+        "absmax": float(np.abs(a).max()),
+        "l2": float(np.sqrt((a * a).sum())),
+    }
+
+
+class AlignRecorder:
+    """Dump per-step scalar + tensor stats to JSONL (one line per step)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "w")
+
+    def record(self, step: int, loss=None, params=None, grads=None, **scalars):
+        """``params``/``grads``: iterables of (name, tensor)."""
+        from ..framework.tensor import Tensor
+
+        row: Dict = {"step": int(step)}
+        if loss is not None:
+            row["loss"] = float(np.asarray(loss._data if isinstance(loss, Tensor) else loss))
+        for k, v in scalars.items():
+            row[k] = float(v)
+        for group_name, group in (("params", params), ("grads", grads)):
+            if group is None:
+                continue
+            row[group_name] = {name: tensor_stats(t) for name, t in group}
+        self._f.write(json.dumps(row) + "\n")
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+@dataclass
+class AlignReport:
+    aligned: bool
+    steps_compared: int
+    max_loss_diff: float
+    first_divergence: Optional[str] = None
+    diffs: List[str] = field(default_factory=list)
+
+
+def _load(path: str) -> List[Dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def compare_dumps(path_a: str, path_b: str, rtol: float = 1e-3,
+                  atol: float = 1e-6) -> AlignReport:
+    """Step-by-step comparison of two AlignRecorder dumps (the
+    ``auto_align_tool`` diff role): losses, scalars, and every recorded
+    tensor-stat must match within tolerance."""
+    a_rows, b_rows = _load(path_a), _load(path_b)
+    n = min(len(a_rows), len(b_rows))
+    diffs: List[str] = []
+    max_loss_diff = 0.0
+
+    def close(x, y):
+        return abs(x - y) <= atol + rtol * max(abs(x), abs(y))
+
+    for i in range(n):
+        ra, rb = a_rows[i], b_rows[i]
+        step = ra.get("step", i)
+        skip = ("step", "params", "grads")
+        for key in rb:  # symmetric: extras in B are a structural mismatch too
+            if key not in skip and key not in ra:
+                diffs.append(f"step {step}: scalar {key!r} missing in A")
+        for key in ra:
+            if key in skip:
+                continue
+            if key not in rb:
+                diffs.append(f"step {step}: scalar {key!r} missing in B")
+                continue
+            if key == "loss":
+                max_loss_diff = max(max_loss_diff, abs(ra[key] - rb[key]))
+            if not close(ra[key], rb[key]):
+                diffs.append(f"step {step}: {key} {ra[key]:.6g} vs {rb[key]:.6g}")
+        for group in ("params", "grads"):
+            ga, gb = ra.get(group, {}), rb.get(group, {})
+            for name in gb:
+                if name not in ga:
+                    diffs.append(f"step {step}: {group}[{name!r}] missing in A")
+            for name in ga:
+                if name not in gb:
+                    diffs.append(f"step {step}: {group}[{name!r}] missing in B")
+                    continue
+                for stat, va in ga[name].items():
+                    vb = gb[name].get(stat)
+                    if vb is None or not close(va, vb):
+                        diffs.append(
+                            f"step {step}: {group}[{name!r}].{stat} "
+                            f"{va:.6g} vs {vb if vb is None else format(vb, '.6g')}")
+    if len(a_rows) != len(b_rows):
+        diffs.append(f"step counts differ: {len(a_rows)} vs {len(b_rows)}")
+    return AlignReport(
+        aligned=not diffs,
+        steps_compared=n,
+        max_loss_diff=max_loss_diff,
+        first_divergence=diffs[0] if diffs else None,
+        diffs=diffs,
+    )

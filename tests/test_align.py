@@ -1,0 +1,87 @@
+"""Loss-curve alignment harness (reference acc_align / auto_align_tool role)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.utils.align import (
+    AlignRecorder,
+    align_mode,
+    compare_dumps,
+    in_align_mode,
+    tensor_stats,
+)
+
+
+def _train_run(path, lr=1e-2, nudge=0.0):
+    with align_mode(seed=7):
+        net = nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 1))
+        opt = paddle.optimizer.Adam(learning_rate=lr, parameters=net.parameters())
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(16, 6)).astype(np.float32))
+        y = paddle.to_tensor(rng.normal(size=(16, 1)).astype(np.float32))
+        if nudge:
+            with paddle.no_grad():
+                net[0].weight._data = net[0].weight._data + nudge
+        with AlignRecorder(path) as rec:
+            for i in range(5):
+                loss = ((net(x) - y) ** 2).mean()
+                loss.backward()
+                rec.record(i, loss=loss,
+                           params=net.named_parameters(),
+                           grads=[(n, p.grad) for n, p in net.named_parameters()])
+                opt.step()
+                opt.clear_grad()
+
+
+def test_identical_runs_align(tmp_path):
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _train_run(a)
+    _train_run(b)
+    report = compare_dumps(a, b, rtol=1e-6, atol=1e-8)
+    assert report.aligned, report.first_divergence
+    assert report.steps_compared == 5
+    assert report.max_loss_diff == 0.0  # align_mode makes runs bit-identical
+
+
+def test_perturbed_run_flagged_with_location(tmp_path):
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "p.jsonl")
+    _train_run(a)
+    _train_run(b, nudge=1e-2)
+    report = compare_dumps(a, b, rtol=1e-5)
+    assert not report.aligned
+    assert report.first_divergence is not None
+    assert "step 0" in report.first_divergence  # divergence located at the start
+
+
+def test_align_mode_context():
+    assert not in_align_mode()
+    with align_mode():
+        assert in_align_mode()
+    assert not in_align_mode()
+
+
+def test_tensor_stats_fields():
+    s = tensor_stats(np.asarray([[3.0, -4.0]]))
+    assert s["absmax"] == 4.0 and s["l2"] == pytest.approx(5.0)
+    assert s["mean"] == pytest.approx(-0.5)
+
+
+def test_align_mode_reentrant():
+    with align_mode():
+        with align_mode():
+            assert in_align_mode()
+        assert in_align_mode()  # inner exit must not clear the outer mode
+    assert not in_align_mode()
+
+
+def test_extras_in_b_flagged(tmp_path):
+    import json
+
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    open(a, "w").write(json.dumps({"step": 0, "loss": 1.0}) + "\n")
+    open(b, "w").write(json.dumps({"step": 0, "loss": 1.0, "lr": 0.1}) + "\n")
+    report = compare_dumps(a, b)
+    assert not report.aligned
+    assert "missing in A" in report.first_divergence

@@ -6,3 +6,4 @@ fused_rotary_position_embedding, ...); ours route to the Pallas kernel library.
 
 from . import nn  # noqa: F401
 from . import asp  # noqa: F401
+from . import optimizer  # noqa: F401

@@ -1,0 +1,151 @@
+"""``paddle.incubate.optimizer`` — LookAhead, ModelAverage.
+
+Counterpart of the reference's ``python/paddle/incubate/optimizer/``
+(``lookahead.py``, ``modelaverage.py``): optimizer wrappers maintaining slow /
+averaged copies of the weights on the host side of the step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k steps forward, one step back (Zhang et al. 2019; reference
+    ``lookahead.py`` LookAhead): every ``k`` inner steps the slow weights move
+    ``alpha`` of the way toward the fast weights, and the fast weights reset
+    to the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_count = 0
+        self._slow: Dict[int, jnp.ndarray] = {
+            id(p): p._data for p in inner_optimizer._parameter_list}
+
+    def step(self):
+        out = self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            a = self.alpha
+            for p in self.inner_optimizer._parameter_list:
+                slow = self._slow[id(p)]
+                new_slow = slow + a * (p._data - slow)
+                self._slow[id(p)] = new_slow
+                p._data = new_slow
+        return out
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        """Route through THIS step() so the lookahead sync still fires."""
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def clear_grad(self):
+        return self.inner_optimizer.clear_grad()
+
+    def state_dict(self) -> dict:
+        import numpy as np
+
+        out = self.inner_optimizer.state_dict()
+        out["lookahead"] = {
+            "step_count": self._step_count,
+            "slow": [np.asarray(self._slow[id(p)])
+                     for p in self.inner_optimizer._parameter_list],
+        }
+        return out
+
+    def set_state_dict(self, state: dict):
+        la = state.pop("lookahead", None) if isinstance(state, dict) else None
+        self.inner_optimizer.set_state_dict(state)
+        if la is not None:
+            self._step_count = la["step_count"]
+            for p, s in zip(self.inner_optimizer._parameter_list, la["slow"]):
+                self._slow[id(p)] = jnp.asarray(s)
+
+    def __getattr__(self, item):
+        inner = self.__dict__.get("inner_optimizer")
+        if inner is None:  # during unpickling, before __init__ ran
+            raise AttributeError(item)
+        return getattr(inner, item)
+
+
+class ModelAverage:
+    """Running average of the weights applied at eval time (reference
+    ``modelaverage.py``: accumulators + ``apply``/``restore``).
+
+    The window grows with training up to ``max_average_window`` (the
+    reference's num_accumulates/old_num_accumulates bookkeeping collapses into
+    an exponential-window running mean when the window saturates)."""
+
+    def __init__(self, average_window_rate: float = 0.15, parameters=None,
+                 min_average_window: int = 10000, max_average_window: int = 10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("ModelAverage needs parameters=")
+        self.parameters: List[Tensor] = list(parameters)
+        self.average_window_rate = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._n = 0
+        self._sum: Dict[int, jnp.ndarray] = {
+            id(p): jnp.zeros_like(p._data) for p in self.parameters}
+        self._backup: Optional[Dict[int, jnp.ndarray]] = None
+
+    def step(self):
+        """Accumulate the current weights (call after the inner optimizer's
+        step).  Window semantics follow the reference: the effective window is
+        ``clip(total_updates * average_window_rate, min_average_window,
+        max_average_window)`` — early training averages everything, later the
+        window slides."""
+        for p in self.parameters:
+            self._sum[id(p)] = self._sum[id(p)] + p._data
+        self._n += 1
+        self._total = getattr(self, "_total", 0) + 1
+        window = int(max(self.min_average_window,
+                         min(self.max_average_window,
+                             self._total * self.average_window_rate)))
+        if self._n > window:
+            scale = window / self._n
+            for p in self.parameters:
+                self._sum[id(p)] = self._sum[id(p)] * scale
+            self._n = window
+
+    def apply(self, executor=None, need_restore: bool = True):
+        """Swap in the averaged weights (context manager, reference
+        semantics)."""
+        return self._apply_ctx(need_restore)
+
+    @contextlib.contextmanager
+    def _apply_ctx(self, need_restore: bool):
+        if self._n == 0:
+            yield
+            return
+        self._backup = {id(p): p._data for p in self.parameters}
+        for p in self.parameters:
+            p._data = (self._sum[id(p)] / self._n).astype(p._data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self.parameters:
+            p._data = self._backup[id(p)]
+        self._backup = None

@@ -49,7 +49,7 @@ __all__ = [
     # misc
     "interpolate", "upsample", "pixel_shuffle", "pixel_unshuffle", "cosine_similarity",
     "pad", "pairwise_distance", "label_smooth", "sequence_mask", "unfold",
-    "scaled_dot_product_attention", "flash_attention", "channel_shuffle",
+    "scaled_dot_product_attention", "flash_attention", "flash_attn_unpadded", "channel_shuffle",
     "temporal_shift", "npair_loss", "rrelu", "zeropad2d",
 ]
 
@@ -1362,4 +1362,48 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     out = scaled_dot_product_attention(query, key, value, None, dropout, causal, training)
     if return_softmax:
         return out, None
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False, fixed_seed_offset=None,
+                        rng_name="", training=True, name=None):
+    """Varlen (packed) attention (reference
+    ``nn/functional/flash_attention.py:652`` flash_attn_unpadded, the
+    ``flash_attn_varlen_fwd`` kernel's API).
+
+    query/key/value: ``[total_seq, H, D]`` — multiple sequences packed along
+    axis 0; ``cu_seqlens_*``: ``[B+1]`` cumulative boundaries.  Each sequence
+    attends only within itself (optionally causally).  XLA fallback path: one
+    masked attention over the packed length with a segment mask — a Pallas
+    varlen kernel would additionally SKIP cross-segment blocks.
+    """
+    cu_q = jnp.asarray(cu_seqlens_q._data if isinstance(cu_seqlens_q, Tensor)
+                       else cu_seqlens_q, jnp.int32)
+    cu_k = jnp.asarray(cu_seqlens_k._data if isinstance(cu_seqlens_k, Tensor)
+                       else cu_seqlens_k, jnp.int32)
+
+    def f(q, k, v):
+        from ..kernels.flash_attention import _attention_reference
+
+        Tq, Tk = q.shape[0], k.shape[0]
+        seg_q = jnp.searchsorted(cu_q[1:], jnp.arange(Tq), side="right")
+        seg_k = jnp.searchsorted(cu_k[1:], jnp.arange(Tk), side="right")
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            # BOTTOM-RIGHT alignment (flash-attn varlen convention, matching
+            # _attention_reference's tril k=Sk-Sq): when a segment's k side is
+            # longer than its q side (decode), the queries sit at the END
+            rel_q = jnp.arange(Tq) - cu_q[seg_q]
+            rel_k = jnp.arange(Tk) - cu_k[seg_k]
+            len_q = (cu_q[seg_q + 1] - cu_q[seg_q])
+            len_k_of_q = (cu_k[seg_q + 1] - cu_k[seg_q])
+            row_shift = rel_q + (len_k_of_q - len_q)
+            mask = mask & (row_shift[:, None] >= rel_k[None, :])
+        out = _attention_reference(q[None], k[None], v[None], False,
+                                   mask[None, None], scale)
+        return out[0]
+
+    out = apply_op("flash_attn_unpadded", f, (_t(query), _t(key), _t(value)), {})
     return out, None

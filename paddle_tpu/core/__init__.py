@@ -1,0 +1,14 @@
+"""``paddle_tpu.core`` — native (C++) runtime components.
+
+The compute path is JAX/XLA/Pallas; this package is the native runtime
+AROUND it, mirroring the reference's C++ subsystems that survive the TPU
+collapse (SURVEY §2.5): the bootstrap key-value store (``TCPStore``,
+reference ``phi/core/distributed/store/tcp_store.h``) and the host profiler
+tracer (reference ``fluid/platform/profiler/host_tracer.cc``).  Sources live
+in ``csrc/``; ``native.py`` builds/loads them via ctypes with pure-Python
+fallbacks.
+"""
+
+from paddle_tpu.core.native import available, build, load
+
+__all__ = ["available", "build", "load"]

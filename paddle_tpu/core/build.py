@@ -1,0 +1,6 @@
+"""``python -m paddle_tpu.core.build`` — compile the native runtime library."""
+
+from paddle_tpu.core.native import build
+
+if __name__ == "__main__":
+    print(build(verbose=True))

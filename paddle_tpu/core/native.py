@@ -1,0 +1,115 @@
+"""Loader/builder for the native runtime library (``libpaddle_tpu_native.so``).
+
+The reference implements its runtime in C++ (store: ``tcp_store.h``; host
+profiler: ``host_tracer.cc``); this package holds the TPU-native C++
+equivalents under ``csrc/`` and compiles them with the system ``g++`` into one
+shared library loaded via ctypes (no pybind11 in this environment).
+
+Build happens lazily on first use (or explicitly via
+``python -m paddle_tpu.core.build``) and is cached next to the sources.
+Every consumer has a pure-Python fallback, so a missing toolchain degrades
+gracefully rather than breaking import.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "csrc")
+_LIB = os.path.join(_DIR, "libpaddle_tpu_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _sources():
+    return sorted(
+        os.path.join(_SRC, f) for f in os.listdir(_SRC) if f.endswith(".cc"))
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB):
+        return True
+    lib_mtime = os.path.getmtime(_LIB)
+    return any(os.path.getmtime(s) > lib_mtime for s in _sources())
+
+
+def build(verbose: bool = False) -> str:
+    """Compile csrc/*.cc into the shared library; returns its path."""
+    srcs = _sources()
+    # build into a temp name then rename: concurrent builders (test workers)
+    # must never load a half-written .so
+    tmp = _LIB + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", tmp] + srcs
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True, capture_output=not verbose)
+    os.replace(tmp, _LIB)
+    return _LIB
+
+
+def _decorate(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    # tcp_store
+    lib.pts_server_start.restype = c.c_void_p
+    lib.pts_server_start.argtypes = [c.c_int]
+    lib.pts_server_port.restype = c.c_int
+    lib.pts_server_port.argtypes = [c.c_void_p]
+    lib.pts_server_num_keys.restype = c.c_int
+    lib.pts_server_num_keys.argtypes = [c.c_void_p]
+    lib.pts_server_stop.argtypes = [c.c_void_p]
+    lib.pts_client_connect.restype = c.c_void_p
+    lib.pts_client_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    lib.pts_client_close.argtypes = [c.c_void_p]
+    lib.pts_set.restype = c.c_int
+    lib.pts_set.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int]
+    lib.pts_get.restype = c.c_int
+    lib.pts_get.argtypes = [c.c_void_p, c.c_char_p,
+                            c.POINTER(c.POINTER(c.c_uint8)), c.POINTER(c.c_int)]
+    lib.pts_buf_free.argtypes = [c.POINTER(c.c_uint8)]
+    lib.pts_add.restype = c.c_int
+    lib.pts_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64,
+                            c.POINTER(c.c_int64)]
+    lib.pts_wait.restype = c.c_int
+    lib.pts_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+    lib.pts_delete.restype = c.c_int
+    lib.pts_delete.argtypes = [c.c_void_p, c.c_char_p]
+    # host_tracer
+    lib.ptt_begin.argtypes = [c.c_char_p]
+    lib.ptt_counter.argtypes = [c.c_char_p, c.c_double]
+    lib.ptt_span.argtypes = [c.c_char_p, c.c_uint64, c.c_uint64]
+    lib.ptt_now_ns.restype = c.c_uint64
+    lib.ptt_num_events.restype = c.c_int64
+    lib.ptt_enabled.restype = c.c_int
+    lib.ptt_export_chrome.restype = c.c_int
+    lib.ptt_export_chrome.argtypes = [c.c_char_p, c.c_int64]
+    return lib
+
+
+def load():
+    """Return the loaded native library, building if needed; None if
+    unavailable (no toolchain / build failure)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if _needs_build():
+                build()
+            _lib = _decorate(ctypes.CDLL(_LIB))
+        except (OSError, subprocess.CalledProcessError, FileNotFoundError):
+            _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None

@@ -19,6 +19,7 @@ from .api import (  # noqa: F401
     dtensor_from_fn, dtensor_from_local, reshard, shard_dataloader, shard_layer,
     shard_optimizer, shard_tensor, unshard_dtensor,
 )
+from .store import TCPStore  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from . import rpc  # noqa: F401

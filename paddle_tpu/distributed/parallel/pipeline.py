@@ -32,7 +32,8 @@ from ...framework.tensor import Tensor
 from ...nn.layers import Layer, LayerList
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
-           "pipeline_spmd_step", "pipeline_1f1b_step", "pipeline_vpp_step"]
+           "pipeline_spmd_step", "pipeline_1f1b_step", "pipeline_vpp_step",
+           "pipeline_zb_step"]
 
 
 class LayerDesc:
@@ -341,6 +342,168 @@ def pipeline_1f1b_step(first_fn, block_fn, last_fn, n_stages, n_micro,
     return schedule
 
 
+def pipeline_zb_step(first_fn, block_fn, last_fn, n_stages, n_micro,
+                     axis_name: str = "pp"):
+    """Compiled zero-bubble (ZBH1-style) schedule: backward is SPLIT into
+    input-grad (B) and weight-grad (W); only B stays on the pipelined critical
+    path, W is deferred out of the scan entirely.
+
+    Reference: ``passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:43``
+    (``_split_matmul_grad_ops_to_matmul`` — rewrites ``matmul_grad`` into
+    separate dX / dW matmuls so dW can fill bubble slots).  TPU-native
+    mapping: in a lockstep compiled schedule every stage executes the round
+    body every round — bubble rounds cost the same as active rounds — so
+    deferring W shrinks the PER-ROUND body from fwd+recompute+dX+dW to
+    fwd+recompute+dX (~25% less), and all the bubble rounds get cheaper.  The
+    deferred W then runs as ONE full-batch vjp per stage ([n_micro*mb]
+    concatenated), i.e. the dW matmuls XLA loves: maximal MXU tiles, zero
+    ppermute dependencies.
+
+    Cost model (f ~ fwd, b_x ~ input-grad, w ~ weight-grad per microbatch,
+    R = M + 2(S-1) rounds): 1F1B totals R*(2f + b_x + w); ZB totals
+    R*(2f + b_x) + M*(f + w).  ZB wins when M < 2(S-1)*(w/f) — the
+    bubble-dominated small-microbatch regime ZBH1 targets.  Memory: stashes
+    the stage INPUT and OUTPUT-GRAD for every microbatch ([2*M] activations
+    vs 1F1B's [2*S] ring) — the memory/bubble trade the ZB papers make.
+
+    ``first_fn``/``block_fn``/``last_fn`` contracts match
+    ``pipeline_1f1b_step``.  ``block_fn`` must be batch-elementwise (true of
+    transformer stages), since the deferred W pass runs it on the
+    concatenated [n_micro*mb, ...] batch.
+
+    Returns ``schedule(stage_params, first_params, last_params, micro_data,
+    *extra) -> (loss, g_stage, g_first, g_last)`` for shard_map manual over
+    ``axis_name``.
+    """
+    S, M = n_stages, n_micro
+    if S < 2:
+        raise ValueError("pipeline_zb_step needs n_stages >= 2")
+    R = M + 2 * (S - 1)
+
+    def schedule(stage_params, first_params, last_params, micro_data, *extra):
+        stage = jax.lax.axis_index(axis_name)
+        data0 = jax.tree.map(lambda a: a[0], micro_data)
+        x_shape = jax.eval_shape(first_fn, first_params, data0)
+        act0 = jnp.zeros(x_shape.shape, x_shape.dtype)
+        first_params = _varying(first_params, axis_name)
+        last_params = _varying(last_params, axis_name)
+
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+        zero_g_first = jax.tree.map(jnp.zeros_like, first_params)
+        zero_g_last = jax.tree.map(jnp.zeros_like, last_params)
+
+        carry0 = (
+            _varying(act0, axis_name),                        # fwd message
+            _varying(act0, axis_name),                        # bwd (grad) message
+            _varying(jnp.zeros((M,) + x_shape.shape, x_shape.dtype), axis_name),
+            _varying(jnp.zeros((M,) + x_shape.shape, x_shape.dtype), axis_name),
+            _varying(zero_g_first, axis_name),
+            _varying(zero_g_last, axis_name),
+            _varying(jnp.zeros((), jnp.float32), axis_name),  # loss sum
+        )
+
+        def pick(md, idx):
+            return jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, idx, 0, keepdims=False), md)
+
+        def round_step(carry, r):
+            fwd_msg, bwd_msg, x_stash, gy_stash, g_first, g_last, loss_sum = carry
+
+            # ---------- forward sub-step: microbatch fm = r - stage ----------
+            fm = r - stage
+            f_active = (fm >= 0) & (fm < M)
+            fm_c = jnp.clip(fm, 0, M - 1)
+            data_f = pick(micro_data, fm_c)
+            x_in = jax.lax.cond(
+                stage == 0,
+                lambda: _varying(first_fn(first_params, data_f).astype(act0.dtype),
+                                 axis_name),
+                lambda: fwd_msg)
+            y = block_fn(stage_params, x_in, *extra)
+            x_stash = jnp.where(
+                f_active,
+                jax.lax.dynamic_update_index_in_dim(x_stash, x_in, fm_c, 0),
+                x_stash)
+            fwd_msg = jax.lax.ppermute(
+                jnp.where(f_active, y, jnp.zeros_like(y)), axis_name, fwd_perm)
+
+            # ------- backward B sub-step (input grad only): bm = r - (2S-2-s) -
+            bm = r - (2 * S - 2 - stage)
+            b_active = (bm >= 0) & (bm < M)
+            bm_c = jnp.clip(bm, 0, M - 1)
+            data_b = pick(micro_data, bm_c)
+            x_m = jax.lax.dynamic_index_in_dim(x_stash, bm_c, 0, keepdims=False)
+            # vjp w.r.t. the INPUT only — stage_params closed over as constants,
+            # so no dW matmuls are emitted on the critical path
+            y_m, vjp_x = jax.vjp(lambda xx: block_fn(stage_params, xx, *extra), x_m)
+
+            def seed_last():
+                def loss_of(lp, yy):
+                    return last_fn(lp, yy, data_b)
+                loss_m, (g_lp, gy) = jax.value_and_grad(loss_of, argnums=(0, 1))(
+                    last_params, y_m)
+                return _varying(
+                    (loss_m.astype(jnp.float32), g_lp, gy.astype(y_m.dtype)),
+                    axis_name)
+
+            loss_m, g_last_m, gy = jax.lax.cond(
+                stage == S - 1,
+                seed_last,
+                lambda: (_varying(jnp.zeros((), jnp.float32), axis_name),
+                         _varying(zero_g_last, axis_name), bwd_msg))
+
+            (gx,) = vjp_x(gy)
+            gy_stash = jnp.where(
+                b_active,
+                jax.lax.dynamic_update_index_in_dim(gy_stash, gy.astype(x_shape.dtype),
+                                                    bm_c, 0),
+                gy_stash)
+
+            def seed_first(gxx):
+                _, first_vjp = jax.vjp(lambda fp: first_fn(fp, data_b), first_params)
+                (g_fp,) = first_vjp(gxx.astype(x_shape.dtype))
+                return _varying(g_fp, axis_name)
+
+            g_first_m = jax.lax.cond(
+                stage == 0, seed_first,
+                lambda _gx: _varying(zero_g_first, axis_name), gx)
+
+            mask = b_active
+            g_first = jax.tree.map(
+                lambda acc, g: acc + jnp.where(mask, g, jnp.zeros_like(g)),
+                g_first, g_first_m)
+            g_last = jax.tree.map(
+                lambda acc, g: acc + jnp.where(mask, g, jnp.zeros_like(g)),
+                g_last, g_last_m)
+            loss_sum = loss_sum + mask.astype(jnp.float32) * loss_m
+            bwd_msg = jax.lax.ppermute(
+                jnp.where(mask, gx, jnp.zeros_like(gx)), axis_name, bwd_perm)
+
+            return (fwd_msg, bwd_msg, x_stash, gy_stash, g_first, g_last,
+                    loss_sum), None
+
+        carry, _ = jax.lax.scan(round_step, carry0, jnp.arange(R))
+        _, _, x_stash, gy_stash, g_first, g_last, loss_sum = carry
+
+        # ---------- deferred W pass: one full-batch vjp per stage ----------
+        # every stash slot was written exactly once (each stage saw each
+        # microbatch once), so concatenating over the microbatch axis gives
+        # the exact summed weight grad in dense full-batch dW matmuls
+        flat = lambda a: a.reshape((M * a.shape[1],) + a.shape[2:])
+        xs, gys = flat(x_stash), flat(gy_stash)
+        _, vjp_p = jax.vjp(lambda sp: block_fn(sp, xs, *extra), stage_params)
+        (g_stage,) = vjp_p(gys)
+
+        loss = jax.lax.psum(loss_sum, axis_name)
+        g_first = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_first)
+        g_last = jax.tree.map(lambda g: jax.lax.psum(g, axis_name), g_last)
+        return loss, g_stage, g_first, g_last
+
+    return schedule
+
+
 def pipeline_vpp_step(block_fn, n_stages, n_micro, virtual_pp_degree,
                       axis_name: str = "pp", remat: bool = True):
     """Compiled interleaved (circular) virtual-pipeline forward — the
@@ -468,8 +631,10 @@ class PipelineParallel(Layer):
           (pp-1)/(n_micro+pp-1), so raise this above pp_degree;
         - ``schedule``: ``"FThenB"`` (compiled GPipe, autodiff backward,
           default), ``"1F1B"`` (manual-vjp interleaved schedule, activation
-          stash bounded by 2*pp microbatches), or ``"VPP"`` (circular virtual
-          stages — model must be built with ``virtual_pp_degree > 1``).
+          stash bounded by 2*pp microbatches), ``"ZB"``/``"ZBH1"``
+          (zero-bubble: weight-grad deferred off the critical path —
+          ``pipeline_zb_step``), or ``"VPP"`` (circular virtual stages — model
+          must be built with ``virtual_pp_degree > 1``).
         """
         from ...jit import TrainStep
 
@@ -480,10 +645,11 @@ class PipelineParallel(Layer):
         inputs, labels = data
         pc = self._pipeline_configs()
         schedule = str(pc.get("schedule", "FThenB"))
-        if schedule.upper() not in ("FTHENB", "GPIPE", "1F1B", "VPP"):
+        if schedule.upper() not in ("FTHENB", "GPIPE", "1F1B", "VPP", "ZB", "ZBH1"):
             raise ValueError(
                 f"unknown pipeline schedule {schedule!r}; choose FThenB (GPipe), "
-                "1F1B, or VPP — a typo must not silently fall back to FThenB")
+                "1F1B, ZB/ZBH1, or VPP — a typo must not silently fall back to "
+                "FThenB")
         acc = int(pc["accumulate_steps"]) if "accumulate_steps" in pc else 0
         model = self._layers
         if acc >= 1 and getattr(model, "n_micro", None) not in (None, acc):
@@ -498,21 +664,26 @@ class PipelineParallel(Layer):
                 "virtual_pp_degree > 1 (e.g. LlamaForCausalLMPipe(cfg, "
                 "virtual_pp_degree=2))")
 
-        cache_key = (id(optimizer), id(loss_fn), schedule, acc)
+        sched_u = schedule.upper()
+        cache_key = (id(optimizer), id(loss_fn), sched_u, acc)
         if self._compiled is None or self._compiled_key != cache_key:
-            if schedule.upper() == "1F1B":
+            if sched_u in ("1F1B", "ZB", "ZBH1"):
                 if loss_fn is not None:
                     raise ValueError(
-                        "schedule='1F1B' hand-rolls its vjp with the model's "
-                        "built-in next-token loss (build_manual_train_fn); a "
-                        "custom loss_fn would be silently ignored — use "
-                        "schedule='FThenB' with it instead")
+                        f"schedule={schedule!r} hand-rolls its vjp with the "
+                        "model's built-in next-token loss "
+                        "(build_manual_train_fn); a custom loss_fn would be "
+                        "silently ignored — use schedule='FThenB' with it instead")
                 if not hasattr(model, "build_manual_train_fn"):
                     raise ValueError(
-                        f"schedule='1F1B' needs {type(model).__name__}."
+                        f"schedule={schedule!r} needs {type(model).__name__}."
                         "build_manual_train_fn (see LlamaForCausalLMPipe)")
-                if model._manual_fn is None:
-                    model._manual_fn = model.build_manual_train_fn()
+                manual_sched = "ZB" if sched_u in ("ZB", "ZBH1") else "1F1B"
+                if model._manual_fn is None or \
+                        getattr(model, "_manual_fn_schedule", None) != manual_sched:
+                    model._manual_fn = model.build_manual_train_fn(
+                        schedule=manual_sched)
+                    model._manual_fn_schedule = manual_sched
                 self._compiled = TrainStep(model, None, optimizer,
                                            grads_fn=model._manual_fn)
             else:

@@ -6,8 +6,9 @@ Counterpart of the reference's ``python/paddle/distributed/rpc/rpc.py``
 TPU-native scope: training-control RPC between launcher processes (eval
 coordination, custom data services) — NOT the tensor transport (tensors move
 over ICI/DCN inside compiled programs).  Transport is plain TCP + pickle:
-rank 0 hosts the worker-info registry (the brpc master's role); every worker
-runs a serve thread executing incoming calls.
+rank 0 hosts the worker-info registry (the brpc master's role) on a
+``TCPStore`` (native C++ when built — ``paddle_tpu/core/csrc/tcp_store.cc``);
+every worker runs a serve thread executing incoming calls.
 
 Only use within a trusted training cluster (pickle over sockets — the same
 trust model as the reference's brpc stack).
@@ -38,7 +39,7 @@ class WorkerInfo:
 
 
 _STATE: Dict[str, Any] = {"workers": None, "self": None, "server": None,
-                          "master": None}
+                          "store": None}
 
 
 def _send_msg(sock: socket.socket, obj) -> None:
@@ -107,19 +108,6 @@ class _Server:
                         _send_msg(conn, {"ok": False, "error": RuntimeError(
                             f"rpc reply not picklable: {e!r}; original reply "
                             f"ok={reply['ok']}, repr={reply.get('value', reply.get('error'))!r}")})
-                elif kind == "register":  # master-only registry ops
-                    workers: Dict[str, WorkerInfo] = _STATE["registry"]
-                    info = msg["info"]
-                    workers[info.name] = info
-                    want = _STATE["world_size"]
-                    _send_msg(conn, {"ok": True, "complete": len(workers) >= want})
-                elif kind == "workers":
-                    _send_msg(conn, {"ok": True, "value": dict(_STATE["registry"])})
-                elif kind == "bye":  # shutdown rendezvous (reference barrier)
-                    byes: set = _STATE.setdefault("byes", set())
-                    byes.add(msg["name"])
-                    _send_msg(conn, {"ok": True,
-                                     "complete": len(byes) >= _STATE["world_size"]})
         except ConnectionError:
             pass
 
@@ -154,33 +142,25 @@ def init_rpc(name: str, rank: Optional[int] = None, world_size: Optional[int] = 
     _STATE.update(server=server, self=me, world_size=world_size)
 
     if world_size == 1:
-        _STATE["registry"] = {name: me}
         _STATE["workers"] = {name: me}
         return
 
+    from paddle_tpu.distributed.store import TCPStore
+
     host, port = (master_endpoint or "127.0.0.1:8813").rsplit(":", 1)
     port = int(port)
-    _STATE["master_ep"] = (host, port)
-    if rank == 0:
-        _STATE["registry"] = {}
-        # a second server socket at the WELL-KNOWN endpoint for the registry
-        master = _Server(host, port)
-        _STATE["master"] = master
-        _STATE["registry"][name] = me
-    # every worker (incl. rank 0, already inserted) registers + polls
-    deadline = time.monotonic() + 300
-    while True:
-        try:
-            resp = _call_endpoint(host, port, {"kind": "register", "info": me}, 5.0)
-            if resp["complete"]:
-                break
-        except (ConnectionError, OSError):
-            pass  # master not up yet
-        if time.monotonic() > deadline:
-            raise TimeoutError("init_rpc: registration did not complete")
-        time.sleep(0.05)
-    workers = _call_endpoint(host, port, {"kind": "workers"}, 5.0)["value"]
+    # rank 0 hosts the registry store at the well-known endpoint (the brpc
+    # master's role); everyone (rank 0 included) is a store client
+    store = TCPStore(host, port, world_size=world_size, is_master=(rank == 0),
+                     timeout=300.0)
+    _STATE["store"] = store
+    store.set(f"rpc/worker/{rank}", pickle.dumps(me))
+    workers: Dict[str, WorkerInfo] = {}
+    for r in range(world_size):
+        info = pickle.loads(store.get(f"rpc/worker/{r}"))  # blocking get
+        workers[info.name] = info
     _STATE["workers"] = workers
+    store.barrier("rpc/init")
 
 
 def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
@@ -227,24 +207,29 @@ def shutdown(graceful: bool = True, timeout: float = 60.0):
     this BARRIERS: the worker keeps serving until every worker announced
     shutdown, so an early-finishing peer cannot strand in-flight calls."""
     me: Optional[WorkerInfo] = _STATE.get("self")
-    master_ep = _STATE.get("master_ep")
-    if graceful and me is not None and _STATE.get("world_size", 1) > 1 and master_ep:
-        host, port = master_ep
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            try:
-                resp = _call_endpoint(host, port, {"kind": "bye", "name": me.name}, 5.0)
-                if resp["complete"]:
-                    break
-            except (ConnectionError, OSError):
-                break  # master already gone: everyone else left
-            time.sleep(0.05)
-    for key in ("server", "master"):
-        srv = _STATE.get(key)
-        if srv is not None:
-            srv.stop()
-            _STATE[key] = None
+    store = _STATE.get("store")
+    world = _STATE.get("world_size", 1)
+    if graceful and me is not None and world > 1 and store is not None:
+        try:
+            # keep serving until every worker reached the barrier, so an
+            # early-finishing peer cannot strand in-flight calls; the ack
+            # counter then lets the coordinator close its server only after
+            # every rank's LAST store op completed
+            store.barrier("rpc/bye", timeout=timeout)
+            acked = store.add("rpc/byeack", 1)
+            if store.is_master:
+                deadline = time.monotonic() + timeout
+                while acked < world and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                    acked = store.add("rpc/byeack", 0)
+        except (TimeoutError, ConnectionError, OSError, RuntimeError):
+            pass  # peers gone: close what we have
+    if store is not None:
+        store.close()
+        _STATE["store"] = None
+    srv = _STATE.get("server")
+    if srv is not None:
+        srv.stop()
+        _STATE["server"] = None
     _STATE["workers"] = None
     _STATE["self"] = None
-    _STATE["master_ep"] = None
-    _STATE.pop("byes", None)

@@ -1,0 +1,358 @@
+"""``paddle.distributed.TCPStore`` — host-side bootstrap key-value store.
+
+Counterpart of the reference's native ``TCPStore``
+(``paddle/phi/core/distributed/store/tcp_store.h:121`` ``class TCPStore :
+Store`` with set/get/add/wait, ``tcp_utils.cc`` socket plumbing).  On TPU the
+DEVICE rendezvous belongs to PJRT's coordination service
+(``jax.distributed.initialize``); this store is the host control plane the
+reference uses TCPStore for: launcher/elastic membership, rpc registries,
+checkpoint coordination, cross-host barriers outside compiled programs.
+
+The hot implementation is native C++ (``paddle_tpu/core/csrc/tcp_store.cc``)
+loaded via ctypes; a pure-Python client/server speaking the SAME wire
+protocol is the fallback when the toolchain is unavailable, so the two
+interoperate within one job.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Union
+
+from paddle_tpu.core import native
+
+__all__ = ["TCPStore"]
+
+_SET, _GET, _ADD, _WAIT, _DELETE = 1, 2, 3, 4, 5
+
+
+# ---------------------------------------------------------------------------
+# pure-Python protocol fallback (same wire format as tcp_store.cc)
+# ---------------------------------------------------------------------------
+
+def _send_bytes(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(struct.pack("!I", len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("store peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_bytes(sock: socket.socket) -> bytes:
+    (n,) = struct.unpack("!I", _recv_exact(sock, 4))
+    return _recv_exact(sock, n) if n else b""
+
+
+class _PyServer:
+    def __init__(self, port: int):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self._sock.listen(512)
+        self.port = self._sock.getsockname()[1]
+        self._kv: Dict[bytes, bytes] = {}
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept, daemon=True)
+        self._thread.start()
+
+    def num_keys(self) -> int:
+        with self._cond:
+            return len(self._kv)
+
+    def _accept(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        try:
+            with conn:
+                while not self._stop.is_set():
+                    cmd = _recv_exact(conn, 1)[0]
+                    key = _recv_bytes(conn)
+                    if cmd == _SET:
+                        val = _recv_bytes(conn)
+                        with self._cond:
+                            self._kv[key] = val
+                            self._cond.notify_all()
+                        conn.sendall(b"\x00" + struct.pack("!I", 0))
+                    elif cmd == _GET:
+                        with self._cond:
+                            val = self._kv.get(key)
+                        if val is None:
+                            conn.sendall(b"\x01" + struct.pack("!I", 0))
+                        else:
+                            conn.sendall(b"\x00")
+                            _send_bytes(conn, val)
+                    elif cmd == _ADD:
+                        (delta,) = struct.unpack("<q", _recv_bytes(conn))
+                        with self._cond:
+                            raw = self._kv.get(key)
+                            # non-8-byte existing value counts as 0, matching
+                            # the native server (tcp_store.cc kAdd size check)
+                            cur = struct.unpack("<q", raw)[0] \
+                                if raw is not None and len(raw) == 8 else 0
+                            now = cur + delta
+                            self._kv[key] = struct.pack("<q", now)
+                            self._cond.notify_all()
+                        conn.sendall(b"\x00")
+                        _send_bytes(conn, struct.pack("<q", now))
+                    elif cmd == _WAIT:
+                        (timeout_ms,) = struct.unpack("<I", _recv_bytes(conn))
+                        deadline = time.monotonic() + timeout_ms / 1000.0
+                        with self._cond:
+                            while key not in self._kv and not self._stop.is_set():
+                                left = deadline - time.monotonic()
+                                if left <= 0 or not self._cond.wait(left):
+                                    break
+                            have = key in self._kv
+                        conn.sendall((b"\x00" if have else b"\x01") +
+                                     struct.pack("!I", 0))
+                    elif cmd == _DELETE:
+                        with self._cond:
+                            self._kv.pop(key, None)
+                        conn.sendall(b"\x00" + struct.pack("!I", 0))
+                    else:
+                        return
+        except (ConnectionError, OSError):
+            pass
+
+    def stop(self):
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _PyClient:
+    def __init__(self, host: str, port: int, timeout: float):
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=5.0)
+                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._sock.settimeout(None)
+                self._mu = threading.Lock()
+                return
+            except OSError as e:
+                last = e
+                time.sleep(0.05)
+        raise TimeoutError(f"TCPStore: cannot reach {host}:{port}: {last}")
+
+    def _roundtrip(self, cmd: int, key: bytes, payload: Optional[bytes]):
+        with self._mu:
+            msg = bytes([cmd]) + struct.pack("!I", len(key)) + key
+            if payload is not None:
+                msg += struct.pack("!I", len(payload)) + payload
+            self._sock.sendall(msg)
+            status = _recv_exact(self._sock, 1)[0]
+            val = _recv_bytes(self._sock)
+            return status, val
+
+    def set(self, key: bytes, val: bytes):
+        status, _ = self._roundtrip(_SET, key, val)
+        if status != 0:
+            raise RuntimeError("store set failed")
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        status, val = self._roundtrip(_GET, key, None)
+        return val if status == 0 else None
+
+    def add(self, key: bytes, delta: int) -> int:
+        status, val = self._roundtrip(_ADD, key, struct.pack("<q", delta))
+        if status != 0:
+            raise RuntimeError("store add failed")
+        return struct.unpack("<q", val)[0]
+
+    def wait_key(self, key: bytes, timeout_ms: int) -> bool:
+        status, _ = self._roundtrip(_WAIT, key, struct.pack("<I", timeout_ms))
+        return status == 0
+
+    def delete(self, key: bytes):
+        self._roundtrip(_DELETE, key, None)
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# native handles
+# ---------------------------------------------------------------------------
+
+class _NativeServer:
+    def __init__(self, lib, port: int):
+        self._lib = lib
+        self._h = lib.pts_server_start(port)
+        if not self._h:
+            raise OSError(f"TCPStore: cannot bind port {port}")
+        self.port = lib.pts_server_port(self._h)
+
+    def num_keys(self) -> int:
+        return self._lib.pts_server_num_keys(self._h)
+
+    def stop(self):
+        if self._h:
+            self._lib.pts_server_stop(self._h)
+            self._h = None
+
+
+class _NativeClient:
+    def __init__(self, lib, host: str, port: int, timeout: float):
+        self._lib = lib
+        self._h = lib.pts_client_connect(host.encode(), port,
+                                         int(timeout * 1000))
+        if not self._h:
+            raise TimeoutError(f"TCPStore: cannot reach {host}:{port}")
+
+    def set(self, key: bytes, val: bytes):
+        if self._lib.pts_set(self._h, key, val, len(val)) != 0:
+            raise RuntimeError("store set failed")
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        import ctypes
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_int()
+        rc = self._lib.pts_get(self._h, key, ctypes.byref(out), ctypes.byref(n))
+        if rc == 1:
+            return None
+        if rc != 0:
+            raise RuntimeError("store get failed")
+        val = bytes(bytearray(out[: n.value])) if n.value else b""
+        self._lib.pts_buf_free(out)
+        return val
+
+    def add(self, key: bytes, delta: int) -> int:
+        import ctypes
+        res = ctypes.c_int64()
+        if self._lib.pts_add(self._h, key, delta, ctypes.byref(res)) != 0:
+            raise RuntimeError("store add failed")
+        return res.value
+
+    def wait_key(self, key: bytes, timeout_ms: int) -> bool:
+        rc = self._lib.pts_wait(self._h, key, timeout_ms)
+        if rc < 0:
+            raise RuntimeError("store wait failed")
+        return rc == 0
+
+    def delete(self, key: bytes):
+        self._lib.pts_delete(self._h, key)
+
+    def close(self):
+        if self._h:
+            self._lib.pts_client_close(self._h)
+            self._h = None
+
+
+# ---------------------------------------------------------------------------
+# public API (reference TCPStore surface)
+# ---------------------------------------------------------------------------
+
+class TCPStore:
+    """Reference-compatible store: the coordinator (``is_master=True``) hosts
+    the map; every process (coordinator included) is a client.
+
+    >>> s0 = TCPStore("127.0.0.1", 0, world_size=2, is_master=True)
+    >>> s1 = TCPStore("127.0.0.1", s0.port, world_size=2)
+    >>> s1.set("k", b"v"); s0.get("k")
+    b'v'
+    """
+
+    def __init__(self, host: str, port: int, world_size: int = 1,
+                 is_master: bool = False, timeout: float = 300.0,
+                 use_native: Optional[bool] = None):
+        lib = native.load() if use_native in (None, True) else None
+        if use_native is True and lib is None:
+            raise RuntimeError("native store requested but library unavailable")
+        self._server = None
+        self.is_master = bool(is_master)
+        self.world_size = int(world_size)
+        self.timeout = float(timeout)
+        if is_master:
+            self._server = (_NativeServer(lib, port) if lib is not None
+                            else _PyServer(port))
+            port = self._server.port
+        self.host, self.port = host, port
+        self._client = (_NativeClient(lib, host, port, timeout)
+                        if lib is not None else _PyClient(host, port, timeout))
+        self.native = lib is not None
+
+    @staticmethod
+    def _k(key: Union[str, bytes]) -> bytes:
+        return key.encode() if isinstance(key, str) else bytes(key)
+
+    def set(self, key, value: Union[str, bytes]) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        self._client.set(self._k(key), value)
+
+    def get(self, key, wait: bool = True) -> Optional[bytes]:
+        """Blocking get (reference ``Store::get`` waits for the key)."""
+        k = self._k(key)
+        if wait:
+            if not self._client.wait_key(k, int(self.timeout * 1000)):
+                raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+        return self._client.get(k)
+
+    def add(self, key, delta: int = 1) -> int:
+        return self._client.add(self._k(key), int(delta))
+
+    def wait(self, keys: Union[str, List[str]], timeout: Optional[float] = None) -> None:
+        if isinstance(keys, (str, bytes)):
+            keys = [keys]
+        ms = int((self.timeout if timeout is None else timeout) * 1000)
+        for key in keys:
+            if not self._client.wait_key(self._k(key), ms):
+                raise TimeoutError(f"TCPStore.wait({key!r}) timed out")
+
+    def delete_key(self, key) -> None:
+        self._client.delete(self._k(key))
+
+    def num_keys(self) -> int:
+        if self._server is None:
+            raise RuntimeError("num_keys is coordinator-only")
+        return self._server.num_keys()
+
+    def barrier(self, name: str = "barrier", timeout: Optional[float] = None) -> None:
+        """All ``world_size`` processes rendezvous; generation-counted so the
+        same name can be reused across phases."""
+        arrived = self.add(f"__{name}/arrive", 1)
+        gen = (arrived - 1) // self.world_size  # which barrier round am I in
+        if arrived == (gen + 1) * self.world_size:  # last one in: release
+            self.set(f"__{name}/release/{gen}", b"1")
+        self.wait(f"__{name}/release/{gen}", timeout)
+
+    def close(self) -> None:
+        self._client.close()
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

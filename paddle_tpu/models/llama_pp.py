@@ -41,7 +41,7 @@ from ..distributed.mesh import ProcessMesh, get_mesh
 from ..distributed.placement import Replicate, Shard
 from ..distributed.api import shard_tensor
 from ..distributed.parallel.pipeline import (pipeline_1f1b_step, pipeline_spmd_step,
-                                             pipeline_vpp_step)
+                                             pipeline_vpp_step, pipeline_zb_step)
 from .llama import (LlamaConfig, LlamaForCausalLM, _place_all_params,
                     attention_fn, mlp_fn)
 
@@ -258,21 +258,29 @@ class LlamaForCausalLMPipe(Layer):
         return jax.jit(fwd)
 
     # -- compiled 1F1B: manual-vjp train grads ------------------------------
-    def build_manual_train_fn(self, ignore_index: int = -100):
+    def build_manual_train_fn(self, ignore_index: int = -100,
+                              schedule: str = "1F1B"):
         """Returns ``fn(params, buffers, ids, labels) -> (loss, grads)`` running
-        the compiled 1F1B schedule (``pipeline_1f1b_step``): fwd/bwd interleaved,
-        per-device activation stash bounded by 2*pp microbatches regardless of
-        ``n_micro``.  Loss/grads match ``compute_loss`` exactly: per-microbatch
-        token-NLL sums are scaled by the precomputed global ``1/mask_count``.
-        Plugs into ``jit.TrainStep(grads_fn=...)``.
+        a manual-vjp compiled schedule.  ``schedule``:
+
+        - ``"1F1B"`` (``pipeline_1f1b_step``): fwd/bwd interleaved, per-device
+          activation stash bounded by 2*pp microbatches regardless of ``n_micro``;
+        - ``"ZB"`` (``pipeline_zb_step``, ZBH1-style): weight-grad split off the
+          critical path and deferred to one full-batch vjp per stage — cheaper
+          rounds in the bubble-dominated small-``n_micro`` regime, at the cost
+          of stashing all ``n_micro`` stage inputs + output grads.
+
+        Loss/grads match ``compute_loss`` exactly: per-microbatch token-NLL
+        sums are scaled by the precomputed global ``1/mask_count``.  Plugs into
+        ``jit.TrainStep(grads_fn=...)``.
         """
         cfg = self.config
         mesh = self._mesh
         pp, n_micro = self.pp, self.n_micro
         if self.virtual_pp_degree > 1:
             raise NotImplementedError(
-                "1F1B with virtual stages (interleaved 1F1B) is not implemented; "
-                "use schedule='1F1B' with virtual_pp_degree=1 or schedule='VPP'")
+                "manual-vjp schedules with virtual stages (interleaved 1F1B) are "
+                "not implemented; use virtual_pp_degree=1 or schedule='VPP'")
         run_layers = self._layers_scan_fn(remat=True)
 
         def block_fn(stage_params, x, cos, sin):
@@ -294,8 +302,15 @@ class LlamaForCausalLMPipe(Layer):
             mask = (lb != ignore_index).astype(jnp.float32)
             return jnp.sum(nll * mask) * inv_count
 
-        schedule = pipeline_1f1b_step(first_fn, block_fn, last_fn, pp, n_micro,
-                                      axis_name="pp")
+        builders = {"1F1B": pipeline_1f1b_step, "ZB": pipeline_zb_step,
+                    "ZBH1": pipeline_zb_step}
+        if schedule.upper() not in builders:
+            raise ValueError(
+                f"build_manual_train_fn schedule must be one of {sorted(builders)}, "
+                f"got {schedule!r} (VPP/FThenB run via the autodiff forward path)")
+        step_builder = builders[schedule.upper()]
+        schedule = step_builder(first_fn, block_fn, last_fn, pp, n_micro,
+                                axis_name="pp")
 
         def manual_fn(params, buffers, ids, labels):
             B, S = ids.shape

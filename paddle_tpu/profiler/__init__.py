@@ -3,6 +3,13 @@
 Host annotations (``RecordEvent``) + chrome-trace export are native here; the
 device side delegates to the JAX/XLA profiler (XPlane → TensorBoard), which is
 the TPU equivalent of the reference's CUPTI tracer.
+
+Fast path: when the native runtime library is built
+(``paddle_tpu/core/csrc/host_tracer.cc`` — the counterpart of the reference's
+C++ ``host_tracer.cc`` + ``chrometracing_logger.cc``), ``RecordEvent`` spans
+are recorded in C++ (steady-clock ns, per-thread buffers) instead of Python
+dict appends; ``Profiler.stop()`` drains them back so ``summary()`` and
+``export_chrome_tracing`` see one merged stream.
 """
 
 from __future__ import annotations
@@ -47,6 +54,37 @@ class _EventStore:
 
 
 _store = _EventStore()
+_native_lib = None  # loaded by Profiler.start(); RecordEvent fast path
+
+
+def _load_native():
+    global _native_lib
+    if _native_lib is None:
+        from paddle_tpu.core import native
+
+        _native_lib = native.load() or False
+    return _native_lib or None
+
+
+def _drain_native_events():
+    """Pull spans recorded in C++ into ``_store.events`` (merged stream)."""
+    lib = _native_lib or None
+    if not lib or lib.ptt_num_events() == 0:
+        return
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        tmp = f.name
+    try:
+        if lib.ptt_export_chrome(tmp.encode(), os.getpid()) == 0:
+            with open(tmp) as f:
+                for ev in json.load(f).get("traceEvents", []):
+                    if ev.get("ph") == "X":
+                        ev["cat"] = "host"
+                        _store.events.append(ev)
+        lib.ptt_clear()
+    finally:
+        os.unlink(tmp)
 
 
 class RecordEvent:
@@ -55,6 +93,7 @@ class RecordEvent:
     def __init__(self, name: str, event_type=None):
         self.name = name
         self._t0 = None
+        self._native = False
 
     def __enter__(self):
         self.begin()
@@ -65,10 +104,20 @@ class RecordEvent:
         return False
 
     def begin(self):
-        self._t0 = time.perf_counter()
+        lib = _native_lib or None
+        if lib is not None and _store.enabled:
+            lib.ptt_begin(self.name.encode())
+            self._native = True
+        else:
+            self._t0 = time.perf_counter()
 
     def end(self):
-        if self._t0 is not None and _store.enabled:
+        if self._native:
+            lib = _native_lib or None
+            if lib is not None:
+                lib.ptt_end()
+            self._native = False
+        elif self._t0 is not None and _store.enabled:
             t1 = time.perf_counter()
             _store.add(self.name, self._t0, t1 - self._t0, threading.get_ident())
         self._t0 = None
@@ -118,6 +167,10 @@ class Profiler:
         self._jax_running = False
 
     def start(self):
+        lib = _load_native()
+        if lib is not None:
+            lib.ptt_clear()
+            lib.ptt_enable()
         _store.enabled = True
         _store.events.clear()
         try:
@@ -132,6 +185,10 @@ class Profiler:
 
     def stop(self):
         _store.enabled = False
+        lib = _native_lib or None
+        if lib is not None:
+            lib.ptt_disable()
+            _drain_native_events()
         if self._jax_running:
             import jax
 

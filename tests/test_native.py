@@ -1,0 +1,203 @@
+"""Native runtime components: C++ TCPStore + host tracer (paddle_tpu.core).
+
+Reference counterparts: ``phi/core/distributed/store/tcp_store.h`` (store),
+``fluid/platform/profiler/host_tracer.cc`` + ``chrometracing_logger.cc``
+(tracer).  The native library builds from ``paddle_tpu/core/csrc`` with the
+system g++; the Python fallback speaks the same wire protocol, so both
+implementations are exercised and interoperate.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.core import native
+from paddle_tpu.distributed.store import TCPStore, _PyClient
+
+
+class TestNativeBuild:
+    def test_library_builds_and_loads(self):
+        assert native.available(), "native library failed to build/load"
+
+
+def _store_roundtrip(use_native):
+    with TCPStore("127.0.0.1", 0, world_size=1, is_master=True,
+                  timeout=10.0, use_native=use_native) as master:
+        client = TCPStore("127.0.0.1", master.port, world_size=1,
+                          timeout=10.0, use_native=use_native)
+        client.set("alpha", b"bytes\x00with\x00nulls")
+        assert master.get("alpha") == b"bytes\x00with\x00nulls"
+        client.set("text", "utf8 value")
+        assert master.get("text") == b"utf8 value"
+        assert client.add("ctr", 5) == 5
+        assert master.add("ctr", 3) == 8
+        assert client.add("ctr", 0) == 8  # read-only add
+        client.delete_key("alpha")
+        assert client.get("alpha", wait=False) is None
+        with pytest.raises(TimeoutError):
+            client.wait("missing", timeout=0.2)
+        assert master.num_keys() == 2  # text + ctr
+        client.close()
+
+
+class TestTCPStore:
+    def test_native_roundtrip(self):
+        if not native.available():
+            pytest.skip("no native lib")
+        _store_roundtrip(use_native=True)
+
+    def test_python_fallback_roundtrip(self):
+        _store_roundtrip(use_native=False)
+
+    def test_wire_interop_python_client_native_server(self):
+        """The pure-Python client must speak the C++ server's protocol."""
+        if not native.available():
+            pytest.skip("no native lib")
+        with TCPStore("127.0.0.1", 0, world_size=1, is_master=True,
+                      use_native=True) as master:
+            py = _PyClient("127.0.0.1", master.port, timeout=10.0)
+            py.set(b"k", b"from-python")
+            assert master.get("k") == b"from-python"
+            assert py.add(b"n", 7) == 7
+            assert master.add("n", 1) == 8
+            assert py.wait_key(b"k", 500)
+            assert not py.wait_key(b"absent", 100)
+            py.close()
+
+    def test_blocking_get_waits_for_set(self):
+        with TCPStore("127.0.0.1", 0, world_size=1, is_master=True,
+                      timeout=10.0) as master:
+            client = TCPStore("127.0.0.1", master.port, timeout=10.0)
+            got = {}
+
+            def getter():
+                got["v"] = client.get("late")  # blocks server-side
+
+            t = threading.Thread(target=getter)
+            t.start()
+            time.sleep(0.15)
+            master.set("late", b"worth-the-wait")
+            t.join(timeout=5)
+            assert got["v"] == b"worth-the-wait"
+            client.close()
+
+    def test_barrier_releases_all_and_is_reusable(self):
+        world = 4
+        with TCPStore("127.0.0.1", 0, world_size=world, is_master=True,
+                      timeout=10.0) as master:
+            clients = [TCPStore("127.0.0.1", master.port, world_size=world,
+                                timeout=10.0) for _ in range(world - 1)]
+            stores = [master] + clients
+            for _round in range(2):  # same name twice: generation counting
+                done = []
+
+                def arrive(s):
+                    s.barrier("phase", timeout=10.0)
+                    done.append(1)
+
+                threads = [threading.Thread(target=arrive, args=(s,))
+                           for s in stores[1:]]
+                for t in threads:
+                    t.start()
+                time.sleep(0.1)
+                assert not done, "barrier released before all arrived"
+                master.barrier("phase", timeout=10.0)
+                for t in threads:
+                    t.join(timeout=5)
+                assert len(done) == world - 1
+            for c in clients:
+                c.close()
+
+
+class TestNativeTracer:
+    def test_record_event_fast_path_and_chrome_export(self, tmp_path):
+        if not native.available():
+            pytest.skip("no native lib")
+        import paddle_tpu.profiler as profiler
+
+        prof = profiler.Profiler()
+        with prof:
+            with profiler.RecordEvent("outer"):
+                with profiler.RecordEvent("inner"):
+                    time.sleep(0.01)
+            with profiler.RecordEvent("outer"):
+                pass
+        summary = prof.summary()
+        assert "outer" in summary and "inner" in summary
+
+        handler = profiler.export_chrome_tracing(str(tmp_path), "w0")
+        handler(prof)
+        files = os.listdir(tmp_path)
+        assert len(files) == 1
+        trace = json.load(open(tmp_path / files[0]))
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert names.count("outer") == 2 and "inner" in names
+        # nesting: inner lies within an outer span
+        outer = min((e for e in trace["traceEvents"] if e["name"] == "outer"),
+                    key=lambda e: e["ts"])
+        inner = next(e for e in trace["traceEvents"] if e["name"] == "inner")
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+
+    def test_counter_events(self):
+        if not native.available():
+            pytest.skip("no native lib")
+        lib = native.load()
+        lib.ptt_enable()
+        lib.ptt_clear()
+        lib.ptt_counter(b"tokens_per_s", 21000.0)
+        assert lib.ptt_num_events() >= 1
+        lib.ptt_disable()
+        lib.ptt_clear()
+
+    def test_disabled_records_nothing(self):
+        if not native.available():
+            pytest.skip("no native lib")
+        lib = native.load()
+        lib.ptt_disable()
+        lib.ptt_clear()
+        lib.ptt_begin(b"ghost")
+        lib.ptt_end()
+        assert lib.ptt_num_events() == 0
+
+
+class TestRpcOverStore:
+    def test_two_process_rpc_uses_store_registry(self, tmp_path):
+        """Full two-process init_rpc/rpc_sync/shutdown over the TCPStore."""
+        import subprocess
+        import sys
+        import textwrap
+
+        port = _free_port()
+        script = textwrap.dedent(f"""
+            import os, sys
+            sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            from paddle_tpu.distributed import rpc
+            rank = int(sys.argv[1])
+            rpc.init_rpc(f"w{{rank}}", rank=rank, world_size=2,
+                         master_endpoint="127.0.0.1:{port}")
+            if rank == 0:
+                out = rpc.rpc_sync("w1", eval, args=("6*7",))
+                assert out == 42, out
+                print("RPC_OK", out)
+            rpc.shutdown()
+        """)
+        procs = [subprocess.Popen([sys.executable, "-c", script, str(r)],
+                                  stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                                  text=True)
+                 for r in range(2)]
+        outs = [p.communicate(timeout=90)[0] for p in procs]
+        assert all(p.returncode == 0 for p in procs), outs
+        assert "RPC_OK 42" in outs[0], outs
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

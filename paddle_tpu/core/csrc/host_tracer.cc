@@ -47,6 +47,11 @@ struct Counter {
 };
 
 struct ThreadBuf {
+  // mu guards open/done/counters: the owning thread appends under it, and
+  // export/clear/count (any thread, holding g_mu) read under it too — an
+  // uncontended lock on the hot path, but drain can no longer race a
+  // push_back's reallocation
+  std::mutex mu;
   std::vector<Span> open;       // stack of in-flight spans
   std::vector<Span> done;
   std::vector<Counter> counters;
@@ -98,15 +103,20 @@ int ptt_enabled() { return g_enabled.load(std::memory_order_relaxed) ? 1 : 0; }
 void ptt_begin(const char* name) {
   if (!g_enabled.load(std::memory_order_relaxed)) return;
   ThreadBuf* b = tls();
+  std::lock_guard<std::mutex> g(b->mu);
   b->open.push_back(Span{name, now_ns(), 0, b->tid});
 }
 
 void ptt_end() {
-  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  // pop even when disabled: a span that straddles Profiler.stop() must not
+  // linger on the open stack (it would surface later as a bogus huge span);
+  // only the RECORDING of the completed span is gated on enabled
   ThreadBuf* b = tls();
+  std::lock_guard<std::mutex> g(b->mu);
   if (b->open.empty()) return;  // unmatched end: drop (enable raced a begin)
   Span s = std::move(b->open.back());
   b->open.pop_back();
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
   s.end_ns = now_ns();
   b->done.push_back(std::move(s));
 }
@@ -114,12 +124,14 @@ void ptt_end() {
 void ptt_counter(const char* name, double value) {
   if (!g_enabled.load(std::memory_order_relaxed)) return;
   ThreadBuf* b = tls();
+  std::lock_guard<std::mutex> g(b->mu);
   b->counters.push_back(Counter{name, now_ns(), value, b->tid});
 }
 
 // Record a pre-timed span (for wrapping host work timed externally).
 void ptt_span(const char* name, uint64_t start_ns, uint64_t end_ns) {
   ThreadBuf* b = tls();
+  std::lock_guard<std::mutex> g(b->mu);
   b->done.push_back(Span{name, start_ns, end_ns, b->tid});
 }
 
@@ -128,15 +140,22 @@ uint64_t ptt_now_ns() { return now_ns(); }
 int64_t ptt_num_events() {
   std::lock_guard<std::mutex> g(g_mu);
   int64_t n = 0;
-  for (auto* b : g_bufs) n += static_cast<int64_t>(b->done.size() + b->counters.size());
+  for (auto* b : g_bufs) {
+    std::lock_guard<std::mutex> gb(b->mu);
+    n += static_cast<int64_t>(b->done.size() + b->counters.size());
+  }
   return n;
 }
 
 void ptt_clear() {
   std::lock_guard<std::mutex> g(g_mu);
   for (auto* b : g_bufs) {
+    std::lock_guard<std::mutex> gb(b->mu);
     b->done.clear();
     b->counters.clear();
+    // stale in-flight spans from a previous profiling session would pair
+    // with a future ptt_end and emit garbage; a fresh session starts empty
+    b->open.clear();
   }
 }
 
@@ -148,6 +167,7 @@ int ptt_export_chrome(const char* path, int64_t pid) {
   std::lock_guard<std::mutex> g(g_mu);
   uint64_t t0 = UINT64_MAX;
   for (auto* b : g_bufs) {
+    std::lock_guard<std::mutex> gb(b->mu);
     for (auto& s : b->done) t0 = s.start_ns < t0 ? s.start_ns : t0;
     for (auto& c : b->counters) t0 = c.ts_ns < t0 ? c.ts_ns : t0;
   }
@@ -158,6 +178,7 @@ int ptt_export_chrome(const char* path, int64_t pid) {
   bool first = true;
   std::string esc;
   for (auto* b : g_bufs) {
+    std::lock_guard<std::mutex> gb(b->mu);
     for (auto& s : b->done) {
       esc.clear();
       json_escape(s.name, &esc);

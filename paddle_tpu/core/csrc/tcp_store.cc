@@ -127,26 +127,27 @@ class StoreServer {
       listen_fd_ = -1;
     }
     cv_.notify_all();
+    // join the accept thread FIRST: a connection accepted concurrently with
+    // Stop() is guaranteed registered once this join returns, so the
+    // client-fd shutdown pass below cannot miss it (and then hang on join)
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::vector<Client*> clients;
     {
+      std::lock_guard<std::mutex> g(threads_mu_);
+      clients.swap(clients_);
+    }
+    for (auto* c : clients) {
       // unblock Serve threads parked in recv on a still-connected client;
       // without this, Stop() would hang until every peer disconnects
-      std::lock_guard<std::mutex> g(threads_mu_);
-      for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);
+      ::shutdown(c->fd, SHUT_RDWR);
     }
-    if (accept_thread_.joinable()) accept_thread_.join();
-    std::vector<std::thread> workers;
-    {
-      std::lock_guard<std::mutex> g(threads_mu_);
-      workers.swap(client_threads_);
+    for (auto* c : clients) {
+      if (c->thread.joinable()) c->thread.join();
+      // fd closes only after its Serve thread exited — closing earlier
+      // would let the kernel recycle the fd number while we still hold it
+      ::close(c->fd);
+      delete c;
     }
-    for (auto& t : workers)
-      if (t.joinable()) t.join();
-    // fds close here, after every Serve thread exited — closing inside
-    // Serve would let the kernel reuse the fd number while Stop still
-    // holds it in client_fds_ (shutdown on a recycled fd)
-    std::lock_guard<std::mutex> g(threads_mu_);
-    for (int fd : client_fds_) ::close(fd);
-    client_fds_.clear();
   }
 
   int port() const { return port_; }
@@ -158,6 +159,35 @@ class StoreServer {
   ~StoreServer() { Stop(); }
 
  private:
+  struct Client {
+    int fd;
+    std::thread thread;
+    std::atomic<bool> done{false};
+  };
+
+  void ReapFinished() {
+    // join Serve threads that already exited and release their fds, so a
+    // long-lived coordinator serving churning clients (elastic membership,
+    // checkpoint coordination) does not grow fds/threads monotonically
+    std::vector<Client*> dead;
+    {
+      std::lock_guard<std::mutex> g(threads_mu_);
+      for (auto it = clients_.begin(); it != clients_.end();) {
+        if ((*it)->done.load()) {
+          dead.push_back(*it);
+          it = clients_.erase(it);
+        } else {
+          ++it;
+        }
+      }
+    }
+    for (auto* c : dead) {
+      if (c->thread.joinable()) c->thread.join();
+      ::close(c->fd);
+      delete c;
+    }
+  }
+
   void AcceptLoop() {
     while (!stop_.load()) {
       int fd = ::accept(listen_fd_, nullptr, nullptr);
@@ -165,15 +195,18 @@ class StoreServer {
         if (stop_.load()) return;
         continue;
       }
+      ReapFinished();
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* c = new Client{fd, {}, };
       std::lock_guard<std::mutex> g(threads_mu_);
-      client_fds_.push_back(fd);
-      client_threads_.emplace_back([this, fd] { Serve(fd); });
+      clients_.push_back(c);
+      c->thread = std::thread([this, c] { Serve(c); });
     }
   }
 
-  void Serve(int fd) {
+  void Serve(Client* client) {
+    const int fd = client->fd;
     while (!stop_.load()) {
       uint8_t cmd;
       if (!recv_all(fd, &cmd, 1)) break;
@@ -255,7 +288,8 @@ class StoreServer {
       }
       if (!ok) break;
     }
-    ::shutdown(fd, SHUT_RDWR);  // closed by Stop() after the join
+    ::shutdown(fd, SHUT_RDWR);  // closed by ReapFinished()/Stop() after join
+    client->done.store(true);
   }
 
   int port_;
@@ -263,8 +297,7 @@ class StoreServer {
   std::atomic<bool> stop_{false};
   std::thread accept_thread_;
   std::mutex threads_mu_;
-  std::vector<std::thread> client_threads_;
-  std::vector<int> client_fds_;
+  std::vector<Client*> clients_;
   std::mutex mu_;
   std::condition_variable cv_;
   std::map<std::string, std::string> kv_;
@@ -408,8 +441,16 @@ void pts_client_close(void* h) {
   delete c;
 }
 
-int pts_set(void* h, const char* key, const uint8_t* val, int vlen) {
-  return static_cast<StoreClient*>(h)->Set(key, std::string(
+// Keys are length-delimited (klen), never NUL-terminated: binary keys with
+// embedded NULs must behave identically to the Python fallback client.
+
+static std::string pts_key(const uint8_t* key, int klen) {
+  return std::string(reinterpret_cast<const char*>(key), klen);
+}
+
+int pts_set(void* h, const uint8_t* key, int klen, const uint8_t* val,
+            int vlen) {
+  return static_cast<StoreClient*>(h)->Set(pts_key(key, klen), std::string(
              reinterpret_cast<const char*>(val), vlen))
              ? 0
              : -1;
@@ -417,9 +458,10 @@ int pts_set(void* h, const char* key, const uint8_t* val, int vlen) {
 
 // Two-call get: pts_get fills a malloc'd buffer the caller frees via
 // pts_buf_free.  Returns 0 ok / 1 missing / -1 error.
-int pts_get(void* h, const char* key, uint8_t** out, int* out_len) {
+int pts_get(void* h, const uint8_t* key, int klen, uint8_t** out,
+            int* out_len) {
   std::string val;
-  int rc = static_cast<StoreClient*>(h)->Get(key, &val);
+  int rc = static_cast<StoreClient*>(h)->Get(pts_key(key, klen), &val);
   if (rc != 0) {
     *out = nullptr;
     *out_len = 0;
@@ -433,16 +475,19 @@ int pts_get(void* h, const char* key, uint8_t** out, int* out_len) {
 
 void pts_buf_free(uint8_t* p) { std::free(p); }
 
-int pts_add(void* h, const char* key, int64_t delta, int64_t* result) {
-  return static_cast<StoreClient*>(h)->Add(key, delta, result) ? 0 : -1;
+int pts_add(void* h, const uint8_t* key, int klen, int64_t delta,
+            int64_t* result) {
+  return static_cast<StoreClient*>(h)->Add(pts_key(key, klen), delta, result)
+             ? 0
+             : -1;
 }
 
-int pts_wait(void* h, const char* key, int timeout_ms) {
-  return static_cast<StoreClient*>(h)->Wait(key, timeout_ms);
+int pts_wait(void* h, const uint8_t* key, int klen, int timeout_ms) {
+  return static_cast<StoreClient*>(h)->Wait(pts_key(key, klen), timeout_ms);
 }
 
-int pts_delete(void* h, const char* key) {
-  return static_cast<StoreClient*>(h)->Delete(key) ? 0 : -1;
+int pts_delete(void* h, const uint8_t* key, int klen) {
+  return static_cast<StoreClient*>(h)->Delete(pts_key(key, klen)) ? 0 : -1;
 }
 
 }  // extern "C"

@@ -67,19 +67,20 @@ def _decorate(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pts_client_connect.restype = c.c_void_p
     lib.pts_client_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
     lib.pts_client_close.argtypes = [c.c_void_p]
+    # keys are (ptr, len) pairs — binary-safe, embedded NULs preserved
     lib.pts_set.restype = c.c_int
-    lib.pts_set.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int]
+    lib.pts_set.argtypes = [c.c_void_p, c.c_char_p, c.c_int, c.c_char_p, c.c_int]
     lib.pts_get.restype = c.c_int
-    lib.pts_get.argtypes = [c.c_void_p, c.c_char_p,
+    lib.pts_get.argtypes = [c.c_void_p, c.c_char_p, c.c_int,
                             c.POINTER(c.POINTER(c.c_uint8)), c.POINTER(c.c_int)]
     lib.pts_buf_free.argtypes = [c.POINTER(c.c_uint8)]
     lib.pts_add.restype = c.c_int
-    lib.pts_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64,
+    lib.pts_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int, c.c_int64,
                             c.POINTER(c.c_int64)]
     lib.pts_wait.restype = c.c_int
-    lib.pts_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+    lib.pts_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_int, c.c_int]
     lib.pts_delete.restype = c.c_int
-    lib.pts_delete.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pts_delete.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
     # host_tracer
     lib.ptt_begin.argtypes = [c.c_char_p]
     lib.ptt_counter.argtypes = [c.c_char_p, c.c_double]

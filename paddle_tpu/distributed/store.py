@@ -229,14 +229,15 @@ class _NativeClient:
             raise TimeoutError(f"TCPStore: cannot reach {host}:{port}")
 
     def set(self, key: bytes, val: bytes):
-        if self._lib.pts_set(self._h, key, val, len(val)) != 0:
+        if self._lib.pts_set(self._h, key, len(key), val, len(val)) != 0:
             raise RuntimeError("store set failed")
 
     def get(self, key: bytes) -> Optional[bytes]:
         import ctypes
         out = ctypes.POINTER(ctypes.c_uint8)()
         n = ctypes.c_int()
-        rc = self._lib.pts_get(self._h, key, ctypes.byref(out), ctypes.byref(n))
+        rc = self._lib.pts_get(self._h, key, len(key),
+                               ctypes.byref(out), ctypes.byref(n))
         if rc == 1:
             return None
         if rc != 0:
@@ -248,18 +249,19 @@ class _NativeClient:
     def add(self, key: bytes, delta: int) -> int:
         import ctypes
         res = ctypes.c_int64()
-        if self._lib.pts_add(self._h, key, delta, ctypes.byref(res)) != 0:
+        if self._lib.pts_add(self._h, key, len(key), delta,
+                             ctypes.byref(res)) != 0:
             raise RuntimeError("store add failed")
         return res.value
 
     def wait_key(self, key: bytes, timeout_ms: int) -> bool:
-        rc = self._lib.pts_wait(self._h, key, timeout_ms)
+        rc = self._lib.pts_wait(self._h, key, len(key), timeout_ms)
         if rc < 0:
             raise RuntimeError("store wait failed")
         return rc == 0
 
     def delete(self, key: bytes):
-        self._lib.pts_delete(self._h, key)
+        self._lib.pts_delete(self._h, key, len(key))
 
     def close(self):
         if self._h:

@@ -68,7 +68,14 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     initializer_range: float = 0.02
     tie_word_embeddings: bool = False
-    dtype: str = "float32"           # param/activation dtype ("bfloat16" for TPU perf)
+    dtype: str = "float32"           # compute/activation dtype ("bfloat16" for TPU perf)
+    # storage dtype of parameters; None -> same as ``dtype``.  Setting
+    # "float32" with dtype="bfloat16" gives the standard TPU mixed-precision
+    # recipe: fp32 params ARE the master weights (weights cast to bf16 at
+    # each use — every matmul already does ``w.astype(hidden.dtype)``), so
+    # AdamW(multi_precision) keeps no separate master copy: 1.4GB less
+    # optimizer memory on the 0.7B bench model with identical numerics
+    param_dtype: Optional[str] = None
     sequence_parallel: bool = False  # shard seq dim over 'mp' between blocks
     use_flash_attention: bool = True
     recompute: bool = False          # jax.checkpoint each decoder layer
@@ -87,6 +94,11 @@ class LlamaConfig:
     @property
     def kv_heads(self) -> int:
         return self.num_key_value_heads or self.num_attention_heads
+
+    @property
+    def pdtype(self) -> str:
+        """Parameter storage dtype (see ``param_dtype``)."""
+        return self.param_dtype or self.dtype
 
 
 def llama_tiny_config(**overrides) -> LlamaConfig:
@@ -182,7 +194,7 @@ class LlamaRMSNorm(Layer):
         from ..nn.initializer import Constant
 
         self.weight = self.create_parameter(
-            [config.hidden_size], dtype=config.dtype,
+            [config.hidden_size], dtype=config.pdtype,
             default_initializer=Constant(1.0))
         self.epsilon = config.rms_norm_eps
 
@@ -267,9 +279,9 @@ class LlamaAttention(Layer):
         hk = config.kv_heads
         init = Normal(0.0, config.initializer_range)
         self.qkv_proj = self.create_parameter(
-            [config.hidden_size, (h + 2 * hk) * d], dtype=config.dtype, default_initializer=init)
+            [config.hidden_size, (h + 2 * hk) * d], dtype=config.pdtype, default_initializer=init)
         self.o_proj = self.create_parameter(
-            [h * d, config.hidden_size], dtype=config.dtype, default_initializer=init)
+            [h * d, config.hidden_size], dtype=config.pdtype, default_initializer=init)
         _shard_param(self.qkv_proj, mesh, 1)
         _shard_param(self.o_proj, mesh, 0)
 
@@ -303,10 +315,10 @@ class LlamaMLP(Layer):
         super().__init__()
         init = Normal(0.0, config.initializer_range)
         self.gate_up_proj = self.create_parameter(
-            [config.hidden_size, 2 * config.intermediate_size], dtype=config.dtype,
+            [config.hidden_size, 2 * config.intermediate_size], dtype=config.pdtype,
             default_initializer=init)
         self.down_proj = self.create_parameter(
-            [config.intermediate_size, config.hidden_size], dtype=config.dtype,
+            [config.intermediate_size, config.hidden_size], dtype=config.pdtype,
             default_initializer=init)
         _shard_param(self.gate_up_proj, mesh, 1)
         _shard_param(self.down_proj, mesh, 0)
@@ -377,7 +389,7 @@ class LlamaModel(Layer):
         mesh = mesh if mesh is not None else get_mesh()
         self._mesh = mesh
         self.embed_tokens = self.create_parameter(
-            [config.vocab_size, config.hidden_size], dtype=config.dtype,
+            [config.vocab_size, config.hidden_size], dtype=config.pdtype,
             default_initializer=Normal(0.0, config.initializer_range))
         _shard_param(self.embed_tokens, mesh, 0)  # vocab-parallel
         self.layers = LayerList([LlamaDecoderLayer(config, mesh)
@@ -408,6 +420,10 @@ class LlamaModel(Layer):
         ``(hidden, aux_loss_total)``.  With ``cache`` (from :meth:`init_cache`)
         runs incrementally and additionally returns the updated cache."""
         x = F.embedding(input_ids, self.embed_tokens)
+        if self.config.pdtype != self.config.dtype:
+            # fp32-stored params, bf16 compute: enter the compute dtype here;
+            # every weight use downstream casts via ``.astype(hidden.dtype)``
+            x = x.astype(self.config.dtype)
         x = _constrain_hidden(x, self._mesh, self.config.sequence_parallel)
         cos, sin = self.rope_cos, self.rope_sin
         is_moe = self.config.moe_num_experts > 1
@@ -462,7 +478,7 @@ class LlamaForCausalLM(Layer):
             self.lm_head = None
         else:
             self.lm_head = self.create_parameter(
-                [config.hidden_size, config.vocab_size], dtype=config.dtype,
+                [config.hidden_size, config.vocab_size], dtype=config.pdtype,
                 default_initializer=Normal(0.0, config.initializer_range))
             _shard_param(self.lm_head, mesh, 1)
         _place_all_params(self, mesh)

@@ -100,7 +100,7 @@ class LlamaForCausalLMPipe(Layer):
         Lps = self.layers_per_stage
 
         def stacked(name, shape, initializer, mp_dim=None):
-            p = self.create_parameter([pp, Lps] + shape, dtype=config.dtype,
+            p = self.create_parameter([pp, Lps] + shape, dtype=config.pdtype,
                                       default_initializer=initializer)
             placements = [Replicate()] * mesh.ndim
             pp_ax = mesh.dim_names.index("pp")
@@ -113,7 +113,7 @@ class LlamaForCausalLMPipe(Layer):
             self.add_parameter(name, p)
             return p
 
-        self.embed_tokens = self.create_parameter([config.vocab_size, H], dtype=config.dtype,
+        self.embed_tokens = self.create_parameter([config.vocab_size, H], dtype=config.pdtype,
                                                   default_initializer=init)
         self._shard_replicated(self.embed_tokens, mp_dim=0)
         stacked("ln1_w", [H], Constant(1.0))
@@ -122,10 +122,10 @@ class LlamaForCausalLMPipe(Layer):
         stacked("ln2_w", [H], Constant(1.0))
         stacked("gate_up_w", [H, 2 * inter], init, mp_dim=3)
         stacked("down_w", [inter, H], init, mp_dim=2)
-        self.norm_w = self.create_parameter([H], dtype=config.dtype,
+        self.norm_w = self.create_parameter([H], dtype=config.pdtype,
                                             default_initializer=Constant(1.0))
         self._shard_replicated(self.norm_w)
-        self.lm_head = self.create_parameter([H, config.vocab_size], dtype=config.dtype,
+        self.lm_head = self.create_parameter([H, config.vocab_size], dtype=config.pdtype,
                                              default_initializer=init)
         self._shard_replicated(self.lm_head, mp_dim=1)
 
@@ -234,7 +234,9 @@ class LlamaForCausalLMPipe(Layer):
         def fwd(ids, embed, ln1, qkv, o, ln2, gate_up, down, norm_w, head, cos, sin):
             B, S = ids.shape
             mb = B // n_micro
-            x = jnp.take(embed, ids, axis=0)  # [B, S, H]
+            # fp32-stored params, bf16 compute (pdtype != dtype): enter the
+            # compute dtype at the embedding, like the sequential model
+            x = jnp.take(embed, ids, axis=0).astype(jnp.dtype(cfg.dtype))
             micro = x.reshape(n_micro, mb, S, cfg.hidden_size)
             stacked = {"ln1": ln1, "qkv": qkv, "o": o, "ln2": ln2,
                        "gate_up": gate_up, "down": down}
@@ -289,7 +291,7 @@ class LlamaForCausalLMPipe(Layer):
 
         def first_fn(fp, data_m):
             ids_m = data_m[0]
-            return jnp.take(fp["embed"], ids_m, axis=0)
+            return jnp.take(fp["embed"], ids_m, axis=0).astype(jnp.dtype(cfg.dtype))
 
         def last_fn(lp, y, data_m):
             labels_m, inv_count = data_m[1], data_m[2]

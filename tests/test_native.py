@@ -67,6 +67,19 @@ class TestTCPStore:
             assert not py.wait_key(b"absent", 100)
             py.close()
 
+    def test_binary_keys_with_embedded_nuls(self):
+        """Keys are length-delimited on the wire: b'a\\x00x' and b'a\\x00y'
+        must be distinct through the native client (no NUL truncation)."""
+        if not native.available():
+            pytest.skip("no native lib")
+        with TCPStore("127.0.0.1", 0, world_size=1, is_master=True,
+                      use_native=True) as master:
+            master.set(b"a\x00x", b"one")
+            master.set(b"a\x00y", b"two")
+            assert master.get(b"a\x00x") == b"one"
+            assert master.get(b"a\x00y") == b"two"
+            assert master.num_keys() == 2
+
     def test_blocking_get_waits_for_set(self):
         with TCPStore("127.0.0.1", 0, world_size=1, is_master=True,
                       timeout=10.0) as master:

@@ -63,6 +63,7 @@ from . import geometric  # noqa: F401
 from . import text  # noqa: F401
 from . import audio  # noqa: F401
 from . import utils  # noqa: F401
+from . import onnx  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from . import linalg as _linalg_ns  # noqa: F401

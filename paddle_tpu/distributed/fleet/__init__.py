@@ -22,6 +22,7 @@ from ..mesh import ProcessMesh, get_mesh, set_global_mesh
 from . import topology as tp_mod
 from .elastic import ELASTIC_EXIT_CODE, CheckpointManager
 from .recompute import recompute
+from . import metrics  # noqa: F401  (fleet.metrics.sum/max/auc/... reductions)
 from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode
 
 __all__ = ["init", "DistributedStrategy", "get_hybrid_communicate_group", "fleet",

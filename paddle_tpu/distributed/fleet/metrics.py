@@ -1,0 +1,111 @@
+"""``fleet.metrics`` — distributed metric reduction.
+
+Counterpart of the reference's ``python/paddle/distributed/fleet/metrics/
+metric.py`` (global sum/max/min/auc/mae/rmse/mse/acc over the trainer comm,
+there via gloo/NCCL allreduce).  TPU-native: host-side collectives from
+``distributed.collective`` (which honor groups and run over the launcher's
+process set); in single-process runs every reduction is the identity, so the
+same training script works at any scale.
+
+Inputs accept ``Tensor``, numpy arrays, or Python scalars — metrics are
+host-side accumulators by the time they are globally reduced (the reference
+reads scope variables; here the accumulator values are passed directly).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.tensor import Tensor
+from .. import collective
+
+__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "mse", "acc"]
+
+_builtin_sum, _builtin_max, _builtin_min = sum, max, min
+
+
+def _to_array(x) -> np.ndarray:
+    if isinstance(x, Tensor):
+        return np.asarray(x._data)
+    return np.asarray(x)
+
+
+def _global_reduce(x, op: str, group=None) -> np.ndarray:
+    arr = np.ascontiguousarray(_to_array(x), dtype=np.float64)
+    if collective.get_world_size(group) <= 1:
+        return arr
+    # Transport BIT-EXACT: jax (x64 disabled) would downcast an f64 payload to
+    # f32 inside process_allgather and round counters above 2^24 — so gather
+    # the raw bits as uint32 and reduce in float64 on the host.
+    bits = arr.reshape(-1).view(np.uint32)
+    rows = collective._gather_rows(bits)
+    rows_f64 = np.ascontiguousarray(rows).view(np.float64)
+    rows_f64 = rows_f64.reshape((rows.shape[0],) + arr.shape)
+    return collective._reduce_rows(rows_f64[collective._group_ranks(group)], op)
+
+
+def sum(input, scope=None, util=None, group=None):
+    """Global elementwise sum (reference ``metric.py:26``)."""
+    return _global_reduce(input, collective.ReduceOp.SUM, group)
+
+
+def max(input, scope=None, util=None, group=None):
+    """Global elementwise max (reference ``metric.py:67``)."""
+    return _global_reduce(input, collective.ReduceOp.MAX, group)
+
+
+def min(input, scope=None, util=None, group=None):
+    """Global elementwise min (reference ``metric.py:108``)."""
+    return _global_reduce(input, collective.ReduceOp.MIN, group)
+
+
+def acc(correct, total, scope=None, util=None, group=None) -> float:
+    """Global accuracy: sum(correct) / sum(total) (reference ``metric.py:385``)."""
+    c = float(_global_reduce(correct, collective.ReduceOp.SUM, group))
+    t = float(_global_reduce(total, collective.ReduceOp.SUM, group))
+    return c / t if t else 0.0
+
+
+def mae(abserr, total_ins_num, scope=None, util=None, group=None) -> float:
+    """Global mean absolute error from a summed |err| accumulator
+    (reference ``metric.py:233``)."""
+    e = float(np.sum(_global_reduce(abserr, collective.ReduceOp.SUM, group)))
+    n = float(_global_reduce(total_ins_num, collective.ReduceOp.SUM, group))
+    return e / n if n else 0.0
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None, group=None) -> float:
+    """Global mean squared error (reference ``metric.py:335``)."""
+    e = float(np.sum(_global_reduce(sqrerr, collective.ReduceOp.SUM, group)))
+    n = float(_global_reduce(total_ins_num, collective.ReduceOp.SUM, group))
+    return e / n if n else 0.0
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None, group=None) -> float:
+    """Global root-mean-squared error (reference ``metric.py:284``)."""
+    return float(np.sqrt(mse(sqrerr, total_ins_num, scope, util, group)))
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None, group=None) -> float:
+    """Global AUC from per-rank positive/negative score histograms
+    (reference ``metric.py:149`` — same trapezoid-over-buckets computation
+    after summing the histograms across ranks).
+
+    ``stat_pos[i]`` / ``stat_neg[i]`` count positive/negative examples whose
+    predicted score falls in bucket i.
+    """
+    pos = _global_reduce(stat_pos, collective.ReduceOp.SUM, group).ravel()
+    neg = _global_reduce(stat_neg, collective.ReduceOp.SUM, group).ravel()
+    if pos.shape != neg.shape:
+        raise ValueError(f"stat_pos {pos.shape} and stat_neg {neg.shape} differ")
+    # walk buckets from high score to low, accumulating the ROC integral
+    area = 0.0
+    tp = fp = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_tp = tp + pos[i]
+        new_fp = fp + neg[i]
+        area += (new_fp - fp) * (tp + new_tp) / 2.0  # trapezoid
+        tp, fp = new_tp, new_fp
+    if tp == 0 or fp == 0:
+        return 0.0
+    return float(area / (tp * fp))

@@ -1,0 +1,408 @@
+"""``paddle.onnx`` — export models to ONNX.
+
+The reference hook (``python/paddle/onnx/export.py``) shells out to the
+paddle2onnx wheel; this environment has no onnx package at all, so the
+exporter here is self-contained: the layer's forward is traced to a jaxpr
+(the framework's single IR), each primitive is mapped to an ONNX operator,
+and the ModelProto is serialized with the wire-format writer in
+``onnx/proto.py``.  ``load_graph`` reads a model back (tests round-trip and
+numerically re-execute exported graphs against the live model).
+
+Supported primitive set covers the inference graphs of the nn layer library
+(matmul/conv/normalizations/activations/softmax/pooling reductions);
+training-only or TPU-kernel ops (Pallas calls, collectives) are rejected
+with a clear error — export the plain XLA path (``use_flash_attention=False``)
+for interchange.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import proto
+
+__all__ = ["export", "load_graph"]
+
+
+def _np_of(x):
+    import jax
+
+    return np.asarray(jax.device_get(x))
+
+
+class _Converter:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.names: Dict = {}
+        self._ctr = itertools.count()
+
+    def fresh(self, prefix: str = "v") -> str:
+        return f"{prefix}{next(self._ctr)}"
+
+    def add_init(self, arr: np.ndarray, prefix: str = "w") -> str:
+        nm = self.fresh(prefix)
+        self.initializers.append(proto.tensor_proto(nm, np.asarray(arr)))
+        return nm
+
+    def name_of(self, var) -> str:
+        from jax._src import core
+
+        if isinstance(var, core.Literal):
+            return self.add_init(np.asarray(var.val), "lit")
+        return self.names[var]
+
+    def emit(self, op: str, ins: Sequence[str], n_out: int = 1, **attrs) -> List[str]:
+        outs = [self.fresh() for _ in range(n_out)]
+        self.nodes.append(proto.node(op, ins, outs, name=self.fresh("n"),
+                                     attrs=attrs or None))
+        return outs
+
+    # -- per-equation dispatch ------------------------------------------------
+
+    def convert_eqn(self, eqn):
+        prim = eqn.primitive.name
+        handler = getattr(self, f"_op_{prim.replace('-', '_')}", None)
+        if handler is None:
+            raise NotImplementedError(
+                f"ONNX export: primitive {prim!r} is not supported (export the "
+                "plain XLA path: use_flash_attention=False, eval mode)")
+        handler(eqn)
+
+    def _bind1(self, eqn, op, **attrs):
+        ins = [self.name_of(v) for v in eqn.invars]
+        (out,) = self.emit(op, ins, **attrs)
+        self.names[eqn.outvars[0]] = out
+
+    def _op_add(self, eqn):
+        self._bind1(eqn, "Add")
+
+    def _op_sub(self, eqn):
+        self._bind1(eqn, "Sub")
+
+    def _op_mul(self, eqn):
+        self._bind1(eqn, "Mul")
+
+    def _op_div(self, eqn):
+        self._bind1(eqn, "Div")
+
+    def _op_max(self, eqn):
+        self._bind1(eqn, "Max")
+
+    def _op_min(self, eqn):
+        self._bind1(eqn, "Min")
+
+    def _op_pow(self, eqn):
+        self._bind1(eqn, "Pow")
+
+    def _op_neg(self, eqn):
+        self._bind1(eqn, "Neg")
+
+    def _op_exp(self, eqn):
+        self._bind1(eqn, "Exp")
+
+    def _op_log(self, eqn):
+        self._bind1(eqn, "Log")
+
+    def _op_tanh(self, eqn):
+        self._bind1(eqn, "Tanh")
+
+    def _op_logistic(self, eqn):
+        self._bind1(eqn, "Sigmoid")
+
+    def _op_sqrt(self, eqn):
+        self._bind1(eqn, "Sqrt")
+
+    def _op_abs(self, eqn):
+        self._bind1(eqn, "Abs")
+
+    def _op_erf(self, eqn):
+        self._bind1(eqn, "Erf")
+
+    def _op_sign(self, eqn):
+        self._bind1(eqn, "Sign")
+
+    def _op_floor(self, eqn):
+        self._bind1(eqn, "Floor")
+
+    def _op_ceil(self, eqn):
+        self._bind1(eqn, "Ceil")
+
+    def _op_is_finite(self, eqn):
+        # Not(Or(IsNaN, IsInf))
+        x = self.name_of(eqn.invars[0])
+        (nan_,) = self.emit("IsNaN", [x])
+        (inf_,) = self.emit("IsInf", [x])
+        (or_,) = self.emit("Or", [nan_, inf_])
+        (out,) = self.emit("Not", [or_])
+        self.names[eqn.outvars[0]] = out
+
+    def _op_rsqrt(self, eqn):
+        x = self.name_of(eqn.invars[0])
+        (s,) = self.emit("Sqrt", [x])
+        (out,) = self.emit("Reciprocal", [s])
+        self.names[eqn.outvars[0]] = out
+
+    def _op_integer_pow(self, eqn):
+        x = self.name_of(eqn.invars[0])
+        y = int(eqn.params["y"])
+        dt = np.dtype(eqn.invars[0].aval.dtype)
+        e = self.add_init(np.asarray(y, dt if dt.kind == "f" else np.int64))
+        (out,) = self.emit("Pow", [x, e])
+        self.names[eqn.outvars[0]] = out
+
+    def _op_stop_gradient(self, eqn):
+        self._bind1(eqn, "Identity")
+
+    def _op_copy(self, eqn):
+        self._bind1(eqn, "Identity")
+
+    def _op_convert_element_type(self, eqn):
+        to = proto.onnx_dtype(eqn.params["new_dtype"])
+        self._bind1(eqn, "Cast", to=to)
+
+    def _op_transpose(self, eqn):
+        self._bind1(eqn, "Transpose", perm=list(map(int, eqn.params["permutation"])))
+
+    def _op_reshape(self, eqn):
+        x = self.name_of(eqn.invars[0])
+        shp = self.add_init(np.asarray(eqn.params["new_sizes"], np.int64), "shape")
+        (out,) = self.emit("Reshape", [x, shp])
+        self.names[eqn.outvars[0]] = out
+
+    def _op_squeeze(self, eqn):
+        x = self.name_of(eqn.invars[0])
+        shp = self.add_init(
+            np.asarray(eqn.outvars[0].aval.shape, np.int64), "shape")
+        (out,) = self.emit("Reshape", [x, shp])
+        self.names[eqn.outvars[0]] = out
+
+    def _op_broadcast_in_dim(self, eqn):
+        x = self.name_of(eqn.invars[0])
+        out_shape = list(map(int, eqn.params["shape"]))
+        bdims = list(map(int, eqn.params["broadcast_dimensions"]))
+        # Reshape to rank(out) with 1s off the broadcast dims, then Expand
+        mid = [1] * len(out_shape)
+        for src_axis, dst_axis in enumerate(bdims):
+            mid[dst_axis] = int(eqn.invars[0].aval.shape[src_axis])
+        shp_mid = self.add_init(np.asarray(mid, np.int64), "shape")
+        (r,) = self.emit("Reshape", [x, shp_mid])
+        shp_out = self.add_init(np.asarray(out_shape, np.int64), "shape")
+        (out,) = self.emit("Expand", [r, shp_out])
+        self.names[eqn.outvars[0]] = out
+
+    def _op_concatenate(self, eqn):
+        ins = [self.name_of(v) for v in eqn.invars]
+        (out,) = self.emit("Concat", ins, axis=int(eqn.params["dimension"]))
+        self.names[eqn.outvars[0]] = out
+
+    def _op_slice(self, eqn):
+        x = self.name_of(eqn.invars[0])
+        starts = np.asarray(eqn.params["start_indices"], np.int64)
+        ends = np.asarray(eqn.params["limit_indices"], np.int64)
+        strides = eqn.params.get("strides")
+        axes = np.arange(len(starts), dtype=np.int64)
+        ins = [x, self.add_init(starts, "starts"), self.add_init(ends, "ends"),
+               self.add_init(axes, "axes")]
+        if strides is not None:
+            ins.append(self.add_init(np.asarray(strides, np.int64), "steps"))
+        (out,) = self.emit("Slice", ins)
+        self.names[eqn.outvars[0]] = out
+
+    def _op_select_n(self, eqn):
+        if len(eqn.invars) != 3 or eqn.invars[0].aval.dtype != np.bool_:
+            raise NotImplementedError(
+                "ONNX export: select_n supported only with a boolean predicate "
+                "and two cases (jnp.where); integer/multi-way select has no "
+                "single ONNX op")
+        pred, x0, x1 = (self.name_of(v) for v in eqn.invars)
+        # select_n(c, x_false, x_true); Where(cond, A, B) = A where cond
+        (out,) = self.emit("Where", [pred, x1, x0])
+        self.names[eqn.outvars[0]] = out
+
+    def _op_reduce_sum(self, eqn):
+        x = self.name_of(eqn.invars[0])
+        axes = self.add_init(np.asarray(eqn.params["axes"], np.int64), "axes")
+        (out,) = self.emit("ReduceSum", [x, axes], keepdims=0)
+        self.names[eqn.outvars[0]] = out
+
+    def _op_reduce_max(self, eqn):
+        self._bind1(eqn, "ReduceMax", axes=list(map(int, eqn.params["axes"])),
+                    keepdims=0)
+
+    def _op_reduce_min(self, eqn):
+        self._bind1(eqn, "ReduceMin", axes=list(map(int, eqn.params["axes"])),
+                    keepdims=0)
+
+    def _op_dot_general(self, eqn):
+        ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+        lhs, rhs = eqn.invars
+        l_rank = len(lhs.aval.shape)
+        ok_matmul = (list(lb) == list(rb) == list(range(len(lb))) and
+                     len(lc) == 1 and len(rc) == 1 and
+                     lc[0] == l_rank - 1 and rc[0] == len(lb))
+        if not ok_matmul:
+            raise NotImplementedError(
+                f"ONNX export: dot_general with dimension_numbers "
+                f"{eqn.params['dimension_numbers']} is not a plain matmul")
+        a, b = self.name_of(lhs), self.name_of(rhs)
+        (out,) = self.emit("MatMul", [a, b])
+        self.names[eqn.outvars[0]] = out
+
+    def _op_conv_general_dilated(self, eqn):
+        p = eqn.params
+        dn = p["dimension_numbers"]
+        spec = (dn.lhs_spec, dn.rhs_spec, dn.out_spec)
+        ndim = len(p["window_strides"]) + 2
+        nchw = (tuple(range(ndim)),) * 3  # NCHW / OIHW / NCHW
+        if spec != nchw:
+            raise NotImplementedError(
+                "ONNX export: conv supported only in NCHW/OIHW layout")
+        if any(d != 1 for d in p["lhs_dilation"]):
+            raise NotImplementedError("ONNX export: transposed conv unsupported")
+        x, w = (self.name_of(v) for v in eqn.invars)
+        pads_pairs = list(p["padding"])
+        pads = [int(lo) for lo, _ in pads_pairs] + [int(hi) for _, hi in pads_pairs]
+        (out,) = self.emit(
+            "Conv", [x, w],
+            strides=list(map(int, p["window_strides"])),
+            pads=pads,
+            dilations=list(map(int, p["rhs_dilation"])),
+            group=int(p["feature_group_count"]))
+        self.names[eqn.outvars[0]] = out
+
+    # comparison ops (emit bool outputs)
+    def _op_gt(self, eqn):
+        self._bind1(eqn, "Greater")
+
+    def _op_lt(self, eqn):
+        self._bind1(eqn, "Less")
+
+    def _op_ge(self, eqn):
+        self._bind1(eqn, "GreaterOrEqual")
+
+    def _op_le(self, eqn):
+        self._bind1(eqn, "LessOrEqual")
+
+    def _op_eq(self, eqn):
+        self._bind1(eqn, "Equal")
+
+    # call primitives: inline the inner jaxpr with shared naming
+    def _inline(self, eqn, closed):
+        inner = closed.jaxpr
+        for outer, innerv in zip(eqn.invars, inner.invars):
+            self.names[innerv] = self.name_of(outer)
+        for cv, cval in zip(inner.constvars, closed.consts):
+            self.names[cv] = self.add_init(_np_of(cval), "c")
+        self.convert_jaxpr_body(inner)
+        from jax._src import core
+
+        for outer, innerv in zip(eqn.outvars, inner.outvars):
+            if isinstance(innerv, core.Literal):
+                self.names[outer] = self.add_init(np.asarray(innerv.val), "lit")
+            else:
+                self.names[outer] = self.names[innerv]
+
+    def _op_pjit(self, eqn):
+        self._inline(eqn, eqn.params["jaxpr"])
+
+    _op_jit = _op_pjit  # newer jax names the pjit primitive 'jit'
+
+    def _op_closed_call(self, eqn):
+        self._inline(eqn, eqn.params["call_jaxpr"])
+
+    def _op_custom_jvp_call(self, eqn):
+        self._inline(eqn, eqn.params["call_jaxpr"])
+
+    def _op_custom_vjp_call(self, eqn):
+        self._inline(eqn, eqn.params["call_jaxpr"])
+
+    def _op_remat(self, eqn):
+        from jax._src import core
+
+        closed = core.ClosedJaxpr(eqn.params["jaxpr"], ())
+        self._inline(eqn, closed)
+
+    _op_checkpoint = _op_remat
+
+    def convert_jaxpr_body(self, jaxpr):
+        for eqn in jaxpr.eqns:
+            self.convert_eqn(eqn)
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 13,
+           **configs) -> str:
+    """Trace ``layer.forward`` and write ``<path>.onnx``.
+
+    ``input_spec``: list of example inputs — Tensors, numpy arrays, or
+    ``static.InputSpec``-like objects with ``.shape``/``.dtype``.  Returns the
+    written file path.  (Reference: ``python/paddle/onnx/export.py`` — same
+    call shape, but self-contained instead of delegating to paddle2onnx.)
+    """
+    import jax
+
+    from ..framework.tensor import Tensor
+    from ..jit import functional_call
+
+    if input_spec is None:
+        raise ValueError("onnx.export needs input_spec (example inputs)")
+
+    examples = []
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            examples.append(spec._data)
+        elif hasattr(spec, "shape") and hasattr(spec, "dtype") and not isinstance(
+                spec, np.ndarray):
+            # static.InputSpec normalizes None dims to -1; both mean "dynamic"
+            dims = [1 if d is None or int(d) < 0 else int(d) for d in spec.shape]
+            examples.append(np.zeros(dims, np.dtype(str(spec.dtype))))
+        else:
+            examples.append(np.asarray(spec))
+
+    params = {n: p._data for n, p in layer.named_parameters()}
+    buffers = {n: b._data for n, b in layer.named_buffers()}
+
+    def fn(*xs):
+        out = functional_call(layer, params, buffers, *xs)
+        return out
+
+    closed = jax.make_jaxpr(fn)(*examples)
+    conv = _Converter()
+    jaxpr = closed.jaxpr
+
+    input_names, input_vis = [], []
+    for var, ex in zip(jaxpr.invars, examples):
+        nm = conv.fresh("input_")
+        conv.names[var] = nm
+        input_names.append(nm)
+        input_vis.append(proto.value_info(
+            nm, proto.onnx_dtype(var.aval.dtype), var.aval.shape))
+    for cv, cval in zip(jaxpr.constvars, closed.consts):
+        conv.names[cv] = conv.add_init(_np_of(cval), "p")
+
+    conv.convert_jaxpr_body(jaxpr)
+
+    output_vis = []
+    out_names = []
+    for var in jaxpr.outvars:
+        nm = conv.name_of(var)
+        out_names.append(nm)
+        output_vis.append(proto.value_info(
+            nm, proto.onnx_dtype(var.aval.dtype), var.aval.shape))
+
+    g = proto.graph(conv.nodes, type(layer).__name__, input_vis, output_vis,
+                    conv.initializers)
+    payload = proto.model(g, opset=opset_version)
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(payload)
+    return out_path
+
+
+def load_graph(path: str) -> Dict:
+    """Parse an exported .onnx file back into a dict (see proto.read_model)."""
+    with open(path, "rb") as f:
+        return proto.read_model(f.read())

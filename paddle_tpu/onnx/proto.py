@@ -1,0 +1,340 @@
+"""Minimal ONNX protobuf serialization — no ``onnx`` package dependency.
+
+The reference's ``paddle.onnx.export`` delegates to the external paddle2onnx
+wheel (``python/paddle/onnx/export.py``); this environment has no onnx
+runtime at all, so this module writes the ONNX protobuf WIRE FORMAT directly
+(protobuf encoding is just tag-varints + length-delimited fields).  Field
+numbers follow onnx/onnx.proto3 (IR version 8, default opset 13).
+
+Only the message subset needed for inference graphs is implemented:
+ModelProto / GraphProto / NodeProto / AttributeProto / TensorProto /
+ValueInfoProto / TypeProto / TensorShapeProto / OperatorSetIdProto.
+
+``reader`` implements the inverse (used by tests to round-trip and by
+``paddle_tpu.onnx.load_graph`` for inspection) — together they make the
+exporter verifiable without third-party packages.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# protobuf wire primitives
+# ---------------------------------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64  # two's-complement 64-bit (negative enum/int64)
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _int_field(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(value)
+
+
+def _str_field(field: int, value: str) -> bytes:
+    return _len_field(field, value.encode())
+
+
+# ONNX TensorProto.DataType
+FLOAT, INT32, INT64, BOOL, FLOAT16, DOUBLE, BFLOAT16 = 1, 6, 7, 9, 10, 11, 16
+
+_NP_TO_ONNX = {
+    np.dtype(np.float32): FLOAT,
+    np.dtype(np.float64): DOUBLE,
+    np.dtype(np.int32): INT32,
+    np.dtype(np.int64): INT64,
+    np.dtype(np.bool_): BOOL,
+    np.dtype(np.float16): FLOAT16,
+}
+
+_ONNX_TO_NP = {v: k for k, v in _NP_TO_ONNX.items()}
+
+
+def onnx_dtype(np_dtype) -> int:
+    dt = np.dtype(np_dtype)
+    if dt.name == "bfloat16":
+        return BFLOAT16
+    if dt not in _NP_TO_ONNX:
+        raise ValueError(f"dtype {dt} has no ONNX mapping")
+    return _NP_TO_ONNX[dt]
+
+
+# ---------------------------------------------------------------------------
+# message builders
+# ---------------------------------------------------------------------------
+
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    arr = np.ascontiguousarray(arr)
+    out = b"".join(_int_field(1, d) for d in arr.shape)
+    out += _int_field(2, onnx_dtype(arr.dtype))
+    out += _str_field(8, name)
+    out += _len_field(9, arr.tobytes())
+    return out
+
+
+def _tensor_shape(dims: Sequence[int]) -> bytes:
+    """TensorShapeProto: dim=1; Dim.dim_value=1."""
+    return b"".join(_len_field(1, _int_field(1, int(d))) for d in dims)
+
+
+def value_info(name: str, dtype: int, shape: Sequence[int]) -> bytes:
+    """ValueInfoProto: name=1, type=2; TypeProto.tensor_type=1
+    (elem_type=1, shape=2)."""
+    tensor_type = _int_field(1, dtype) + _len_field(2, _tensor_shape(shape))
+    type_proto = _len_field(1, tensor_type)
+    return _str_field(1, name) + _len_field(2, type_proto)
+
+
+# AttributeProto.AttributeType
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 6, 7, 8
+
+
+def attribute(name: str, value) -> bytes:
+    """AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8, type=20."""
+    out = _str_field(1, name)
+    if isinstance(value, bool):
+        out += _int_field(3, int(value)) + _int_field(20, ATTR_INT)
+    elif isinstance(value, int):
+        out += _int_field(3, value) + _int_field(20, ATTR_INT)
+    elif isinstance(value, float):
+        out += _tag(2, 5) + np.float32(value).tobytes() + _int_field(20, ATTR_FLOAT)
+    elif isinstance(value, str):
+        out += _len_field(4, value.encode()) + _int_field(20, ATTR_STRING)
+    elif isinstance(value, np.ndarray):
+        out += _len_field(5, tensor_proto(name, value)) + _int_field(20, ATTR_TENSOR)
+    elif isinstance(value, (list, tuple)) and all(isinstance(v, int) for v in value):
+        out += b"".join(_int_field(8, v) for v in value) + _int_field(20, ATTR_INTS)
+    elif isinstance(value, (list, tuple)):
+        out += b"".join(_tag(7, 5) + np.float32(v).tobytes() for v in value)
+        out += _int_field(20, ATTR_FLOATS)
+    else:
+        raise TypeError(f"unsupported attribute {name}={value!r}")
+    return out
+
+
+def node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+         name: str = "", attrs: Optional[Dict] = None) -> bytes:
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    out = b"".join(_str_field(1, i) for i in inputs)
+    out += b"".join(_str_field(2, o) for o in outputs)
+    if name:
+        out += _str_field(3, name)
+    out += _str_field(4, op_type)
+    for k, v in (attrs or {}).items():
+        out += _len_field(5, attribute(k, v))
+    return out
+
+
+def graph(nodes: Sequence[bytes], name: str,
+          inputs: Sequence[bytes], outputs: Sequence[bytes],
+          initializers: Sequence[bytes]) -> bytes:
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    out = b"".join(_len_field(1, n) for n in nodes)
+    out += _str_field(2, name)
+    out += b"".join(_len_field(5, t) for t in initializers)
+    out += b"".join(_len_field(11, vi) for vi in inputs)
+    out += b"".join(_len_field(12, vi) for vi in outputs)
+    return out
+
+
+def model(graph_payload: bytes, opset: int = 13, ir_version: int = 8,
+          producer: str = "paddle_tpu") -> bytes:
+    """ModelProto: ir_version=1, producer_name=2, graph=7, opset_import=8."""
+    opset_id = _int_field(2, opset)  # OperatorSetIdProto: domain=1, version=2
+    out = _int_field(1, ir_version)
+    out += _str_field(2, producer)
+    out += _len_field(7, graph_payload)
+    out += _len_field(8, opset_id)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reader (inverse, for verification/inspection)
+# ---------------------------------------------------------------------------
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) — value is int for varint,
+    bytes for length-delimited, raw bytes for fixed32/64."""
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            n, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + n]
+            pos += n
+        elif wire == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"wire type {wire}")
+        yield field, wire, val
+
+
+def read_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
+    dims: List[int] = []
+    dtype = FLOAT
+    name = ""
+    raw = b""
+    for field, _, val in _fields(buf):
+        if field == 1:
+            dims.append(val)
+        elif field == 2:
+            dtype = val
+        elif field == 8:
+            name = val.decode()
+        elif field == 9:
+            raw = val
+    if dtype == BFLOAT16:
+        arr = np.frombuffer(raw, np.uint16).astype(np.uint32) << 16
+        arr = arr.view(np.float32).astype(np.float32).reshape(dims)
+    else:
+        arr = np.frombuffer(raw, _ONNX_TO_NP[dtype]).reshape(dims)
+    return name, arr
+
+
+def read_attribute(buf: bytes):
+    name = ""
+    atype = None
+    vals = {"i": None, "f": None, "s": None, "t": None, "ints": [], "floats": []}
+    for field, _, val in _fields(buf):
+        if field == 1:
+            name = val.decode()
+        elif field == 20:
+            atype = val
+        elif field == 3:
+            vals["i"] = val if val < (1 << 63) else val - (1 << 64)
+        elif field == 2:
+            vals["f"] = float(np.frombuffer(val, np.float32)[0])
+        elif field == 4:
+            vals["s"] = val.decode()
+        elif field == 5:
+            vals["t"] = read_tensor(val)[1]
+        elif field == 8:
+            vals["ints"].append(val if val < (1 << 63) else val - (1 << 64))
+        elif field == 7:
+            vals["floats"].append(float(np.frombuffer(val, np.float32)[0]))
+    if atype == ATTR_INTS:
+        return name, vals["ints"]
+    if atype == ATTR_FLOATS:
+        return name, vals["floats"]
+    if atype == ATTR_INT:
+        return name, vals["i"]
+    if atype == ATTR_FLOAT:
+        return name, vals["f"]
+    if atype == ATTR_STRING:
+        return name, vals["s"]
+    if atype == ATTR_TENSOR:
+        return name, vals["t"]
+    return name, vals["i"] if vals["i"] is not None else vals["f"]
+
+
+def read_node(buf: bytes) -> Dict:
+    n = {"input": [], "output": [], "name": "", "op_type": "", "attrs": {}}
+    for field, _, val in _fields(buf):
+        if field == 1:
+            n["input"].append(val.decode())
+        elif field == 2:
+            n["output"].append(val.decode())
+        elif field == 3:
+            n["name"] = val.decode()
+        elif field == 4:
+            n["op_type"] = val.decode()
+        elif field == 5:
+            k, v = read_attribute(val)
+            n["attrs"][k] = v
+    return n
+
+
+def _read_value_info(buf: bytes) -> Dict:
+    out = {"name": "", "dtype": None, "shape": []}
+    for field, _, val in _fields(buf):
+        if field == 1:
+            out["name"] = val.decode()
+        elif field == 2:
+            for f2, _, v2 in _fields(val):
+                if f2 == 1:  # tensor_type
+                    for f3, _, v3 in _fields(v2):
+                        if f3 == 1:
+                            out["dtype"] = v3
+                        elif f3 == 2:
+                            for f4, _, v4 in _fields(v3):
+                                if f4 == 1:
+                                    for f5, _, v5 in _fields(v4):
+                                        if f5 == 1:
+                                            out["shape"].append(v5)
+    return out
+
+
+def read_model(buf: bytes) -> Dict:
+    """Parse a serialized ModelProto into a dict:
+    {ir_version, opset, producer, graph: {name, nodes, initializers,
+    inputs, outputs}}."""
+    out = {"ir_version": None, "opset": None, "producer": "", "graph": None}
+    for field, _, val in _fields(buf):
+        if field == 1:
+            out["ir_version"] = val
+        elif field == 2:
+            out["producer"] = val.decode()
+        elif field == 8:
+            for f2, _, v2 in _fields(val):
+                if f2 == 2:
+                    out["opset"] = v2
+        elif field == 7:
+            g = {"name": "", "nodes": [], "initializers": {}, "inputs": [],
+                 "outputs": []}
+            for f2, _, v2 in _fields(val):
+                if f2 == 1:
+                    g["nodes"].append(read_node(v2))
+                elif f2 == 2:
+                    g["name"] = v2.decode()
+                elif f2 == 5:
+                    name, arr = read_tensor(v2)
+                    g["initializers"][name] = arr
+                elif f2 == 11:
+                    g["inputs"].append(_read_value_info(v2))
+                elif f2 == 12:
+                    g["outputs"].append(_read_value_info(v2))
+            out["graph"] = g
+    return out

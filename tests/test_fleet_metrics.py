@@ -1,0 +1,48 @@
+"""fleet.metrics distributed metric reductions (reference
+``python/paddle/distributed/fleet/metrics/metric.py``)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet import metrics
+
+
+class TestSingleProcessIdentity:
+    """world_size 1: every reduction is the identity over its accumulator."""
+
+    def test_sum_max_min(self):
+        np.testing.assert_allclose(metrics.sum(np.array([1.0, 2.0])), [1.0, 2.0])
+        assert float(metrics.max(3.5)) == 3.5
+        assert float(metrics.min(paddle.to_tensor(np.float32(-2.0)))) == -2.0
+
+    def test_acc_mae_mse_rmse(self):
+        assert metrics.acc(correct=30, total=40) == 0.75
+        assert metrics.acc(correct=0, total=0) == 0.0
+        assert abs(metrics.mae(abserr=10.0, total_ins_num=4) - 2.5) < 1e-12
+        assert abs(metrics.mse(sqrerr=16.0, total_ins_num=4) - 4.0) < 1e-12
+        assert abs(metrics.rmse(sqrerr=16.0, total_ins_num=4) - 2.0) < 1e-12
+
+    def test_auc_perfect_and_random(self):
+        # scores bucketed 0..9; all positives in the top bucket -> AUC 1
+        pos = np.zeros(10); pos[9] = 100
+        neg = np.zeros(10); neg[0] = 100
+        assert abs(metrics.auc(pos, neg) - 1.0) < 1e-9
+        # identical score distributions -> AUC 0.5
+        pos = np.ones(10) * 10
+        neg = np.ones(10) * 5
+        assert abs(metrics.auc(pos, neg) - 0.5) < 1e-9
+        # degenerate: one class absent
+        assert metrics.auc(np.zeros(4), np.ones(4)) == 0.0
+
+    def test_auc_matches_sklearn_style_reference(self):
+        """Histogram AUC equals the exact pairwise-comparison AUC."""
+        rng = np.random.default_rng(0)
+        n_buckets = 100
+        pos_scores = rng.integers(30, n_buckets, 500)
+        neg_scores = rng.integers(0, 80, 400)
+        pos = np.bincount(pos_scores, minlength=n_buckets).astype(float)
+        neg = np.bincount(neg_scores, minlength=n_buckets).astype(float)
+        # exact AUC: P(score_pos > score_neg) + 0.5 P(equal)
+        gt = (pos_scores[:, None] > neg_scores[None, :]).mean() \
+            + 0.5 * (pos_scores[:, None] == neg_scores[None, :]).mean()
+        assert abs(metrics.auc(pos, neg) - gt) < 1e-9

@@ -1,0 +1,184 @@
+"""paddle.onnx export (reference ``python/paddle/onnx/export.py``).
+
+The exporter is self-contained (no onnx wheel in this environment), so the
+tests verify it end-to-end: round-trip the protobuf wire format with the
+in-repo reader, then NUMERICALLY re-execute the exported graph with a
+numpy evaluator and compare against the live model's outputs.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.onnx import export, load_graph
+from paddle_tpu.onnx import proto
+
+
+# ---------------------------------------------------------------------------
+# tiny numpy ONNX evaluator (tests only)
+# ---------------------------------------------------------------------------
+
+def _run_graph(g, feeds):
+    vals = dict(g["initializers"])
+    vals.update(feeds)
+
+    def conv(x, w, attrs):
+        import jax.lax as lax
+
+        pads = attrs.get("pads") or [0] * (2 * (x.ndim - 2))
+        half = len(pads) // 2
+        padding = list(zip(pads[:half], pads[half:]))
+        out = lax.conv_general_dilated(
+            x.astype(np.float32), w.astype(np.float32),
+            window_strides=attrs.get("strides") or [1] * (x.ndim - 2),
+            padding=padding,
+            rhs_dilation=attrs.get("dilations") or [1] * (x.ndim - 2),
+            feature_group_count=attrs.get("group", 1))
+        return np.asarray(out)
+
+    ops = {
+        "Add": lambda a, b: a + b,
+        "Sub": lambda a, b: a - b,
+        "Mul": lambda a, b: a * b,
+        "Div": lambda a, b: a / b,
+        "Max": lambda *xs: __import__("functools").reduce(np.maximum, xs),
+        "Min": lambda *xs: __import__("functools").reduce(np.minimum, xs),
+        "Pow": lambda a, b: a ** b,
+        "Neg": lambda a: -a,
+        "Exp": np.exp,
+        "Log": np.log,
+        "Tanh": np.tanh,
+        "Sigmoid": lambda a: 1.0 / (1.0 + np.exp(-a)),
+        "Sqrt": np.sqrt,
+        "Abs": np.abs,
+        "Erf": lambda a: np.vectorize(__import__("math").erf)(a).astype(a.dtype),
+        "Reciprocal": lambda a: 1.0 / a,
+        "Identity": lambda a: a,
+        "MatMul": lambda a, b: a @ b,
+        "Reshape": lambda a, s: a.reshape([int(d) for d in s]),
+        "Expand": lambda a, s: np.broadcast_to(
+            a, np.broadcast_shapes(tuple(int(d) for d in s), a.shape)),
+        "Transpose": None,  # attr-dependent, handled below
+        "Where": lambda c, a, b: np.where(c, a, b),
+        "Greater": lambda a, b: a > b,
+        "Less": lambda a, b: a < b,
+        "Equal": lambda a, b: a == b,
+        "Concat": None,
+    }
+
+    for node in g["nodes"]:
+        ins = [vals[i] for i in node["input"]]
+        at = node["attrs"]
+        op = node["op_type"]
+        if op == "Transpose":
+            out = np.transpose(ins[0], at["perm"])
+        elif op == "Concat":
+            out = np.concatenate(ins, axis=at["axis"])
+        elif op == "Cast":
+            out = ins[0].astype(proto._ONNX_TO_NP[at["to"]])
+        elif op == "ReduceSum":
+            out = np.sum(ins[0], axis=tuple(int(a) for a in ins[1]),
+                         keepdims=bool(at.get("keepdims", 1)))
+        elif op == "ReduceMax":
+            out = np.max(ins[0], axis=tuple(at["axes"]),
+                         keepdims=bool(at.get("keepdims", 1)))
+        elif op == "ReduceMin":
+            out = np.min(ins[0], axis=tuple(at["axes"]),
+                         keepdims=bool(at.get("keepdims", 1)))
+        elif op == "Conv":
+            out = conv(ins[0], ins[1], at)
+        elif op == "Slice":
+            starts, ends = ins[1], ins[2]
+            axes = ins[3] if len(ins) > 3 else np.arange(len(starts))
+            steps = ins[4] if len(ins) > 4 else np.ones(len(starts), np.int64)
+            sl = [slice(None)] * ins[0].ndim
+            for a, s, e, st in zip(axes, starts, ends, steps):
+                sl[int(a)] = slice(int(s), int(e), int(st))
+            out = ins[0][tuple(sl)]
+        elif op in ops and ops[op] is not None:
+            out = ops[op](*ins)
+        else:
+            raise NotImplementedError(f"evaluator: {op}")
+        vals[node["output"][0]] = np.asarray(out)
+
+    return [vals[o["name"]] for o in g["outputs"]]
+
+
+def _export_and_check(model, x_np, atol=1e-5, path_name="model"):
+    ref = np.asarray(model(paddle.to_tensor(x_np))._data)
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as d:
+        path = export(model, os.path.join(d, path_name),
+                      input_spec=[paddle.to_tensor(x_np)])
+        assert path.endswith(".onnx") and os.path.exists(path)
+        m = load_graph(path)
+    assert m["ir_version"] == 8 and m["opset"] == 13
+    g = m["graph"]
+    assert g["inputs"] and g["outputs"] and g["nodes"]
+    (out,) = _run_graph(g, {g["inputs"][0]["name"]: x_np})
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=atol)
+    return m
+
+
+class TestOnnxExport:
+    def test_mlp_roundtrip_and_numerics(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+        model.eval()
+        x = np.random.default_rng(0).normal(size=(4, 16)).astype(np.float32)
+        m = _export_and_check(model, x)
+        op_types = {n["op_type"] for n in m["graph"]["nodes"]}
+        assert "MatMul" in op_types
+        # weights travelled as initializers
+        shapes = sorted(tuple(v.shape) for v in m["graph"]["initializers"].values()
+                        if v.ndim == 2)
+        assert (16, 32) in shapes and (32, 8) in shapes
+
+    def test_softmax_classifier(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(10, 6), nn.Softmax())
+        model.eval()
+        x = np.random.default_rng(1).normal(size=(3, 10)).astype(np.float32)
+        _export_and_check(model, x)
+
+    def test_conv_net(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                              nn.Conv2D(8, 4, 3, stride=2))
+        model.eval()
+        x = np.random.default_rng(2).normal(size=(2, 3, 12, 12)).astype(np.float32)
+        m = _export_and_check(model, x, atol=1e-4)
+        convs = [n for n in m["graph"]["nodes"] if n["op_type"] == "Conv"]
+        assert len(convs) == 2
+        assert convs[0]["attrs"]["pads"] == [1, 1, 1, 1]
+        assert convs[1]["attrs"]["strides"] == [2, 2]
+
+    def test_input_spec_objects(self):
+        """static.InputSpec-style specs (shape/dtype, batch dim None) work."""
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(5, 2))
+        model.eval()
+
+        class Spec:
+            shape = (None, 5)
+            dtype = "float32"
+
+        import tempfile, os
+
+        with tempfile.TemporaryDirectory() as d:
+            path = export(model, os.path.join(d, "m"), input_spec=[Spec()])
+            g = load_graph(path)["graph"]
+        assert g["inputs"][0]["shape"] == [1, 5]
+
+    def test_unsupported_primitive_raises(self):
+        """A graph with a Pallas kernel (flash attention) must fail loudly,
+        not emit a broken file."""
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny_config())
+        ids = paddle.to_tensor(np.zeros((1, 8), np.int32))
+        with pytest.raises((NotImplementedError, ValueError)):
+            export(model, "/tmp/llama_should_fail", input_spec=[ids])

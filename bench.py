@@ -15,8 +15,10 @@ Presets:
            conv-heavy fusion-path recipe); images/s + MFU from XLA cost analysis
   moe    — Qwen2-MoE/DeepSeekMoE-style Llama-MoE training (BASELINE configs[4]);
            tokens/s + MFU from XLA cost analysis (routing makes 6P wrong)
+  longctx— the 0.7B model at seq 16384 on ONE chip (streaming flash kernels
+           page K/V through VMEM; full remat): the long-context capability row
 
-Usage: python bench.py [--preset tiny|small|base|ocr|moe] [--device cpu|tpu]
+Usage: python bench.py [--preset tiny|small|base|longctx|ocr|moe] [--device cpu|tpu]
        [--steps N] [--batch B] [--seq S]
 """
 
@@ -80,6 +82,16 @@ def build_config(preset: str, dtype: str):
                            num_key_value_heads=8, max_position_embeddings=2048,
                            dtype=dtype, recompute=False,
                            param_dtype="float32" if dtype != "float32" else None)
+    if preset == "longctx":
+        # the long-sequence capability headline: the SAME 0.7B model at seq
+        # 16384 on one chip (b1) — causal flash keeps attention O(S) memory,
+        # remat bounds activations; multi-chip scales further via ring
+        # attention over 'sep' (context_parallel.py)
+        return LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                           num_hidden_layers=12, num_attention_heads=16,
+                           num_key_value_heads=8, max_position_embeddings=16384,
+                           dtype=dtype, recompute=True,
+                           param_dtype="float32" if dtype != "float32" else None)
     raise ValueError(preset)
 
 
@@ -87,6 +99,7 @@ DEFAULTS = {  # preset -> (batch, seq, steps)
     "tiny": (4, 128, 5),
     "small": (8, 2048, 10),
     "base": (3, 2048, 10),  # b3 beats b4 by ~2% once spills clear (PERF.md)
+    "longctx": (1, 16384, 5),
 }
 
 
@@ -287,7 +300,7 @@ def _bench_moe(jax, paddle, backend, on_tpu, args):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--preset", default=None, choices=["tiny", "small", "base", "ocr", "moe"])
+    ap.add_argument("--preset", default=None, choices=["tiny", "small", "base", "longctx", "ocr", "moe"])
     ap.add_argument("--device", default=None, choices=["cpu", "tpu"])
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--batch", type=int, default=None)

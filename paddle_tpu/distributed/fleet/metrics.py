@@ -21,8 +21,6 @@ from .. import collective
 
 __all__ = ["sum", "max", "min", "auc", "mae", "rmse", "mse", "acc"]
 
-_builtin_sum, _builtin_max, _builtin_min = sum, max, min
-
 
 def _to_array(x) -> np.ndarray:
     if isinstance(x, Tensor):
@@ -31,17 +29,23 @@ def _to_array(x) -> np.ndarray:
 
 
 def _global_reduce(x, op: str, group=None) -> np.ndarray:
-    arr = np.ascontiguousarray(_to_array(x), dtype=np.float64)
-    if collective.get_world_size(group) <= 1:
+    arr = np.asarray(_to_array(x), dtype=np.float64)
+    import jax
+
+    # single process (incl. the simulated-8-device mesh): identity, even for
+    # subgroups — there is only one rank's worth of data to reduce
+    if jax.process_count() <= 1 or collective.get_world_size(group) <= 1:
         return arr
     # Transport BIT-EXACT: jax (x64 disabled) would downcast an f64 payload to
     # f32 inside process_allgather and round counters above 2^24 — so gather
-    # the raw bits as uint32 and reduce in float64 on the host.
-    bits = arr.reshape(-1).view(np.uint32)
+    # the raw bits as uint32 and reduce in float64 on the host.  Only the
+    # transport copy is flattened; the caller's shape (incl. 0-d) is restored.
+    bits = np.ascontiguousarray(arr.reshape(-1)).view(np.uint32)
     rows = collective._gather_rows(bits)
     rows_f64 = np.ascontiguousarray(rows).view(np.float64)
-    rows_f64 = rows_f64.reshape((rows.shape[0],) + arr.shape)
-    return collective._reduce_rows(rows_f64[collective._group_ranks(group)], op)
+    rows_f64 = rows_f64.reshape((rows.shape[0],) + arr.reshape(-1).shape)
+    out = collective._reduce_rows(rows_f64[collective._group_ranks(group)], op)
+    return out.reshape(arr.shape)
 
 
 def sum(input, scope=None, util=None, group=None):

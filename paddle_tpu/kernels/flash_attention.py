@@ -94,12 +94,123 @@ def _flash_fwd_bh(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     return o
 
 
+# full-KV (or full-Q) residency budget per kernel instance; beyond it the
+# streaming variants page blocks through a third grid dimension instead
+# (v5e scoped VMEM is ~16MB; 2 resident streams of Sk*D*2B must fit beside
+# the working blocks)
+_VMEM_RESIDENT_BYTES = 2 * 1024 * 1024
+
+
+def _resident_ok(S: int, D: int, itemsize: int) -> bool:
+    return S * D * itemsize <= _VMEM_RESIDENT_BYTES
+
+
+def _replicated(vec, width: int = 128):
+    """[n] -> [n, width] lane-replicated (TPU scratch wants 2D tiles)."""
+    return jnp.broadcast_to(vec[:, None], (vec.shape[0], width))
+
+
+def _flash_fwd_stream(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    """Streaming forward: grid (BH, n_q, n_k) with K/V paged per k-step and
+    the online-softmax state carried in VMEM scratch — VMEM use is O(block)
+    regardless of sequence length (the resident kernel keeps full K/V in
+    VMEM and dies around seq 16k on v5e)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    n_q = Sq // block_q
+    n_k = Sk // block_k
+    off = Sk - Sq
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref):
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+
+        @pl.when(ki == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
+
+        active = (ki * block_k <= qi * block_q + block_q - 1 + off) \
+            if causal else (ki >= 0)
+
+        @pl.when(active)
+        def _step():
+            qb = q_ref[0].astype(jnp.float32)
+            kb = k_ref[0].astype(jnp.float32)
+            vb = v_ref[0].astype(jnp.float32)
+            s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * sm_scale
+            if causal:
+                s = _causal_mask(s, qi, ki, block_q, block_k, off)
+            m_prev = jnp.max(m_ref[...], axis=1)   # lane-replicated -> [bq]
+            l_prev = jnp.max(l_ref[...], axis=1)
+            m_cur = jnp.max(s, axis=1)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[...] = _replicated(alpha * l_prev + jnp.sum(p, axis=1))
+            acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            m_ref[...] = _replicated(m_new)
+
+        @pl.when(ki == n_k - 1)
+        def _finalize():
+            l_fin = jnp.max(l_ref[...], axis=1)
+            m_fin = jnp.max(m_ref[...], axis=1)
+            l_safe = jnp.maximum(l_fin, 1e-30)
+            o_ref[0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+            lse_ref[0, 0] = (m_fin + jnp.log(l_safe)).astype(jnp.float32)
+
+    if causal:
+        # clamp the paged K/V index into the active (<= diagonal) range:
+        # pl.when skips the COMPUTE of masked steps, but the pipeline would
+        # still DMA their blocks — a repeated identical index elides the fetch
+        def kv_idx(b, i, j):
+            hi = (i * block_q + block_q - 1 + off) // block_k
+            return (b, jnp.minimum(j, hi), 0)
+    else:
+        def kv_idx(b, i, j):
+            return (b, j, 0)
+
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(BH, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), kv_idx),
+            pl.BlockSpec((1, block_k, D), kv_idx),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, 1, Sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
 def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     """q,k,v: [BH, S, D]. Returns (o, lse) with lse: [BH, 1, Sq]."""
     from jax.experimental import pallas as pl
 
     BH, Sq, D = q.shape
     Sk = k.shape[1]
+    if not _resident_ok(Sk, D, q.dtype.itemsize):
+        return _flash_fwd_stream(q, k, v, causal, sm_scale, block_q, block_k,
+                                 interpret)
     n_q = Sq // block_q
     n_k = Sk // block_k
 
@@ -171,6 +282,160 @@ def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, do):
 _flash_fwd_bh.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def _flash_bwd_stream(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
+                      interpret):
+    """Streaming two-pass backward: the opposing operand is paged through a
+    third grid dim with accumulators in VMEM scratch (see _flash_fwd_stream)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    n_q = Sq // block_q
+    n_k = Sk // block_k
+    off = Sk - Sq
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[:, None, :]
+
+    def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dk_ref, dv_ref, dk_acc_ref, dv_acc_ref):
+        ki = pl.program_id(1)
+        qi = pl.program_id(2)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+            dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+        # causal: q block contributes iff its last row reaches this k block
+        active = (qi * block_q + block_q - 1 + off >= ki * block_k) \
+            if causal else (qi >= 0)
+
+        @pl.when(active)
+        def _step():
+            kb = k_ref[0].astype(jnp.float32)
+            vb = v_ref[0].astype(jnp.float32)
+            qb = q_ref[0].astype(jnp.float32)
+            dob = do_ref[0].astype(jnp.float32)
+            lseb = lse_ref[0, 0]
+            deltab = delta_ref[0, 0]
+            s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * sm_scale
+            if causal:
+                s = _causal_mask(s, qi, ki, block_q, block_k, off)
+            p = jnp.exp(s - lseb[:, None])
+            dv_acc_ref[...] += jax.lax.dot_general(
+                p, dob, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - deltab[:, None]) * sm_scale
+            dk_acc_ref[...] += jax.lax.dot_general(
+                ds, qb, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+        @pl.when(qi == n_q - 1)
+        def _finalize():
+            dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+            dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+    if causal:
+        # q-side blocks below the causal lower bound never contribute to this
+        # k block; clamping the index avoids their DMA (see fwd kv_idx)
+        def q_row(i, j):
+            lo = jnp.maximum((i * block_k - off) // block_q, 0)
+            return jnp.maximum(j, lo)
+    else:
+        def q_row(i, j):
+            return j
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(BH, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, q_row(i, j), 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, q_row(i, j), 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, q_row(i, j))),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, q_row(i, j))),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sk, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    def dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                  dq_acc_ref):
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+
+        @pl.when(ki == 0)
+        def _init():
+            dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+        active = (ki * block_k <= qi * block_q + block_q - 1 + off) \
+            if causal else (ki >= 0)
+
+        @pl.when(active)
+        def _step():
+            qb = q_ref[0].astype(jnp.float32)
+            dob = do_ref[0].astype(jnp.float32)
+            lseb = lse_ref[0, 0]
+            deltab = delta_ref[0, 0]
+            kb = k_ref[0].astype(jnp.float32)
+            vb = v_ref[0].astype(jnp.float32)
+            s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * sm_scale
+            if causal:
+                s = _causal_mask(s, qi, ki, block_q, block_k, off)
+            p = jnp.exp(s - lseb[:, None])
+            dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - deltab[:, None]) * sm_scale
+            dq_acc_ref[...] += jax.lax.dot_general(
+                ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+        @pl.when(ki == n_k - 1)
+        def _finalize():
+            dq_ref[0] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+    if causal:
+        def kv_idx(b, i, j):
+            hi = (i * block_q + block_q - 1 + off) // block_k
+            return (b, jnp.minimum(j, hi), 0)
+    else:
+        def kv_idx(b, i, j):
+            return (b, j, 0)
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(BH, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), kv_idx),
+            pl.BlockSpec((1, block_k, D), kv_idx),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    return dq, dk, dv
+
+
 def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, interpret):
     """Two-pass flash backward: dKV pass (grid over KV blocks) and dQ pass.
 
@@ -180,6 +445,10 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k, int
 
     BH, Sq, D = q.shape
     Sk = k.shape[1]
+    if not (_resident_ok(Sk, D, q.dtype.itemsize)
+            and _resident_ok(Sq, D, q.dtype.itemsize)):
+        return _flash_bwd_stream(q, k, v, o, lse, do, causal, sm_scale,
+                                 block_q, block_k, interpret)
     n_q = Sq // block_q
     n_k = Sk // block_k
 

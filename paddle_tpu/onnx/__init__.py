@@ -349,18 +349,34 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
 
     if input_spec is None:
         raise ValueError("onnx.export needs input_spec (example inputs)")
+    if not 13 <= int(opset_version) <= 17:
+        raise ValueError(
+            f"opset_version={opset_version} unsupported: the emitted op set "
+            "follows opset 13 semantics (ReduceSum axes-as-input, "
+            "ReduceMax/Min axes-as-attribute), valid through opset 17")
 
     examples = []
+    dynamic_axes: List[List[int]] = []  # per input: axes traced at 1 but dynamic
     for spec in input_spec:
         if isinstance(spec, Tensor):
             examples.append(spec._data)
+            dynamic_axes.append([])
         elif hasattr(spec, "shape") and hasattr(spec, "dtype") and not isinstance(
                 spec, np.ndarray):
-            # static.InputSpec normalizes None dims to -1; both mean "dynamic"
-            dims = [1 if d is None or int(d) < 0 else int(d) for d in spec.shape]
+            # static.InputSpec normalizes None dims to -1; both mean "dynamic":
+            # trace with 1 and declare a symbolic dim_param on the graph input
+            dims, dyn = [], []
+            for ax, d in enumerate(spec.shape):
+                if d is None or int(d) < 0:
+                    dims.append(1)
+                    dyn.append(ax)
+                else:
+                    dims.append(int(d))
             examples.append(np.zeros(dims, np.dtype(str(spec.dtype))))
+            dynamic_axes.append(dyn)
         else:
             examples.append(np.asarray(spec))
+            dynamic_axes.append([])
 
     params = {n: p._data for n, p in layer.named_parameters()}
     buffers = {n: b._data for n, b in layer.named_buffers()}
@@ -374,12 +390,15 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
     jaxpr = closed.jaxpr
 
     input_names, input_vis = [], []
-    for var, ex in zip(jaxpr.invars, examples):
+    for idx, (var, ex) in enumerate(zip(jaxpr.invars, examples)):
         nm = conv.fresh("input_")
         conv.names[var] = nm
         input_names.append(nm)
+        dims = list(var.aval.shape)
+        for ax in dynamic_axes[idx]:
+            dims[ax] = f"{nm}_dim{ax}"  # symbolic dim_param
         input_vis.append(proto.value_info(
-            nm, proto.onnx_dtype(var.aval.dtype), var.aval.shape))
+            nm, proto.onnx_dtype(var.aval.dtype), dims))
     for cv, cval in zip(jaxpr.constvars, closed.consts):
         conv.names[cv] = conv.add_init(_np_of(cval), "p")
 

@@ -95,9 +95,16 @@ def tensor_proto(name: str, arr: np.ndarray) -> bytes:
     return out
 
 
-def _tensor_shape(dims: Sequence[int]) -> bytes:
-    """TensorShapeProto: dim=1; Dim.dim_value=1."""
-    return b"".join(_len_field(1, _int_field(1, int(d))) for d in dims)
+def _tensor_shape(dims: Sequence) -> bytes:
+    """TensorShapeProto: dim=1; Dim.dim_value=1 (int) / dim_param=2 (symbolic
+    string, used for dynamic axes like the batch dim)."""
+    out = b""
+    for d in dims:
+        if isinstance(d, str):
+            out += _len_field(1, _str_field(2, d))
+        else:
+            out += _len_field(1, _int_field(1, int(d)))
+    return out
 
 
 def value_info(name: str, dtype: int, shape: Sequence[int]) -> bytes:
@@ -304,6 +311,8 @@ def _read_value_info(buf: bytes) -> Dict:
                                     for f5, _, v5 in _fields(v4):
                                         if f5 == 1:
                                             out["shape"].append(v5)
+                                        elif f5 == 2:  # dim_param (symbolic)
+                                            out["shape"].append(v5.decode())
     return out
 
 

@@ -214,3 +214,58 @@ def test_swiglu():
     a, b = x[:, :4], x[:, 4:]
     ref = a / (1 + np.exp(-a)) * b
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_streaming_kernels_interpret(causal, monkeypatch):
+    """The streaming (paged K/V + scratch carry) fwd/bwd variants — selected
+    automatically above the VMEM residency budget — match the XLA reference.
+    Forced here by shrinking the budget so small shapes take the stream path."""
+    monkeypatch.setattr(fa, "_VMEM_RESIDENT_BYTES", 1)  # always stream
+    B, S, H, D = 1, 256, 2, 64
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    sm = 1.0 / np.sqrt(D)
+
+    out = fa._pallas_flash(q, k, v, causal, sm, interpret=True)
+    ref = fa._attention_reference(q, k, v, causal, None, sm)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+    def f_pallas(q, k, v):
+        return fa._pallas_flash(q, k, v, causal, sm, interpret=True).sum()
+
+    def f_ref(q, k, v):
+        return fa._attention_reference(q, k, v, causal, None, sm).sum()
+
+    gp = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3,
+                                   atol=2e-3, err_msg=f"d{name} mismatch (stream)")
+
+
+def test_flash_streaming_nonsquare_interpret(monkeypatch):
+    """Streaming variants with Sq != Sk (cross-attention diagonal offset)."""
+    monkeypatch.setattr(fa, "_VMEM_RESIDENT_BYTES", 1)
+    B, H, D = 1, 2, 64
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(B, 128, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, 256, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, 256, H, D).astype(np.float32))
+    sm = 1.0 / np.sqrt(D)
+    out = fa._pallas_flash(q, k, v, True, sm, interpret=True)
+    ref = fa._attention_reference(q, k, v, True, None, sm)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+    gp = jax.grad(lambda q, k, v: fa._pallas_flash(q, k, v, True, sm,
+                                                   interpret=True).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: fa._attention_reference(q, k, v, True, None,
+                                                          sm).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3,
+                                   atol=2e-3, err_msg=f"d{name} mismatch")

@@ -156,7 +156,8 @@ class TestOnnxExport:
         assert convs[1]["attrs"]["strides"] == [2, 2]
 
     def test_input_spec_objects(self):
-        """static.InputSpec-style specs (shape/dtype, batch dim None) work."""
+        """static.InputSpec-style specs: dynamic (None/-1) dims become
+        symbolic dim_param entries on the graph input, not a baked 1."""
         paddle.seed(0)
         model = nn.Sequential(nn.Linear(5, 2))
         model.eval()
@@ -170,7 +171,24 @@ class TestOnnxExport:
         with tempfile.TemporaryDirectory() as d:
             path = export(model, os.path.join(d, "m"), input_spec=[Spec()])
             g = load_graph(path)["graph"]
-        assert g["inputs"][0]["shape"] == [1, 5]
+        batch_dim, feat_dim = g["inputs"][0]["shape"]
+        assert isinstance(batch_dim, str) and batch_dim  # symbolic
+        assert feat_dim == 5
+
+        from paddle_tpu.static import InputSpec  # the real one (-1 dims)
+
+        with tempfile.TemporaryDirectory() as d:
+            path = export(model, os.path.join(d, "m2"),
+                          input_spec=[InputSpec(shape=[None, 5], dtype="float32")])
+            g = load_graph(path)["graph"]
+        assert isinstance(g["inputs"][0]["shape"][0], str)
+
+    def test_bad_opset_rejected(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(3, 2))
+        with pytest.raises(ValueError, match="opset"):
+            export(model, "/tmp/bad_opset", input_spec=[
+                paddle.to_tensor(np.zeros((1, 3), np.float32))], opset_version=11)
 
     def test_unsupported_primitive_raises(self):
         """A graph with a Pallas kernel (flash attention) must fail loudly,

@@ -136,22 +136,12 @@ def _peak_flops(jax, on_tpu):
 
 
 def _step_flops_of(lowered) -> float:
-    """FLOPs of a lowered step: HLO-level analysis first (free), compiled
-    executable's analysis as fallback (the remote TPU plugin implements only
-    the latter; the program is already in the compile cache by bench time)."""
-    try:
-        cost = lowered.cost_analysis()
-        if cost and cost.get("flops"):
-            return float(cost["flops"])
-    except Exception:
-        pass
-    try:
-        cost = lowered.compile().cost_analysis()
-        if cost and cost.get("flops"):
-            return float(cost["flops"])
-    except Exception:
-        pass
-    return 0.0
+    """FLOPs of a lowered step via the shared cost-analysis helper (the
+    remote TPU plugin implements only the executable-level analysis; the
+    program is already in the compile cache by bench time)."""
+    from paddle_tpu.utils.xla_cost import flops_of_lowered
+
+    return flops_of_lowered(lowered) or 0.0
 
 
 def _bench_ocr(jax, paddle, backend, on_tpu, args):

@@ -65,7 +65,7 @@ from . import audio  # noqa: F401
 from . import utils  # noqa: F401
 from . import onnx  # noqa: F401
 from . import hapi  # noqa: F401
-from .hapi import Model, summary  # noqa: F401
+from .hapi import Model, summary, flops  # noqa: F401
 from . import linalg as _linalg_ns  # noqa: F401
 from . import fft  # noqa: F401
 

@@ -47,7 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="number of hosts in the job")
     p.add_argument("--rank", "--node_rank", dest="rank", type=int,
                    default=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
-                   help="this host's index [0, nnodes)")
+                   help="this host's index [0, nnodes); -1 = assign via "
+                        "store rendezvous at --master (reference "
+                        "HTTPMaster/ETCDMaster role)")
     p.add_argument("--nproc_per_node", type=int, default=1,
                    help="processes per host (1 on TPU; >1 only for CPU simulation)")
     p.add_argument("--max_restarts", type=int, default=3,
@@ -59,13 +61,13 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _child_env(args, local_rank: int) -> dict:
+def _child_env(args, local_rank: int, coordinator: Optional[str] = None) -> dict:
     env = dict(os.environ)
     nproc = args.nproc_per_node
     world = args.nnodes * nproc
     proc_id = args.rank * nproc + local_rank
     if world > 1:
-        master = args.master or f"127.0.0.1:8476"
+        master = coordinator or args.master or f"127.0.0.1:8476"
         env["PADDLE_TPU_COORDINATOR"] = master
         env["PADDLE_TPU_NUM_PROCESSES"] = str(world)
         env["PADDLE_TPU_PROCESS_ID"] = str(proc_id)
@@ -106,6 +108,26 @@ class _Proc:
 
 def launch(args) -> int:
     """Run the job on this host; returns the exit code."""
+    rdzv = None
+    coordinator = None
+    if args.rank < 0:
+        # dynamic rank assignment over the native TCPStore (the reference's
+        # launch-master role); requires --master and --nnodes
+        if not args.master:
+            raise SystemExit("--rank -1 (auto) needs --master host:port")
+        from .rendezvous import rendezvous
+
+        rdzv = rendezvous(args.master.replace("tcp://", ""), args.nnodes,
+                          job_id=args.job_id)
+        args.rank = rdzv.rank
+        # the rendezvous store OWNS the --master port for the job's lifetime;
+        # the PJRT coordination service must bind a DIFFERENT one, on the
+        # machine of PJRT process 0 (= the rank-0 node by arrival order)
+        host, port_s = args.master.replace("tcp://", "").rsplit(":", 1)
+        coord_port = (int(port_s) or rdzv.store.port) + 1
+        coordinator = f"{rdzv.peers[0]['host']}:{coord_port}"
+        print(f"[launch] rendezvous assigned node rank {args.rank}/{args.nnodes}"
+              f" (jax coordinator {coordinator})", file=sys.stderr)
     procs: List[_Proc] = []
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
@@ -113,7 +135,8 @@ def launch(args) -> int:
         cmd = [sys.executable, args.training_script] + list(args.training_script_args)
         log_path = (os.path.join(args.log_dir, f"{args.job_id}.rank{args.rank}.local{lr}.log")
                     if args.log_dir else None)
-        p = _Proc(cmd, _child_env(args, lr), log_path, tag=f"rank{args.rank}.{lr}")
+        p = _Proc(cmd, _child_env(args, lr, coordinator), log_path,
+                  tag=f"rank{args.rank}.{lr}")
         p.start()
         procs.append(p)
 
@@ -155,6 +178,8 @@ def launch(args) -> int:
                 except subprocess.TimeoutExpired:
                     p.popen.kill()
             p.close()
+        if rdzv is not None:
+            rdzv.store.close()
     return exit_code
 
 

@@ -1,0 +1,103 @@
+"""Launcher rendezvous over the native TCPStore.
+
+Counterpart of the reference's launch masters
+(``launch/controllers/master.py:35,73`` — ``HTTPMaster`` KV sync /
+``ETCDMaster`` registration): nodes join knowing only the master address and
+job size; ranks are assigned by the store's atomic counter and every node
+learns the full peer list before spawning trainers.
+
+The node that successfully BINDS the master port hosts the store (the
+reference's HTTPMaster works the same way: the process whose IP matches the
+master address serves); everyone else connects as a client.  Generation
+counting makes the same store reusable across elastic restarts.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..store import TCPStore
+
+__all__ = ["rendezvous", "RendezvousResult"]
+
+
+class RendezvousResult:
+    def __init__(self, rank: int, nnodes: int, peers: List[dict],
+                 store: TCPStore):
+        self.rank = rank
+        self.nnodes = nnodes
+        self.peers = peers          # [{rank, host}, ...] in rank order
+        self.store = store          # kept open: heartbeat/elastic use it
+
+    def __repr__(self):
+        return f"RendezvousResult(rank={self.rank}, nnodes={self.nnodes})"
+
+
+def _is_local(host: str) -> bool:
+    """Does ``host`` name this machine?  (The store server binds 0.0.0.0, so
+    'bind succeeded' would be true on EVERY machine — arbitration must be by
+    address, like the reference HTTPMaster serving only when the master IP
+    is local.)"""
+    if host in ("127.0.0.1", "localhost", "0.0.0.0", socket.gethostname()):
+        return True
+    try:
+        target = socket.gethostbyname(host)
+    except OSError:
+        return False
+    if target.startswith("127."):
+        return True
+    try:
+        local = socket.gethostbyname_ex(socket.gethostname())[2]
+    except OSError:
+        local = []
+    return target in local
+
+
+def _try_host(host: str, port: int, nnodes: int, timeout: float):
+    """Host the master store when the master address is THIS machine (falling
+    back to client if another local process already bound it); pure client
+    otherwise."""
+    if _is_local(host):
+        try:
+            return TCPStore(host, port, world_size=nnodes, is_master=True,
+                            timeout=timeout)
+        except OSError:
+            pass
+    return TCPStore(host, port, world_size=nnodes, is_master=False,
+                    timeout=timeout)
+
+
+def rendezvous(master: str, nnodes: int, job_id: str = "default",
+               timeout: float = 300.0) -> RendezvousResult:
+    """Join the job; blocks until all ``nnodes`` nodes registered.
+
+    Returns the assigned node rank and the full peer list.  Rank 0 is NOT
+    necessarily the store host — ranks come from arrival order (the
+    reference's ETCDMaster also assigns by registration order).
+
+    Failure semantics: a node that crashes AFTER joining but before its
+    generation completes leaves that generation short — the remaining
+    joiners raise ``TimeoutError`` after ``timeout`` (they never hang
+    forever).  Recover by restarting the whole set of nodes (the next
+    ``nnodes`` joins form a fresh generation) or restarting the master.
+    """
+    host, port_s = master.rsplit(":", 1)
+    store = _try_host(host, int(port_s), nnodes, timeout)
+
+    # ranks from the atomic join counter; a full round of nnodes joins forms
+    # one GENERATION, so elastic restarts re-entering rendezvous on the same
+    # store simply start the next generation (no state to reset)
+    joined = store.add(f"rdzv/{job_id}/joined", 1) - 1
+    gen, rank = divmod(joined, nnodes)
+    info = {"rank": rank, "host": socket.gethostname()}
+    store.set(f"rdzv/{job_id}/{gen}/node/{rank}", json.dumps(info))
+
+    peers: List[dict] = []
+    for r in range(nnodes):
+        raw = store.get(f"rdzv/{job_id}/{gen}/node/{r}")  # blocking
+        peers.append(json.loads(raw))
+    store.barrier(f"rdzv/{job_id}/{gen}/ready", timeout=timeout)
+    return RendezvousResult(rank, nnodes, peers, store)

@@ -29,6 +29,7 @@ __all__ = [
     "Exponential", "Gamma", "Beta", "Dirichlet", "Laplace", "LogNormal",
     "Gumbel", "Cauchy", "Geometric", "Poisson", "Binomial", "Multinomial",
     "Chi2", "StudentT", "MultivariateNormal", "Independent", "TransformedDistribution",
+    "Weibull", "Pareto", "LKJCholesky",
     "kl_divergence", "register_kl",
     "Transform", "AffineTransform", "ExpTransform", "SigmoidTransform",
     "TanhTransform", "PowerTransform", "ChainTransform", "SoftmaxTransform",
@@ -892,6 +893,140 @@ class TransformedDistribution(Distribution):
             ldj = ldj + t._fldj(x_prev)
             x = x_prev
         return self.base._log_prob(x) - ldj
+
+
+class Weibull(Distribution):
+    """Weibull(scale, concentration k) (reference ``distribution/weibull.py``)."""
+
+    def __init__(self, scale, concentration, name=None):
+        self._param("scale", scale)
+        self._param("concentration", concentration)
+        super().__init__(jnp.broadcast_shapes(self.scale.shape,
+                                              self.concentration.shape))
+
+    def _rsample(self, key, shape):
+        shp = shape + self.batch_shape
+        u = jax.random.uniform(key, shp, jnp.float32, minval=1e-7, maxval=1.0)
+        return self.scale * (-jnp.log(u)) ** (1.0 / self.concentration)
+
+    def _log_prob(self, x):
+        k, lam = self.concentration, self.scale
+        z = x / lam
+        return jnp.where(
+            x >= 0,
+            jnp.log(k / lam) + (k - 1) * jnp.log(jnp.maximum(z, 1e-30)) - z ** k,
+            -jnp.inf)
+
+    def _mean(self):
+        return self.scale * jnp.exp(jax.lax.lgamma(1.0 + 1.0 / self.concentration))
+
+    def _variance(self):
+        g1 = jnp.exp(jax.lax.lgamma(1.0 + 1.0 / self.concentration))
+        g2 = jnp.exp(jax.lax.lgamma(1.0 + 2.0 / self.concentration))
+        return self.scale ** 2 * (g2 - g1 ** 2)
+
+    def _entropy(self):
+        k, lam = self.concentration, self.scale
+        euler = 0.5772156649015329
+        return jnp.broadcast_to(
+            euler * (1.0 - 1.0 / k) + jnp.log(lam / k) + 1.0, self.batch_shape)
+
+
+class Pareto(Distribution):
+    """Pareto(scale x_m, alpha) — power-law tail (torch/paddle surface)."""
+
+    def __init__(self, scale, alpha, name=None):
+        self._param("scale", scale)
+        self._param("alpha", alpha)
+        super().__init__(jnp.broadcast_shapes(self.scale.shape, self.alpha.shape))
+
+    def _rsample(self, key, shape):
+        shp = shape + self.batch_shape
+        u = jax.random.uniform(key, shp, jnp.float32, minval=1e-7, maxval=1.0)
+        return self.scale * u ** (-1.0 / self.alpha)
+
+    def _log_prob(self, x):
+        return jnp.where(
+            x >= self.scale,
+            jnp.log(self.alpha) + self.alpha * jnp.log(self.scale)
+            - (self.alpha + 1) * jnp.log(x),
+            -jnp.inf)
+
+    def _mean(self):
+        return jnp.where(self.alpha > 1,
+                         self.alpha * self.scale / (self.alpha - 1), jnp.inf)
+
+    def _variance(self):
+        a = self.alpha
+        return jnp.where(
+            a > 2, self.scale ** 2 * a / ((a - 1) ** 2 * (a - 2)), jnp.inf)
+
+    def _entropy(self):
+        return jnp.broadcast_to(
+            jnp.log(self.scale / self.alpha) + 1.0 + 1.0 / self.alpha,
+            self.batch_shape)
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over Cholesky factors of correlation matrices (reference
+    ``distribution/lkj_cholesky.py``; onion-method sampler).
+
+    ``dim``: matrix dimension n; ``concentration`` eta > 0 (eta=1 uniform over
+    correlation matrices).  ``sample`` returns lower-triangular [.., n, n].
+    """
+
+    def __init__(self, dim, concentration=1.0, name=None):
+        self.dim = int(dim)
+        if self.dim < 2:
+            raise ValueError("LKJCholesky needs dim >= 2")
+        self._param("concentration", concentration)
+        super().__init__(self.concentration.shape, (self.dim, self.dim))
+
+    def _rsample(self, key, shape):
+        # onion method: row i (1-indexed) is a point on the sphere scaled by
+        # sqrt(beta-sample); Beta(i/2, alpha_i) with alpha descending from eta
+        n = self.dim
+        eta = self.concentration
+        shp = shape + self.batch_shape
+        key_n, key_b = jax.random.split(key)
+        normals = jax.random.normal(key_n, shp + (n, n), jnp.float32)
+        L = jnp.zeros(shp + (n, n), jnp.float32)
+        L = L.at[..., 0, 0].set(1.0)
+        for i in range(1, n):
+            alpha = eta + (n - 1 - i) / 2.0
+            key_b, sub = jax.random.split(key_b)
+            b = jax.random.beta(sub, i / 2.0, alpha, shp)
+            u = normals[..., i, :i]
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            L = L.at[..., i, :i].set(jnp.sqrt(b)[..., None] * u)
+            L = L.at[..., i, i].set(jnp.sqrt(jnp.maximum(1.0 - b, 1e-12)))
+        return L
+
+    def _log_prob(self, value):
+        # density over the free lower-tri coordinates (torch/reference
+        # parameterization): log p(L) = sum_{rows i=2..n} (n - i + 2(eta-1))
+        # * log L_ii - log C(eta, n); verified to integrate to 1 for n=2 at
+        # eta in {1, 2} (see tests)
+        n = self.dim
+        eta = self.concentration
+        diag = jnp.diagonal(value, axis1=-2, axis2=-1)[..., 1:]
+        orders = jnp.arange(n - 1, dtype=jnp.float32)  # row i = orders + 2
+        exps = (n - 2 - orders) + 2.0 * (eta[..., None] - 1.0)
+        unnorm = jnp.sum(exps * jnp.log(jnp.maximum(diag, 1e-30)), axis=-1)
+        # normalizer: product over i=1..n-1 of the onion-step constants
+        # pi^{i/2} * Gamma(alpha_i) / Gamma(i/2 + alpha_i)
+        i = jnp.arange(1, n, dtype=jnp.float32)
+        alpha = eta[..., None] + (n - 1 - i) / 2.0
+        lognorm = jnp.sum(
+            i * math.log(math.pi) / 2.0 + jax.lax.lgamma(alpha)
+            - jax.lax.lgamma(i / 2.0 + alpha), axis=-1)
+        return unnorm - lognorm
+
+    def _mean(self):
+        raise NotImplementedError("LKJCholesky mean is not defined in closed form")
+
+    def _variance(self):
+        raise NotImplementedError
 
 
 # ---------------------------------------------------------------------------

@@ -17,6 +17,7 @@ from .callbacks import (  # noqa: F401
     ProgBarLogger,
 )
 from .model import Model, summary  # noqa: F401
+from .flops import flops  # noqa: F401
 
-__all__ = ["Model", "summary", "Callback", "ProgBarLogger", "ModelCheckpoint",
-           "EarlyStopping", "LRSchedulerCallback"]
+__all__ = ["Model", "summary", "flops", "Callback", "ProgBarLogger",
+           "ModelCheckpoint", "EarlyStopping", "LRSchedulerCallback"]

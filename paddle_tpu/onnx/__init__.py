@@ -39,6 +39,7 @@ class _Converter:
         self.initializers: List[bytes] = []
         self.names: Dict = {}
         self._ctr = itertools.count()
+        self.has_baked_reshape = False  # Reshape targets are traced constants
 
     def fresh(self, prefix: str = "v") -> str:
         return f"{prefix}{next(self._ctr)}"
@@ -172,6 +173,7 @@ class _Converter:
         shp = self.add_init(np.asarray(eqn.params["new_sizes"], np.int64), "shape")
         (out,) = self.emit("Reshape", [x, shp])
         self.names[eqn.outvars[0]] = out
+        self.has_baked_reshape = True
 
     def _op_squeeze(self, eqn):
         x = self.name_of(eqn.invars[0])
@@ -403,6 +405,15 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
         conv.names[cv] = conv.add_init(_np_of(cval), "p")
 
     conv.convert_jaxpr_body(jaxpr)
+    if conv.has_baked_reshape and any(dynamic_axes):
+        import warnings
+
+        warnings.warn(
+            "onnx.export: the graph contains Reshape nodes whose target "
+            "shapes were baked at trace time; the declared dynamic dims "
+            "(dim_param) will NOT generalize through them — run with the "
+            "traced sizes, or avoid reshapes over dynamic axes",
+            stacklevel=2)
 
     output_vis = []
     out_names = []

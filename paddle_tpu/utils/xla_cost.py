@@ -1,0 +1,27 @@
+"""XLA cost-analysis helper shared by ``paddle.flops`` and ``bench.py``.
+
+The JAX cost-analysis API has two entry points whose availability varies by
+backend (HLO-level ``lowered.cost_analysis()``; executable-level
+``lowered.compile().cost_analysis()`` — the remote TPU plugin implements only
+the latter); this is the one place that fallback chain lives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["flops_of_lowered"]
+
+
+def flops_of_lowered(lowered) -> Optional[float]:
+    """FLOPs of a lowered jax computation, or None when neither analysis
+    path yields a count (callers decide whether that is an error)."""
+    for get in (lambda: lowered.cost_analysis(),
+                lambda: lowered.compile().cost_analysis()):
+        try:
+            cost = get()
+        except Exception:
+            continue
+        if cost and cost.get("flops"):
+            return float(cost["flops"])
+    return None

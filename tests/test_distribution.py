@@ -278,3 +278,71 @@ class TestMultivariateNormal:
             stats.multivariate_normal(np.zeros(2), 2 * np.eye(2)).logpdf(np.zeros(2)),
             abs=1e-5)
         np.testing.assert_allclose(_np(d.variance), [[1, 1], [2, 2]], rtol=1e-6)
+
+
+class TestWeibullParetoLKJ:
+    def test_weibull_moments_and_logprob(self):
+        from scipy import stats
+
+        d = D.Weibull(scale=2.0, concentration=1.5)
+        paddle.seed(0)
+        s = _np(d.sample([40000]))
+        ref = stats.weibull_min(1.5, scale=2.0)
+        assert np.mean(s) == pytest.approx(ref.mean(), rel=0.02)
+        assert np.var(s) == pytest.approx(ref.var(), rel=0.05)
+        assert float(_np(d.mean)) == pytest.approx(ref.mean(), rel=1e-5)
+        assert float(_np(d.variance)) == pytest.approx(ref.var(), rel=1e-5)
+        for x in (0.5, 1.0, 3.0):
+            assert float(_np(d.log_prob(np.float32(x)))) == pytest.approx(
+                ref.logpdf(x), abs=1e-5)
+        assert float(_np(d.entropy())) == pytest.approx(ref.entropy(), abs=1e-5)
+
+    def test_pareto_moments_and_logprob(self):
+        from scipy import stats
+
+        d = D.Pareto(scale=1.5, alpha=4.0)
+        paddle.seed(1)
+        s = _np(d.sample([40000]))
+        ref = stats.pareto(4.0, scale=1.5)
+        assert np.mean(s) == pytest.approx(ref.mean(), rel=0.02)
+        assert float(_np(d.mean)) == pytest.approx(ref.mean(), rel=1e-6)
+        assert float(_np(d.variance)) == pytest.approx(ref.var(), rel=1e-5)
+        for x in (1.6, 2.5, 10.0):
+            assert float(_np(d.log_prob(np.float32(x)))) == pytest.approx(
+                ref.logpdf(x), abs=1e-5)
+        # below the support
+        assert float(_np(d.log_prob(np.float32(1.0)))) == -np.inf
+
+    def test_lkj_cholesky_samples_are_correlation_factors(self):
+        d = D.LKJCholesky(4, concentration=2.0)
+        paddle.seed(2)
+        L = _np(d.sample([64]))
+        assert L.shape == (64, 4, 4)
+        # lower-triangular with unit-norm rows -> diag(LL^T) == 1
+        assert np.allclose(np.triu(L, 1), 0, atol=1e-6)
+        C = L @ np.swapaxes(L, -1, -2)
+        assert np.allclose(np.diagonal(C, axis1=-2, axis2=-1), 1.0, atol=1e-5)
+        # off-diagonals are valid correlations
+        assert np.all(np.abs(C) <= 1.0 + 1e-5)
+
+    @pytest.mark.parametrize("eta", [1.0, 2.0, 0.5])
+    def test_lkj_density_integrates_to_one_n2(self, eta):
+        """n=2: the free coordinate is c = L21 in (-1, 1) with
+        L22 = sqrt(1-c^2); exp(log_prob) must integrate to 1 over it."""
+        d = D.LKJCholesky(2, concentration=eta)
+        c = np.linspace(-0.9999, 0.9999, 20001, dtype=np.float64)
+        L = np.zeros((len(c), 2, 2), np.float32)
+        L[:, 0, 0] = 1.0
+        L[:, 1, 0] = c
+        L[:, 1, 1] = np.sqrt(1.0 - c ** 2)
+        lp = _np(d.log_prob(L)).astype(np.float64)
+        integral = np.trapezoid(np.exp(lp), c)
+        # eta<1 has an integrable edge singularity the grid truncates
+        assert integral == pytest.approx(1.0, abs=2e-2 if eta < 1 else 2e-3)
+
+    def test_lkj_logprob_uniform_at_eta1(self):
+        """eta=1, n=2: the density is the constant 1/2 for every valid L."""
+        d = D.LKJCholesky(2, concentration=1.0)
+        for c in (-0.7, 0.0, 0.4):
+            L = np.array([[1.0, 0.0], [c, np.sqrt(1 - c * c)]], np.float32)
+            assert float(_np(d.log_prob(L))) == pytest.approx(np.log(0.5), abs=1e-5)

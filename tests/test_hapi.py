@@ -166,3 +166,20 @@ def test_summary_counts_params(capsys):
     assert info["total_params"] == 8 * 4 + 4 + 4 * 2 + 2
     out = capsys.readouterr().out
     assert "Total params" in out
+
+
+def test_flops_counts_xla_cost():
+    """paddle.flops (reference hapi/dynamic_flops.py) via XLA cost analysis."""
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    f = paddle.flops(m, [4, 16])
+    expect = 2 * 4 * (16 * 32 + 32 * 8)  # forward matmul FLOPs
+    assert f >= expect and f < expect * 1.3, (f, expect)
+    # conv model: XLA counts it too (the reference table would need a
+    # per-layer-type entry)
+    conv = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU())
+    fc = paddle.flops(conv, [2, 3, 16, 16])
+    conv_expect = 2 * 2 * 8 * 16 * 16 * 3 * 9
+    assert fc >= conv_expect * 0.9, (fc, conv_expect)

@@ -77,3 +77,50 @@ def test_log_dir(tmp_path):
     assert r.returncode == 0
     logs = list(log_dir.glob("j1.*.log"))
     assert logs and "hello-from-child" in logs[0].read_text()
+
+
+class TestStoreRendezvous:
+    def test_auto_rank_assignment_two_nodes(self, tmp_path):
+        """--rank -1: two launcher processes rendezvous over the native
+        TCPStore and receive distinct ranks 0/1 (reference master role)."""
+        import socket as _socket
+        import subprocess
+        import sys
+        import textwrap
+
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        script = tmp_path / "train.py"
+        script.write_text(textwrap.dedent("""
+            import os
+            print("ASSIGNED", os.environ["PADDLE_TRAINER_ID"], flush=True)
+        """))
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--master", f"127.0.0.1:{port}", "--nnodes", "2",
+               "--rank", "-1", str(script)]
+        env = {**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"}
+        procs = [subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True, env=env)
+                 for _ in range(2)]
+        outs = [p.communicate(timeout=120)[0] for p in procs]
+        assert all(p.returncode == 0 for p in procs), outs
+        ranks = sorted(line.split()[1] for out in outs
+                       for line in out.splitlines() if line.startswith("ASSIGNED"))
+        assert ranks == ["0", "1"], outs
+
+    def test_rendezvous_generations_roll(self):
+        """Re-entering rendezvous on the same store forms the next
+        generation — the elastic-restart path."""
+        from paddle_tpu.distributed.launch.rendezvous import rendezvous
+
+        # nnodes=1: each call completes alone; port 0 binds a fresh master
+        r1 = rendezvous("127.0.0.1:0", 1, job_id="genroll")
+        assert r1.rank == 0 and r1.peers[0]["rank"] == 0
+        # second join on the SAME store: the generation rolls over
+        r2 = rendezvous(f"127.0.0.1:{r1.store.port}", 1, job_id="genroll")
+        assert r2.rank == 0
+        r2.store.close()
+        r1.store.close()

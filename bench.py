@@ -17,6 +17,9 @@ Presets:
            tokens/s + MFU from XLA cost analysis (routing makes 6P wrong)
   longctx— the 0.7B model at seq 16384 on ONE chip (streaming flash kernels
            page K/V through VMEM; full remat): the long-context capability row
+  decode — KV-cache greedy generation (prefill 512 + 512 new tokens):
+           serving-path throughput; vs_baseline = fraction of the
+           weight-streaming bandwidth bound
 
 Usage: python bench.py [--preset tiny|small|base|longctx|ocr|moe] [--device cpu|tpu]
        [--steps N] [--batch B] [--seq S]
@@ -142,6 +145,71 @@ def _step_flops_of(lowered) -> float:
     from paddle_tpu.utils.xla_cost import flops_of_lowered
 
     return flops_of_lowered(lowered) or 0.0
+
+
+def _bench_decode(jax, paddle, backend, on_tpu, args):
+    """Serving path: KV-cache greedy decode throughput (new tokens/s).
+
+    Exercises the incremental ``use_cache`` attention + decode-MHA Pallas
+    kernel (reference ``masked_multihead_attention`` /
+    ``block_multi_head_attention`` role).  Decode is bandwidth-bound (reads
+    every weight per token), so the companion figure is the % of the
+    weight-streaming bound: tokens/s * param_bytes / HBM bandwidth."""
+    import numpy as np
+
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.models.llama import LlamaConfig
+
+    paddle.seed(0)
+    dtype = "bfloat16" if on_tpu else "float32"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                          num_hidden_layers=12, num_attention_heads=16,
+                          num_key_value_heads=8, max_position_embeddings=2048,
+                          dtype=dtype)
+        batch, prompt, new = (args.batch or 8), 512, 512
+    else:
+        from paddle_tpu.models import llama_tiny_config
+
+        cfg = llama_tiny_config(dtype=dtype)
+        batch, prompt, new = (args.batch or 2), 16, 16
+    model = LlamaForCausalLM(cfg)
+    n_params = sum(p.size for p in model.parameters())
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, size=(batch, prompt)).astype(np.int32))
+
+    out = model.generate(ids, max_new_tokens=new)   # compile + warm
+    _ = np.asarray(out._data[:, -1])                # host read = sync
+    t0 = time.perf_counter()
+    reps = 3 if on_tpu else 1
+    for _i in range(reps):
+        out = model.generate(ids, max_new_tokens=new)
+    _ = np.asarray(out._data[:, -1])
+    dt = (time.perf_counter() - t0) / reps
+
+    new_tokens_per_sec = batch * new / dt
+    dev_kind, _ = _peak_flops(jax, on_tpu)
+    # weight-streaming bound: each decode step reads all param bytes once
+    param_bytes = n_params * (2 if dtype == "bfloat16" else 4)
+    hbm = 819e9 if on_tpu else None   # v5e HBM bandwidth
+    steps_per_sec = new / dt
+    frac_bound = (steps_per_sec * param_bytes / hbm) if hbm else 0.0
+    return {
+        "metric": "llama_decode_new_tokens_per_sec",
+        "value": round(new_tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(frac_bound, 4),   # fraction of weight-stream bound
+        "mfu": 0.0,
+        "device": dev_kind,
+        "backend": backend,
+        "preset": "decode",
+        "params": n_params,
+        "batch": batch,
+        "prompt_len": prompt,
+        "new_tokens": new,
+        "decode_ms_per_step": round(1000 * dt / new, 3),
+    }
 
 
 def _bench_ocr(jax, paddle, backend, on_tpu, args):
@@ -290,7 +358,7 @@ def _bench_moe(jax, paddle, backend, on_tpu, args):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--preset", default=None, choices=["tiny", "small", "base", "longctx", "ocr", "moe"])
+    ap.add_argument("--preset", default=None, choices=["tiny", "small", "base", "longctx", "ocr", "moe", "decode"])
     ap.add_argument("--device", default=None, choices=["cpu", "tpu"])
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--batch", type=int, default=None)
@@ -316,6 +384,10 @@ def main():
 
     import paddle_tpu as paddle
 
+    if preset == "decode":
+        result = _bench_decode(jax, paddle, backend, on_tpu, args)
+        print(json.dumps(result))
+        return
     if preset == "ocr":
         result = _bench_ocr(jax, paddle, backend, on_tpu, args)
         print(json.dumps(result))

@@ -81,6 +81,23 @@ def _decorate(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pts_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_int, c.c_int]
     lib.pts_delete.restype = c.c_int
     lib.pts_delete.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+    # shm_channel
+    lib.ptc_create.restype = c.c_void_p
+    lib.ptc_create.argtypes = [c.c_char_p, c.c_uint64, c.c_uint64]
+    lib.ptc_open.restype = c.c_void_p
+    lib.ptc_open.argtypes = [c.c_char_p]
+    lib.ptc_send.restype = c.c_int
+    lib.ptc_send.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64, c.c_int]
+    lib.ptc_recv.restype = c.c_int64
+    lib.ptc_recv.argtypes = [c.c_void_p, c.c_void_p, c.c_uint64, c.c_int]
+    lib.ptc_next_len.restype = c.c_int64
+    lib.ptc_next_len.argtypes = [c.c_void_p]
+    lib.ptc_wait_nonempty.restype = c.c_int
+    lib.ptc_wait_nonempty.argtypes = [c.c_void_p, c.c_int]
+    lib.ptc_mark_closed.argtypes = [c.c_void_p]
+    lib.ptc_slot_bytes.restype = c.c_uint64
+    lib.ptc_slot_bytes.argtypes = [c.c_void_p]
+    lib.ptc_close.argtypes = [c.c_void_p]
     # host_tracer
     lib.ptt_begin.argtypes = [c.c_char_p]
     lib.ptt_counter.argtypes = [c.c_char_p, c.c_double]

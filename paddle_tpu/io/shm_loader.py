@@ -1,0 +1,269 @@
+"""Multiprocess DataLoader workers over the native shared-memory channel.
+
+Counterpart of the reference's C++ dataloader core: its
+``use_shared_memory=True`` path moves batch tensors between worker processes
+and the trainer through shared-memory segments instead of pickling them over
+multiprocessing pipes (``python/paddle/io/dataloader/dataloader_iter.py:368``
+multi-process iterator + the fluid shared-memory allocator).
+
+Here: ``num_workers`` forked processes each own one ring channel
+(``core/csrc/shm_channel.cc``).  Worker ``w`` produces batch indices
+``w, w+W, ...``; the consumer reads channels round-robin, preserving batch
+order.  Batches are serialized with pickle protocol 5 — array bodies travel
+as out-of-band buffers, so the bulk bytes take exactly two memcpys (worker →
+shm → trainer) and are never pickled.
+
+Workers produce NUMPY (never jax arrays — a forked child must not touch the
+parent's accelerator runtime); the trainer-side iterator converts with the
+normal collate path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import signal
+import struct
+import time
+from typing import Any, List, Optional
+
+import numpy as np
+
+from paddle_tpu.core import native
+
+__all__ = ["ShmWorkerPool", "available"]
+
+
+def available() -> bool:
+    return native.load() is not None
+
+
+def _serialize(obj) -> bytes:
+    """Frame = u32 body_len | pickle5 body | u32 nbufs | (u64 len | bytes)*.
+
+    Array bodies travel as out-of-band PickleBuffers copied ONCE into the
+    preallocated frame (the channel then copies frame -> shm -> trainer:
+    three bulk copies total, vs pickle-over-pipe's pickle + chunked writes +
+    reads)."""
+    bufs: List[pickle.PickleBuffer] = []
+    body = pickle.dumps(obj, protocol=5, buffer_callback=bufs.append)
+    raws = [b.raw() for b in bufs]  # contiguous by PEP 574 contract
+    total = 4 + len(body) + 4 + sum(8 + r.nbytes for r in raws)
+    frame = bytearray(total)
+    mv = memoryview(frame)
+    struct.pack_into("<I", frame, 0, len(body))
+    mv[4:4 + len(body)] = body
+    off = 4 + len(body)
+    struct.pack_into("<I", frame, off, len(raws))
+    off += 4
+    for r in raws:
+        struct.pack_into("<Q", frame, off, r.nbytes)
+        off += 8
+        mv[off:off + r.nbytes] = r.cast("B")
+        off += r.nbytes
+    return frame  # bytearray: _Channel.send passes it zero-copy via ctypes
+
+
+def _deserialize(data: memoryview):
+    (nbody,) = struct.unpack_from("<I", data, 0)
+    body = data[4:4 + nbody]
+    off = 4 + nbody
+    (nbufs,) = struct.unpack_from("<I", data, off)
+    off += 4
+    bufs = []
+    for _ in range(nbufs):
+        (blen,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        bufs.append(data[off:off + blen])
+        off += blen
+    return pickle.loads(body, buffers=bufs)
+
+
+class _Channel:
+    """ctypes wrapper over one shm ring (owner = consumer side)."""
+
+    def __init__(self, name: str, slots: int = 0, slot_bytes: int = 0,
+                 create: bool = False):
+        self._lib = native.load()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        if create:
+            self._h = self._lib.ptc_create(name.encode(), slots, slot_bytes)
+        else:
+            self._h = self._lib.ptc_open(name.encode())
+        if not self._h:
+            raise OSError(f"shm channel {name} {'create' if create else 'open'} failed")
+        self.name = name
+
+    def send(self, payload, timeout_ms: int = 60000, retry_forever: bool = False) -> None:
+        """``payload``: bytes or bytearray (bytearray passes zero-copy).
+
+        ``retry_forever``: keep waiting through full-ring timeouts (worker
+        side — a paused trainer, e.g. saving a checkpoint, must not kill its
+        workers); channel closure still exits."""
+        if isinstance(payload, bytearray):
+            buf = (ctypes.c_char * len(payload)).from_buffer(payload)
+        else:
+            buf = payload
+        while True:
+            rc = self._lib.ptc_send(self._h, buf, len(payload), timeout_ms)
+            if rc == 2:
+                raise ValueError(
+                    f"batch of {len(payload)} bytes exceeds the shm slot size "
+                    f"({self._lib.ptc_slot_bytes(self._h)}); raise DataLoader's "
+                    "shm_slot_bytes")
+            if rc == 3:
+                raise BrokenPipeError("channel closed")
+            if rc == 0:
+                return
+            if not retry_forever:
+                raise TimeoutError("shm send timed out (consumer stalled?)")
+
+    def recv(self, timeout_ms: int = 100) -> Optional[bytes]:
+        """One record; None on timeout; b'' means closed-and-drained.
+
+        Waits via ptc_wait_nonempty first, so no receive buffer is allocated
+        on empty polls."""
+        rc = self._lib.ptc_wait_nonempty(self._h, timeout_ms)
+        if rc == 1:
+            return None
+        if rc == 2:
+            return b""
+        n = self._lib.ptc_next_len(self._h)
+        cap = n if n > 0 else self._lib.ptc_slot_bytes(self._h)
+        buf = ctypes.create_string_buffer(int(cap) or 1)
+        got = self._lib.ptc_recv(self._h, buf, cap, timeout_ms)
+        if got == -1:
+            return None
+        if got == 0:
+            return b""
+        if got < 0:
+            raise RuntimeError(f"shm recv error {got}")
+        return buf.raw[:got]
+
+    def mark_closed(self):
+        self._lib.ptc_mark_closed(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.ptc_close(self._h)
+            self._h = None
+
+
+def _worker_main(channel_name: str, spec_bytes: bytes):
+    """Spawned worker entry (module-level so 'spawn' can import it: forking a
+    JAX-threaded parent risks deadlock on inherited locks, so workers are
+    FRESH interpreters — the dataset must be picklable, the same contract as
+    the reference's / torch's spawn workers)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # never grab the TPU
+    spec = pickle.loads(spec_bytes)
+    ch = _Channel(channel_name)
+    try:
+        if spec["worker_init_fn"] is not None:
+            spec["worker_init_fn"](spec["worker_id"])
+        dataset = spec["dataset"]
+        collate = spec["collate"]
+        for b in range(spec["worker_id"], spec["n_batches"], spec["num_workers"]):
+            samples = [dataset[i] for i in spec["batches"][b]]
+            obj = collate(samples) if collate is not None else samples
+            # retry_forever: a trainer paused past the timeout (checkpoint
+            # save, eval, long compile) must not kill its workers
+            ch.send(_serialize(obj), timeout_ms=60000, retry_forever=True)
+        ch.mark_closed()
+    except BrokenPipeError:
+        pass  # consumer tore the pool down early
+    finally:
+        ch.close()
+
+
+class ShmWorkerPool:
+    """Spawn ``num_workers`` producer processes over a map-style dataset.
+
+    Worker ``w`` produces batch indices ``w, w+W, ...`` with ``collate``
+    (numpy-producing) applied in the worker; iterate with :meth:`__iter__`,
+    order matches batch index order.
+    """
+
+    def __init__(self, dataset, batches: List, collate, num_workers: int,
+                 slots: int = 4, slot_bytes: int = 8 << 20,
+                 worker_init_fn=None, timeout: float = 120.0):
+        import multiprocessing as mp
+
+        self.n_batches = len(batches)
+        self.num_workers = num_workers
+        self.timeout = timeout
+        uid = f"{os.getpid()}_{id(self):x}"
+        self.channels = []
+        self.procs = []
+        try:
+            self.channels = [
+                _Channel(f"/pt_dl_{uid}_{w}", slots=slots,
+                         slot_bytes=slot_bytes, create=True)
+                for w in range(num_workers)
+            ]
+            ctx = mp.get_context("spawn")
+            for w in range(num_workers):
+                spec = pickle.dumps({
+                    "dataset": dataset, "batches": batches, "collate": collate,
+                    "worker_id": w, "num_workers": num_workers,
+                    "n_batches": self.n_batches,
+                    "worker_init_fn": worker_init_fn, "timeout": timeout,
+                })
+                p = ctx.Process(target=_worker_main,
+                                args=(self.channels[w].name, spec), daemon=True)
+                p.start()
+                self.procs.append(p)
+        except BaseException:
+            # half-built pool: release shm segments + any started workers,
+            # or every failed epoch would leak named /dev/shm segments
+            self.shutdown()
+            raise
+
+    def __iter__(self):
+        for b in range(self.n_batches):
+            ch = self.channels[b % self.num_workers]
+            # timeout <= 0 means "no stall limit" (reference DataLoader
+            # timeout=0 semantics); dead workers are still detected each poll
+            deadline = (time.monotonic() + self.timeout) if self.timeout > 0 \
+                else float("inf")
+            while True:
+                rec = ch.recv(timeout_ms=200)
+                if rec is None:
+                    if time.monotonic() > deadline:
+                        self.shutdown()
+                        raise TimeoutError(f"DataLoader worker {b % self.num_workers} "
+                                           f"stalled on batch {b}")
+                    p = self.procs[b % self.num_workers]
+                    if not p.is_alive() and p.exitcode not in (0, None):
+                        self.shutdown()
+                        raise RuntimeError(
+                            f"DataLoader worker {b % self.num_workers} died "
+                            f"(exitcode {p.exitcode}); its traceback is on "
+                            "stderr. Spawn workers must be able to import the "
+                            "dataset/collate_fn from their defining modules "
+                            "(no __main__-guarded or interactive definitions)")
+                    continue
+                if rec == b"":
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker channel closed before batch {b}")
+                yield _deserialize(memoryview(rec))
+                break
+        self.shutdown()
+
+    def shutdown(self):
+        for ch in self.channels:
+            try:
+                ch.mark_closed()
+            except Exception:
+                pass
+        for p in self.procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5)
+        self.procs = []
+        for ch in self.channels:
+            ch.close()
+        self.channels = []

@@ -1,0 +1,121 @@
+"""Native shared-memory DataLoader workers (paddle_tpu/io/shm_loader.py +
+core/csrc/shm_channel.cc) — the reference's ``use_shared_memory=True``
+multiprocess path."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.io import shm_loader
+
+
+class ArrayDS(Dataset):
+    """Module-level (spawn workers re-import this module)."""
+
+    def __init__(self, n=37):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.full((8, 8), i, np.float32), np.int64(i)
+
+
+class DictDS(Dataset):
+    def __len__(self):
+        return 10
+
+    def __getitem__(self, i):
+        return {"x": np.full((4,), i, np.float32), "meta": [np.int64(i), np.int64(2 * i)]}
+
+
+class BoomDS(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at 5")
+        return np.zeros((2,), np.float32)
+
+
+def _head_collate(samples):
+    # runs on the TRAINER for the custom-collate path
+    return Tensor(np.stack([s[0] for s in samples]))
+
+
+class TestShmChannelUnit:
+    def test_roundtrip_and_serialization(self):
+        if not shm_loader.available():
+            pytest.skip("no native lib")
+        ch = shm_loader._Channel("/pt_test_unit", slots=2, slot_bytes=1 << 16,
+                                 create=True)
+        obj = {"a": np.arange(12, dtype=np.float32).reshape(3, 4),
+               "b": [np.int64(7), "txt"]}
+        ch.send(shm_loader._serialize(obj))
+        out = shm_loader._deserialize(memoryview(ch.recv(timeout_ms=1000)))
+        np.testing.assert_array_equal(out["a"], obj["a"])
+        assert out["b"] == [7, "txt"]
+        assert ch.recv(timeout_ms=50) is None  # empty -> timeout
+        ch.mark_closed()
+        assert ch.recv(timeout_ms=50) == b""   # closed-and-drained
+        ch.close()
+
+    def test_oversized_record_rejected(self):
+        if not shm_loader.available():
+            pytest.skip("no native lib")
+        ch = shm_loader._Channel("/pt_test_big", slots=2, slot_bytes=64,
+                                 create=True)
+        with pytest.raises(ValueError, match="slot"):
+            ch.send(b"x" * 1000)
+        ch.close()
+
+
+@pytest.mark.skipif(not shm_loader.available(), reason="no native lib")
+class TestShmDataLoader:
+    def test_order_and_values(self):
+        dl = DataLoader(ArrayDS(), batch_size=5, num_workers=3)
+        batches = list(dl)
+        assert len(batches) == 8
+        x0, y0 = batches[0]
+        assert isinstance(x0, Tensor) and list(x0.shape) == [5, 8, 8]
+        ids = np.concatenate([np.asarray(y._data) for _, y in batches])
+        np.testing.assert_array_equal(ids, np.arange(37))
+
+    def test_nested_dict_batches(self):
+        dl = DataLoader(DictDS(), batch_size=4, num_workers=2)
+        b0 = next(iter(dl))
+        assert isinstance(b0["x"], Tensor) and list(b0["x"].shape) == [4, 4]
+        np.testing.assert_array_equal(np.asarray(b0["meta"][1]._data),
+                                      [0, 2, 4, 6])
+
+    def test_custom_collate_runs_on_trainer(self):
+        dl = DataLoader(ArrayDS(12), batch_size=4, num_workers=2,
+                        collate_fn=_head_collate)
+        shapes = [list(b.shape) for b in dl]
+        assert shapes == [[4, 8, 8]] * 3
+
+    def test_worker_exception_surfaces(self):
+        dl = DataLoader(BoomDS(), batch_size=2, num_workers=2)
+        with pytest.raises(RuntimeError, match="worker"):
+            list(dl)
+
+    def test_unpicklable_dataset_falls_back_with_warning(self):
+        class Local(ArrayDS):  # function-local: spawn can never import it
+            pass
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            batches = list(DataLoader(Local(12), batch_size=4, num_workers=2))
+        assert len(batches) == 3
+        assert any("picklable" in str(x.message) for x in w)
+
+    def test_shuffle_covers_all_samples_once(self):
+        dl = DataLoader(ArrayDS(20), batch_size=4, num_workers=2, shuffle=True)
+        ids = np.sort(np.concatenate([np.asarray(y._data) for _, y in dl]))
+        np.testing.assert_array_equal(ids, np.arange(20))

@@ -20,7 +20,7 @@ import numpy as np
 from ..collective import get_rank, get_world_size, init_parallel_env
 from ..mesh import ProcessMesh, get_mesh, set_global_mesh
 from . import topology as tp_mod
-from .elastic import ELASTIC_EXIT_CODE, CheckpointManager
+from .elastic import ELASTIC_EXIT_CODE, CheckpointManager, ElasticManager
 from .recompute import recompute
 from . import metrics  # noqa: F401  (fleet.metrics.sum/max/auc/... reductions)
 from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode
@@ -28,7 +28,7 @@ from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode
 __all__ = ["init", "DistributedStrategy", "get_hybrid_communicate_group", "fleet",
            "distributed_model", "distributed_optimizer", "HybridParallelOptimizer",
            "HybridCommunicateGroup", "CommunicateTopology", "ParallelMode", "recompute",
-           "CheckpointManager", "ELASTIC_EXIT_CODE"]
+           "CheckpointManager", "ElasticManager", "ELASTIC_EXIT_CODE"]
 
 
 class DistributedStrategy:

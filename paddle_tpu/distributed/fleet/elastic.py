@@ -28,7 +28,7 @@ from typing import Optional
 from ..checkpoint import load_state_dict, save_state_dict
 from ..collective import barrier, get_rank
 
-__all__ = ["CheckpointManager", "ELASTIC_EXIT_CODE"]
+__all__ = ["CheckpointManager", "ElasticManager", "ELASTIC_EXIT_CODE"]
 
 # reference fleet/elastic/__init__.py:33
 ELASTIC_EXIT_CODE = 101
@@ -158,3 +158,121 @@ class CheckpointManager:
                 target.set_state_dict(work)
             return step
         return 0
+
+
+class ElasticManager:
+    """Store-backed node heartbeat + membership watch — the failure-DETECTION
+    half of elastic training (reference ``fleet/elastic/manager.py:125``:
+    etcd node registry + heartbeats + membership watch; here the native
+    ``TCPStore`` plays etcd's role).
+
+    Heartbeats are MONOTONIC COUNTERS, not timestamps: each node's beat
+    thread increments ``hb/<job>/<rank>``; the watcher samples all counters
+    twice across ``interval`` — a counter that did not advance is a dead (or
+    wedged) peer.  No cross-host clock comparison anywhere.
+
+    Usage on every node::
+
+        mgr = ElasticManager(store, rank, nnodes)   # store from rendezvous
+        mgr.start()
+        ...
+        if mgr.dead_peers():          # or mgr.watch(on_dead=...) in a thread
+            sys.exit(ELASTIC_EXIT_CODE)   # relauncher re-rendezvous + resume
+    """
+
+    def __init__(self, store, rank: int, nnodes: int, job_id: str = "default",
+                 interval: float = 5.0):
+        self.store = store
+        self.rank = int(rank)
+        self.nnodes = int(nnodes)
+        self.job_id = job_id
+        self.interval = float(interval)
+        self._stop = None
+        self._thread = None
+
+    def _key(self, rank: int) -> str:
+        return f"hb/{self.job_id}/{rank}"
+
+    def start(self):
+        """Begin heartbeating this node (daemon thread)."""
+        import threading
+
+        self._stop = threading.Event()
+
+        def beat():
+            failures = 0
+            while not self._stop.is_set():
+                try:
+                    self.store.add(self._key(self.rank), 1)
+                    failures = 0
+                except Exception as e:
+                    # a transient store error must NOT stop the heartbeat —
+                    # peers would flag this healthy node dead and restart the
+                    # whole job; only give up after sustained failure
+                    failures += 1
+                    if failures >= 5:
+                        import sys
+
+                        print(f"[elastic] heartbeat giving up after "
+                              f"{failures} store failures: {e}", file=sys.stderr)
+                        return
+                self._stop.wait(self.interval)
+
+        self._thread = threading.Thread(target=beat, name="elastic-heartbeat",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    #: pseudo-rank reported when the STORE itself (the coordinator node) is
+    #: unreachable — also a membership loss, needing re-rendezvous
+    STORE_LOST = -1
+
+    def counters(self):
+        """Current heartbeat counter per rank (0 = never beat)."""
+        out = {}
+        for r in range(self.nnodes):
+            out[r] = self.store.add(self._key(r), 0)  # add 0 = atomic read
+        return out
+
+    def dead_peers(self, wait_factor: float = 2.5, _retries: int = 3):
+        """Ranks whose counter did not advance across ``wait_factor *
+        interval`` seconds (a beat interval plus slack).  Blocking.
+        ``[STORE_LOST]`` when the store itself is persistently unreachable
+        (the coordinator node died — the membership is lost wholesale)."""
+        import time as _time
+
+        for attempt in range(_retries):
+            try:
+                before = self.counters()
+                _time.sleep(self.interval * wait_factor)
+                after = self.counters()
+            except Exception:
+                if attempt == _retries - 1:
+                    return [self.STORE_LOST]
+                _time.sleep(self.interval)
+                continue
+            return [r for r in range(self.nnodes)
+                    if r != self.rank and after[r] == before[r]]
+        return [self.STORE_LOST]
+
+    def watch(self, on_dead, poll_factor: float = 2.5):
+        """Loop until dead peers appear (or the store is lost —
+        ``[STORE_LOST]``), then call ``on_dead(ranks)`` and return them (run
+        in a thread for background monitoring).  Never raises out of a
+        monitoring thread."""
+        while not (self._stop and self._stop.is_set()):
+            try:
+                dead = self.dead_peers(poll_factor)
+            except Exception:
+                dead = [self.STORE_LOST]
+            if dead:
+                on_dead(dead)
+                return dead
+        return []
+
+    def stop(self):
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -129,6 +129,28 @@ def launch(args) -> int:
         print(f"[launch] rendezvous assigned node rank {args.rank}/{args.nnodes}"
               f" (jax coordinator {coordinator})", file=sys.stderr)
     procs: List[_Proc] = []
+    elastic_mgr = None
+    node_died = []
+    if rdzv is not None and args.nnodes > 1:
+        # heartbeat this node + watch peers over the rendezvous store
+        # (reference ElasticManager: etcd registry + watch -> relaunch)
+        from ..fleet.elastic import ElasticManager
+
+        elastic_mgr = ElasticManager(rdzv.store, args.rank, args.nnodes,
+                                     job_id=args.job_id).start()
+        import threading
+
+        def _watch():
+            dead = elastic_mgr.watch(on_dead=lambda rs: node_died.extend(rs))
+            if dead:
+                print(f"[launch] peer node(s) {dead} stopped heartbeating; "
+                      f"stopping local trainers for re-rendezvous",
+                      file=sys.stderr)
+                for p in procs:
+                    p.stop()
+
+        threading.Thread(target=_watch, daemon=True,
+                         name="elastic-watch").start()
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
     for lr in range(args.nproc_per_node):
@@ -145,6 +167,21 @@ def launch(args) -> int:
         alive = list(procs)
         while alive:
             time.sleep(0.2)
+            # a dead PEER NODE needs whole-job re-rendezvous, not a local
+            # restart: exit with the elastic code so an outer supervisor
+            # relaunches this launcher into the next rendezvous generation.
+            # Checked BEFORE child exit codes — a trainer that traps SIGTERM
+            # and exits 0 must not read as success while the job is short
+            if node_died:
+                exit_code = ELASTIC_EXIT_CODE
+                for p in alive:
+                    p.stop()
+                for p in alive:
+                    try:
+                        p.popen.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.popen.kill()
+                break
             for p in list(alive):
                 rc = p.popen.poll()
                 if rc is None:
@@ -178,6 +215,8 @@ def launch(args) -> int:
                 except subprocess.TimeoutExpired:
                     p.popen.kill()
             p.close()
+        if elastic_mgr is not None:
+            elastic_mgr.stop()
         if rdzv is not None:
             rdzv.store.close()
     return exit_code

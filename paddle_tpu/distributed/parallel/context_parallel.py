@@ -37,7 +37,7 @@ from ...framework.dispatch import apply_op
 from ...framework.tensor import Tensor
 from ..mesh import ProcessMesh, get_mesh
 
-__all__ = ["ring_attention"]
+__all__ = ["ring_attention", "ulysses_attention"]
 
 NEG_INF = -1e30
 
@@ -168,3 +168,88 @@ def ring_attention(q, k, v, mesh: Optional[ProcessMesh] = None, axis_name: str =
     kt = k if isinstance(k, Tensor) else Tensor(kd)
     vt = v if isinstance(v, Tensor) else Tensor(vd)
     return apply_op("ring_attention", fn, (qt, kt, vt), {})
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (all-to-all) sequence parallelism
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def _build_ulysses_fn(mesh: ProcessMesh, axis_name: str, cp: int, causal: bool,
+                      rep: int, hk_divisible: bool, sm_scale: float):
+    from ...kernels import flash_attention as fa_mod
+
+    P = PartitionSpec
+    seq_spec = P(None, axis_name, None, None)
+
+    def body(q_loc, k_loc, v_loc):
+        # [B, S/P, H, D] -> all_to_all -> [B, S, H/P, D]: every device holds
+        # the FULL sequence for a head subset, so plain (flash) attention is
+        # exact; one all_to_all each way replaces the ring's P-1 ppermutes
+        if rep != 1 and not hk_divisible:
+            # kv heads don't divide the CP degree: repeat to the q head
+            # count so the a2a can split them.  When they DO divide (the
+            # common GQA case) the unrepeated kv cross the interconnect and
+            # flash_attention repeats AFTER — rep-fold less kv comm volume
+            k_loc = jnp.repeat(k_loc, rep, axis=2)
+            v_loc = jnp.repeat(v_loc, rep, axis=2)
+
+        def fwd_a2a(x):
+            return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                      concat_axis=1, tiled=True)
+
+        qg, kg, vg = fwd_a2a(q_loc), fwd_a2a(k_loc), fwd_a2a(v_loc)
+        o = fa_mod.flash_attention(qg, kg, vg, causal=causal, sm_scale=sm_scale)
+        # inverse: split the sequence back, regather this shard's heads
+        return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    sm = jax.shard_map(body, mesh=mesh.jax_mesh,
+                       in_specs=(seq_spec, seq_spec, seq_spec),
+                       out_specs=seq_spec, axis_names={axis_name})
+    return jax.jit(sm)
+
+
+def ulysses_attention(q, k, v, mesh: Optional[ProcessMesh] = None,
+                      axis_name: str = "sep", causal: bool = True,
+                      sm_scale: Optional[float] = None):
+    """Exact attention over a sequence sharded on ``axis_name`` via
+    all-to-all head/sequence re-sharding (DeepSpeed-Ulysses style) — the
+    second CP strategy beside :func:`ring_attention`.
+
+    Trade-off vs the ring: 2 ``all_to_all`` collectives total instead of
+    P-1 ``ppermute`` steps (lower latency on fat ICI), but the CP degree is
+    bounded by the head count (each device must own >= 1 head).  q, k, v:
+    [B, S, H, D] with GLOBAL S; GQA kv heads are repeated to the q head
+    count first.  Requires ``H % cp == 0`` and ``S % cp == 0``.
+    """
+    mesh = mesh if mesh is not None else get_mesh()
+    if mesh is None or axis_name not in mesh.dim_names:
+        raise ValueError(f"ulysses_attention needs a mesh with a {axis_name!r} axis")
+    cp = mesh.get_dim_size(axis_name)
+
+    any_tensor = any(isinstance(t, Tensor) for t in (q, k, v))
+    qd = q._data if isinstance(q, Tensor) else q
+    kd = k._data if isinstance(k, Tensor) else k
+    vd = v._data if isinstance(v, Tensor) else v
+
+    B, S, H, D = qd.shape
+    if S % cp != 0:
+        raise ValueError(f"sequence length {S} not divisible by {axis_name} degree {cp}")
+    if H % cp != 0:
+        raise ValueError(
+            f"ulysses_attention needs heads ({H}) divisible by the CP degree "
+            f"({cp}) — each device must own whole heads; use ring_attention "
+            "for head-count-free scaling")
+    rep = H // kd.shape[2]
+    hk_divisible = kd.shape[2] % cp == 0
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    fn = _build_ulysses_fn(mesh, axis_name, cp, causal, rep, hk_divisible,
+                           float(np.float32(scale)))
+
+    if not any_tensor:
+        return fn(qd, kd, vd)
+    qt = q if isinstance(q, Tensor) else Tensor(qd)
+    kt = k if isinstance(k, Tensor) else Tensor(kd)
+    vt = v if isinstance(v, Tensor) else Tensor(vd)
+    return apply_op("ulysses_attention", fn, (qt, kt, vt), {})

@@ -219,3 +219,50 @@ def test_resume_restores_lr_scheduler(tmp_path):
     assert mgr.resume(step2) == 5
     assert opt2.get_lr() == pytest.approx(lr_after_5)
     assert sched2.last_epoch == sched.last_epoch
+
+
+class TestElasticManager:
+    """Store-backed heartbeat membership (reference ElasticManager role)."""
+
+    def _stores(self, n):
+        from paddle_tpu.distributed import TCPStore
+
+        master = TCPStore("127.0.0.1", 0, world_size=n, is_master=True,
+                          timeout=10.0)
+        others = [TCPStore("127.0.0.1", master.port, world_size=n, timeout=10.0)
+                  for _ in range(n - 1)]
+        return [master] + others
+
+    def test_healthy_peers_not_flagged(self):
+        from paddle_tpu.distributed.fleet import ElasticManager
+
+        stores = self._stores(2)
+        mgrs = [ElasticManager(s, r, 2, job_id="hb1", interval=0.1).start()
+                for r, s in enumerate(stores)]
+        try:
+            assert mgrs[0].dead_peers() == []
+            assert mgrs[1].dead_peers() == []
+        finally:
+            for m in mgrs:
+                m.stop()
+            for s in stores:
+                s.close()
+
+    def test_dead_peer_detected_and_watch_fires(self):
+        from paddle_tpu.distributed.fleet import ElasticManager
+
+        stores = self._stores(3)
+        mgrs = [ElasticManager(s, r, 3, job_id="hb2", interval=0.1).start()
+                for r, s in enumerate(stores)]
+        try:
+            mgrs[2].stop()  # "node 2 dies"
+            seen = {}
+            dead = mgrs[0].watch(on_dead=lambda rs: seen.setdefault("d", rs))
+            assert dead == [2] and seen["d"] == [2]
+            # counters never started for an absent rank -> also dead
+            assert 2 in mgrs[1].dead_peers()
+        finally:
+            for m in mgrs:
+                m.stop()
+            for s in stores:
+                s.close()

@@ -124,3 +124,49 @@ class TestStoreRendezvous:
         assert r2.rank == 0
         r2.store.close()
         r1.store.close()
+
+
+class TestElasticNodeDeath:
+    def test_peer_death_exits_elastic_code(self, tmp_path):
+        """Two auto-rank launchers; one node is killed mid-run — the
+        survivor must stop its trainers and exit ELASTIC_EXIT_CODE (101)
+        so an outer supervisor re-rendezvouses the job."""
+        import signal
+        import socket as _socket
+        import subprocess
+        import sys
+        import textwrap
+        import time
+
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        script = tmp_path / "train_long.py"
+        script.write_text(textwrap.dedent("""
+            import os, time
+            print("UP", os.environ["PADDLE_TRAINER_ID"], flush=True)
+            time.sleep(300)
+        """))
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"}
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--master", f"127.0.0.1:{port}", "--nnodes", "2",
+               "--rank", "-1", "--max_restarts", "0", str(script)]
+        # small heartbeat interval via the manager default is 5s; tolerate it
+        procs = [subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True, env=env)
+                 for _ in range(2)]
+        try:
+            time.sleep(8)  # both rendezvoused, trainers up, heartbeats running
+            assert procs[0].poll() is None and procs[1].poll() is None
+            procs[1].kill()  # node 1 dies (heartbeat stops)
+            out0, _ = procs[0].communicate(timeout=120)
+            from paddle_tpu.distributed.launch import ELASTIC_EXIT_CODE
+            assert procs[0].returncode == ELASTIC_EXIT_CODE, \
+                (procs[0].returncode, out0[-2000:])
+            assert "stopped heartbeating" in out0
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()

@@ -1,20 +1,25 @@
-"""``paddle.incubate.optimizer`` — LookAhead, ModelAverage.
+"""``paddle.incubate.optimizer`` — LookAhead, ModelAverage, DGCMomentum.
 
 Counterpart of the reference's ``python/paddle/incubate/optimizer/``
-(``lookahead.py``, ``modelaverage.py``): optimizer wrappers maintaining slow /
-averaged copies of the weights on the host side of the step.
+(``lookahead.py``, ``modelaverage.py``, DGC): optimizer wrappers maintaining
+slow / averaged copies of the weights, and deep-gradient-compression
+momentum with error feedback.
 """
 
 from __future__ import annotations
 
 import contextlib
+import math
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...framework.tensor import Tensor
+from ...optimizer.optimizer import Optimizer
 
-__all__ = ["LookAhead", "ModelAverage"]
+__all__ = ["LookAhead", "ModelAverage", "DGCMomentum"]
 
 
 class LookAhead:
@@ -149,3 +154,92 @@ class ModelAverage:
         for p in self.parameters:
             p._data = self._backup[id(p)]
         self._backup = None
+
+
+class DGCMomentum(Optimizer):
+    """Deep Gradient Compression momentum (reference
+    ``incubate/optimizer/`` DGCMomentumOptimizer; Lin et al. 2018).
+
+    Each step accumulates momentum (u) and an error-feedback residual (v),
+    then applies only the top-(1-sparsity) fraction of |v| — the unsent mass
+    stays in the residual, and the masked entries' momentum is also cleared
+    (the paper's momentum factor masking).  Sparsity ramps through the
+    ``sparsity`` stages over ``rampup_step`` steps starting at
+    ``rampup_begin_step``; before that the update is plain dense momentum.
+
+    TPU-native role: in-graph gradient sync is GSPMD's (dense psums over
+    ICI); DGC matters for the HOST-side dp sync of the eager hybrid path and
+    for DCN-bound multi-host data parallelism, where only the sparse
+    (index, value) pairs need to travel.  The selection math runs compiled
+    (lax.top_k with a static k_max, dynamic threshold index).
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 rampup_begin_step=0, rampup_step=1,
+                 sparsity=(0.999,), parameters=None, use_nesterov=False,
+                 weight_decay=None, grad_clip=None, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = float(momentum)
+        self._rampup_begin = int(rampup_begin_step)
+        self._rampup_step = max(1, int(rampup_step))
+        self._sparsity = tuple(float(s) for s in sparsity)
+        if not self._sparsity or not all(0.0 < s < 1.0 for s in self._sparsity):
+            raise ValueError(f"sparsity stages must lie in (0, 1): {sparsity}")
+        if len(self._sparsity) > 1 and self._rampup_step < len(self._sparsity):
+            raise ValueError(
+                f"rampup_step ({rampup_step}) must cover the {len(self._sparsity)} "
+                "sparsity stages (each stage needs >= 1 step, else the warmup "
+                "schedule silently collapses to the last stage)")
+        if use_nesterov:
+            raise NotImplementedError("DGC with nesterov is not supported")
+
+    def _init_slots(self, p):
+        return {"velocity": jnp.zeros(p.shape, jnp.float32),
+                "residual": jnp.zeros(p.shape, jnp.float32)}
+
+    def _sparsity_at(self, step):
+        """Scheduled sparsity for a (traced) step: stage i applies within
+        its slice of the rampup window, the last stage thereafter."""
+        stages = self._sparsity
+        per = self._rampup_step / len(stages)
+        conds = [step < self._rampup_begin + int((i + 1) * per)
+                 for i in range(len(stages) - 1)]
+        return jnp.select(conds, stages[:-1],
+                          default=jnp.asarray(stages[-1], jnp.float32)) \
+            if conds else jnp.asarray(stages[-1], jnp.float32)
+
+    def _update(self, p32, g32, slots, lr, step):
+        m = self._momentum
+        u = m * slots["velocity"] + g32     # momentum accumulation
+        v = slots["residual"] + u           # error-feedback accumulation
+
+        n = int(np.prod(v.shape)) if v.ndim else 1
+        min_sparsity = min(self._sparsity)
+        k_max = max(1, int(math.ceil((1.0 - min_sparsity) * n)))
+        if k_max >= n:
+            # param too small to sparsify: dense momentum (v == u here since
+            # the residual stays empty; velocity must PERSIST)
+            return p32 - lr * v, {"velocity": u, "residual": jnp.zeros_like(v)}
+
+        s_now = self._sparsity_at(step)
+        k_dyn = jnp.clip(jnp.ceil((1.0 - s_now) * n).astype(jnp.int32), 1, k_max)
+        absv = jnp.abs(v).reshape(-1)
+        top_vals, _ = jax.lax.top_k(absv, k_max)
+        thr = jax.lax.dynamic_index_in_dim(top_vals, k_dyn - 1, keepdims=False)
+        # a zero threshold (fewer than k nonzero residuals) must not select
+        # the zero entries: that would clear momentum for the whole param
+        mask = ((jnp.abs(v) >= thr) & (jnp.abs(v) > 0)).astype(jnp.float32)
+        dense = (step < self._rampup_begin).astype(jnp.float32)
+
+        # dense phase (pre-rampup): plain momentum — update with u (== v,
+        # since the residual is empty then) and KEEP the velocity.  Sparse
+        # phase: send top-k of v; sent entries clear both residual and
+        # momentum (momentum factor masking, DGC paper §3.2)
+        update = v * jnp.maximum(mask, dense)
+        p_new = p32 - lr * update
+        keep = 1.0 - mask
+        velocity = dense * u + (1.0 - dense) * (u * keep)
+        residual = (1.0 - dense) * (v * keep)
+        return p_new, {"velocity": velocity, "residual": residual}

@@ -109,3 +109,118 @@ def test_lookahead_minimize_and_state_roundtrip():
                                          parameters=net2.parameters()), alpha=0.5, k=2)
     la2.set_state_dict(state)
     assert la2._step_count == 1
+
+
+class TestDGCMomentum:
+    """Deep gradient compression (reference DGCMomentumOptimizer)."""
+
+    def _problem(self, n=64, seed=0):
+        rng = np.random.default_rng(seed)
+        A = rng.normal(size=(n, n)).astype(np.float32) / np.sqrt(n)
+        x = paddle.to_tensor(rng.normal(size=(n,)).astype(np.float32))
+        x.stop_gradient = False
+        At = paddle.to_tensor(A)
+
+        def loss():
+            r = At @ x
+            return (r * r).sum()
+
+        return x, loss
+
+    def test_dense_phase_matches_momentum(self):
+        from paddle_tpu.incubate.optimizer import DGCMomentum
+
+        paddle.seed(0)
+        x1, loss1 = self._problem()
+        x2, loss2 = self._problem()
+        m = paddle.optimizer.Momentum(learning_rate=1e-2, momentum=0.9,
+                                      parameters=[x1])
+        d = DGCMomentum(learning_rate=1e-2, momentum=0.9,
+                        rampup_begin_step=100, parameters=[x2])
+        for _ in range(5):  # all steps inside the dense phase
+            l1 = loss1(); l1.backward(); m.step(); m.clear_grad()
+            l2 = loss2(); l2.backward(); d.step(); d.clear_grad()
+        np.testing.assert_allclose(np.asarray(x1._data), np.asarray(x2._data),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_sparse_update_counts_and_error_feedback(self):
+        from paddle_tpu.incubate.optimizer import DGCMomentum
+
+        n = 256
+        x = paddle.to_tensor(np.zeros(n, np.float32))
+        x.stop_gradient = False
+        d = DGCMomentum(learning_rate=1.0, momentum=0.0,
+                        rampup_begin_step=0, sparsity=(0.9,),
+                        parameters=[x])
+        g = np.linspace(1, 2, n).astype(np.float32)
+
+        def loss():
+            return (x * paddle.to_tensor(g)).sum()
+
+        l = loss(); l.backward(); d.step(); d.clear_grad()
+        # ~10% of entries moved (ties may add a few), the rest stayed 0
+        moved = np.count_nonzero(np.asarray(x._data))
+        k = int(np.ceil(0.1 * n))
+        assert k <= moved <= k + 4, (moved, k)
+        # error feedback conserves the unsent mass: residual + sent == grad
+        resid = np.asarray(d._state[0]["residual"])
+        sent = -np.asarray(x._data)  # lr 1.0, momentum 0
+        np.testing.assert_allclose(resid + sent, g, rtol=1e-5, atol=1e-6)
+        # the LARGEST |v| entries were the ones sent
+        assert np.min(np.abs(sent[sent != 0])) >= np.max(np.abs(resid)) - 1e-6
+
+    def test_converges_despite_sparsity(self):
+        from paddle_tpu.incubate.optimizer import DGCMomentum
+
+        x, loss = self._problem(n=32, seed=3)
+        # final sparsity 0.9 -> ~3 of 32 coords per step: the regime DGC
+        # targets (k=1 on a 32-dim toy oscillates from momentum staleness)
+        d = DGCMomentum(learning_rate=5e-3, momentum=0.9, rampup_begin_step=0,
+                        rampup_step=20, sparsity=(0.75, 0.9),
+                        parameters=[x])
+        first = float(loss().numpy())
+        for _ in range(120):
+            l = loss(); l.backward(); d.step(); d.clear_grad()
+        last = float(loss().numpy())
+        assert last < first * 0.05, (first, last)
+
+    def test_compiled_trainstep_path(self):
+        from paddle_tpu.incubate.optimizer import DGCMomentum
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+        opt = DGCMomentum(learning_rate=5e-2, momentum=0.9,
+                          rampup_begin_step=0, sparsity=(0.8,),
+                          parameters=model.parameters())
+        step = paddle.jit.TrainStep(
+            model, lambda m, a, b: ((m(a) - b) ** 2).mean(), opt)
+        rng = np.random.default_rng(0)
+        a = paddle.to_tensor(rng.normal(size=(32, 8)).astype(np.float32))
+        b = paddle.to_tensor(rng.normal(size=(32, 1)).astype(np.float32))
+        losses = [float(step(a, b).numpy()) for _ in range(40)]
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_dgc_small_param_keeps_momentum():
+    """Scalar/bias params (k_max >= n) must get real dense MOMENTUM, not SGD."""
+    from paddle_tpu.incubate.optimizer import DGCMomentum
+
+    x1 = paddle.to_tensor(np.ones(1, np.float32)); x1.stop_gradient = False
+    x2 = paddle.to_tensor(np.ones(1, np.float32)); x2.stop_gradient = False
+    m = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9, parameters=[x1])
+    d = DGCMomentum(learning_rate=0.1, momentum=0.9, rampup_begin_step=0,
+                    sparsity=(0.999,), parameters=[x2])
+    for _ in range(4):
+        (x1 * 1.0).sum().backward(); m.step(); m.clear_grad()
+        (x2 * 1.0).sum().backward(); d.step(); d.clear_grad()
+    np.testing.assert_allclose(np.asarray(x1._data), np.asarray(x2._data),
+                               rtol=1e-6)
+
+
+def test_dgc_rampup_step_validation():
+    from paddle_tpu.incubate.optimizer import DGCMomentum
+
+    x = paddle.to_tensor(np.ones(4, np.float32)); x.stop_gradient = False
+    with pytest.raises(ValueError, match="rampup_step"):
+        DGCMomentum(sparsity=(0.75, 0.9, 0.99), rampup_step=1, parameters=[x])

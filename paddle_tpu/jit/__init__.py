@@ -328,10 +328,19 @@ class TrainStep:
         loss = step(x, y)                     # updates model params in place
     """
 
-    def __init__(self, model, loss_fn, optimizer, donate: bool = True, grads_fn=None):
+    def __init__(self, model, loss_fn, optimizer, donate: bool = True, grads_fn=None,
+                 grad_dtype=None):
         """``grads_fn(params, buffers, *args) -> (loss, grads)`` replaces the
         default ``jax.value_and_grad`` over ``loss_fn`` when given — used by
-        schedules that hand-roll their vjp (compiled 1F1B pipeline)."""
+        schedules that hand-roll their vjp (compiled 1F1B pipeline).
+
+        ``grad_dtype`` (e.g. ``"bfloat16"``): cast gradient buffers to this
+        dtype between backward and the optimizer update — with fp32-stored
+        params the cotangents are fp32, and casting lets XLA fuse the
+        down-cast into the grad matmul epilogues, halving gradient HBM
+        traffic/peak; the optimizer's fp32 math upcasts again.  bf16 grads
+        are the standard loss-scaling-free TPU recipe; leave None for exact
+        fp32 gradient accumulation."""
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -353,6 +362,9 @@ class TrainStep:
                 loss, grads = grads_fn(params, buffers, *args)
             else:
                 loss, grads = jax.value_and_grad(loss_of)(params)
+            if grad_dtype is not None:
+                gd = jnp.dtype(grad_dtype)
+                grads = jax.tree.map(lambda g: g.astype(gd), grads)
             if grad_clip is not None:
                 flat = [(None, g) for g in jax.tree.leaves(grads)]
                 clipped = [g for _, g in grad_clip(flat)]

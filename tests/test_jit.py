@@ -78,3 +78,33 @@ def test_train_step_with_clip_and_scheduler():
     sched.step()
     l1 = float(step(x, y))
     assert l1 <= l0 * 1.5
+
+
+def test_trainstep_grad_dtype_bf16():
+    """grad_dtype='bfloat16': gradient buffers cast before the optimizer
+    (fp32 math upcasts again); training still converges and matches the
+    fp32-grad run to bf16 tolerance."""
+    import jax.numpy as jnp
+    import paddle_tpu.nn as nn
+
+    def build():
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 1))
+        o = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=m.parameters())
+        return m, o
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.normal(size=(64, 16)).astype(np.float32))
+    y = paddle.to_tensor(rng.normal(size=(64, 1)).astype(np.float32))
+    lf = lambda m, a, b: ((m(a) - b) ** 2).mean()
+
+    m1, o1 = build()
+    s1 = paddle.jit.TrainStep(m1, lf, o1)
+    l1 = [float(s1(x, y).numpy()) for _ in range(20)]
+
+    m2, o2 = build()
+    s2 = paddle.jit.TrainStep(m2, lf, o2, grad_dtype="bfloat16")
+    l2 = [float(s2(x, y).numpy()) for _ in range(20)]
+
+    assert l2[-1] < l2[0] / 2            # converges
+    assert abs(l2[-1] - l1[-1]) < 0.05   # close to the fp32-grad run

@@ -34,7 +34,15 @@ from .framework.autograd import no_grad, enable_grad, is_grad_enabled, grad  # n
 from .framework.device import (  # noqa: F401
     set_device, get_device, device_count, is_compiled_with_tpu, synchronize,
 )
-from .framework.random import seed, get_rng_state_tracker  # noqa: F401
+from .framework.random import (  # noqa: F401
+    seed,
+    get_rng_state_tracker,
+    get_rng_state,
+    set_rng_state,
+    get_cuda_rng_state,
+    set_cuda_rng_state,
+)
+from .framework.param_attr import ParamAttr, create_parameter  # noqa: F401
 from .framework.flags import get_flags, set_flags  # noqa: F401
 from .framework import flags as _flags  # noqa: F401
 
@@ -70,6 +78,14 @@ from . import linalg as _linalg_ns  # noqa: F401
 from . import fft  # noqa: F401
 
 from .framework.io import save, load  # noqa: F401
+from .io import batch  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
+
+# ``paddle.dtype`` — the dtype TYPE (reference exposes the DataType class);
+# our canonical dtypes are numpy/jax dtype objects
+import numpy as _np  # noqa: E402
+
+dtype = _np.dtype
 
 # paddle-style CPU/generator seeds
 disable_static = lambda *a, **k: None  # dynamic-by-default, parity no-op

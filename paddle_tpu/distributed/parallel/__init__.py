@@ -1,5 +1,8 @@
 """Hybrid-parallel building blocks (TP layers, pipeline engine, MoE, sequence/context parallel)."""
 
+import contextlib
+
+from ...nn import layers as _nn_layers
 from . import mp_layers  # noqa: F401
 from .mp_layers import (  # noqa: F401
     ColumnParallelLinear,
@@ -16,3 +19,87 @@ from .segment_parallel import (  # noqa: F401
     segment_parallel_allreduce_grads,
     split_sequence,
 )
+
+
+class DataParallel(_nn_layers.Layer):
+    """Eager data-parallel model wrapper (reference ``paddle.DataParallel``,
+    ``python/paddle/distributed/parallel.py:219`` + the EagerReducer).
+
+    TPU-native scope: the COMPILED path gets DP from GSPMD batch sharding
+    (no wrapper needed); this wrapper serves the reference's eager
+    multi-process contract — after ``loss.backward()`` each parameter's
+    gradient is averaged across processes via a grad hook riding the host
+    collectives.  Single-process runs are passthrough.  ``no_sync()``
+    suspends averaging (gradient accumulation); grads accumulated inside
+    the window are folded into the average on the FIRST synced backward
+    after it (hooks allreduce ``accumulated + cotangent``, then subtract
+    the local accumulated part, so the post-accumulation total is the
+    exact cross-rank mean — the reference's resync-after-no_sync
+    semantics).
+
+    Constraints (vs the reference's bucketing reducer): every rank must run
+    the SAME graph each backward — the per-parameter collectives would
+    misalign otherwise, so ``find_unused_parameters`` is not supported;
+    ``comm_buffer_size`` is accepted for API compatibility but the host
+    path does not bucket.
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self._sync_enabled = True
+        if find_unused_parameters:
+            raise NotImplementedError(
+                "DataParallel(find_unused_parameters=True): rank-varying "
+                "graphs would misalign the per-parameter collectives; all "
+                "ranks must run the same backward")
+        import jax as _jax
+
+        if _jax.process_count() > 1:
+            from .. import collective as _coll
+
+            world = _coll.get_world_size(group)
+
+            def make_hook(p):
+                def hook(grad):
+                    if not self._sync_enabled:
+                        return grad
+                    import jax.numpy as _jnp
+                    import numpy as _np
+
+                    # allreduce (accumulated_local + cotangent) and subtract
+                    # the accumulated part: after the tape ADDS the returned
+                    # value, p.grad == cross-rank mean of the full totals —
+                    # exact both with and without a prior no_sync window
+                    prior = _np.asarray(p._grad) if p._grad is not None else 0.0
+                    total = prior + _np.asarray(grad)
+                    mean = _coll._host_allreduce(total, "sum", group) / world
+                    return _jnp.asarray(mean - prior)
+
+                return hook
+
+            for p in layers.parameters():
+                if not p.stop_gradient:
+                    p.register_hook(make_hook(p))
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Suspend gradient averaging (gradient accumulation window)."""
+        prev = self._sync_enabled
+        self._sync_enabled = False
+        try:
+            yield
+        finally:
+            self._sync_enabled = prev
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)

@@ -56,6 +56,22 @@ def is_grad_enabled() -> bool:
     return _grad_enabled()
 
 
+class set_grad_enabled:
+    """Switch grad tracking on/off, usable as a plain call or context manager
+    (reference ``paddle.set_grad_enabled``)."""
+
+    def __init__(self, mode: bool):
+        self._prev = _grad_enabled()
+        _set_grad_enabled(bool(mode))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _set_grad_enabled(self._prev)
+        return False
+
+
 class GradNode:
     """One recorded op on the tape.
 

@@ -1,0 +1,58 @@
+"""``paddle.ParamAttr`` / ``paddle.create_parameter``.
+
+Counterpart of the reference's parameter-attribute object
+(``python/paddle/base/param_attr.py``) consumed by every layer's
+``weight_attr``/``bias_attr``, and the standalone parameter factory
+(``python/paddle/tensor/creation.py`` ``create_parameter``).  Regularizers
+are accepted for API compatibility but the decoupled weight-decay path in
+the optimizers is the TPU-native mechanism.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["ParamAttr", "create_parameter"]
+
+
+class ParamAttr:
+    def __init__(self, name: Optional[str] = None, initializer=None,
+                 learning_rate: float = 1.0, regularizer=None,
+                 trainable: bool = True, do_model_average: bool = True,
+                 need_clip: bool = True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone trainable Parameter (reference ``paddle.create_parameter``)."""
+    from ..nn.initializer import Constant, XavierUniform
+    from .dtype import convert_dtype
+    from .tensor import Parameter
+
+    # reference ParamAttr._to_attr coercions: str -> named attr, None/True ->
+    # defaults (False means "no parameter" for bias_attr, which has no
+    # meaning for an explicit create_parameter call)
+    if attr is None or attr is True:
+        attr = ParamAttr(name=name)
+    elif isinstance(attr, str):
+        attr = ParamAttr(name=attr)
+    elif attr is False:
+        raise ValueError("create_parameter(attr=False): nothing to create")
+    init = default_initializer or attr.initializer
+    if init is None:
+        init = Constant(0.0) if is_bias else XavierUniform()
+    data = init(list(shape), convert_dtype(dtype))
+    p = Parameter(data, name=attr.name or name)
+    if attr.learning_rate is not None:
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+    if attr.trainable is False:
+        p.stop_gradient = True
+        p.trainable = False
+    return p

@@ -157,3 +157,25 @@ _TRACKER = RNGStatesTracker()
 
 def get_rng_state_tracker() -> RNGStatesTracker:
     return _TRACKER
+
+
+def get_rng_state(device=None):
+    """Generator state list (reference ``paddle.get_rng_state`` returns one
+    state per device; one program == one logical device here)."""
+    return [_DEFAULT.get_state()]
+
+
+def set_rng_state(state_list, device=None) -> None:
+    """Inverse of :func:`get_rng_state`."""
+    states = state_list if isinstance(state_list, (list, tuple)) else [state_list]
+    _DEFAULT.set_state(states[0])
+
+
+def get_cuda_rng_state():
+    """Reference CUDA-surface alias: the accelerator RNG here IS the
+    functional key of the default generator."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state_list) -> None:
+    set_rng_state(state_list)

@@ -25,7 +25,7 @@ __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset", "ChainDataset",
     "Subset", "ConcatDataset", "random_split", "BatchSampler", "Sampler", "SequenceSampler",
     "RandomSampler", "WeightedRandomSampler", "DistributedBatchSampler", "DataLoader",
-    "default_collate_fn", "get_worker_info",
+    "default_collate_fn", "get_worker_info", "batch",
 ]
 
 
@@ -456,3 +456,19 @@ class DataLoader:
             if item is sentinel:
                 break
             yield item
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Reader decorator (reference ``paddle.batch``): turns a sample reader
+    (a zero-arg callable yielding samples) into a batch reader."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched

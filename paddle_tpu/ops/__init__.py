@@ -7,8 +7,9 @@ class (``python/paddle/tensor/__init__.py`` method registration), so
 
 from __future__ import annotations
 
-from . import creation, linalg, logic, manipulation, math, random, reduction, search
+from . import creation, extras, linalg, logic, manipulation, math, random, reduction, search
 from .creation import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
@@ -21,6 +22,7 @@ from ..framework.tensor import Tensor
 
 __all__ = (
     creation.__all__
+    + extras.__all__
     + linalg.__all__
     + logic.__all__
     + manipulation.__all__
@@ -29,6 +31,10 @@ __all__ = (
     + reduction.__all__
     + search.__all__
 )
+
+# generate the reference's trailing-underscore inplace variants over every
+# base op present here (paddle.abs_ / tril_ / ... — extras.py factory)
+__all__ = __all__ + extras.install_inplace_variants(globals())
 
 
 def _install_tensor_methods():

@@ -1,0 +1,567 @@
+"""Long-tail tensor API parity: the remaining ``paddle.*`` names.
+
+Closes the top-level API diff against the reference's
+``python/paddle/__init__.py`` ``__all__`` (measured by an AST diff):
+stacking/splitting conveniences, special functions (gamma family, bessel),
+distance ops, scatter variants, dtype/introspection helpers — plus a factory
+generating the reference's trailing-underscore INPLACE variants over the
+existing functional ops (``paddle.abs_``, ``paddle.tril_``, ...), which
+rebind the input tensor's storage the way the hand-written ``reshape_``
+does.
+
+Intentionally absent (documented, not stubbed): CUDA-runtime surface
+(``CUDAPlace``, ``get_cuda_rng_state`` maps to the ONE device RNG here),
+``LazyGuard`` (lazy host-side init has no XLA benefit), and
+``disable_signal_handler``.
+"""
+
+from __future__ import annotations
+
+import math as _math
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .common import binary_op, ensure_tensor, unary_op
+
+__all__ = [
+    # linear algebra / math
+    "addmm", "mm", "block_diag", "cdist", "pdist", "vander",
+    "logcumsumexp", "reduce_as", "trapezoid", "cumulative_trapezoid",
+    "sinc", "frexp", "isin",
+    # special functions
+    "gammaln", "gammainc", "gammaincc", "multigammaln", "i0e", "i1e",
+    # stacking / splitting / rearrange
+    "hstack", "vstack", "dstack", "column_stack", "row_stack",
+    "hsplit", "vsplit", "dsplit", "cartesian_prod", "combinations",
+    "reverse", "diagonal_scatter", "slice_scatter", "take",
+    # predicates / introspection
+    "isneginf", "isposinf", "isreal", "is_complex", "is_floating_point",
+    "is_integer", "broadcast_shape", "histogram_bin_edges", "rank", "shape",
+    "tolist", "finfo", "iinfo",
+    # misc
+    "increment", "shard_index", "floor_mod", "set_printoptions",
+    "set_grad_enabled", "where_",
+]
+
+
+# -- linear algebra / math ---------------------------------------------------
+
+def mm(input, mat2, name=None):
+    """Alias of matmul without broadcasting semantics differences we need to
+    distinguish here (reference ``paddle.mm``)."""
+    return binary_op("mm", lambda a, b: a @ b, input, mat2)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    def f(i, a, b):
+        return beta * i + alpha * (a @ b)
+
+    from ..framework.dispatch import apply_op
+
+    return apply_op("addmm", f,
+                    (ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)), {})
+
+
+def block_diag(inputs, name=None):
+    from ..framework.dispatch import apply_op
+
+    ts = [ensure_tensor(t) for t in inputs]
+
+    def f(*mats):
+        mats = [jnp.atleast_2d(m) for m in mats]
+        rows = sum(m.shape[0] for m in mats)
+        cols = sum(m.shape[1] for m in mats)
+        out = jnp.zeros((rows, cols), mats[0].dtype)
+        r = c = 0
+        for m in mats:
+            out = jax.lax.dynamic_update_slice(out, m.astype(out.dtype), (r, c))
+            r += m.shape[0]
+            c += m.shape[1]
+        return out
+
+    return apply_op("block_diag", f, tuple(ts), {})
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-norm distance [.., N, M] (reference ``paddle.cdist``)."""
+    def f(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(d * d, -1), 0.0))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d), -1)
+        return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+
+    return binary_op("cdist", f, x, y)
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of [N, D] rows (reference ``paddle.pdist``)."""
+    def f(a):
+        n = a.shape[0]
+        iu, ju = jnp.triu_indices(n, k=1)
+        d = a[iu] - a[ju]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(d * d, -1), 0.0))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d), -1)
+        return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+
+    return unary_op("pdist", f, x)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    def f(a):
+        cols = a.shape[0] if n is None else int(n)
+        powers = jnp.arange(cols)
+        if not increasing:
+            powers = powers[::-1]
+        return a[:, None] ** powers[None, :]
+
+    return unary_op("vander", f, x)
+
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        # associative scan of logaddexp: numerically stable at every prefix
+        # (a per-element running-max rescale would mix scales across terms)
+        out = jax.lax.associative_scan(jnp.logaddexp, a, axis=ax)
+        return out.astype(dtype) if dtype else out
+
+    return unary_op("logcumsumexp", f, x)
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce ``x`` down to ``target``'s shape (reference
+    ``paddle.reduce_as`` — the broadcast-transpose reduction)."""
+    tgt_shape = tuple(target.shape) if isinstance(target, Tensor) else tuple(target)
+
+    def f(a):
+        extra = a.ndim - len(tgt_shape)
+        if extra:
+            a = jnp.sum(a, axis=tuple(range(extra)))
+        axes = tuple(i for i, (s, t) in enumerate(zip(a.shape, tgt_shape))
+                     if s != t and t == 1)
+        return jnp.sum(a, axis=axes, keepdims=True) if axes else a
+
+    return unary_op("reduce_as", f, x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def f(a, *rest):
+        xs = rest[0] if rest else None
+        spacing = 1.0 if dx is None else dx
+        if xs is not None:
+            return jnp.trapezoid(a, x=xs, axis=axis)
+        return jnp.trapezoid(a, dx=spacing, axis=axis)
+
+    from ..framework.dispatch import apply_op
+
+    args = (ensure_tensor(y),) + ((ensure_tensor(x),) if x is not None else ())
+    return apply_op("trapezoid", f, args, {})
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def f(a, *rest):
+        xs = rest[0] if rest else None
+        a1 = jax.lax.slice_in_dim(a, 1, a.shape[axis], axis=axis)
+        a0 = jax.lax.slice_in_dim(a, 0, a.shape[axis] - 1, axis=axis)
+        if xs is not None:
+            w1 = jax.lax.slice_in_dim(xs, 1, xs.shape[axis], axis=axis)
+            w0 = jax.lax.slice_in_dim(xs, 0, xs.shape[axis] - 1, axis=axis)
+            widths = w1 - w0
+        else:
+            widths = dx if dx is not None else 1.0
+        return jnp.cumsum((a0 + a1) / 2.0 * widths, axis=axis)
+
+    from ..framework.dispatch import apply_op
+
+    args = (ensure_tensor(y),) + ((ensure_tensor(x),) if x is not None else ())
+    return apply_op("cumulative_trapezoid", f, args, {})
+
+
+def sinc(x, name=None):
+    return unary_op("sinc", jnp.sinc, x)
+
+
+def frexp(x, name=None):
+    from ..framework.dispatch import apply_op
+
+    def f(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(jnp.int32)
+
+    return apply_op("frexp", f, (ensure_tensor(x),), {}, num_outputs=2)
+
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return binary_op("isin", lambda a, b: jnp.isin(a, b, invert=invert), x, test_x)
+
+
+# -- special functions -------------------------------------------------------
+
+def gammaln(x, name=None):
+    return unary_op("gammaln", jax.scipy.special.gammaln, x)
+
+
+def gammainc(x, y, name=None):
+    return binary_op("gammainc", jax.scipy.special.gammainc, x, y)
+
+
+def gammaincc(x, y, name=None):
+    return binary_op("gammaincc", jax.scipy.special.gammaincc, x, y)
+
+
+def multigammaln(x, p, name=None):
+    return unary_op("multigammaln",
+                    lambda a: jax.scipy.special.multigammaln(a, int(p)), x)
+
+
+def i0e(x, name=None):
+    return unary_op("i0e", jax.scipy.special.i0e, x)
+
+
+def i1e(x, name=None):
+    return unary_op("i1e", jax.scipy.special.i1e, x)
+
+
+# -- stacking / splitting ----------------------------------------------------
+
+def _nary(name, np_fn, xs):
+    from ..framework.dispatch import apply_op
+
+    ts = [ensure_tensor(t) for t in xs]
+    return apply_op(name, lambda *a: np_fn(a), tuple(ts), {})
+
+
+def hstack(x, name=None):
+    return _nary("hstack", jnp.hstack, x)
+
+
+def vstack(x, name=None):
+    return _nary("vstack", jnp.vstack, x)
+
+
+def dstack(x, name=None):
+    return _nary("dstack", jnp.dstack, x)
+
+
+def column_stack(x, name=None):
+    return _nary("column_stack", jnp.column_stack, x)
+
+
+def row_stack(x, name=None):
+    return _nary("row_stack", jnp.vstack, x)
+
+
+def _split_list(name, fn, x, arg):
+    if not isinstance(x, Tensor):
+        x = Tensor(x)
+    pieces = fn(x._data, arg)
+    return [Tensor(p) for p in pieces]
+
+
+def hsplit(x, num_or_indices, name=None):
+    return _split_list("hsplit", jnp.hsplit, x, num_or_indices)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return _split_list("vsplit", jnp.vsplit, x, num_or_indices)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return _split_list("dsplit", jnp.dsplit, x, num_or_indices)
+
+
+def cartesian_prod(x, name=None):
+    from ..framework.dispatch import apply_op
+
+    ts = [ensure_tensor(t) for t in x]
+
+    def f(*arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    return apply_op("cartesian_prod", f, tuple(ts), {})
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+
+    n = x.shape[0]
+    gen = (itertools.combinations_with_replacement if with_replacement
+           else itertools.combinations)
+    idx = np.asarray(list(gen(range(n), r)), dtype=np.int32).reshape(-1, r)
+
+    def f(a):
+        return a[jnp.asarray(idx)]
+
+    return unary_op("combinations", f, x)
+
+
+def reverse(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return unary_op("reverse", lambda a: jnp.flip(a, ax), x)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def f(a, b):
+        k = b.shape[-1]
+        i = jnp.arange(k)
+        r = i + max(-offset, 0)
+        c = i + max(offset, 0)
+        # scatter on a moved-axis view: diagonal entries live at (r, c)
+        moved = jnp.moveaxis(a, (axis1, axis2), (-2, -1))
+        bm = jnp.broadcast_to(b, moved.shape[:-2] + (k,))
+        moved = moved.at[..., r, c].set(bm.astype(moved.dtype))
+        return jnp.moveaxis(moved, (-2, -1), (axis1, axis2))
+
+    return binary_op("diagonal_scatter", f, x, y)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def f(a, v):
+        idx = [slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = slice(int(s), int(e), int(st))
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+
+    return binary_op("slice_scatter", f, x, value)
+
+
+def take(x, index, mode="raise", name=None):
+    def f(a, i):
+        flat = a.reshape(-1)
+        ii = i.astype(jnp.int32)
+        n = flat.shape[0]
+        if mode == "wrap":
+            ii = ii % n
+        elif mode == "clip":
+            ii = jnp.clip(ii, 0, n - 1)
+        else:
+            ii = jnp.where(ii < 0, ii + n, ii)  # raise-mode negatives wrap once
+        return flat[ii]
+
+    return binary_op("take", f, x, index)
+
+
+# -- predicates / introspection ---------------------------------------------
+
+def isneginf(x, name=None):
+    return unary_op("isneginf", jnp.isneginf, x)
+
+
+def isposinf(x, name=None):
+    return unary_op("isposinf", jnp.isposinf, x)
+
+
+def isreal(x, name=None):
+    return unary_op("isreal", jnp.isreal, x)
+
+
+def is_complex(x) -> bool:
+    return jnp.issubdtype((x._data if isinstance(x, Tensor) else jnp.asarray(x)).dtype,
+                          jnp.complexfloating)
+
+
+def is_floating_point(x) -> bool:
+    return jnp.issubdtype((x._data if isinstance(x, Tensor) else jnp.asarray(x)).dtype,
+                          jnp.floating)
+
+
+def is_integer(x) -> bool:
+    return jnp.issubdtype((x._data if isinstance(x, Tensor) else jnp.asarray(x)).dtype,
+                          jnp.integer)
+
+
+def broadcast_shape(x_shape, y_shape) -> List[int]:
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    def f(a):
+        lo, hi = (float(min), float(max))
+        if lo == 0 and hi == 0:
+            lo, hi = jnp.min(a), jnp.max(a)
+        return jnp.linspace(lo, hi, int(bins) + 1).astype(jnp.float32)
+
+    return unary_op("histogram_bin_edges", f, input)
+
+
+def rank(input) -> Tensor:
+    return Tensor(jnp.asarray((input._data if isinstance(input, Tensor)
+                               else jnp.asarray(input)).ndim, jnp.int32))
+
+
+def shape(input) -> Tensor:
+    arr = input._data if isinstance(input, Tensor) else jnp.asarray(input)
+    return Tensor(jnp.asarray(arr.shape, jnp.int32))
+
+
+def tolist(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x).tolist()
+
+
+class finfo:
+    """dtype float info (reference ``paddle.finfo``)."""
+
+    def __init__(self, dtype):
+        from ..framework.dtype import convert_dtype
+
+        info = jnp.finfo(convert_dtype(dtype))
+        self.dtype = str(info.dtype)
+        self.bits = info.bits
+        self.eps = float(info.eps)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+
+
+class iinfo:
+    """dtype int info (reference ``paddle.iinfo``)."""
+
+    def __init__(self, dtype):
+        from ..framework.dtype import convert_dtype
+
+        info = jnp.iinfo(convert_dtype(dtype))
+        self.dtype = str(info.dtype)
+        self.bits = info.bits
+        self.min = int(info.min)
+        self.max = int(info.max)
+
+
+# -- misc --------------------------------------------------------------------
+
+def increment(x, value=1.0, name=None):
+    """In-place add of a scalar (reference ``paddle.increment``)."""
+    from ..framework.tensor import inplace_rebind_
+
+    out = binary_op("increment", lambda a, v: a + v, x, value)
+    return inplace_rebind_(x, out)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Map global ids to shard-local ids (reference ``paddle.shard_index``:
+    the vocab-sharding helper for distributed embeddings)."""
+    size = (index_num + nshards - 1) // nshards
+
+    def f(a):
+        shard = a // size
+        local = a % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+
+    return unary_op("shard_index", f, input)
+
+
+def floor_mod(x, y, name=None):
+    return binary_op("floor_mod", jnp.mod, x, y)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor print formatting (maps onto numpy's printoptions — Tensor
+    repr renders through numpy)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def where_(condition, x, y, name=None):
+    """In-place ``where`` (reference ``paddle.where_``): writes the selected
+    values into ``x`` and returns it."""
+    from ..framework.tensor import inplace_rebind_
+    from .manipulation import where as _where
+
+    out = _where(condition, x, y)
+    return inplace_rebind_(x, out)
+
+
+def set_grad_enabled(mode: bool):
+    """Context manager / switch for grad tracking (reference
+    ``paddle.set_grad_enabled``)."""
+    from ..framework import autograd
+
+    return autograd.set_grad_enabled(mode)
+
+
+# -- inplace variants (reference trailing-underscore API) --------------------
+
+_INPLACE_BASES = [
+    "abs", "acos", "asin", "atan", "cos", "cosh", "sin", "sinh", "tan",
+    "tanh", "ceil", "floor", "round", "trunc", "exp", "expm1", "erf",
+    "log", "log2", "log10", "log1p", "logit", "neg", "reciprocal", "rsqrt",
+    "sqrt", "square", "sigmoid", "digamma", "lgamma", "frac", "i0",
+    "nan_to_num", "tril", "triu", "cumsum", "cumprod", "cast",
+    "divide", "multiply", "subtract", "add", "pow", "remainder", "mod",
+    "floor_divide", "gcd", "lcm", "hypot", "ldexp", "copysign",
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor",
+    "bitwise_left_shift", "bitwise_right_shift",
+    "equal", "greater_equal", "greater_than", "less_equal", "less_than",
+    "not_equal", "masked_fill", "masked_scatter", "index_add",
+    "index_fill", "index_put", "scale", "clip", "lerp", "erfinv",
+    "polygamma", "renorm", "ldexp", "copysign", "hypot",
+    "transpose", "t", "fill_diagonal",
+]
+
+
+def _make_inplace(base_name, base_fn):
+    def inplace(x, *args, **kwargs):
+        from ..framework.tensor import inplace_rebind_
+
+        out = base_fn(x, *args, **kwargs)
+        return inplace_rebind_(x, out)
+
+    inplace.__name__ = base_name + "_"
+    inplace.__qualname__ = base_name + "_"
+    inplace.__doc__ = (f"In-place variant of :func:`{base_name}` (reference "
+                       f"``paddle.{base_name}_``): rebinds ``x``'s storage "
+                       "to the result and returns ``x``.")
+    return inplace
+
+
+def install_inplace_variants(namespace: dict) -> List[str]:
+    """Generate ``<op>_`` for every base op present in ``namespace`` that
+    does not already have a hand-written inplace form.  Returns the names
+    added (ops/__init__ extends its ``__all__`` with them)."""
+    added = []
+    for base in _INPLACE_BASES:
+        name = base + "_"
+        if name in namespace or base not in namespace:
+            continue
+        fn = namespace[base]
+        if not callable(fn):
+            continue
+        namespace[name] = _make_inplace(base, fn)
+        added.append(name)
+    # this module's own ops get their inplace forms too
+    for base in ("sinc", "gammaln", "gammainc", "gammaincc",
+                 "multigammaln", "addmm", "floor_mod"):
+        name = base + "_"
+        if name not in namespace and base in globals():
+            namespace[name] = _make_inplace(base, globals()[base])
+            added.append(name)
+    return added

@@ -20,7 +20,8 @@ from .creation import _shape, _dt
 __all__ = [
     "uniform", "uniform_", "normal", "normal_", "standard_normal", "randn", "rand",
     "randint", "randint_like", "randperm", "bernoulli", "bernoulli_", "multinomial",
-    "poisson", "exponential_", "standard_gamma", "log_normal", "cauchy_", "geometric_",
+    "poisson", "exponential_", "standard_gamma", "log_normal", "log_normal_", "cauchy_", "geometric_",
+    "binomial",
 ]
 
 
@@ -127,6 +128,14 @@ def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
     return Tensor(jnp.exp(mean + std * jax.random.normal(rnd.next_key(), shp, dtype=_dt(dtype))))
 
 
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """Refill ``x`` with elementwise LogNormal(mean, std) samples in place
+    (reference ``paddle.log_normal_`` — same fill contract as uniform_)."""
+    x._set_data(jnp.exp(mean + std * jax.random.normal(
+        rnd.next_key(), tuple(x.shape))).astype(x.dtype))
+    return x
+
+
 def cauchy_(x, loc=0, scale=1, name=None):
     x._set_data(loc + scale * jax.random.cauchy(rnd.next_key(), tuple(x.shape), dtype=x.dtype))
     return x
@@ -136,3 +145,19 @@ def geometric_(x, probs, name=None):
     u = jax.random.uniform(rnd.next_key(), tuple(x.shape), dtype=jnp.float32, minval=1e-7, maxval=1.0)
     x._set_data((jnp.ceil(jnp.log(u) / jnp.log1p(-probs))).astype(x.dtype))
     return x
+
+
+def binomial(count, prob, name=None):
+    """Binomial(count, prob) samples (reference ``paddle.binomial``)."""
+    from .common import ensure_tensor
+    from ..framework.dispatch import apply_op
+
+    c = ensure_tensor(count)
+    p = ensure_tensor(prob)
+    key = rnd.next_key()
+
+    def f(n, pp):
+        return jax.random.binomial(key, n.astype(jnp.float32),
+                                   pp.astype(jnp.float32)).astype(jnp.int32)
+
+    return apply_op("binomial", f, (c, p), {})

@@ -1,0 +1,170 @@
+"""Top-level API parity against the reference's ``paddle.__all__`` (AST
+diff), plus behavior checks on the extras/inplace surface."""
+
+import ast
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+REF_INIT = "/root/reference/python/paddle/__init__.py"
+
+# CUDA-runtime / host-specific surface with no TPU-native meaning
+# (documented in ops/extras.py)
+INTENTIONALLY_ABSENT = {"CUDAPlace", "LazyGuard", "check_shape",
+                        "disable_signal_handler"}
+
+
+@pytest.mark.skipif(not os.path.exists(REF_INIT), reason="no reference mount")
+def test_top_level_all_parity():
+    ref_all = []
+    for node in ast.walk(ast.parse(open(REF_INIT).read())):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgt = node.targets[0] if isinstance(node, ast.Assign) else node.target
+            if getattr(tgt, "id", "") == "__all__":
+                try:
+                    ref_all += ast.literal_eval(node.value)
+                except Exception:
+                    pass
+    missing = {n for n in set(ref_all) if not hasattr(paddle, n)}
+    assert missing <= INTENTIONALLY_ABSENT, sorted(missing - INTENTIONALLY_ABSENT)
+
+
+class TestExtrasOps:
+    def test_stacking_matches_numpy(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        for name in ("hstack", "vstack", "dstack", "column_stack", "row_stack"):
+            got = np.asarray(getattr(paddle, name)(
+                [paddle.to_tensor(a), paddle.to_tensor(a)])._data)
+            want = getattr(np, name if name != "row_stack" else "vstack")([a, a])
+            np.testing.assert_array_equal(got, want, err_msg=name)
+
+    def test_splits(self):
+        a = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+        hs = paddle.hsplit(paddle.to_tensor(a), 2)
+        assert len(hs) == 2 and list(hs[0].shape) == [2, 2, 3]
+        vs = paddle.vsplit(paddle.to_tensor(a), 2)
+        assert list(vs[0].shape) == [1, 4, 3]
+        ds = paddle.dsplit(paddle.to_tensor(a), 3)
+        assert list(ds[0].shape) == [2, 4, 1]
+
+    def test_special_functions_vs_scipy(self):
+        from scipy import special
+
+        x = np.linspace(0.5, 5.0, 7).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(paddle.gammaln(paddle.to_tensor(x))._data),
+            special.gammaln(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(paddle.gammainc(paddle.to_tensor(x), paddle.to_tensor(x))._data),
+            special.gammainc(x, x), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(paddle.i0e(paddle.to_tensor(x))._data),
+            special.i0e(x), rtol=1e-5)
+        x2 = np.linspace(1.0, 5.0, 7).astype(np.float32)  # domain: a > (d-1)/2
+        np.testing.assert_allclose(
+            np.asarray(paddle.multigammaln(paddle.to_tensor(x2), 2)._data),
+            special.multigammaln(x2, 2), rtol=1e-5)
+
+    def test_cdist_pdist(self):
+        from scipy.spatial.distance import cdist as sp_cdist, pdist as sp_pdist
+
+        a = np.random.default_rng(0).normal(size=(5, 3)).astype(np.float32)
+        b = np.random.default_rng(1).normal(size=(4, 3)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(paddle.cdist(paddle.to_tensor(a), paddle.to_tensor(b))._data),
+            sp_cdist(a, b), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(paddle.pdist(paddle.to_tensor(a))._data),
+            sp_pdist(a), rtol=1e-4, atol=1e-5)
+
+    def test_inplace_variants_rebind(self):
+        t = paddle.to_tensor(np.array([-1.5, 2.5], np.float32))
+        out = t.abs_() if hasattr(t, "abs_") else paddle.abs_(t)
+        assert out is t
+        np.testing.assert_allclose(np.asarray(t._data), [1.5, 2.5])
+        u = paddle.to_tensor(np.eye(3, dtype=np.float32))
+        paddle.tril_(u)
+        assert np.allclose(np.asarray(u._data), np.tril(np.eye(3)))
+        # where_ writes into x
+        c = paddle.to_tensor(np.array([True, False]))
+        x = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+        y = paddle.to_tensor(np.array([9.0, 9.0], np.float32))
+        paddle.where_(c, x, y)
+        np.testing.assert_allclose(np.asarray(x._data), [1.0, 9.0])
+
+    def test_inplace_grad_flow(self):
+        """Inplace variants stay differentiable through the tape."""
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+        x.stop_gradient = False
+        y = (x * x)
+        y.square_()      # y = (x^2)^2 = x^4
+        y.sum().backward()
+        np.testing.assert_allclose(np.asarray(x._grad), 4 * np.array([2.0, 3.0]) ** 3,
+                                   rtol=1e-5)
+
+    def test_misc(self):
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+        assert paddle.finfo("float32").bits == 32
+        assert paddle.iinfo("int32").max == 2 ** 31 - 1
+        t = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        assert paddle.tolist(t) == [0.0, 1.0, 2.0, 3.0]
+        assert int(paddle.rank(t).numpy()) == 1
+        np.testing.assert_array_equal(np.asarray(paddle.shape(t)._data), [4])
+        out = paddle.shard_index(paddle.to_tensor(np.array([0, 5, 9], np.int32)),
+                                 index_num=10, nshards=2, shard_id=1)
+        np.testing.assert_array_equal(np.asarray(out._data), [-1, 0, 4])
+        np.testing.assert_allclose(
+            float(paddle.logcumsumexp(paddle.to_tensor(
+                np.array([1.0, 2.0], np.float32)))[1].numpy()),
+            np.log(np.exp(1.0) + np.exp(2.0)), rtol=1e-5)
+
+    def test_take_and_scatter_variants(self):
+        a = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        np.testing.assert_array_equal(
+            np.asarray(paddle.take(a, paddle.to_tensor(np.array([0, 5, -1]))).numpy()),
+            [0.0, 5.0, 11.0])
+        d = paddle.diagonal_scatter(a, paddle.to_tensor(np.array([100.0, 200.0, 300.0], np.float32)))
+        got = np.asarray(d._data)
+        assert got[0, 0] == 100 and got[1, 1] == 200 and got[2, 2] == 300
+        s = paddle.slice_scatter(a, paddle.to_tensor(np.zeros((3, 2), np.float32)),
+                                 axes=[1], starts=[1], ends=[3], strides=[1])
+        assert np.all(np.asarray(s._data)[:, 1:3] == 0)
+
+
+def test_data_parallel_passthrough():
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    dp = paddle.DataParallel(net)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    np.testing.assert_allclose(np.asarray(dp(x)._data), np.asarray(net(x)._data))
+    with dp.no_sync():
+        pass  # context works single-process
+    assert "weight" in dp.state_dict()
+
+
+def test_log_normal_inplace_distribution():
+    """log_normal_ refills x elementwise (regression: the generated variant
+    passed x as the MEAN with a single scalar draw)."""
+    paddle.seed(0)
+    x = paddle.to_tensor(np.zeros(20000, np.float32))
+    paddle.log_normal_(x, mean=0.0, std=0.5)
+    logs = np.log(np.asarray(x._data))
+    assert abs(logs.mean()) < 0.02 and abs(logs.std() - 0.5) < 0.02
+    assert len(np.unique(np.asarray(x._data))) > 10000  # independent draws
+
+
+def test_create_parameter_attr_coercions():
+    p = paddle.create_parameter([2, 2], "float32", attr="w_named")
+    assert p.name == "w_named"
+    p2 = paddle.create_parameter([2], "float32", attr=True)
+    assert p2.shape == [2]
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        paddle.create_parameter([2], "float32", attr=False)

@@ -76,6 +76,7 @@ from . import hapi  # noqa: F401
 from .hapi import Model, summary, flops  # noqa: F401
 from . import linalg as _linalg_ns  # noqa: F401
 from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 
 from .framework.io import save, load  # noqa: F401
 from .io import batch  # noqa: F401

@@ -1,0 +1,770 @@
+"""nn.functional long tail (part of the ``paddle.nn.functional`` surface).
+
+Counterpart of the remaining reference functionals
+(``python/paddle/nn/functional/``): sampling geometry (grid_sample /
+affine_grid), fold, unpooling, LP/fractional pooling, maxout, the loss
+family (dice/log/multi-margin/triplet-distance/hsigmoid/RNN-T/adaptive
+log-softmax), packed flash-attention entry points, and the in-place
+activation variants.  Numerics are verified against torch (cpu) where torch
+implements the op, else against hand DP references.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import apply_op
+from ..framework.tensor import Tensor
+from ..ops.common import binary_op, ensure_tensor, unary_op
+
+__all__ = [
+    "affine_grid", "grid_sample", "fold",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d",
+    "lp_pool1d", "lp_pool2d", "fractional_max_pool2d", "fractional_max_pool3d",
+    "adaptive_max_pool3d", "maxout",
+    "dice_loss", "log_loss", "multi_margin_loss",
+    "triplet_margin_with_distance_loss", "hsigmoid_loss", "rnnt_loss",
+    "adaptive_log_softmax_with_loss",
+    "flash_attn_qkvpacked", "flash_attn_varlen_qkvpacked",
+    "flashmask_attention", "sparse_attention",
+    "gather_tree", "feature_alpha_dropout", "bilinear",
+    "class_center_sample", "margin_cross_entropy",
+    "softmax_", "tanh_", "elu_", "leaky_relu_", "hardtanh_",
+    "thresholded_relu_",
+]
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# geometry
+# ---------------------------------------------------------------------------
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2D/3D sampling grids from affine matrices (reference
+    ``vision.py`` ``affine_grid``; torch semantics)."""
+    shp = [int(s) for s in (np.asarray(_raw(out_shape)).tolist()
+                            if not isinstance(out_shape, (list, tuple))
+                            else out_shape)]
+
+    def line(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        return (jnp.arange(n) * 2 + 1) / n - 1.0
+
+    def f(th):
+        if len(shp) == 4:
+            N, _, H, W = shp
+            ys, xs = jnp.meshgrid(line(H), line(W), indexing="ij")
+            base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)  # [H,W,3]
+            grid = jnp.einsum("hwk,nck->nhwc", base, th)            # [N,H,W,2]
+            return grid
+        N, _, D, H, W = shp
+        zs, ys, xs = jnp.meshgrid(line(D), line(H), line(W), indexing="ij")
+        base = jnp.stack([xs, ys, zs, jnp.ones_like(xs)], axis=-1)
+        return jnp.einsum("dhwk,nck->ndhwc", base, th)
+
+    return unary_op("affine_grid", f, ensure_tensor(theta))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Spatial sampling by normalized grid coordinates (reference
+    ``vision.py`` ``grid_sample``; 4-D NCHW input, torch semantics)."""
+
+    def f(a, g):
+        N, C, H, W = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+
+        def unnorm(v, n):
+            if align_corners:
+                return (v + 1.0) * (n - 1) / 2.0
+            return ((v + 1.0) * n - 1.0) / 2.0
+
+        ix = unnorm(gx, W)
+        iy = unnorm(gy, H)
+
+        if padding_mode == "border":
+            ix = jnp.clip(ix, 0, W - 1)
+            iy = jnp.clip(iy, 0, H - 1)
+        elif padding_mode == "reflection":
+            def reflect(v, n):
+                if align_corners:
+                    span = 2 * (n - 1)
+                    v = jnp.abs(v) % span if span else v * 0
+                    return jnp.where(v > n - 1, span - v, v)
+                span = 2 * n
+                v = (jnp.abs(v + 0.5) % span)
+                v = jnp.where(v > n, span - v, v) - 0.5
+                return jnp.clip(v, 0, n - 1)
+
+            ix = reflect(ix, W)
+            iy = reflect(iy, H)
+
+        def gather(yy, xx):
+            yy_c = jnp.clip(yy, 0, H - 1)
+            xx_c = jnp.clip(xx, 0, W - 1)
+            out = a[jnp.arange(N)[:, None, None], :, yy_c, xx_c]  # [N,Hg,Wg,C]
+            if padding_mode == "zeros":
+                valid = ((yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1))
+                out = out * valid[..., None]
+            return out
+
+        if mode == "nearest":
+            out = gather(jnp.round(iy).astype(jnp.int32),
+                         jnp.round(ix).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(ix)
+            y0 = jnp.floor(iy)
+            wx = ix - x0
+            wy = iy - y0
+            x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+            out = (gather(y0i, x0i) * ((1 - wy) * (1 - wx))[..., None]
+                   + gather(y0i, x0i + 1) * ((1 - wy) * wx)[..., None]
+                   + gather(y0i + 1, x0i) * (wy * (1 - wx))[..., None]
+                   + gather(y0i + 1, x0i + 1) * (wy * wx)[..., None])
+        return jnp.moveaxis(out, -1, 1)  # [N,C,Hg,Wg]
+
+    return binary_op("grid_sample", f, ensure_tensor(x), ensure_tensor(grid))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im — inverse of unfold (reference ``common.py`` ``fold``).
+    x: [N, C*kh*kw, L] -> [N, C, H, W] with overlapping patches summed."""
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    H, W = pair(output_sizes)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    ph, pw = pair(paddings)
+    dh, dw = pair(dilations)
+    oh = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+    def f(a):
+        N = a.shape[0]
+        C = a.shape[1] // (kh * kw)
+        patches = a.reshape(N, C, kh, kw, oh, ow)
+        out = jnp.zeros((N, C, H + 2 * ph, W + 2 * pw), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                ys = i * dh
+                xs = j * dw
+                out = out.at[:, :, ys:ys + sh * oh:sh,
+                             xs:xs + sw * ow:sw].add(patches[:, :, i, j])
+        return out[:, :, ph:ph + H, pw:pw + W]
+
+    return unary_op("fold", f, ensure_tensor(x))
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+def _unpool_nd(x, indices, kernel_size, stride, padding, output_size, nd):
+    """Scatter pooled values back to the pre-pool positions recorded in
+    ``indices`` (flat within each [spatial] map, reference max_unpoolNd)."""
+    def f(a, idx):
+        spatial = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(int(s) for s in output_size[-nd:])
+        else:
+            ks = (kernel_size,) * nd if isinstance(kernel_size, int) else tuple(kernel_size)
+            st = ks if stride is None else ((stride,) * nd if isinstance(stride, int) else tuple(stride))
+            pd = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+            out_sp = tuple((spatial[i] - 1) * st[i] - 2 * pd[i] + ks[i]
+                           for i in range(nd))
+        N, C = a.shape[0], a.shape[1]
+        flat_len = int(np.prod(out_sp))
+        av = a.reshape(N, C, -1)
+        iv = idx.reshape(N, C, -1).astype(jnp.int32)
+        out = jnp.zeros((N, C, flat_len), a.dtype)
+        out = out.at[jnp.arange(N)[:, None, None],
+                     jnp.arange(C)[None, :, None], iv].set(av)
+        return out.reshape((N, C) + out_sp)
+
+    return binary_op("max_unpool", f, ensure_tensor(x), ensure_tensor(indices))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    return _unpool_nd(x, indices, kernel_size, stride, padding, output_size, 1)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    return _unpool_nd(x, indices, kernel_size, stride, padding, output_size, 2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    return _unpool_nd(x, indices, kernel_size, stride, padding, output_size, 3)
+
+
+def _lp_pool(x, norm_type, kernel, stride, nd, ceil_mode=False):
+    def f(a):
+        ks = (kernel,) * nd if isinstance(kernel, int) else tuple(kernel)
+        st = ks if stride is None else ((stride,) * nd if isinstance(stride, int) else tuple(stride))
+        p = float(norm_type)
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pow_sum = jax.lax.reduce_window(
+            jnp.abs(a) ** p, 0.0, jax.lax.add, window, strides,
+            "VALID")
+        return pow_sum ** (1.0 / p)
+
+    return unary_op("lp_pool", f, ensure_tensor(x))
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, ceil_mode=False,
+              data_format="NCL", name=None):
+    return _lp_pool(x, norm_type, kernel_size, stride, 1, ceil_mode)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, ceil_mode=False,
+              data_format="NCHW", name=None):
+    return _lp_pool(x, norm_type, kernel_size, stride, 2, ceil_mode)
+
+
+def _fractional_pool(x, output_size, random_u, nd):
+    """Fractional max pooling (Graham 2014): pseudo-random pooling region
+    boundaries from one u in (0,1) per call (the reference's deterministic
+    ``random_u`` mode)."""
+    def boundaries(n_in, n_out, u):
+        alpha = n_in / n_out
+        idx = (np.ceil(alpha * (np.arange(n_out) + u)) - 1).astype(np.int64)
+        idx = np.clip(idx, 0, n_in - 1)
+        starts = np.concatenate([[0], idx[:-1] + 0]) if False else None
+        # region r spans [b[r], b[r+1]) with b[0]=0, b[n_out]=n_in
+        b = np.concatenate([[0], idx[:-1] + 1, [n_in]])
+        return b
+
+    def f(a):
+        spatial = a.shape[2:]
+        outs = ((output_size,) * nd if isinstance(output_size, int)
+                else tuple(output_size))
+        u = float(random_u) if random_u is not None else 0.5
+        bs = [boundaries(spatial[i], outs[i], u) for i in range(nd)]
+        out = a
+        # pool one spatial dim at a time (segment max between boundaries)
+        for d in range(nd):
+            axis = 2 + d
+            segs = []
+            b = bs[d]
+            for r in range(len(b) - 1):
+                seg = jax.lax.slice_in_dim(out, int(b[r]), int(b[r + 1]),
+                                           axis=axis)
+                segs.append(jnp.max(seg, axis=axis, keepdims=True))
+            out = jnp.concatenate(segs, axis=axis)
+        return out
+
+    return unary_op("fractional_max_pool", f, ensure_tensor(x))
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    out = _fractional_pool(x, output_size, random_u, 2)
+    return (out, None) if return_mask else out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    out = _fractional_pool(x, output_size, random_u, 3)
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    def f(a):
+        outs = ((output_size,) * 3 if isinstance(output_size, int)
+                else tuple(output_size))
+        out = a
+        for d in range(3):
+            axis = 2 + d
+            n_in, n_out = out.shape[axis], outs[d]
+            segs = []
+            for r in range(n_out):
+                lo = (r * n_in) // n_out
+                hi = -(-((r + 1) * n_in) // n_out)
+                seg = jax.lax.slice_in_dim(out, lo, hi, axis=axis)
+                segs.append(jnp.max(seg, axis=axis, keepdims=True))
+            out = jnp.concatenate(segs, axis=axis)
+        return out
+
+    out = unary_op("adaptive_max_pool3d", f, ensure_tensor(x))
+    return (out, None) if return_mask else out
+
+
+def maxout(x, groups, axis=1, name=None):
+    """Max over ``groups`` consecutive channels (reference ``maxout``)."""
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+
+    return unary_op("maxout", f, ensure_tensor(x))
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - Dice coefficient (reference ``loss.py`` ``dice_loss``): input
+    [N, ..., C] probabilities, label [N, ..., 1] class ids."""
+    def f(p, y):
+        oh = jax.nn.one_hot(y[..., 0], p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * oh, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(oh, axis=reduce_dims)
+        dice = (2 * inter) / (union + epsilon)
+        return jnp.mean(1 - dice)
+
+    return binary_op("dice_loss", f, ensure_tensor(input), ensure_tensor(label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """Negative log likelihood of binary probabilities (reference
+    ``log_loss``)."""
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+
+    return binary_op("log_loss", f, ensure_tensor(input), ensure_tensor(label))
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class margin loss (reference ``multi_margin_loss``)."""
+    def f(x, y):
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, y[:, None], axis=1)
+        m = jnp.maximum(margin - correct + x, 0.0) ** p
+        if weight is not None:
+            w = _raw(weight)
+            m = m * w[y][:, None]
+        m = m.at[jnp.arange(n), y].set(0.0)
+        per = jnp.sum(m, axis=1) / c
+        if reduction == "none":
+            return per
+        return jnp.mean(per) if reduction == "mean" else jnp.sum(per)
+
+    return binary_op("multi_margin_loss", f, ensure_tensor(input),
+                     ensure_tensor(label))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    """Triplet loss with a custom distance (reference
+    ``triplet_margin_with_distance_loss``)."""
+    from ..framework.dispatch import apply_op
+
+    def default_dist(a, b):
+        return jnp.sqrt(jnp.maximum(jnp.sum((a - b) ** 2, axis=-1), 1e-12))
+
+    def f(a, pos, neg):
+        if distance_function is not None:
+            dp = _raw(distance_function(Tensor(a), Tensor(pos)))
+            dn = _raw(distance_function(Tensor(a), Tensor(neg)))
+            if swap:
+                dn = jnp.minimum(dn, _raw(distance_function(Tensor(pos), Tensor(neg))))
+        else:
+            dp = default_dist(a, pos)
+            dn = default_dist(a, neg)
+            if swap:
+                dn = jnp.minimum(dn, default_dist(pos, neg))
+        per = jnp.maximum(dp - dn + margin, 0.0)
+        if reduction == "none":
+            return per
+        return jnp.mean(per) if reduction == "mean" else jnp.sum(per)
+
+    return apply_op("triplet_margin_with_distance_loss", f,
+                    (ensure_tensor(input), ensure_tensor(positive),
+                     ensure_tensor(negative)), {})
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid loss over the DEFAULT complete binary tree
+    (reference ``hsigmoid_loss``; custom trees via path_table/path_code).
+
+    input [N, D]; label [N]; weight [num_classes-1, D]."""
+    from ..framework.dispatch import apply_op
+
+    depth = max(1, int(math.ceil(math.log2(max(num_classes, 2)))))
+
+    def default_paths(y):
+        # leaf id -> internal-node path (heap layout): node ids and
+        # left(+1)/right(-1) codes, padded with -1
+        nodes = []
+        codes = []
+        cur = y + (1 << depth)  # implicit leaf index in a full binary heap
+        for _ in range(depth):
+            parent = cur // 2
+            nodes.append(parent - 1)        # internal nodes are 1-based heap
+            codes.append(jnp.where(cur % 2 == 0, 1.0, -1.0))
+            cur = parent
+        return jnp.stack(nodes, -1), jnp.stack(codes, -1)
+
+    def f(x, y, w, *rest):
+        b = rest[0] if rest else None
+        if path_table is not None:
+            nodes = _raw(path_table).astype(jnp.int32)
+            codes = jnp.where(_raw(path_code) > 0, 1.0, -1.0)
+            valid = nodes >= 0
+            nodes = jnp.maximum(nodes, 0)
+        else:
+            nodes, codes = default_paths(y)
+            valid = (nodes >= 0) & (nodes < num_classes - 1)
+            nodes = jnp.clip(nodes, 0, num_classes - 2)
+        scores = jnp.einsum("nd,npd->np", x, w[nodes])   # [N, path]
+        if b is not None:
+            scores = scores + b[nodes][..., 0] if b.ndim == 2 else scores + b[nodes]
+        logp = jax.nn.log_sigmoid(codes * scores)
+        return -jnp.sum(jnp.where(valid, logp, 0.0), axis=-1).mean()
+
+    args = [ensure_tensor(input), ensure_tensor(label), ensure_tensor(weight)]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return apply_op("hsigmoid_loss", f, tuple(args), {})
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (reference ``rnnt_loss`` — warprnnt's role),
+    implemented as the standard log-space alpha recursion over the (T, U)
+    lattice with ``lax.scan`` over time steps.
+
+    input: [B, T, U+1, V] logits; label: [B, U] targets.
+    """
+    from ..framework.dispatch import apply_op
+
+    def f(logits, labels, t_lens, u_lens):
+        B, T, U1, V = logits.shape
+        U = U1 - 1
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        blank_lp = logp[..., blank]                                  # [B,T,U+1]
+        lab_lp = jnp.take_along_axis(
+            logp[:, :, :U, :], labels[:, None, :, None].astype(jnp.int32),
+            axis=-1)[..., 0]                                         # [B,T,U]
+        NEG = -1e30
+
+        def t_step(alpha_prev, t):
+            # alpha[t, u] = logaddexp(alpha[t-1, u] + blank(t-1, u),
+            #                         alpha[t, u-1] + label(t, u-1))
+            from_blank = alpha_prev + blank_lp[:, t - 1, :]
+
+            def u_step(carry, u):
+                left = carry  # alpha[t, u-1]
+                cur = jnp.where(
+                    u == 0, from_blank[:, 0],
+                    jnp.logaddexp(
+                        jnp.take_along_axis(from_blank,
+                                            jnp.full((B, 1), u), 1)[:, 0],
+                        left + jnp.take_along_axis(
+                            lab_lp[:, t, :],
+                            jnp.clip(jnp.full((B, 1), u - 1), 0, U - 1),
+                            1)[:, 0]))
+                return cur, cur
+
+            _, cols = jax.lax.scan(u_step, jnp.full((B,), NEG),
+                                   jnp.arange(U1))
+            return jnp.swapaxes(cols, 0, 1), None
+
+        # t = 0 row: only label emissions
+        first = jnp.concatenate(
+            [jnp.zeros((B, 1), jnp.float32),
+             jnp.cumsum(lab_lp[:, 0, :], axis=-1)], axis=-1)
+        # iterate t = 1..T-1 (python loop unrolled; T is static)
+        alphas = [first]
+        alpha = first
+        for t in range(1, T):
+            alpha, _ = t_step(alpha, t)
+            alphas.append(alpha)
+        alpha_all = jnp.stack(alphas, axis=1)        # [B, T, U+1]
+        t_idx = (t_lens - 1).astype(jnp.int32)
+        u_idx = u_lens.astype(jnp.int32)
+        final = alpha_all[jnp.arange(B), t_idx, u_idx] + \
+            blank_lp[jnp.arange(B), t_idx, u_idx]
+        nll = -final
+        if reduction == "none":
+            return nll
+        return jnp.mean(nll) if reduction == "mean" else jnp.sum(nll)
+
+    return apply_op("rnnt_loss", f,
+                    (ensure_tensor(input), ensure_tensor(label),
+                     ensure_tensor(input_lengths), ensure_tensor(label_lengths)),
+                    {})
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (reference ``adaptive_log_softmax_with_loss``;
+    Grave et al.): head covers frequent classes + one entry per tail
+    cluster; each tail cluster has a two-matrix projection.
+
+    Returns (output [N] log-likelihoods, loss scalar)."""
+    from ..framework.dispatch import apply_op
+
+    n_clusters = len(cutoffs)
+    head_size = cutoffs[0] + n_clusters
+
+    def f(x, y, hw, *rest):
+        hb = rest[-1] if head_bias is not None else None
+        tails = rest[:2 * n_clusters]
+        head = x @ hw
+        if hb is not None:
+            head = head + hb
+        head_lp = jax.nn.log_softmax(head, axis=-1)
+        # frequent classes: direct head entries
+        out = jnp.take_along_axis(
+            head_lp, jnp.clip(y, 0, cutoffs[0] - 1)[:, None], 1)[:, 0]
+        lo = cutoffs[0]
+        for c in range(n_clusters):
+            hi = cutoffs[c + 1] if c + 1 < len(cutoffs) else None
+            hi = hi if hi is not None else cutoffs[-1]
+            w1, w2 = tails[2 * c], tails[2 * c + 1]
+            cluster_lp = jax.nn.log_softmax((x @ w1) @ w2, axis=-1)
+            size = w2.shape[-1]
+            rel = jnp.clip(y - lo, 0, size - 1)
+            in_cluster = (y >= lo) & (y < lo + size)
+            cand = head_lp[:, cutoffs[0] + c] + \
+                jnp.take_along_axis(cluster_lp, rel[:, None], 1)[:, 0]
+            out = jnp.where(in_cluster, cand, out)
+            lo += size
+        return out, -jnp.mean(out)
+
+    args = [ensure_tensor(input), ensure_tensor(label), ensure_tensor(head_weight)]
+    for w1, w2 in tail_weights:
+        args += [ensure_tensor(w1), ensure_tensor(w2)]
+    if head_bias is not None:
+        args.append(ensure_tensor(head_bias))
+    return apply_op("adaptive_log_softmax_with_loss", f, tuple(args), {},
+                    num_outputs=2)
+
+
+# ---------------------------------------------------------------------------
+# attention entry points / misc
+# ---------------------------------------------------------------------------
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         fixed_seed_offset=None, rng_name="", training=True,
+                         name=None):
+    """Packed-QKV flash attention (reference ``flash_attention.py``
+    ``flash_attn_qkvpacked``): qkv [B, S, 3, H, D]."""
+    from .functional import scaled_dot_product_attention
+
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    out = scaled_dot_product_attention(q, k, v, None, dropout, causal, training)
+    return out, None
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                                max_seqlen_k, scale, dropout=0.0, causal=False,
+                                return_softmax=False, fixed_seed_offset=None,
+                                rng_name="", varlen_padded=True, training=True,
+                                name=None):
+    """Varlen packed-QKV flash attention (reference
+    ``flash_attn_varlen_qkvpacked``): qkv [T, 3, H, D]."""
+    from .functional import flash_attn_unpadded
+
+    q = qkv[:, 0]
+    k = qkv[:, 1]
+    v = qkv[:, 2]
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale, dropout,
+                               causal, return_softmax, fixed_seed_offset,
+                               rng_name, training)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, window_size=None, name=None):
+    """FlashMask attention (reference ``flashmask_attention``): the mask is
+    given COMPRESSED as per-column start/end row indices
+    [B, H or 1, S, 1|2|4].  XLA fallback: expand to a dense mask; a Pallas
+    kernel would skip fully-masked blocks."""
+    from ..kernels.flash_attention import _attention_reference
+
+    def f(q, k, v, *rest):
+        B, S, H, D = q.shape
+        mask = None
+        if rest:
+            sre = rest[0].astype(jnp.int32)     # [B, Hm, S, n]
+            rows = jnp.arange(S)[:, None]       # query rows
+            n = sre.shape[-1]
+            if causal:
+                base = rows >= jnp.arange(S)[None, :]
+            else:
+                base = jnp.ones((S, S), bool)
+            # column j masked for rows in [start_j, end_j)
+            start = sre[..., 0]                  # [B, Hm, S]
+            masked = (rows[None, None] >= start[:, :, None, :])
+            if n >= 2:
+                end = sre[..., 1]
+                masked = masked & (rows[None, None] < end[:, :, None, :])
+            mask = base[None, None] & ~masked
+        elif causal:
+            mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
+        sm = 1.0 / math.sqrt(D)
+        return _attention_reference(q, k, v, False, mask, sm)
+
+    from ..framework.dispatch import apply_op
+
+    args = [ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)]
+    if startend_row_indices is not None:
+        args.append(ensure_tensor(startend_row_indices))
+    return apply_op("flashmask_attention", f, tuple(args), {})
+
+
+def sparse_attention(x, q, k, v=None, offset=None, columns=None, name=None):
+    raise NotImplementedError(
+        "sparse_attention (block-sparse CSR attention) is not implemented: "
+        "use flashmask_attention (compressed row masks) or "
+        "flash_attn_unpadded (segment masks) — the TPU-native sparse "
+        "patterns this framework ships")
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (reference ``gather_tree``): follow parent
+    pointers from the last step to recover full beams.
+
+    ids, parents: [T, B, beam]."""
+    def f(seq, par):
+        T = seq.shape[0]
+
+        def step(carry, t):
+            beams = carry  # [B, beam] current beam index at step t+1
+            out = jnp.take_along_axis(seq[t], beams, axis=-1)
+            prev = jnp.take_along_axis(par[t], beams, axis=-1)
+            return prev, out
+
+        _, rev = jax.lax.scan(step, jnp.broadcast_to(
+            jnp.arange(seq.shape[2]), seq.shape[1:]), jnp.arange(T - 1, -1, -1))
+        return rev[::-1]
+
+    return binary_op("gather_tree", f, ensure_tensor(ids), ensure_tensor(parents))
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Channel-wise alpha dropout (reference ``feature_alpha_dropout``):
+    whole feature maps are set to the SELU negative saturation value, with
+    the affine correction keeping mean/variance."""
+    if not training or p == 0.0:
+        return ensure_tensor(x)
+    from ..framework import random as rnd
+
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = rnd.next_key()
+
+    def f(a):
+        shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        a_coef = (1.0 - p + p * alpha_p ** 2) ** -0.5
+        b_coef = -a_coef * p * alpha_p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+
+    return unary_op("feature_alpha_dropout", f, ensure_tensor(x))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Bilinear transform x1ᵀ W x2 (reference ``bilinear``): weight
+    [out, in1, in2]."""
+    from ..framework.dispatch import apply_op
+
+    def f(a, b, w, *rest):
+        out = jnp.einsum("ni,oij,nj->no", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = [ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return apply_op("bilinear", f, tuple(args), {})
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (reference ``class_center_sample``,
+    PartialFC): keep all positive classes + uniformly sampled negatives up
+    to ``num_samples``; returns (remapped_label, sampled_class_indices).
+    Host-side (data-dependent sizes), like the reference's CPU path."""
+    from ..framework import random as rnd
+
+    y = np.asarray(_raw(label)).astype(np.int64)
+    pos = np.unique(y)
+    n_extra = max(0, num_samples - len(pos))
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    key = rnd.next_key()
+    perm = np.asarray(jax.random.permutation(key, rest.shape[0]))
+    sampled = np.sort(np.concatenate([pos, rest[perm[:n_extra]]]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return Tensor(remap[y].astype(np.int64)), Tensor(sampled.astype(np.int64))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-family margin softmax (reference ``margin_cross_entropy``):
+    cos(m1*θ + m2) - m3 applied to the target logit, then scaled CE."""
+    def f(lg, y):
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(jnp.take_along_axis(cos, y[:, None], 1))[:, 0]
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adjusted = cos.at[jnp.arange(cos.shape[0]), y].set(target)
+        z = adjusted * scale
+        logp = jax.nn.log_softmax(z, axis=-1)
+        nll = -jnp.take_along_axis(logp, y[:, None], 1)[:, 0]
+        loss = nll if reduction == "none" else \
+            (jnp.mean(nll) if reduction == "mean" else jnp.sum(nll))
+        if return_softmax:
+            return loss, jax.nn.softmax(z, axis=-1)
+        return loss
+
+    from ..framework.dispatch import apply_op
+
+    n_out = 2 if return_softmax else 1
+    out = apply_op("margin_cross_entropy", f,
+                   (ensure_tensor(logits), ensure_tensor(label)), {},
+                   num_outputs=n_out) if n_out == 2 else \
+        binary_op("margin_cross_entropy", f, ensure_tensor(logits),
+                  ensure_tensor(label))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# inplace activation variants
+# ---------------------------------------------------------------------------
+
+def _act_inplace(base_name):
+    def fn(x, *args, **kwargs):
+        from . import functional as F
+        from ..framework.tensor import inplace_rebind_
+
+        out = getattr(F, base_name)(x, *args, **kwargs)
+        return inplace_rebind_(x, out)
+
+    fn.__name__ = base_name + "_"
+    fn.__doc__ = f"In-place variant of :func:`{base_name}`."
+    return fn
+
+
+softmax_ = _act_inplace("softmax")
+tanh_ = _act_inplace("tanh")
+elu_ = _act_inplace("elu")
+leaky_relu_ = _act_inplace("leaky_relu")
+hardtanh_ = _act_inplace("hardtanh")
+thresholded_relu_ = _act_inplace("thresholded_relu")

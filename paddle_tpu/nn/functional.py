@@ -1407,3 +1407,12 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 
     out = apply_op("flash_attn_unpadded", f, (_t(query), _t(key), _t(value)), {})
     return out, None
+
+
+# ---------------------------------------------------------------------------
+# long-tail functionals (geometry/pooling/losses/packed attention/inplace)
+# ---------------------------------------------------------------------------
+from ._functional_extras import *  # noqa: E402,F401,F403
+from . import _functional_extras as _fx  # noqa: E402
+
+__all__ = __all__ + _fx.__all__

@@ -1,0 +1,422 @@
+"""nn layer long tail — wrappers over the extras functionals plus container
+types and seq2seq decoding.
+
+Counterpart of the remaining reference layer classes
+(``python/paddle/nn/layer/``): unpooling/LP/fractional pooling layers, pad
+variants, Maxout/Softmax2D, the loss-layer family, LayerDict/ParameterDict
+containers, BiRNN, and BeamSearchDecoder + ``dynamic_decode`` (the
+reference's ``paddle.nn.decode`` seq2seq machinery, host-loop here like its
+dygraph path).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.tensor import Parameter, Tensor
+from . import functional as F
+from .layers import Layer
+from .common_layers import _PadND
+from .rnn import RNN, _RNNCellBase
+
+__all__ = [
+    "ZeroPad1D", "ZeroPad3D", "Maxout", "Softmax2D",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+    "LPPool1D", "LPPool2D", "FractionalMaxPool2D", "FractionalMaxPool3D",
+    "AdaptiveMaxPool3D", "FeatureAlphaDropout",
+    "MultiMarginLoss", "TripletMarginWithDistanceLoss", "HSigmoidLoss",
+    "RNNTLoss", "AdaptiveLogSoftmaxWithLoss",
+    "LayerDict", "ParameterDict", "RNNCellBase", "BiRNN",
+    "BeamSearchDecoder", "dynamic_decode",
+]
+
+RNNCellBase = _RNNCellBase  # reference-exported name
+
+
+class ZeroPad1D(_PadND):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class ZeroPad3D(_PadND):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self._groups = groups
+        self._axis = axis
+
+    def forward(self, x):
+        return F.maxout(x, self._groups, self._axis)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW inputs (reference Softmax2D)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class _UnpoolND(Layer):
+    _fn = None
+    _nd = 0
+
+    def __init__(self, kernel_size, stride=None, padding=0, data_format=None,
+                 output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, o = self._args
+        return self._fn(x, indices, k, s, p, o)
+
+
+class MaxUnPool1D(_UnpoolND):
+    _fn = staticmethod(F.max_unpool1d)
+
+
+class MaxUnPool2D(_UnpoolND):
+    _fn = staticmethod(F.max_unpool2d)
+
+
+class MaxUnPool3D(_UnpoolND):
+    _fn = staticmethod(F.max_unpool3d)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, ceil_mode=False,
+                 data_format="NCL", name=None):
+        super().__init__()
+        self._args = (norm_type, kernel_size, stride, ceil_mode)
+
+    def forward(self, x):
+        return F.lp_pool1d(x, *self._args)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, ceil_mode=False,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (norm_type, kernel_size, stride, ceil_mode)
+
+    def forward(self, x):
+        return F.lp_pool2d(x, *self._args)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, *self._args)
+
+
+class FractionalMaxPool3D(FractionalMaxPool2D):
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, *self._args)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._args = (output_size, return_mask)
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, *self._args)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self._p, training=self.training)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._args = (p, margin, weight, reduction)
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, *self._args)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._args = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(input, positive, negative,
+                                                   *self._args)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self._num_classes = num_classes
+        self.weight = self.create_parameter([num_classes - 1, feature_size],
+                                            attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_classes - 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self._num_classes, self.weight,
+                               self.bias, path_table, path_code)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._args = (blank, fastemit_lambda, reduction)
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        b, fe, red = self._args
+        return F.rnnt_loss(input, label, input_lengths, label_lengths, b, fe, red)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax with its own head/tail parameters (reference
+    ``AdaptiveLogSoftmaxWithLoss``; Grave et al. cluster projections with
+    ``div_value``-shrinking tail dims)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        self._cutoffs = list(cutoffs)
+        self._n_classes = n_classes
+        head_size = self._cutoffs[0] + len(self._cutoffs)
+        self.head_weight = self.create_parameter([in_features, head_size])
+        self.head_bias = (self.create_parameter([head_size], is_bias=True)
+                          if head_bias else None)
+        self._tails: List = []
+        bounds = self._cutoffs + [n_classes]
+        for i in range(len(self._cutoffs)):
+            size = bounds[i + 1] - bounds[i]
+            proj = max(1, int(in_features / (div_value ** (i + 1))))
+            w1 = self.create_parameter([in_features, proj])
+            w2 = self.create_parameter([proj, size])
+            self.add_parameter(f"tail_{i}_proj", w1)
+            self.add_parameter(f"tail_{i}_out", w2)
+            self._tails.append((w1, w2))
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self._tails, self._cutoffs,
+            head_bias=self.head_bias)
+
+
+class LayerDict(Layer):
+    """Ordered dict of sublayers (reference ``nn.LayerDict``)."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def update(self, sublayers):
+        pairs = sublayers.items() if isinstance(sublayers, dict) else sublayers
+        for k, v in pairs:
+            self[k] = v
+
+    def pop(self, key):
+        layer = self._sub_layers[key]
+        del self[key]
+        return layer
+
+    def clear(self):
+        self._sub_layers.clear()
+
+
+class ParameterDict(Layer):
+    """Ordered dict of parameters (reference ``nn.ParameterDict``)."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters:
+            pairs = parameters.items() if isinstance(parameters, dict) else parameters
+            for k, v in pairs:
+                self[k] = v
+
+    def __getitem__(self, key):
+        return self._parameters[key]
+
+    def __setitem__(self, key, param):
+        self.add_parameter(key, param)
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters)
+
+    def __contains__(self, key):
+        return key in self._parameters
+
+    def keys(self):
+        return self._parameters.keys()
+
+    def items(self):
+        return self._parameters.items()
+
+    def values(self):
+        return self._parameters.values()
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper over two cells (reference ``nn.BiRNN``)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False, name=None):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        from ..ops.manipulation import concat
+
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class BeamSearchDecoder:
+    """Beam-search decoding over a cell (reference
+    ``nn.decode.BeamSearchDecoder``): scores = log-softmax of
+    ``output_fn(cell_out)``, standard length-agnostic beam update.  Used via
+    :func:`dynamic_decode`; the loop runs on the host like the reference's
+    dygraph decoding."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
+                   **kwargs):
+    """Run beam search (reference ``nn.decode.dynamic_decode``).
+
+    Returns (ids [B, beam, T], scores [B, beam]).  ``inits``: initial cell
+    states (batch-majored); each beam starts from the same state.
+    """
+    import jax.numpy as jnp
+
+    K = decoder.beam_size
+    end = decoder.end_token
+
+    def emb(tok_arr):
+        t = Tensor(np.asarray(tok_arr, np.int32))
+        return decoder.embedding_fn(t) if decoder.embedding_fn else t
+
+    # flatten beams into the batch dim: state per (batch, beam)
+    tokens = None
+    B = None
+    states = inits
+    live_scores = None
+    seqs = None
+    finished = None
+
+    for step in range(max_step_num):
+        if tokens is None:
+            # first step: batch size from the cell's first output
+            x0 = emb(np.asarray([decoder.start_token]))
+            out, _ = decoder.cell(x0, states)
+            B = 1 if out.ndim == 1 else out.shape[0]
+            tokens = np.full((B * K,), decoder.start_token, np.int32)
+            live_scores = np.where(np.arange(B * K) % K == 0, 0.0, -1e30)
+            seqs = np.zeros((B * K, 0), np.int32)
+            finished = np.zeros((B * K,), bool)
+            states = _tile_states(inits, B, K)
+
+        out, new_states = decoder.cell(emb(tokens), states)
+        logits = decoder.output_fn(out) if decoder.output_fn else out
+        import jax
+
+        lg = logits._data if isinstance(logits, Tensor) else jnp.asarray(logits)
+        logp = np.asarray(jax.nn.log_softmax(lg, axis=-1))       # [B*K, V]
+        V = logp.shape[-1]
+        # finished beams only extend with end_token at zero cost
+        logp = np.where(finished[:, None],
+                        np.where(np.arange(V)[None, :] == end, 0.0, -1e30),
+                        logp)
+        total = live_scores[:, None] + logp                       # [B*K, V]
+        total = total.reshape(B, K * V)
+        top_idx = np.argsort(-total, axis=-1)[:, :K]              # [B, K]
+        top_scores = np.take_along_axis(total, top_idx, -1)
+        beam_src = top_idx // V
+        tok_new = (top_idx % V).astype(np.int32)
+        flat_src = (np.arange(B)[:, None] * K + beam_src).reshape(-1)
+        seqs = np.concatenate([seqs[flat_src], tok_new.reshape(-1, 1)], axis=1)
+        live_scores = top_scores.reshape(-1)
+        finished = finished[flat_src] | (tok_new.reshape(-1) == end)
+        tokens = tok_new.reshape(-1)
+        states = _select_states(new_states, flat_src)
+        if finished.all():
+            break
+
+    T = seqs.shape[1]
+    return (Tensor(seqs.reshape(B, K, T)),
+            Tensor(live_scores.reshape(B, K).astype(np.float32)))
+
+
+def _tile_states(states, B, K):
+    if states is None:
+        return None
+    if isinstance(states, (tuple, list)):
+        return type(states)(_tile_states(s, B, K) for s in states)
+    arr = states._data if isinstance(states, Tensor) else np.asarray(states)
+    return Tensor(np.repeat(np.asarray(arr), K, axis=0))
+
+
+def _select_states(states, idx):
+    if states is None:
+        return None
+    if isinstance(states, (tuple, list)):
+        return type(states)(_select_states(s, idx) for s in states)
+    arr = states._data if isinstance(states, Tensor) else np.asarray(states)
+    return Tensor(np.asarray(arr)[idx])

@@ -20,7 +20,8 @@ __all__ = [
     "cholesky", "cholesky_solve", "qr", "svd", "svdvals", "pinv", "inv", "det", "slogdet",
     "solve", "triangular_solve", "eig", "eigh", "eigvals", "eigvalsh", "matrix_power",
     "matrix_rank", "einsum", "cross", "multi_dot", "cov", "corrcoef", "lu", "householder_product",
-    "tensordot",
+    "tensordot", "cond", "lstsq", "matrix_exp", "cholesky_inverse", "lu_unpack",
+    "ormqr", "svd_lowrank", "pca_lowrank",
 ]
 
 
@@ -255,3 +256,143 @@ def tensordot(x, y, axes=2, name=None):
     if isinstance(ax, (list, tuple)):
         ax = tuple(tuple(int(i) for i in (a.tolist() if isinstance(a, Tensor) else a)) if isinstance(a, (list, tuple, Tensor)) else int(a) for a in ax)
     return apply_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), (_t(x), _t(y)), {})
+
+
+def cond(x, p=None, name=None):
+    """Condition number (reference ``paddle.linalg.cond``): default / 'fro' /
+    'nuc' / ±1 / ±2 / ±inf."""
+    def f(a):
+        norm_p = 2 if p is None else p
+        if norm_p in (2, -2):
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return (s[..., 0] / s[..., -1]) if norm_p == 2 else (s[..., -1] / s[..., 0])
+        inv_a = jnp.linalg.inv(a)
+        if norm_p == "fro":
+            na = jnp.sqrt(jnp.sum(jnp.abs(a) ** 2, axis=(-2, -1)))
+            ni = jnp.sqrt(jnp.sum(jnp.abs(inv_a) ** 2, axis=(-2, -1)))
+        elif norm_p == "nuc":
+            na = jnp.sum(jnp.linalg.svd(a, compute_uv=False), -1)
+            ni = jnp.sum(jnp.linalg.svd(inv_a, compute_uv=False), -1)
+        elif norm_p in (1, -1):
+            red = jnp.max if norm_p == 1 else jnp.min
+            na = red(jnp.sum(jnp.abs(a), axis=-2), axis=-1)
+            ni = red(jnp.sum(jnp.abs(inv_a), axis=-2), axis=-1)
+        elif norm_p in (jnp.inf, float("inf"), -jnp.inf, float("-inf")):
+            red = jnp.max if norm_p in (jnp.inf, float("inf")) else jnp.min
+            na = red(jnp.sum(jnp.abs(a), axis=-1), axis=-1)
+            ni = red(jnp.sum(jnp.abs(inv_a), axis=-1), axis=-1)
+        else:
+            raise ValueError(f"unsupported p={p}")
+        return na * ni
+
+    return unary_op("cond", f, _t(x))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    """Least squares (reference ``paddle.linalg.lstsq``): returns
+    (solution, residuals, rank, singular_values)."""
+    def f(a, b):
+        sol, res, rk, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rk.astype(jnp.int32), sv
+
+    from ..framework.dispatch import apply_op
+
+    return apply_op("lstsq", f, (_t(x), _t(y)), {}, num_outputs=4)
+
+
+def matrix_exp(x, name=None):
+    return unary_op("matrix_exp", jax.scipy.linalg.expm, _t(x))
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A from its Cholesky factor (reference
+    ``paddle.linalg.cholesky_inverse``)."""
+    def f(L):
+        eye = jnp.eye(L.shape[-1], dtype=L.dtype)
+        return jax.scipy.linalg.cho_solve((L, not upper), eye)  # arg is LOWER
+
+    return unary_op("cholesky_inverse", f, _t(x))
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack ``lu``'s packed factorization into (P, L, U) (reference
+    ``paddle.linalg.lu_unpack``; pivots are the 1-indexed factor pivots)."""
+    def f(packed, piv):
+        m, n = packed.shape[-2], packed.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(packed[..., :, :k], -1) + jnp.eye(m, k, dtype=packed.dtype)
+        U = jnp.triu(packed[..., :k, :])
+        # pivots -> permutation: row i was swapped with piv[i]-1, in order
+        perm = jnp.arange(m)
+        for i in range(piv.shape[-1]):
+            j = piv[..., i] - 1
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+        P = jnp.eye(m, dtype=packed.dtype)[perm].T
+        return P, L, U
+
+    from ..framework.dispatch import apply_op
+
+    return apply_op("lu_unpack", f, (_t(lu_data), _t(lu_pivots)), {}, num_outputs=3)
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply by Q from a ``geqrf``-style factorization (reference
+    ``paddle.linalg.ormqr``): Q @ y, Qᵀ @ y, y @ Q or y @ Qᵀ."""
+    def f(a, t_, other):
+        q = _householder_q(a, t_)
+        qq = jnp.swapaxes(q, -1, -2) if transpose else q
+        return (qq @ other) if left else (other @ qq)
+
+    from ..framework.dispatch import apply_op
+
+    return apply_op("ormqr", f, (_t(x), _t(tau), _t(y)), {})
+
+
+def _householder_q(a, tau):
+    m = a.shape[-2]
+    q = jnp.broadcast_to(jnp.eye(m, dtype=a.dtype), a.shape[:-2] + (m, m))
+    for i in range(tau.shape[-1]):
+        v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
+        v = v.at[..., i].set(1.0)
+        h = jnp.eye(m, dtype=a.dtype) - tau[..., i][..., None, None] * \
+            jnp.einsum("...i,...j->...ij", v, v)
+        q = q @ h
+    return q
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference ``paddle.linalg.svd_lowrank``,
+    Halko et al. subspace iteration)."""
+    from ..framework import random as rnd
+
+    key = rnd.next_key()
+
+    def f(a):
+        m, n = a.shape[-2], a.shape[-1]
+        b = a if M is None else a - M
+        omega = jax.random.normal(key, a.shape[:-2] + (n, q), jnp.float32)
+        y = b @ omega
+        for _ in range(niter):
+            y = b @ (jnp.swapaxes(b, -1, -2) @ y)
+        Q, _ = jnp.linalg.qr(y)
+        B = jnp.swapaxes(Q, -1, -2) @ b
+        u_t, s, vh = jnp.linalg.svd(B, full_matrices=False)
+        return Q @ u_t, s, jnp.swapaxes(vh, -1, -2)
+
+    from ..framework.dispatch import apply_op
+
+    return apply_op("svd_lowrank", f, (_t(x),), {}, num_outputs=3)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (reference ``paddle.linalg.pca_lowrank``)."""
+    xt = _t(x)
+    k = q if q is not None else min(6, xt.shape[-2], xt.shape[-1])
+
+    if center:
+        from .reduction import mean as _mean
+
+        c = _mean(xt, axis=-2, keepdim=True)
+        xt = xt - c
+    return svd_lowrank(xt, q=k, niter=niter)

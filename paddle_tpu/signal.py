@@ -1,0 +1,112 @@
+"""``paddle.signal`` — STFT / ISTFT.
+
+Counterpart of the reference's ``python/paddle/signal.py`` (frame +
+``fft.rfft``-based stft, overlap-add istft with window-envelope
+normalization).  Implemented over jnp so the transforms trace/jit like any
+other op; round-trip and scipy parity covered in tests.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .framework.dispatch import apply_op
+from .framework.tensor import Tensor
+from .ops.common import ensure_tensor
+
+__all__ = ["stft", "istft"]
+
+
+def _frame(x, frame_length: int, hop_length: int):
+    """[.., N] -> [.., n_frames, frame_length] sliding windows."""
+    n = x.shape[-1]
+    n_frames = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    return x[..., idx]
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """Short-time Fourier transform (reference ``signal.py`` ``stft``).
+
+    x: [..., N] real (or complex, with ``onesided=False``).  Returns
+    [..., n_fft//2 + 1 (or n_fft), n_frames] complex64.
+    """
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    if window is not None:
+        w = window._data if isinstance(window, Tensor) else jnp.asarray(window)
+    else:
+        w = jnp.ones((wl,), jnp.float32)
+    if wl < n_fft:  # center-pad the window to n_fft (reference behavior)
+        lp = (n_fft - wl) // 2
+        w = jnp.pad(w, (lp, n_fft - wl - lp))
+
+    def f(a, wa):
+        sig = a
+        if center:
+            pad = [(0, 0)] * (sig.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            sig = jnp.pad(sig, pad, mode=pad_mode)
+        frames = _frame(sig, n_fft, hop) * wa
+        if onesided:
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # [.., freq, frames]
+
+    return apply_op("stft", f, (ensure_tensor(x), Tensor(w)), {})
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    """Inverse STFT via overlap-add with squared-window normalization
+    (reference ``signal.py`` ``istft``)."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    if window is not None:
+        w = window._data if isinstance(window, Tensor) else jnp.asarray(window)
+    else:
+        w = jnp.ones((wl,), jnp.float32)
+    if wl < n_fft:
+        lp = (n_fft - wl) // 2
+        w = jnp.pad(w, (lp, n_fft - wl - lp))
+
+    def f(spec, wa):
+        s = jnp.swapaxes(spec, -1, -2)      # [.., frames, freq]
+        if normalized:
+            s = s * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(s, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(s, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * wa
+        n_frames = frames.shape[-2]
+        out_len = n_fft + hop * (n_frames - 1)
+        # overlap-add the frames and the squared window envelope
+        ola = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        env = jnp.zeros((out_len,), jnp.float32)
+        for t in range(n_frames):
+            sl = slice(t * hop, t * hop + n_fft)
+            ola = ola.at[..., sl].add(frames[..., t, :])
+            env = env.at[sl].add(wa.astype(jnp.float32) ** 2)
+        ola = ola / jnp.where(env > 1e-11, env, 1.0)
+        if center:
+            ola = ola[..., n_fft // 2: out_len - n_fft // 2]
+        if length is not None:
+            ola = ola[..., :length]
+        return ola
+
+    return apply_op("istft", f, (ensure_tensor(x), Tensor(w)), {})

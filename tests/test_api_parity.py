@@ -168,3 +168,63 @@ def test_create_parameter_attr_coercions():
 
     with _pytest.raises(ValueError):
         paddle.create_parameter([2], "float32", attr=False)
+
+
+class TestLinalgCompletions:
+    def test_cond_lstsq_matrix_exp(self):
+        from scipy import linalg as sl
+
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(4, 4)).astype(np.float32)
+        assert float(paddle.linalg.cond(paddle.to_tensor(a)).numpy()) == \
+            pytest.approx(np.linalg.cond(a), rel=1e-3)
+        assert float(paddle.linalg.cond(paddle.to_tensor(a), p="fro").numpy()) == \
+            pytest.approx(np.linalg.cond(a, "fro"), rel=1e-3)
+        b = rng.normal(size=(4, 2)).astype(np.float32)
+        sol, _, rk, sv = paddle.linalg.lstsq(paddle.to_tensor(a), paddle.to_tensor(b))
+        ref = np.linalg.lstsq(a, b, rcond=None)
+        np.testing.assert_allclose(np.asarray(sol._data), ref[0], rtol=1e-3, atol=1e-4)
+        assert int(rk.numpy()) == ref[2]
+        me = np.asarray(paddle.linalg.matrix_exp(paddle.to_tensor(a * 0.1))._data)
+        np.testing.assert_allclose(me, sl.expm(a * 0.1), rtol=1e-4, atol=1e-5)
+
+    def test_cholesky_inverse_and_lu_unpack(self):
+        rng = np.random.default_rng(1)
+        m = rng.normal(size=(3, 3)).astype(np.float32)
+        spd = m @ m.T + 3 * np.eye(3, dtype=np.float32)
+        L = np.linalg.cholesky(spd)
+        inv = np.asarray(paddle.linalg.cholesky_inverse(paddle.to_tensor(L))._data)
+        np.testing.assert_allclose(inv, np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+
+        a = rng.normal(size=(4, 4)).astype(np.float32)
+        lu_t, piv = paddle.linalg.lu(paddle.to_tensor(a))
+        P, Lu, U = paddle.linalg.lu_unpack(lu_t, piv)
+        rec = np.asarray(P._data) @ np.asarray(Lu._data) @ np.asarray(U._data)
+        np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-4)
+
+    def test_ormqr(self):
+        from scipy import linalg as sl
+
+        rng = np.random.default_rng(2)
+        a = rng.normal(size=(4, 3)).astype(np.float32)
+        qr_packed, tau = sl.lapack.sgeqrf(a)[:2]
+        y = rng.normal(size=(4, 2)).astype(np.float32)
+        out = np.asarray(paddle.linalg.ormqr(
+            paddle.to_tensor(qr_packed), paddle.to_tensor(tau),
+            paddle.to_tensor(y))._data)
+        # full m x m Q from the householder vectors: compare Q @ y
+        Hq = np.eye(4, dtype=np.float32)
+        for i in range(len(tau)):
+            v = np.zeros(4, np.float32); v[i] = 1.0; v[i+1:] = qr_packed[i+1:, i]
+            Hq = Hq @ (np.eye(4, dtype=np.float32) - tau[i] * np.outer(v, v))
+        np.testing.assert_allclose(out, Hq @ y, rtol=1e-4, atol=1e-4)
+
+    def test_lowrank(self):
+        rng = np.random.default_rng(3)
+        base = rng.normal(size=(20, 3)).astype(np.float32)
+        a = (base @ rng.normal(size=(3, 15)).astype(np.float32))  # rank 3
+        U, S, V = paddle.linalg.svd_lowrank(paddle.to_tensor(a), q=5)
+        rec = np.asarray(U._data) @ np.diag(np.asarray(S._data)) @ np.asarray(V._data).T
+        np.testing.assert_allclose(rec, a, rtol=1e-2, atol=1e-2)
+        U2, S2, V2 = paddle.linalg.pca_lowrank(paddle.to_tensor(a), q=3)
+        assert np.asarray(S2._data).shape[-1] == 3

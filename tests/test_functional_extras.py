@@ -1,0 +1,371 @@
+"""nn.functional long tail (reference ``python/paddle/nn/functional/``),
+verified against torch (cpu) where torch implements the op, else against
+brute-force references."""
+
+import math
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.default_rng(0)
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+class TestGeometry:
+    def test_affine_grid_and_grid_sample_vs_torch(self):
+        x = RNG.normal(size=(2, 3, 8, 8)).astype(np.float32)
+        theta = RNG.normal(size=(2, 2, 3)).astype(np.float32)
+        for align in (True, False):
+            g_ref = TF.affine_grid(torch.tensor(theta), (2, 3, 8, 8),
+                                   align_corners=align).numpy()
+            g = _np(F.affine_grid(paddle.to_tensor(theta), (2, 3, 8, 8),
+                                  align_corners=align))
+            np.testing.assert_allclose(g, g_ref, atol=1e-5)
+            for mode in ("bilinear", "nearest"):
+                s_ref = TF.grid_sample(torch.tensor(x), torch.tensor(g_ref),
+                                       mode=mode, align_corners=align).numpy()
+                s = _np(F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(g_ref),
+                                      mode=mode, align_corners=align))
+                np.testing.assert_allclose(s, s_ref, atol=1e-4,
+                                           err_msg=f"{mode}/{align}")
+
+    def test_grid_sample_padding_modes(self):
+        x = RNG.normal(size=(1, 2, 6, 6)).astype(np.float32)
+        grid = (RNG.uniform(-1.4, 1.4, size=(1, 5, 5, 2))).astype(np.float32)
+        for pm in ("zeros", "border"):
+            ref = TF.grid_sample(torch.tensor(x), torch.tensor(grid),
+                                 padding_mode=pm, align_corners=True).numpy()
+            got = _np(F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                                    padding_mode=pm))
+            np.testing.assert_allclose(got, ref, atol=1e-4, err_msg=pm)
+
+    def test_fold_is_unfold_inverse_structure(self):
+        u = RNG.normal(size=(2, 3 * 4, 9)).astype(np.float32)
+        ref = TF.fold(torch.tensor(u), (4, 4), (2, 2)).numpy()
+        got = _np(F.fold(paddle.to_tensor(u), (4, 4), (2, 2)))
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+class TestPooling:
+    def test_max_unpool2d_vs_torch(self):
+        x = RNG.normal(size=(2, 3, 8, 8)).astype(np.float32)
+        pooled_t, idx_t = TF.max_pool2d(torch.tensor(x), 2, return_indices=True)
+        ref = TF.max_unpool2d(pooled_t, idx_t, 2).numpy()
+        got = _np(F.max_unpool2d(paddle.to_tensor(pooled_t.numpy()),
+                                 paddle.to_tensor(idx_t.numpy()), 2))
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+
+    def test_lp_pool_vs_torch(self):
+        x = np.abs(RNG.normal(size=(2, 3, 8, 8))).astype(np.float32)
+        ref = TF.lp_pool2d(torch.tensor(x), 3.0, 2).numpy()
+        got = _np(F.lp_pool2d(paddle.to_tensor(x), 3.0, 2))
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+        x1 = np.abs(RNG.normal(size=(2, 3, 10))).astype(np.float32)
+        ref1 = TF.lp_pool1d(torch.tensor(x1), 2.0, 2).numpy()
+        np.testing.assert_allclose(_np(F.lp_pool1d(paddle.to_tensor(x1), 2.0, 2)),
+                                   ref1, rtol=1e-4)
+
+    def test_adaptive_max_pool3d(self):
+        x = RNG.normal(size=(1, 2, 6, 7, 8)).astype(np.float32)
+        ref = TF.adaptive_max_pool3d(torch.tensor(x), (2, 3, 4)).numpy()
+        got = _np(F.adaptive_max_pool3d(paddle.to_tensor(x), (2, 3, 4)))
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+
+    def test_fractional_max_pool_covers_input(self):
+        x = RNG.normal(size=(1, 1, 9, 9)).astype(np.float32)
+        out = _np(F.fractional_max_pool2d(paddle.to_tensor(x), 4, random_u=0.3))
+        assert out.shape == (1, 1, 4, 4)
+        assert out.max() == x.max()  # global max survives any partition
+
+    def test_maxout(self):
+        x = RNG.normal(size=(2, 6, 4)).astype(np.float32)
+        got = _np(F.maxout(paddle.to_tensor(x), groups=3))
+        ref = x.reshape(2, 2, 3, 4).max(axis=2)
+        np.testing.assert_allclose(got, ref)
+
+
+class TestLosses:
+    def test_multi_margin_vs_torch(self):
+        x = RNG.normal(size=(5, 7)).astype(np.float32)
+        y = RNG.integers(0, 7, 5)
+        ref = TF.multi_margin_loss(torch.tensor(x), torch.tensor(y)).numpy()
+        got = float(_np(F.multi_margin_loss(paddle.to_tensor(x),
+                                            paddle.to_tensor(y.astype(np.int32)))))
+        assert got == pytest.approx(float(ref), rel=1e-5)
+
+    def test_triplet_with_distance_vs_torch(self):
+        a = RNG.normal(size=(4, 8)).astype(np.float32)
+        p = RNG.normal(size=(4, 8)).astype(np.float32)
+        n = RNG.normal(size=(4, 8)).astype(np.float32)
+        ref = TF.triplet_margin_loss(torch.tensor(a), torch.tensor(p),
+                                     torch.tensor(n)).numpy()
+        got = float(_np(F.triplet_margin_with_distance_loss(
+            paddle.to_tensor(a), paddle.to_tensor(p), paddle.to_tensor(n))))
+        assert got == pytest.approx(float(ref), rel=1e-4)
+
+    def test_log_and_dice(self):
+        p = RNG.uniform(0.05, 0.95, size=(6, 1)).astype(np.float32)
+        y = RNG.integers(0, 2, (6, 1)).astype(np.float32)
+        got = _np(F.log_loss(paddle.to_tensor(p), paddle.to_tensor(y)))
+        ref = -(y * np.log(p + 1e-4) + (1 - y) * np.log(1 - p + 1e-4))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+        probs = RNG.uniform(0.1, 0.9, size=(2, 4, 3)).astype(np.float32)
+        lab = RNG.integers(0, 3, (2, 4, 1))
+        d = float(_np(F.dice_loss(paddle.to_tensor(probs),
+                                  paddle.to_tensor(lab.astype(np.int32)))))
+        assert 0.0 < d < 1.0
+
+    def test_rnnt_loss_matches_brute_force(self):
+        """Alpha recursion vs an exhaustive path enumeration on a tiny
+        lattice."""
+        B, T, U, V = 1, 3, 2, 4
+        logits = RNG.normal(size=(B, T, U + 1, V)).astype(np.float32)
+        labels = np.array([[1, 2]], np.int32)
+        nll = float(_np(F.rnnt_loss(paddle.to_tensor(logits),
+                                    paddle.to_tensor(labels),
+                                    paddle.to_tensor(np.array([T], np.int32)),
+                                    paddle.to_tensor(np.array([U], np.int32)),
+                                    reduction="none")))
+        # brute force: sum over all monotone alignments
+        import itertools
+        from scipy.special import log_softmax
+
+        lp = log_softmax(logits[0], axis=-1)
+
+        def path_sum():
+            # enumerate label-emission time assignments t1 <= t2 (emissions at
+            # (t, u) BEFORE advancing), blanks fill the rest
+            total = -np.inf
+            for t1 in range(T):
+                for t2 in range(t1, T):
+                    s = 0.0
+                    u = 0
+                    for t in range(T):
+                        while (u == 0 and t == t1) or (u == 1 and t == t2):
+                            s += lp[t, u, labels[0, u]]
+                            u += 1
+                            if u > U - 1:
+                                break
+                        s += lp[t, u, 0]  # blank advances time
+                    total = np.logaddexp(total, s)
+            return total
+
+        assert nll == pytest.approx(-path_sum(), rel=1e-4)
+
+    def test_hsigmoid_loss_runs_and_trains(self):
+        x = paddle.to_tensor(RNG.normal(size=(4, 8)).astype(np.float32))
+        x.stop_gradient = False
+        w = paddle.to_tensor(RNG.normal(size=(9, 8)).astype(np.float32) * 0.1)
+        y = paddle.to_tensor(np.array([0, 3, 7, 9], np.int32))
+        loss = F.hsigmoid_loss(x, y, 10, w)
+        assert float(_np(loss)) > 0
+        loss.backward()
+        assert np.isfinite(np.asarray(x._grad)).all()
+
+    def test_adaptive_log_softmax(self):
+        N, D = 6, 8
+        cutoffs = [4, 10]
+        x = paddle.to_tensor(RNG.normal(size=(N, D)).astype(np.float32))
+        hw = paddle.to_tensor(RNG.normal(size=(D, 4 + 2)).astype(np.float32))
+        tails = [(paddle.to_tensor(RNG.normal(size=(D, 4)).astype(np.float32)),
+                  paddle.to_tensor(RNG.normal(size=(4, 6)).astype(np.float32))),
+                 (paddle.to_tensor(RNG.normal(size=(D, 2)).astype(np.float32)),
+                  paddle.to_tensor(RNG.normal(size=(2, 6)).astype(np.float32)))]
+        y = paddle.to_tensor(np.array([0, 3, 5, 9, 12, 15], np.int32))
+        out, loss = F.adaptive_log_softmax_with_loss(x, y, hw, tails, cutoffs)
+        assert out.shape[0] == N and np.all(_np(out) <= 0)
+        assert float(_np(loss)) == pytest.approx(-float(_np(out).mean()), rel=1e-6)
+
+
+class TestAttentionEntryPoints:
+    def test_qkvpacked_matches_unpacked(self):
+        B, S, H, D = 2, 16, 2, 8
+        qkv = RNG.normal(size=(B, S, 3, H, D)).astype(np.float32)
+        out, _ = F.flash_attn_qkvpacked(paddle.to_tensor(qkv), causal=True)
+        ref, _ = F.flash_attention(paddle.to_tensor(qkv[:, :, 0]),
+                                   paddle.to_tensor(qkv[:, :, 1]),
+                                   paddle.to_tensor(qkv[:, :, 2]), causal=True)
+        np.testing.assert_allclose(_np(out), _np(ref), atol=1e-5)
+
+    def test_flashmask_attention_masks_rows(self):
+        B, S, H, D = 1, 8, 1, 4
+        q = RNG.normal(size=(B, S, H, D)).astype(np.float32)
+        # column j visible only to rows < start_j: mask everything from row 4
+        sre = np.full((B, 1, S, 1), 4, np.int32)
+        out = F.flashmask_attention(paddle.to_tensor(q), paddle.to_tensor(q),
+                                    paddle.to_tensor(q),
+                                    paddle.to_tensor(sre), causal=True)
+        from paddle_tpu.kernels.flash_attention import _attention_reference
+        import jax.numpy as jnp
+
+        rows = np.arange(S)[:, None]
+        cols = np.arange(S)[None, :]
+        mask = (rows >= cols) & ~(rows >= 4)
+        ref = np.asarray(_attention_reference(
+            jnp.asarray(q), jnp.asarray(q), jnp.asarray(q), False,
+            jnp.asarray(mask[None, None]), 1.0 / math.sqrt(D)))
+        np.testing.assert_allclose(_np(out)[0, :4], ref[0, :4], atol=1e-5)
+
+
+class TestMisc:
+    def test_gather_tree_vs_reference(self):
+        T, B, K = 4, 1, 3
+        ids = RNG.integers(0, 9, (T, B, K)).astype(np.int32)
+        parents = RNG.integers(0, K, (T, B, K)).astype(np.int32)
+        got = _np(F.gather_tree(paddle.to_tensor(ids), paddle.to_tensor(parents)))
+        # reference backtrace
+        ref = np.zeros_like(ids)
+        for b in range(B):
+            for k in range(K):
+                beam = k
+                for t in range(T - 1, -1, -1):
+                    ref[t, b, k] = ids[t, b, beam]
+                    beam = parents[t, b, beam]
+        np.testing.assert_array_equal(got, ref)
+
+    def test_bilinear_vs_torch(self):
+        x1 = RNG.normal(size=(3, 4)).astype(np.float32)
+        x2 = RNG.normal(size=(3, 5)).astype(np.float32)
+        w = RNG.normal(size=(2, 4, 5)).astype(np.float32)
+        b = RNG.normal(size=(2,)).astype(np.float32)
+        ref = TF.bilinear(torch.tensor(x1), torch.tensor(x2), torch.tensor(w),
+                          torch.tensor(b)).numpy()
+        got = _np(F.bilinear(paddle.to_tensor(x1), paddle.to_tensor(x2),
+                             paddle.to_tensor(w), paddle.to_tensor(b)))
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_feature_alpha_dropout_stats(self):
+        x = np.ones((64, 32, 4), np.float32)
+        paddle.seed(0)
+        out = _np(F.feature_alpha_dropout(paddle.to_tensor(x), p=0.4))
+        # whole channels share one fate
+        per_channel = out[:, :, 0]
+        assert np.allclose(out, per_channel[:, :, None])
+        assert 0.3 < (per_channel == per_channel.max()).mean() < 0.9
+
+    def test_margin_cross_entropy_reduces_target_logit(self):
+        n, c = 8, 5
+        logits = RNG.uniform(-0.9, 0.9, size=(n, c)).astype(np.float32)
+        y = RNG.integers(0, c, n).astype(np.int32)
+        loss_plain = float(_np(F.margin_cross_entropy(
+            paddle.to_tensor(logits), paddle.to_tensor(y),
+            margin1=1.0, margin2=0.0, margin3=0.0, scale=4.0)))
+        loss_margin = float(_np(F.margin_cross_entropy(
+            paddle.to_tensor(logits), paddle.to_tensor(y),
+            margin1=1.0, margin2=0.5, margin3=0.0, scale=4.0)))
+        assert loss_margin > loss_plain  # margin makes the task harder
+
+    def test_class_center_sample(self):
+        y = paddle.to_tensor(np.array([3, 7, 7, 11], np.int32))
+        remapped, sampled = F.class_center_sample(y, num_classes=20,
+                                                  num_samples=8)
+        s = np.asarray(sampled._data)
+        assert {3, 7, 11} <= set(s.tolist()) and len(s) == 8
+        r = np.asarray(remapped._data)
+        assert np.array_equal(s[r], np.array([3, 7, 7, 11]))
+
+    def test_inplace_activations(self):
+        x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+        F.tanh_(x)
+        np.testing.assert_allclose(_np(x), np.tanh([-1.0, 2.0]), rtol=1e-6)
+        y = paddle.to_tensor(np.array([[1.0, 2.0]], np.float32))
+        F.softmax_(y)
+        assert _np(y).sum() == pytest.approx(1.0, rel=1e-5)
+
+
+class TestLayerWrappers:
+    def test_containers(self):
+        import paddle_tpu.nn as nn
+
+        ld = nn.LayerDict({"a": nn.Linear(2, 3), "b": nn.ReLU()})
+        assert set(ld.keys()) == {"a", "b"} and len(ld) == 2 and "a" in ld
+        ld["c"] = nn.Linear(3, 1)
+        popped = ld.pop("b")
+        assert isinstance(popped, nn.ReLU) and len(ld) == 2
+
+        pd = nn.ParameterDict({"w": paddle.create_parameter([2, 2], "float32")})
+        assert "w" in pd and pd["w"].shape == [2, 2]
+        # parameters registered: visible to a parent optimizer
+        assert len(list(pd.parameters())) == 1
+
+    def test_unpool_layer_roundtrip(self):
+        import paddle_tpu.nn as nn
+
+        x = RNG.normal(size=(1, 2, 4, 4)).astype(np.float32)
+        pooled_t, idx_t = TF.max_pool2d(torch.tensor(x), 2, return_indices=True)
+        up = nn.MaxUnPool2D(2)(paddle.to_tensor(pooled_t.numpy()),
+                               paddle.to_tensor(idx_t.numpy()))
+        ref = TF.max_unpool2d(pooled_t, idx_t, 2).numpy()
+        np.testing.assert_allclose(_np(up), ref)
+
+    def test_hsigmoid_and_rnnt_layers(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        hs = nn.HSigmoidLoss(8, 10)
+        x = paddle.to_tensor(RNG.normal(size=(3, 8)).astype(np.float32))
+        y = paddle.to_tensor(np.array([1, 5, 9], np.int32))
+        assert float(_np(hs(x, y))) > 0
+
+        rl = nn.RNNTLoss()
+        logits = paddle.to_tensor(RNG.normal(size=(1, 3, 3, 4)).astype(np.float32))
+        lab = paddle.to_tensor(np.array([[1, 2]], np.int32))
+        out = rl(logits, lab, paddle.to_tensor(np.array([3], np.int32)),
+                 paddle.to_tensor(np.array([2], np.int32)))
+        assert np.isfinite(float(_np(out)))
+
+    def test_adaptive_log_softmax_layer_trains(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        al = nn.AdaptiveLogSoftmaxWithLoss(8, 16, cutoffs=[4, 10])
+        x = paddle.to_tensor(RNG.normal(size=(6, 8)).astype(np.float32))
+        y = paddle.to_tensor(np.array([0, 3, 5, 9, 12, 15], np.int32))
+        opt = paddle.optimizer.Adam(learning_rate=5e-2,
+                                    parameters=al.parameters())
+        losses = []
+        for _ in range(25):
+            _, loss = al(x, y)
+            loss.backward(); opt.step(); opt.clear_grad()
+            losses.append(float(_np(loss)))
+        assert losses[-1] < losses[0] - 0.3
+
+    def test_birnn_shapes(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        birnn = nn.BiRNN(nn.GRUCell(4, 6), nn.GRUCell(4, 6))
+        x = paddle.to_tensor(RNG.normal(size=(2, 5, 4)).astype(np.float32))
+        out, _ = birnn(x)
+        assert list(out.shape) == [2, 5, 12]
+
+    def test_beam_search_decode_prefers_high_prob_path(self):
+        import paddle_tpu.nn as nn
+
+        V, H = 5, 5
+
+        class ToyCell(nn.Layer):
+            """Deterministic: always favors token 3, then end (4)."""
+
+            def forward(self, x, states=None):
+                s = 0 if states is None else int(np.asarray(states._data).ravel()[0])
+                logits = np.full((1, V), -5.0, np.float32)
+                logits[0, 3 if s < 2 else 4] = 5.0
+                return paddle.to_tensor(np.tile(logits, (x.shape[0], 1))), \
+                    paddle.to_tensor(np.full((x.shape[0],), s + 1, np.int32))
+
+        dec = nn.BeamSearchDecoder(ToyCell(), start_token=0, end_token=4,
+                                   beam_size=2)
+        ids, scores = nn.dynamic_decode(dec, inits=None, max_step_num=6)
+        best = np.asarray(ids._data)[0, 0]
+        assert best[-1] == 4 and 3 in best.tolist()
+        s = np.asarray(scores._data)[0]
+        assert s[0] >= s[1]

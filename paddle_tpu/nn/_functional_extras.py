@@ -243,10 +243,8 @@ def _fractional_pool(x, output_size, random_u, nd):
         alpha = n_in / n_out
         idx = (np.ceil(alpha * (np.arange(n_out) + u)) - 1).astype(np.int64)
         idx = np.clip(idx, 0, n_in - 1)
-        starts = np.concatenate([[0], idx[:-1] + 0]) if False else None
         # region r spans [b[r], b[r+1]) with b[0]=0, b[n_out]=n_in
-        b = np.concatenate([[0], idx[:-1] + 1, [n_in]])
-        return b
+        return np.concatenate([[0], idx[:-1] + 1, [n_in]])
 
     def f(a):
         spatial = a.shape[2:]
@@ -400,20 +398,22 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
     input [N, D]; label [N]; weight [num_classes-1, D]."""
     from ..framework.dispatch import apply_op
 
-    depth = max(1, int(math.ceil(math.log2(max(num_classes, 2)))))
+    # reference SimpleCode tree: code c = label + num_classes; level d's
+    # internal node is (c >> (d+1)) - 1, bit is (c >> d) & 1, path length =
+    # floor(log2(c)) — exact for ANY num_classes (not just powers of two)
+    max_depth = max(1, int(math.floor(math.log2(2 * num_classes - 1))))
 
     def default_paths(y):
-        # leaf id -> internal-node path (heap layout): node ids and
-        # left(+1)/right(-1) codes, padded with -1
-        nodes = []
-        codes = []
-        cur = y + (1 << depth)  # implicit leaf index in a full binary heap
-        for _ in range(depth):
-            parent = cur // 2
-            nodes.append(parent - 1)        # internal nodes are 1-based heap
-            codes.append(jnp.where(cur % 2 == 0, 1.0, -1.0))
-            cur = parent
-        return jnp.stack(nodes, -1), jnp.stack(codes, -1)
+        c = y.astype(jnp.int32) + num_classes
+        nodes, codes, valids = [], [], []
+        for d in range(max_depth):
+            parent = c >> (d + 1)
+            nodes.append(parent - 1)
+            bit = (c >> d) & 1
+            codes.append(jnp.where(bit == 1, -1.0, 1.0))
+            valids.append(parent >= 1)
+        return (jnp.stack(nodes, -1), jnp.stack(codes, -1),
+                jnp.stack(valids, -1))
 
     def f(x, y, w, *rest):
         b = rest[0] if rest else None
@@ -423,8 +423,8 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
             valid = nodes >= 0
             nodes = jnp.maximum(nodes, 0)
         else:
-            nodes, codes = default_paths(y)
-            valid = (nodes >= 0) & (nodes < num_classes - 1)
+            nodes, codes, valid = default_paths(y)
+            valid = valid & (nodes >= 0) & (nodes < num_classes - 1)
             nodes = jnp.clip(nodes, 0, num_classes - 2)
         scores = jnp.einsum("nd,npd->np", x, w[nodes])   # [N, path]
         if b is not None:
@@ -439,13 +439,20 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
 
 
 def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
-              fastemit_lambda=0.001, reduction="mean", name=None):
+              fastemit_lambda=0.0, reduction="mean", name=None):
     """RNN-Transducer loss (reference ``rnnt_loss`` — warprnnt's role),
     implemented as the standard log-space alpha recursion over the (T, U)
     lattice with ``lax.scan`` over time steps.
 
-    input: [B, T, U+1, V] logits; label: [B, U] targets.
+    input: [B, T, U+1, V] logits; label: [B, U] targets.  FastEmit
+    regularization is not implemented — pass ``fastemit_lambda=0`` (the
+    reference default 0.001 would silently change gradients here, so a
+    non-zero value raises).
     """
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss: FastEmit regularization (fastemit_lambda != 0) is "
+            "not implemented")
     from ..framework.dispatch import apply_op
 
     def f(logits, labels, t_lens, u_lens):
@@ -530,8 +537,6 @@ def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
             head_lp, jnp.clip(y, 0, cutoffs[0] - 1)[:, None], 1)[:, 0]
         lo = cutoffs[0]
         for c in range(n_clusters):
-            hi = cutoffs[c + 1] if c + 1 < len(cutoffs) else None
-            hi = hi if hi is not None else cutoffs[-1]
             w1, w2 = tails[2 * c], tails[2 * c + 1]
             cluster_lp = jax.nn.log_softmax((x @ w1) @ w2, axis=-1)
             size = w2.shape[-1]

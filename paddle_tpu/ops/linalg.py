@@ -333,7 +333,18 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=N
 
     from ..framework.dispatch import apply_op
 
-    return apply_op("lu_unpack", f, (_t(lu_data), _t(lu_pivots)), {}, num_outputs=3)
+    packed_t = _t(lu_data)
+    nd = (packed_t._data if hasattr(packed_t, "_data") else packed_t).ndim
+    if nd > 2:
+        # batched factorization: vmap the 2-D unpack over the leading dims
+        import jax as _jax
+
+        base = f
+        f_batched = base
+        for _ in range(nd - 2):
+            f_batched = _jax.vmap(f_batched)
+        f = f_batched
+    return apply_op("lu_unpack", f, (packed_t, _t(lu_pivots)), {}, num_outputs=3)
 
 
 def ormqr(x, tau, y, left=True, transpose=False, name=None):

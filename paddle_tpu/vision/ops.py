@@ -6,7 +6,11 @@ import numpy as np
 
 from ..framework.tensor import Tensor
 
-__all__ = ["nms", "box_iou", "roi_align", "distribute_fpn_proposals"]
+__all__ = ["nms", "box_iou", "roi_align", "roi_pool", "psroi_pool",
+           "distribute_fpn_proposals", "deform_conv2d", "box_coder",
+           "prior_box", "yolo_box", "yolo_loss", "matrix_nms",
+           "generate_proposals", "read_file", "decode_jpeg",
+           "DeformConv2D", "RoIAlign", "RoIPool", "PSRoIPool"]
 
 
 def box_iou(boxes1, boxes2):
@@ -68,7 +72,8 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_rati
         xs = x1[:, None] + (jnp.arange(ow) + 0.5)[None, :] * (roi_w[:, None] / ow)  # [R, ow]
 
         def sample(r):
-            fmap = feat[batch_idx[r]]  # [C, H, W]
+            # r is traced under vmap: index the device copy of batch_idx
+            fmap = feat[jnp.asarray(batch_idx)[r]]  # [C, H, W]
             yy = ys[r]
             xx = xs[r]
             y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
@@ -104,3 +109,550 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level, refer_
         idxs.append(sel)
     restore = np.argsort(np.concatenate(idxs)).astype(np.int32)
     return outs, [Tensor(np.asarray([len(i)], np.int32)) for i in idxs], Tensor(restore)
+
+
+# ---------------------------------------------------------------------------
+# detection op long tail (reference python/paddle/vision/ops.py)
+# ---------------------------------------------------------------------------
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Max-pool RoI pooling (reference ``roi_pool``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.dispatch import apply_op
+
+    bx = np.asarray(boxes._data if isinstance(boxes, Tensor) else boxes)
+    bn = np.asarray(boxes_num._data if isinstance(boxes_num, Tensor) else boxes_num)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else output_size
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+
+    def f(feat):
+        n, c, h, w = feat.shape
+
+        def one(r):
+            fmap = feat[batch_idx[r]]
+            x1 = int(round(bx[r, 0] * spatial_scale))
+            y1 = int(round(bx[r, 1] * spatial_scale))
+            x2 = max(int(round(bx[r, 2] * spatial_scale)), x1 + 1)
+            y2 = max(int(round(bx[r, 3] * spatial_scale)), y1 + 1)
+            rows = []
+            for i in range(oh):
+                cols = []
+                lo_y = y1 + (i * (y2 - y1)) // oh
+                hi_y = max(y1 + ((i + 1) * (y2 - y1) + oh - 1) // oh, lo_y + 1)
+                for j in range(ow):
+                    lo_x = x1 + (j * (x2 - x1)) // ow
+                    hi_x = max(x1 + ((j + 1) * (x2 - x1) + ow - 1) // ow, lo_x + 1)
+                    region = fmap[:, max(lo_y, 0):max(hi_y, 1),
+                                  max(lo_x, 0):max(hi_x, 1)]
+                    cols.append(jnp.max(region, axis=(1, 2)))
+                rows.append(jnp.stack(cols, -1))
+            return jnp.stack(rows, -2)  # [C, oh, ow]
+
+        return jnp.stack([one(r) for r in range(bx.shape[0])])
+
+    return apply_op("roi_pool", f, (x,), {})
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI pooling (reference ``psroi_pool``): input
+    channels C = out_c * oh * ow; bin (i, j) averages channel group (i*ow+j)."""
+    import jax.numpy as jnp
+
+    from ..framework.dispatch import apply_op
+
+    bx = np.asarray(boxes._data if isinstance(boxes, Tensor) else boxes)
+    bn = np.asarray(boxes_num._data if isinstance(boxes_num, Tensor) else boxes_num)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else output_size
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+
+    def f(feat):
+        n, c, h, w = feat.shape
+        out_c = c // (oh * ow)
+
+        def one(r):
+            fmap = feat[batch_idx[r]].reshape(out_c, oh, ow, h, w)
+            x1 = bx[r, 0] * spatial_scale
+            y1 = bx[r, 1] * spatial_scale
+            x2 = bx[r, 2] * spatial_scale
+            y2 = bx[r, 3] * spatial_scale
+            bw = max((x2 - x1) / ow, 0.1)
+            bh = max((y2 - y1) / oh, 0.1)
+            rows = []
+            for i in range(oh):
+                cols = []
+                lo_y = int(np.floor(y1 + i * bh))
+                hi_y = max(int(np.ceil(y1 + (i + 1) * bh)), lo_y + 1)
+                for j in range(ow):
+                    lo_x = int(np.floor(x1 + j * bw))
+                    hi_x = max(int(np.ceil(x1 + (j + 1) * bw)), lo_x + 1)
+                    region = fmap[:, i, j,
+                                  max(lo_y, 0):max(hi_y, 1),
+                                  max(lo_x, 0):max(hi_x, 1)]
+                    cols.append(jnp.mean(region, axis=(1, 2)))
+                rows.append(jnp.stack(cols, -1))
+            return jnp.stack(rows, -2)  # [out_c, oh, ow]
+
+        return jnp.stack([one(r) for r in range(bx.shape[0])])
+
+    return apply_op("psroi_pool", f, (x,), {})
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference ``deform_conv2d``; DCN):
+    sampling positions are the regular grid plus learned offsets, with
+    optional v2 modulation ``mask``.  Bilinear-gather formulation."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.dispatch import apply_op
+    from ..ops.common import ensure_tensor
+
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def f(a, off, w, *rest):
+        m = None
+        b = None
+        for r in rest:
+            if m is None and r.ndim == 4:
+                m = r
+            else:
+                b = r
+        N, C, H, W = a.shape
+        Co, Cin_g, kh, kw = w.shape
+        oh = (H + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        ow = (W + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        K = kh * kw
+        # base sampling grid [oh, ow, kh, kw]
+        gy = (jnp.arange(oh) * st[0] - pd[0])[:, None, None, None] + \
+            (jnp.arange(kh) * dl[0])[None, None, :, None]
+        gx = (jnp.arange(ow) * st[1] - pd[1])[None, :, None, None] + \
+            (jnp.arange(kw) * dl[1])[None, None, None, :]
+        gy = jnp.broadcast_to(gy, (oh, ow, kh, kw)).astype(jnp.float32)
+        gx = jnp.broadcast_to(gx, (oh, ow, kh, kw)).astype(jnp.float32)
+        # offsets: [N, 2*dg*K, oh, ow] -> y/x per tap
+        off = off.reshape(N, deformable_groups, K, 2, oh, ow)
+        # reorder to [N, dg, oh, ow, K]
+        oy = jnp.transpose(off[:, :, :, 0], (0, 1, 3, 4, 2))
+        ox = jnp.transpose(off[:, :, :, 1], (0, 1, 3, 4, 2))
+        cg = C // deformable_groups
+
+        def sample_group(fm, yy, xx):
+            # fm [cg, H, W]; yy/xx [oh, ow, K]
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy = yy - y0
+            wx = xx - x0
+
+            def gat(yi, xi):
+                yc = jnp.clip(yi.astype(jnp.int32), 0, H - 1)
+                xc = jnp.clip(xi.astype(jnp.int32), 0, W - 1)
+                v = fm[:, yc, xc]  # [cg, oh, ow, K]
+                valid = ((yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1))
+                return v * valid[None]
+
+            return (gat(y0, x0) * ((1 - wy) * (1 - wx))[None]
+                    + gat(y0, x0 + 1) * ((1 - wy) * wx)[None]
+                    + gat(y0 + 1, x0) * (wy * (1 - wx))[None]
+                    + gat(y0 + 1, x0 + 1) * (wy * wx)[None])
+
+        outs = []
+        for n_i in range(N):
+            groups_s = []
+            for g in range(deformable_groups):
+                yy = gy.reshape(oh, ow, K) + oy[n_i, g]
+                xx = gx.reshape(oh, ow, K) + ox[n_i, g]
+                s = sample_group(a[n_i, g * cg:(g + 1) * cg], yy, xx)
+                groups_s.append(s)
+            samp = jnp.concatenate(groups_s, axis=0)  # [C, oh, ow, K]
+            if m is not None:
+                mk = jnp.transpose(
+                    m[n_i].reshape(deformable_groups, K, oh, ow), (0, 2, 3, 1))
+                mk = jnp.repeat(mk, cg, axis=0)
+                samp = samp * mk
+            # convolve: weight [Co, Cin_g, kh, kw] over groups
+            cin_per = C // groups
+            co_per = Co // groups
+            parts = []
+            for g in range(groups):
+                s_g = samp[g * cin_per:(g + 1) * cin_per]    # [cin, oh, ow, K]
+                w_g = w[g * co_per:(g + 1) * co_per].reshape(co_per, cin_per, K)
+                parts.append(jnp.einsum("ihwk,oik->ohw", s_g, w_g))
+            out = jnp.concatenate(parts, axis=0)
+            outs.append(out)
+        res = jnp.stack(outs)
+        if b is not None:
+            res = res + b[None, :, None, None]
+        return res
+
+    args = [ensure_tensor(x), ensure_tensor(offset), ensure_tensor(weight)]
+    if mask is not None:
+        args.append(ensure_tensor(mask))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return apply_op("deform_conv2d", f, tuple(args), {})
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """Encode/decode boxes against priors (reference ``box_coder``)."""
+    import jax.numpy as jnp
+
+    from ..framework.dispatch import apply_op
+    from ..ops.common import ensure_tensor
+
+    def f(pb, tb, *rest):
+        pv = rest[0] if rest else None
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw / 2
+            tcy = tb[:, 1] + th / 2
+            out = jnp.stack([(tcx[:, None] - pcx[None]) / pw[None],
+                             (tcy[:, None] - pcy[None]) / ph[None],
+                             jnp.log(tw[:, None] / pw[None]),
+                             jnp.log(th[:, None] / ph[None])], axis=-1)
+            if pv is not None:
+                out = out / pv[None]
+            return out
+        # decode_center_size: tb [N, M, 4] deltas (axis=0: priors along M)
+        d = tb
+        if pv is not None:
+            d = d * (pv[None] if pv.ndim == 2 else pv)
+        shp = (1, -1) if axis == 0 else (-1, 1)
+        cx = d[..., 0] * pw.reshape(shp) + pcx.reshape(shp)
+        cy = d[..., 1] * ph.reshape(shp) + pcy.reshape(shp)
+        bw = jnp.exp(d[..., 2]) * pw.reshape(shp)
+        bh = jnp.exp(d[..., 3]) * ph.reshape(shp)
+        return jnp.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - norm, cy + bh / 2 - norm], axis=-1)
+
+    args = [ensure_tensor(prior_box), ensure_tensor(target_box)]
+    if prior_box_var is not None and not isinstance(prior_box_var, (list, tuple)):
+        args.append(ensure_tensor(prior_box_var))
+    elif isinstance(prior_box_var, (list, tuple)):
+        args.append(ensure_tensor(np.asarray(prior_box_var, np.float32)))
+    return apply_op("box_coder", f, tuple(args), {})
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD anchor generation (reference ``prior_box``); host-side, shapes
+    static.  Returns (boxes [H, W, P, 4], variances [H, W, P, 4])."""
+    fh, fw = (int(input.shape[2]), int(input.shape[3]))
+    ih, iw = (int(image.shape[2]), int(image.shape[3]))
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        sizes = []
+        for ar in ars:
+            sizes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            mx = max_sizes[ms_i]
+            sizes.insert(1, (np.sqrt(ms * mx), np.sqrt(ms * mx)))
+        boxes.extend(sizes)
+    P = len(boxes)
+    cy = (np.arange(fh) + offset) * step_h
+    cx = (np.arange(fw) + offset) * step_w
+    out = np.zeros((fh, fw, P, 4), np.float32)
+    for p, (bw, bh) in enumerate(boxes):
+        out[:, :, p, 0] = (cx[None, :] - bw / 2) / iw
+        out[:, :, p, 1] = (cy[:, None] - bh / 2) / ih
+        out[:, :, p, 2] = (cx[None, :] + bw / 2) / iw
+        out[:, :, p, 3] = (cy[:, None] + bh / 2) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(out), Tensor(var)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio=32,
+             clip_bbox=True, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head outputs into boxes+scores (reference ``yolo_box``)."""
+    import jax.numpy as jnp
+
+    from ..framework.dispatch import apply_op
+    from ..ops.common import ensure_tensor
+
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    A = an.shape[0]
+
+    def f(pred, imsz):
+        N, C, H, W = pred.shape
+        sig = jax.nn.sigmoid
+        ioup = None
+        if iou_aware:
+            # layout [N, A*(6+class_num), H, W]: A ioup channels FIRST
+            ioup = sig(pred[:, :A])
+            pred = pred[:, A:]
+        p = pred.reshape(N, A, 5 + class_num, H, W)
+        bx = (sig(p[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2 +
+              jnp.arange(W)[None, None, None, :]) / W
+        by = (sig(p[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2 +
+              jnp.arange(H)[None, None, :, None]) / H
+        bw = jnp.exp(p[:, :, 2]) * an[None, :, 0, None, None] / (W * downsample_ratio)
+        bh = jnp.exp(p[:, :, 3]) * an[None, :, 1, None, None] / (H * downsample_ratio)
+        conf = sig(p[:, :, 4])
+        if ioup is not None:
+            conf = conf ** (1 - iou_aware_factor) * ioup ** iou_aware_factor
+        cls = sig(p[:, :, 5:])
+        score = conf[:, :, None] * cls
+        ih = imsz[:, 0].astype(jnp.float32)[:, None, None, None]
+        iw = imsz[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * iw
+        y1 = (by - bh / 2) * ih
+        x2 = (bx + bw / 2) * iw
+        y2 = (by + bh / 2) * ih
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, iw - 1)
+            y1 = jnp.clip(y1, 0, ih - 1)
+            x2 = jnp.clip(x2, 0, iw - 1)
+            y2 = jnp.clip(y2, 0, ih - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(N, -1, 4)
+        scores = jnp.moveaxis(score, 2, -1).reshape(N, -1, class_num)
+        keep = (conf.reshape(N, -1) >= conf_thresh)[..., None]
+        return boxes * keep, scores * keep
+
+    import jax
+
+    return apply_op("yolo_box", f, (ensure_tensor(x), ensure_tensor(img_size)),
+                    {}, num_outputs=2)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    raise NotImplementedError(
+        "yolo_loss: train YOLO heads with the composable pieces instead "
+        "(yolo_box decode + ops.math losses); the reference's fused CUDA "
+        "loss has no single TPU-native analogue")
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference ``matrix_nms``; SOLOv2): decay each box's score
+    by its IoU with higher-scoring same-class boxes — no sequential
+    suppression loop.  Host-side (data-dependent sizes)."""
+    bb = np.asarray(bboxes._data if isinstance(bboxes, Tensor) else bboxes)
+    sc = np.asarray(scores._data if isinstance(scores, Tensor) else scores)
+    N, C, M = sc.shape
+    outs, idxs, nums = [], [], []
+    for n in range(N):
+        dets = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            mask = sc[n, c] >= score_threshold
+            if not mask.any():
+                continue
+            cand = np.where(mask)[0]
+            order = cand[np.argsort(-sc[n, c, cand])][:nms_top_k]
+            boxes_c = bb[n, order]
+            scores_c = sc[n, c, order]
+            ious = _iou_matrix(boxes_c, normalized)
+            ious = np.triu(ious, 1)
+            # decay_j = min over higher-scored i of f(iou_ij) / f(comp_i),
+            # comp_i = the SUPPRESSOR's own max IoU with its higher-scored
+            # boxes (reference matrix_nms compensation)
+            comp = ious.max(axis=0)
+            if use_gaussian:
+                decay = np.exp(-(ious ** 2 - comp[:, None] ** 2) * gaussian_sigma)
+            else:
+                decay = (1 - ious) / np.maximum(1 - comp[:, None], 1e-9)
+            decay = decay.min(axis=0) if len(order) else np.ones(0)
+            new_scores = scores_c * decay
+            for k, oi in enumerate(order):
+                if new_scores[k] >= post_threshold:
+                    dets.append((c, new_scores[k], *bb[n, oi], oi))
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:keep_top_k]
+        outs.append(np.asarray([[d[0], d[1], d[2], d[3], d[4], d[5]]
+                                for d in dets], np.float32).reshape(-1, 6))
+        idxs.append(np.asarray([d[6] for d in dets], np.int32))
+        nums.append(len(dets))
+    out = Tensor(np.concatenate(outs) if outs else np.zeros((0, 6), np.float32))
+    rois_num = Tensor(np.asarray(nums, np.int32))
+    index = Tensor(np.concatenate(idxs) if idxs else np.zeros((0,), np.int32))
+    if return_index:
+        return (out, index, rois_num) if return_rois_num else (out, index)
+    return (out, rois_num) if return_rois_num else out
+
+
+def _iou_matrix(boxes, normalized=True):
+    norm = 0.0 if normalized else 1.0
+    areas = (boxes[:, 2] - boxes[:, 0] + norm) * (boxes[:, 3] - boxes[:, 1] + norm)
+    x1 = np.maximum(boxes[:, None, 0], boxes[None, :, 0])
+    y1 = np.maximum(boxes[:, None, 1], boxes[None, :, 1])
+    x2 = np.minimum(boxes[:, None, 2], boxes[None, :, 2])
+    y2 = np.minimum(boxes[:, None, 3], boxes[None, :, 3])
+    inter = np.clip(x2 - x1 + norm, 0, None) * np.clip(y2 - y1 + norm, 0, None)
+    return inter / np.maximum(areas[:, None] + areas[None, :] - inter, 1e-9)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (reference ``generate_proposals``):
+    decode deltas -> clip -> filter small -> top-k -> NMS.  Host-side."""
+    sc = np.asarray(scores._data if isinstance(scores, Tensor) else scores)
+    bd = np.asarray(bbox_deltas._data if isinstance(bbox_deltas, Tensor) else bbox_deltas)
+    ims = np.asarray(img_size._data if isinstance(img_size, Tensor) else img_size)
+    an = np.asarray(anchors._data if isinstance(anchors, Tensor) else anchors).reshape(-1, 4)
+    va = np.asarray(variances._data if isinstance(variances, Tensor) else variances).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    rois_all, num_all, scores_all = [], [], []
+    offset = 1.0 if pixel_offset else 0.0
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)
+        d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = an[:, 2] - an[:, 0] + offset
+        ah = an[:, 3] - an[:, 1] + offset
+        acx = an[:, 0] + aw / 2
+        acy = an[:, 1] + ah / 2
+        cx = d[:, 0] * va[:, 0] * aw + acx
+        cy = d[:, 1] * va[:, 1] * ah + acy
+        bw = np.exp(np.minimum(d[:, 2] * va[:, 2], 10)) * aw
+        bh = np.exp(np.minimum(d[:, 3] * va[:, 3], 10)) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - offset, cy + bh / 2 - offset], -1)
+        ih, iw = ims[n, 0], ims[n, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - offset)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - offset)
+        keep = ((boxes[:, 2] - boxes[:, 0] + offset >= min_size) &
+                (boxes[:, 3] - boxes[:, 1] + offset >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        order = np.argsort(-s)[:pre_nms_top_n]
+        boxes, s = boxes[order], s[order]
+        keep_idx = np.asarray(nms(Tensor(boxes.astype(np.float32)),
+                                  nms_thresh, scores=Tensor(s.astype(np.float32)))._data)
+        keep_idx = keep_idx[:post_nms_top_n]
+        rois_all.append(boxes[keep_idx].astype(np.float32))
+        scores_all.append(s[keep_idx].astype(np.float32))
+        num_all.append(len(keep_idx))
+    rois = Tensor(np.concatenate(rois_all) if rois_all else np.zeros((0, 4), np.float32))
+    rscores = Tensor(np.concatenate(scores_all) if scores_all else np.zeros((0,), np.float32))
+    if return_rois_num:
+        return rois, rscores, Tensor(np.asarray(num_all, np.int32))
+    return rois, rscores
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (reference ``read_file``)."""
+    with open(filename, "rb") as f:
+        return Tensor(np.frombuffer(f.read(), np.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG bytes -> [C, H, W] uint8 (reference ``decode_jpeg``; PIL-backed
+    host decode — image IO is host work on TPU)."""
+    import io
+
+    from PIL import Image
+
+    data = np.asarray(x._data if isinstance(x, Tensor) else x, np.uint8)
+    img = Image.open(io.BytesIO(data.tobytes()))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(np.ascontiguousarray(arr))
+
+
+class DeformConv2D:
+    """Layer form of :func:`deform_conv2d` (reference ``DeformConv2D``)."""
+
+    def __new__(cls, *args, **kwargs):
+        from ..nn.layers import Layer
+
+        class _DeformConv2D(Layer):
+            def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                         padding=0, dilation=1, deformable_groups=1, groups=1,
+                         weight_attr=None, bias_attr=None):
+                super().__init__()
+                ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+                    else tuple(kernel_size)
+                self._args = (stride, padding, dilation, deformable_groups, groups)
+                self.weight = self.create_parameter(
+                    [out_channels, in_channels // groups, ks[0], ks[1]],
+                    attr=weight_attr)
+                self.bias = (None if bias_attr is False else
+                             self.create_parameter([out_channels],
+                                                   attr=bias_attr, is_bias=True))
+
+            def forward(self, x, offset, mask=None):
+                st, pd, dl, dg, g = self._args
+                return deform_conv2d(x, offset, self.weight, self.bias, st, pd,
+                                     dl, dg, g, mask)
+
+        return _DeformConv2D(*args, **kwargs)
+
+
+class RoIAlign:
+    def __new__(cls, output_size, spatial_scale=1.0):
+        from ..nn.layers import Layer
+
+        class _RoIAlign(Layer):
+            def __init__(self):
+                super().__init__()
+
+            def forward(self, x, boxes, boxes_num):
+                return roi_align(x, boxes, boxes_num, output_size, spatial_scale)
+
+        return _RoIAlign()
+
+
+class RoIPool:
+    def __new__(cls, output_size, spatial_scale=1.0):
+        from ..nn.layers import Layer
+
+        class _RoIPool(Layer):
+            def __init__(self):
+                super().__init__()
+
+            def forward(self, x, boxes, boxes_num):
+                return roi_pool(x, boxes, boxes_num, output_size, spatial_scale)
+
+        return _RoIPool()
+
+
+class PSRoIPool:
+    def __new__(cls, output_size, spatial_scale=1.0):
+        from ..nn.layers import Layer
+
+        class _PSRoIPool(Layer):
+            def __init__(self):
+                super().__init__()
+
+            def forward(self, x, boxes, boxes_num):
+                return psroi_pool(x, boxes, boxes_num, output_size, spatial_scale)
+
+        return _PSRoIPool()

@@ -369,3 +369,58 @@ class TestLayerWrappers:
         assert best[-1] == 4 and 3 in best.tolist()
         s = np.asarray(scores._data)[0]
         assert s[0] >= s[1]
+
+
+class TestReviewRegressions:
+    def test_hsigmoid_is_normalized_distribution(self):
+        """SimpleCode tree: sum over all labels of exp(-loss) must be 1 —
+        catches wrong node indexing/dropped path levels for non-power-of-two
+        num_classes."""
+        for num_classes in (8, 10, 13):
+            x = paddle.to_tensor(RNG.normal(size=(1, 6)).astype(np.float32))
+            w = paddle.to_tensor(RNG.normal(size=(num_classes - 1, 6))
+                                 .astype(np.float32))
+            total = 0.0
+            for c in range(num_classes):
+                y = paddle.to_tensor(np.array([c], np.int32))
+                total += np.exp(-float(_np(F.hsigmoid_loss(x, y, num_classes, w))))
+            assert total == pytest.approx(1.0, abs=1e-4), num_classes
+
+    def test_lu_unpack_batched(self):
+        rng = np.random.default_rng(5)
+        a = rng.normal(size=(3, 4, 4)).astype(np.float32)
+        lu_t, piv = paddle.linalg.lu(paddle.to_tensor(a))
+        P, L, U = paddle.linalg.lu_unpack(lu_t, piv)
+        rec = np.asarray(P._data) @ np.asarray(L._data) @ np.asarray(U._data)
+        np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-4)
+
+    def test_rnnt_fastemit_raises(self):
+        logits = paddle.to_tensor(RNG.normal(size=(1, 2, 2, 3)).astype(np.float32))
+        lab = paddle.to_tensor(np.array([[1]], np.int32))
+        with pytest.raises(NotImplementedError, match="FastEmit"):
+            F.rnnt_loss(logits, lab, paddle.to_tensor(np.array([2], np.int32)),
+                        paddle.to_tensor(np.array([1], np.int32)),
+                        fastemit_lambda=0.01)
+
+    def test_matrix_nms_decay_matches_reference_formula(self):
+        """Linear decay on the reviewer's 3-box case: comp uses the
+        SUPPRESSOR's compensation."""
+        from paddle_tpu.vision.ops import _iou_matrix, matrix_nms
+
+        boxes = np.array([[[0, 0, 10, 10], [0, 4, 10, 14], [0, 7, 10, 17]]],
+                         np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]
+        iou = _iou_matrix(boxes[0])
+        iou_t = np.triu(iou, 1)
+        comp = iou_t.max(axis=0)
+        expect = scores[0, 1] * np.minimum.reduce(
+            np.where(np.triu(np.ones((3, 3)), 1) > 0,
+                     (1 - iou_t) / np.maximum(1 - comp[:, None], 1e-9),
+                     np.inf), axis=0)
+        expect = np.minimum(expect, scores[0, 1])  # box 0 has no suppressor
+        out, _ = matrix_nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                            score_threshold=0.0, post_threshold=0.0,
+                            nms_top_k=10, keep_top_k=10)
+        got = np.sort(np.asarray(out._data)[:, 1])[::-1]
+        np.testing.assert_allclose(got, np.sort(expect)[::-1], rtol=1e-5)

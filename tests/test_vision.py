@@ -37,3 +37,161 @@ class TestMobileNetV3:
         x = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
         feat = m(x)
         assert feat.shape[2] == 2 and feat.shape[3] == 2  # stride 32
+
+
+class TestDetectionOps:
+    """vision.ops long tail (reference python/paddle/vision/ops.py)."""
+
+    def test_deform_conv2d_zero_offset_equals_conv(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(1, 3, 8, 8)).astype(np.float32)
+        w = rng.normal(size=(4, 3, 3, 3)).astype(np.float32)
+        off = np.zeros((1, 2 * 9, 6, 6), np.float32)
+        got = np.asarray(deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                                       paddle.to_tensor(w))._data)
+        ref = np.asarray(F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w))._data)
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_deform_conv2d_integer_shift(self):
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(1, 1, 6, 6)).astype(np.float32)
+        w = np.ones((1, 1, 1, 1), np.float32)
+        # 1x1 kernel with offset (+1, +1): output(y, x) = input(y+1, x+1)
+        off = np.ones((1, 2, 6, 6), np.float32)
+        got = np.asarray(deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                                       paddle.to_tensor(w))._data)
+        np.testing.assert_allclose(got[0, 0, :5, :5], x[0, 0, 1:, 1:], atol=1e-5)
+
+    def test_roi_pool_and_psroi_pool(self):
+        from paddle_tpu.vision.ops import psroi_pool, roi_pool
+
+        x = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+        boxes = np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)
+        out = np.asarray(roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                                  paddle.to_tensor(np.array([1], np.int32)),
+                                  2)._data)
+        # bin maxes of the 4x4 region split 2x2
+        np.testing.assert_allclose(out[0, 0], [[9, 11], [25, 27]])
+
+        xp = np.tile(np.arange(4, dtype=np.float32)[:, None, None], (1, 6, 6))[None]
+        ps = np.asarray(psroi_pool(paddle.to_tensor(xp),
+                                   paddle.to_tensor(boxes),
+                                   paddle.to_tensor(np.array([1], np.int32)),
+                                   2)._data)
+        # channel group (i*2+j) feeds bin (i, j): constant maps -> bin value = group id
+        np.testing.assert_allclose(ps[0, 0], [[0, 1], [2, 3]])
+
+    def test_box_coder_roundtrip(self):
+        from paddle_tpu.vision.ops import box_coder
+
+        priors = np.array([[10, 10, 30, 30], [5, 20, 25, 50]], np.float32)
+        targets = np.array([[12, 8, 33, 29]], np.float32)
+        enc = box_coder(paddle.to_tensor(priors), None, paddle.to_tensor(targets),
+                        code_type="encode_center_size")
+        dec = box_coder(paddle.to_tensor(priors), None,
+                        paddle.to_tensor(np.asarray(enc._data)),
+                        code_type="decode_center_size", axis=0)
+        got = np.asarray(dec._data)
+        for m in range(2):
+            np.testing.assert_allclose(got[0, m], targets[0], rtol=1e-4, atol=1e-3)
+
+    def test_prior_box_shapes_and_range(self):
+        from paddle_tpu.vision.ops import prior_box
+
+        feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+        boxes, var = prior_box(feat, img, min_sizes=[16.0], max_sizes=[32.0],
+                               aspect_ratios=[2.0], clip=True)
+        b = np.asarray(boxes._data)
+        assert b.shape[:2] == (4, 4) and b.shape[-1] == 4
+        assert b.min() >= 0 and b.max() <= 1
+        assert np.asarray(var._data).shape == b.shape
+
+    def test_yolo_box_decodes(self):
+        from paddle_tpu.vision.ops import yolo_box
+
+        rng = np.random.default_rng(2)
+        A, C, H = 2, 3, 4
+        x = rng.normal(size=(1, A * (5 + C), H, H)).astype(np.float32)
+        boxes, scores = yolo_box(paddle.to_tensor(x),
+                                 paddle.to_tensor(np.array([[128, 128]], np.int32)),
+                                 anchors=[10, 13, 16, 30], class_num=C,
+                                 conf_thresh=0.0)
+        b = np.asarray(boxes._data)
+        s = np.asarray(scores._data)
+        assert b.shape == (1, A * H * H, 4) and s.shape == (1, A * H * H, C)
+        assert (b[..., 2] >= b[..., 0] - 1e-3).all()
+        assert (s >= 0).all() and (s <= 1).all()
+
+    def test_matrix_nms_suppresses_overlaps(self):
+        from paddle_tpu.vision.ops import matrix_nms
+
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]]],
+                         np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]   # class 1 (0 = background)
+        out, rois_num = matrix_nms(paddle.to_tensor(boxes),
+                                   paddle.to_tensor(scores),
+                                   score_threshold=0.1, post_threshold=0.1,
+                                   nms_top_k=10, keep_top_k=10)
+        o = np.asarray(out._data)
+        assert int(np.asarray(rois_num._data)[0]) == 3
+        # the overlapping second box got decayed below the others' scores
+        assert o[0, 1] > o[1, 1]
+
+    def test_generate_proposals_runs(self):
+        from paddle_tpu.vision.ops import generate_proposals
+
+        rng = np.random.default_rng(3)
+        H = W = 4
+        A = 3
+        scores = rng.uniform(size=(1, A, H, W)).astype(np.float32)
+        deltas = rng.normal(size=(1, A * 4, H, W)).astype(np.float32) * 0.1
+        anchors = rng.uniform(0, 30, size=(H * W * A, 4)).astype(np.float32)
+        anchors[:, 2:] = anchors[:, :2] + 8
+        var = np.full((H * W * A, 4), 0.1, np.float32)
+        rois, rscores, num = generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(np.array([[32, 32]], np.float32)),
+            paddle.to_tensor(anchors), paddle.to_tensor(var),
+            return_rois_num=True)
+        n = int(np.asarray(num._data)[0])
+        assert n > 0 and np.asarray(rois._data).shape == (n, 4)
+
+    def test_read_and_decode_jpeg(self, tmp_path):
+        from PIL import Image
+
+        from paddle_tpu.vision.ops import decode_jpeg, read_file
+
+        arr = (np.random.default_rng(4).uniform(0, 255, (16, 16, 3))
+               .astype(np.uint8))
+        p = tmp_path / "img.jpg"
+        Image.fromarray(arr).save(p, quality=95)
+        raw = read_file(str(p))
+        img = decode_jpeg(raw, mode="rgb")
+        got = np.asarray(img._data)
+        assert got.shape == (3, 16, 16)
+        assert abs(got.astype(np.float32).mean()
+                   - arr.transpose(2, 0, 1).astype(np.float32).mean()) < 10
+
+    def test_layer_forms(self):
+        from paddle_tpu.vision.ops import DeformConv2D, RoIAlign, RoIPool
+
+        paddle.seed(0)
+        dc = DeformConv2D(3, 4, 3)
+        x = paddle.to_tensor(np.random.default_rng(5).normal(
+            size=(1, 3, 8, 8)).astype(np.float32))
+        off = paddle.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+        assert list(dc(x, off).shape) == [1, 4, 6, 6]
+
+        ra = RoIAlign(2)
+        boxes = paddle.to_tensor(np.array([[0, 0, 4, 4]], np.float32))
+        bn = paddle.to_tensor(np.array([1], np.int32))
+        assert list(ra(x, boxes, bn).shape) == [1, 3, 2, 2]
+        rp = RoIPool(2)
+        assert list(rp(x, boxes, bn).shape) == [1, 3, 2, 2]

@@ -22,6 +22,7 @@ from . import debugging  # noqa: F401
 from ..framework.tensor import Tensor
 
 __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "is_auto_cast_enabled",
+           "is_bfloat16_supported", "is_float16_supported",
            "get_amp_dtype", "FP16_WHITE_LIST", "FP16_BLACK_LIST"]
 
 # ops cast TO low precision under O1 (matmul-like, conv)
@@ -235,3 +236,15 @@ class GradScaler:
 
 
 
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    """bf16 is TPU-native (reference checks CUDA arch; every TPU and the
+    XLA-CPU fallback support bfloat16 compute)."""
+    return True
+
+
+def is_float16_supported(device=None) -> bool:
+    """fp16 STORAGE works on every XLA backend (TPUs compute in bf16/f32),
+    which is what the reference API gates on — hence unconditionally True."""
+    return True

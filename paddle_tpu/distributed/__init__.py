@@ -9,16 +9,20 @@ One mechanism underneath: the global device mesh + shardings (GSPMD/ICI).
 """
 
 from .collective import (  # noqa: F401
-    ReduceOp, all_gather, all_gather_object, all_reduce, alltoall, barrier,
-    broadcast, destroy_process_group, get_group, get_rank, get_world_size,
-    init_parallel_env, is_initialized, new_group, recv, reduce, scatter, send, wait,
+    ReduceOp, all_gather, all_gather_object, all_reduce, alltoall,
+    alltoall_single, barrier, broadcast, broadcast_object_list,
+    destroy_process_group, gather, get_backend, get_group, get_rank,
+    get_world_size, gloo_barrier, gloo_init_parallel_env, gloo_release,
+    init_parallel_env, irecv, is_available, is_initialized, isend, new_group,
+    recv, reduce, reduce_scatter, scatter, scatter_object_list, send, wait,
 )
 from .mesh import ProcessMesh, auto_mesh, get_mesh, set_global_mesh  # noqa: F401
 from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
 from .api import (  # noqa: F401
     dtensor_from_fn, dtensor_from_local, reshard, shard_dataloader, shard_layer,
-    shard_optimizer, shard_tensor, unshard_dtensor,
+    shard_optimizer, shard_scaler, shard_tensor, split, unshard_dtensor,
 )
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
@@ -29,6 +33,14 @@ from .parallel import (  # noqa: F401
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear, VocabParallelEmbedding,
 )
 from .parallel.pipeline import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
+from . import launch  # noqa: F401
+from . import io  # noqa: F401
+from .fleet import ParallelMode  # noqa: F401
+from .semi_auto import (  # noqa: F401
+    DistAttr, DistModel, ReduceType, ShardingStage1, ShardingStage2,
+    ShardingStage3, Strategy, to_static,
+)
 
 
 def spawn(func, args=(), nprocs=-1, **kwargs):

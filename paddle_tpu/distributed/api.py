@@ -438,3 +438,36 @@ def shard_dataloader(dataloader, meshes, shard_dims=None, is_dataset_splitted=Fa
         return item
 
     return _ShardedLoader(dataloader)
+
+
+def shard_scaler(scaler):
+    """Make a GradScaler sharding-aware (reference ``shard_scaler``,
+    ``auto_parallel/api.py``).  Our GradScaler already reduces its found-inf
+    over the mesh in the compiled step, so this returns it unchanged — the
+    named hook exists for API parity."""
+    return scaler
+
+
+def split(x, size, operation="linear", axis=0, num_partitions=1,
+          gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """Op-level model parallelism (reference ``fleet/layers/mpu/mp_ops.py:706``
+    ``split``): run a linear/embedding with its weight partitioned over the
+    'mp' mesh axis — here by constructing the corresponding parallel layer
+    (GSPMD inserts the collectives the reference codes by hand)."""
+    from .parallel import (ColumnParallelLinear, RowParallelLinear,
+                           VocabParallelEmbedding)
+
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr)
+        return layer(x)
+    if operation == "linear":
+        if axis == 1:
+            layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    raise ValueError(f"split: unknown operation {operation!r}")

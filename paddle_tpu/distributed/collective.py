@@ -298,3 +298,117 @@ def barrier(group=None):
 def wait(tensor, group=None, use_calc_stream=True):
     if isinstance(tensor, Tensor):
         jax.block_until_ready(tensor._data)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all (reference ``alltoall_single``): rank r's
+    chunk i goes to rank i's chunk r.  Host path over the gather (in-graph
+    all_to_all belongs to shard_map programs)."""
+    world = get_world_size(group)
+    arr = np.asarray(in_tensor._data)
+    me = get_rank(group)
+    ranks = _group_ranks(group)
+    # each SOURCE rank may use different split sizes; exchange them so every
+    # receiver cuts every source's buffer with the source's own splits
+    splits = [None] * world
+    all_gather_object(splits, list(in_split_sizes) if in_split_sizes is not None
+                      else None, group=group)
+    rows = _gather_rows(arr)  # every rank's full input, world-ordered
+    pieces = []
+    for r in ranks:
+        src_buf = rows[r]
+        src_splits = splits[ranks.index(r)]
+        if src_splits is None:
+            piece = np.split(src_buf, world, axis=0)[me]
+        else:
+            cuts = np.cumsum(src_splits)[:-1]
+            piece = np.split(src_buf, cuts, axis=0)[me]
+        pieces.append(piece)
+    out = np.concatenate(pieces, axis=0)
+    out_tensor._data = jnp.asarray(out)
+    return out_tensor
+
+
+def gather(tensor, gather_list=None, dst: int = 0, group=None, sync_op=True):
+    """Gather to ``dst`` (reference ``gather``)."""
+    rows = _gather_rows(np.asarray(tensor._data))
+    if get_rank(group) == dst and gather_list is not None:
+        ranks = _group_ranks(group)
+        gather_list[:] = [Tensor(rows[r]) for r in ranks]
+    return gather_list
+
+
+def broadcast_object_list(object_list, src: int = 0, group=None):
+    """Broadcast picklable python objects (reference
+    ``broadcast_object_list``) — rides all_gather_object."""
+    gathered = [None] * get_world_size(group)
+    all_gather_object(gathered, object_list, group=group)
+    ranks = _group_ranks(group)
+    src_local = ranks.index(src) if src in ranks else 0
+    object_list[:] = gathered[src_local]
+    return object_list
+
+
+def get_backend(group=None) -> str:
+    """The communication backend name: XLA collectives over PJRT (the
+    reference returns 'NCCL'/'GLOO')."""
+    return "XLA"
+
+
+def is_available() -> bool:
+    """Distributed support is always compiled in (reference
+    ``paddle.distributed.is_available``)."""
+    return True
+
+
+def isend(tensor, dst: int = 0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src: int = 0, group=None):
+    return recv(tensor, src, group)
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """Reduce a list of tensors and scatter the result: rank r keeps chunk r
+    (reference ``reduce_scatter``)."""
+    me = get_rank(group)
+    stacked = np.stack([np.asarray(t._data) for t in tensor_list])
+    rows = _gather_rows(stacked)          # [world, n_chunks, ...]
+    ranks = _group_ranks(group)
+    red = _reduce_rows(rows[ranks], op)   # [n_chunks, ...]
+    local = ranks.index(me) if me in ranks else 0
+    tensor._data = jnp.asarray(red[local])
+    return tensor
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src: int = 0,
+                        group=None):
+    """Scatter picklable objects from ``src`` (reference
+    ``scatter_object_list``)."""
+    gathered = [None] * get_world_size(group)
+    all_gather_object(gathered, in_object_list, group=group)
+    ranks = _group_ranks(group)
+    me = get_rank(group)
+    src_local = ranks.index(src) if src in ranks else 0
+    payload = gathered[src_local]
+    local = ranks.index(me) if me in ranks else 0
+    out_object_list[:] = [payload[local]] if payload else []
+    return out_object_list
+
+
+# reference gloo_* CPU-rendezvous helpers: the host collectives here already
+# run over the PJRT coordination service on any backend, so these are the
+# same operations under the reference's names
+def gloo_init_parallel_env(rank_id=None, rank_num=None, server_endpoint=None):
+    init_parallel_env()
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    destroy_process_group()

@@ -1,0 +1,63 @@
+"""``paddle.distributed.io`` — distributed persistence helpers.
+
+Counterpart of the reference's ``python/paddle/distributed/io.py``
+(save/load for distributed training artifacts).  The heavy machinery is
+``distributed.checkpoint`` (sharded save/load with dedup + cross-topology
+reshard); these entry points provide the reference names over it and the
+single-process framework io.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def is_persistable(var) -> bool:
+    from ..framework.tensor import Parameter
+
+    return isinstance(var, Parameter) or getattr(var, "persistable", False)
+
+
+def save_persistables(executor_or_model, dirname, main_program=None,
+                      filename=None):
+    """Save a model's persistable state under ``dirname`` (reference
+    ``io.save_persistables``).  With multiple processes this is the sharded
+    ``distributed.checkpoint.save_state_dict``; single-process it is
+    ``paddle.save``."""
+    import jax
+
+    model = executor_or_model
+    state = model.state_dict() if hasattr(model, "state_dict") else model
+    os.makedirs(dirname, exist_ok=True)
+    if jax.process_count() > 1:
+        from .checkpoint import save_state_dict
+
+        save_state_dict(state, dirname)
+    else:
+        from ..framework.io import save
+
+        save(state, os.path.join(dirname, filename or "persistables.pdparams"))
+
+
+def load_persistables(executor_or_model, dirname, main_program=None,
+                      filename=None):
+    """Inverse of :func:`save_persistables`."""
+    import jax
+
+    model = executor_or_model
+    if jax.process_count() > 1:
+        from .checkpoint import load_state_dict
+
+        state = model.state_dict()
+        load_state_dict(state, dirname)
+        if hasattr(model, "set_state_dict"):
+            model.set_state_dict(state)
+        return state
+    from ..framework.io import load
+
+    state = load(os.path.join(dirname, filename or "persistables.pdparams"))
+    if hasattr(model, "set_state_dict"):
+        model.set_state_dict(state)
+    return state

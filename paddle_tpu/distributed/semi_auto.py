@@ -1,0 +1,187 @@
+"""Semi-auto parallel named API surface (reference ``auto_parallel/api.py``
+exports re-exported at ``paddle.distributed``): ``Strategy``/``DistAttr``/
+``ShardingStage*``/``ReduceType``/``DistModel``/``to_static``.
+
+The mechanisms already exist in this framework — ``shard_tensor`` placements
+(DistAttr), ``shard_optimizer(stage=...)`` (the sharding-stage plans), and
+``jit.to_static`` over a sharded model (DistModel) — this module provides
+the reference's NAMED objects over them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .mesh import ProcessMesh, get_mesh
+from .placement import Placement
+
+__all__ = ["DistAttr", "Strategy", "ReduceType", "ShardingStage1",
+           "ShardingStage2", "ShardingStage3", "DistModel", "to_static"]
+
+
+class ReduceType:
+    """Partial-tensor reduction kinds (reference ``ReduceType``)."""
+
+    kRedSum = "sum"
+    kRedMax = "max"
+    kRedMin = "min"
+    kRedProd = "prod"
+    kRedAvg = "avg"
+    kRedAny = "any"
+    kRedAll = "all"
+
+
+@dataclass
+class DistAttr:
+    """mesh + per-dim placements (reference ``DistAttr``; 1:1 with the
+    ``shard_tensor`` arguments)."""
+
+    mesh: Optional[ProcessMesh] = None
+    placements: Optional[List[Placement]] = None
+    sharding_specs: Optional[List[Optional[str]]] = None
+
+
+class _ShardingStage:
+    """Sharding-stage plan objects (reference ``ShardingStage1/2/3``,
+    ``auto_parallel/api.py:1301``).  Two reference call patterns work:
+
+    - ``stage.apply(optimizer)`` / ``stage(optimizer)`` — shard the whole
+      optimizer at this stage;
+    - ``shard_optimizer(opt, shard_fn=stage)`` — used as the per-state
+      shard_fn ``(param, state_name, mesh) -> placements`` (delegates to the
+      stage's default ZeRO placement rule).
+    """
+
+    stage = 1
+
+    def __init__(self, axis_name: str = "dp", mesh: Optional[ProcessMesh] = None):
+        self.axis_name = axis_name
+        self.mesh = mesh
+
+    def apply(self, optimizer):
+        from .api import shard_optimizer
+
+        return shard_optimizer(optimizer, mesh=self.mesh, stage=self.stage)
+
+    def _placements(self, param, state_name, mesh):
+        from .api import _zero1_state_placements
+
+        shard_axes = [i for i, n in enumerate(mesh.dim_names)
+                      if n in (self.axis_name, "dp", "sharding")] or [0]
+        return _zero1_state_placements(param, mesh, shard_axes)
+
+    def __call__(self, *args):
+        if len(args) == 1:       # stage(optimizer)
+            return self.apply(args[0])
+        if len(args) == 3:       # shard_fn protocol (param, state_name, mesh)
+            return self._placements(*args)
+        raise TypeError(
+            f"{type(self).__name__} expects (optimizer) or "
+            f"(param, state_name, mesh); got {len(args)} arguments")
+
+
+class ShardingStage1(_ShardingStage):
+    stage = 1
+
+
+class ShardingStage2(_ShardingStage):
+    stage = 2
+
+
+class ShardingStage3(_ShardingStage):
+    stage = 3
+
+
+@dataclass
+class Strategy:
+    """Auto-parallel strategy container (reference
+    ``auto_parallel/strategy.py``): typed sub-config dataclasses, consumed by
+    :func:`to_static`/fleet."""
+
+    @dataclass
+    class _Sharding:
+        enable: bool = False
+        stage: int = 1
+        degree: int = -1
+
+    @dataclass
+    class _Pipeline:
+        enable: bool = False
+        schedule_mode: str = "1F1B"
+        micro_batch_size: int = 1
+        accumulate_steps: int = 1
+
+    @dataclass
+    class _Recompute:
+        enable: bool = False
+
+    @dataclass
+    class _AMP:
+        enable: bool = False
+        dtype: str = "bfloat16"
+        level: str = "O1"
+
+    sharding: "_Sharding" = field(default_factory=_Sharding)
+    pipeline: "_Pipeline" = field(default_factory=_Pipeline)
+    recompute: "_Recompute" = field(default_factory=_Recompute)
+    amp: "_AMP" = field(default_factory=_AMP)
+
+
+class DistModel:
+    """A sharded model + optimizer compiled for distributed execution
+    (reference ``DistModel``, ``auto_parallel/api.py:2110``): call it like
+    the layer; ``train()/eval()`` flip the step between TrainStep and the
+    jitted forward."""
+
+    def __init__(self, layer, loader=None, loss_fn=None, optimizer=None,
+                 strategy: Optional[Strategy] = None):
+        self.network = layer
+        self._loss_fn = loss_fn
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        self._mode = "train" if optimizer is not None else "eval"
+        self._train_step = None
+        self._eval_fn = None
+        if strategy and strategy.sharding.enable and optimizer is not None:
+            from .api import shard_optimizer
+
+            shard_optimizer(optimizer, stage=strategy.sharding.stage)
+
+    def train(self):
+        self._mode = "train"
+        return self
+
+    def eval(self):
+        self._mode = "eval"
+        return self
+
+    def __call__(self, *args):
+        if self._mode == "train":
+            if self._loss_fn is None or self._optimizer is None:
+                raise ValueError("DistModel.train needs loss_fn and optimizer")
+            if self._train_step is None:
+                from ..jit import TrainStep
+
+                def lf(model, *xs):
+                    return self._loss_fn(model(*xs[:-1]), xs[-1])
+
+                self._train_step = TrainStep(self.network, lf, self._optimizer)
+            return self._train_step(*args)
+        if self._eval_fn is None:
+            from ..jit import to_static as _ts
+
+            self._eval_fn = _ts(self.network)
+        return self._eval_fn(*args)
+
+    def state_dict(self, *a, **k):
+        return self.network.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self.network.set_state_dict(*a, **k)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """Build a :class:`DistModel` (reference ``distributed.to_static``,
+    ``auto_parallel/api.py:2693``)."""
+    return DistModel(layer, loader, loss, optimizer, strategy)

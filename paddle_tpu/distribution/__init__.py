@@ -29,7 +29,7 @@ __all__ = [
     "Exponential", "Gamma", "Beta", "Dirichlet", "Laplace", "LogNormal",
     "Gumbel", "Cauchy", "Geometric", "Poisson", "Binomial", "Multinomial",
     "Chi2", "StudentT", "MultivariateNormal", "Independent", "TransformedDistribution",
-    "Weibull", "Pareto", "LKJCholesky",
+    "Weibull", "Pareto", "LKJCholesky", "ContinuousBernoulli", "ExponentialFamily",
     "kl_divergence", "register_kl",
     "Transform", "AffineTransform", "ExpTransform", "SigmoidTransform",
     "TanhTransform", "PowerTransform", "ChainTransform", "SoftmaxTransform",
@@ -1183,3 +1183,90 @@ def _kl_independent(p, q):
     if p.rank != q.rank:
         raise NotImplementedError("Independent KL needs equal reinterpreted ranks")
     return jnp.sum(_kl_raw(p.base, q.base), axis=tuple(range(-p.rank, 0)))
+
+
+class ExponentialFamily(Distribution):
+    """Base class for exponential-family distributions (reference
+    ``distribution/exponential_family.py``): subclasses expose natural
+    parameters + log normalizer, and ``entropy`` follows from the Bregman
+    identity H = A(η) - <η, ∇A(η)> + E[-h(x)] (the reference's autodiff
+    formulation)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def _entropy(self):
+        nats = [jnp.asarray(n, jnp.float32) for n in self._natural_parameters]
+        value, grads = jax.value_and_grad(
+            lambda *ns: jnp.sum(self._log_normalizer(*ns)),
+            argnums=tuple(range(len(nats))))(*nats)
+        ent = value * jnp.ones(self.batch_shape) if jnp.ndim(value) == 0 else value
+        result = -self._mean_carrier_measure + jnp.broadcast_to(ent, self.batch_shape)
+        for n, g in zip(nats, grads):
+            result = result - jnp.broadcast_to(n * g, self.batch_shape)
+        return result
+
+
+class ContinuousBernoulli(Distribution):
+    """Continuous Bernoulli on [0, 1] (reference
+    ``distribution/continuous_bernoulli.py``; Loaiza-Ganem & Cunningham)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self._param("probs", probs)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _log_norm_const(self):
+        p = self.probs
+        # C(p) = 2 atanh(1-2p) / (1-2p), -> 2 at p = 0.5 (use a safe series)
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        p_safe = jnp.where(near, 0.25, p)
+        c = 2.0 * jnp.arctanh(1 - 2 * p_safe) / (1 - 2 * p_safe)
+        x = p - 0.5
+        series = 2.0 + (16.0 / 3.0) * x ** 2  # Taylor around 1/2
+        return jnp.log(jnp.where(near, series, c))
+
+    def _log_prob(self, value):
+        p = self.probs
+        return (value * jnp.log(jnp.maximum(p, 1e-30))
+                + (1 - value) * jnp.log(jnp.maximum(1 - p, 1e-30))
+                + self._log_norm_const())
+
+    def _mean(self):
+        p = self.probs
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        p_safe = jnp.where(near, 0.25, p)
+        m = p_safe / (2 * p_safe - 1) + 1.0 / (2 * jnp.arctanh(1 - 2 * p_safe))
+        return jnp.where(near, 0.5, m)
+
+    def _variance(self):
+        # numerically via the cdf-free identity is messy; use quadrature
+        xs = jnp.linspace(0.0, 1.0, 513)
+        pdf = jnp.exp(self._log_prob(xs[:, None] if self.batch_shape else xs))
+        m = self._mean()
+        if self.batch_shape:
+            ex2 = jnp.trapezoid(pdf * (xs[:, None] ** 2), xs, axis=0)
+        else:
+            ex2 = jnp.trapezoid(pdf * xs ** 2, xs)
+        return ex2 - m ** 2
+
+    def _rsample(self, key, shape):
+        # inverse-CDF sampling: F^{-1}(u) in closed form
+        p = self.probs
+        shp = shape + self.batch_shape
+        u = jax.random.uniform(key, shp, jnp.float32, minval=1e-6, maxval=1 - 1e-6)
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        p_safe = jnp.where(near, 0.25, p)
+        # F(x) = (p^x (1-p)^(1-x) + p - 1) / (2p - 1); invert for x
+        num = jnp.log1p(u * (2 * p_safe - 1) / (1 - p_safe))
+        den = jnp.log(p_safe / (1 - p_safe))
+        x = num / den
+        return jnp.where(near, u, jnp.clip(x, 0.0, 1.0))

@@ -13,6 +13,7 @@ from .ops.common import unary_op
 __all__ = [
     "fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2", "rfft2", "irfft2",
     "fftn", "ifftn", "rfftn", "irfftn", "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+    "hfft2", "hfftn", "ihfft2", "ihfftn",
 ]
 
 
@@ -76,3 +77,58 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return unary_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), x)
+
+
+def _hermitian_nd(transform_1d, x, s, axes, norm):
+    """Compose hfft/ihfft over the LAST axis with complex ffts over the rest
+    (the reference's hfft2/hfftn decomposition)."""
+    import jax.numpy as jnp
+
+    axes = tuple(axes)
+    last = axes[-1]
+    rest = axes[:-1]
+
+    def f(a):
+        if rest:
+            a = jnp.fft.fftn(a, s=None if s is None else tuple(s[:-1]),
+                             axes=rest, norm=norm)
+        n_last = None if s is None else s[-1]
+        return transform_1d(a, n=n_last, axis=last, norm=norm)
+
+    return unary_op("hfftn", f, x)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D FFT of a Hermitian-symmetric signal (reference ``fft.hfft2``)."""
+    import jax.numpy as jnp
+
+    return _hermitian_nd(jnp.fft.hfft, x, s, axes, norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    import jax.numpy as jnp
+
+    ax = axes if axes is not None else tuple(range(-(x.ndim), 0))
+    return _hermitian_nd(jnp.fft.hfft, x, s, ax, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    import jax.numpy as jnp
+
+    # inverse order: ihfft last axis first, then ifft over the rest
+    axes = tuple(axes)
+
+    def f(a):
+        out = jnp.fft.ihfft(a, n=None if s is None else s[-1], axis=axes[-1],
+                            norm=norm)
+        if axes[:-1]:
+            out = jnp.fft.ifftn(out, s=None if s is None else tuple(s[:-1]),
+                                axes=axes[:-1], norm=norm)
+        return out
+
+    return unary_op("ihfft2", f, x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    ax = tuple(axes) if axes is not None else tuple(range(-(x.ndim), 0))
+    return ihfft2(x, s=s, axes=ax, norm=norm)

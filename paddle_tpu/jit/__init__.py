@@ -30,6 +30,7 @@ from ..framework.dispatch import unwrap, wrap
 from ..framework.tensor import Parameter, Tensor
 
 __all__ = ["to_static", "not_to_static", "TrainStep", "functional_call", "ignore_module",
+           "enable_to_static", "set_verbosity", "set_code_level", "TranslatedLayer",
            "save", "load", "bucketed"]
 
 
@@ -133,6 +134,9 @@ class StaticFunction:
             for a in jax.tree.leaves((raw_args, raw_kwargs)))
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            # jit.enable_to_static(False): run everything eagerly
+            return self._call_eager(args, kwargs, rnd.next_key())
         if self._jitted is None:
             self._build()
         key = rnd.next_key()
@@ -524,3 +528,48 @@ def load(path, **configs):
     from ..framework.io import load as _load
 
     return _load(path + ".pdparams")
+
+
+# -- reference jit utility surface ------------------------------------------
+
+_to_static_enabled = True
+
+
+def enable_to_static(enable: bool = True) -> None:
+    """Globally toggle ``to_static`` tracing (reference
+    ``paddle.jit.enable_to_static``): when off, decorated functions run
+    eagerly — the SOT-style global fallback switch."""
+    global _to_static_enabled
+    _to_static_enabled = bool(enable)
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False) -> None:
+    """Transcription log verbosity (reference ``jit.set_verbosity``); maps to
+    jax's compiler logging."""
+    import logging
+
+    logging.getLogger("jax").setLevel(
+        logging.DEBUG if level >= 3 else
+        logging.INFO if level >= 1 else logging.WARNING)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False) -> None:
+    """Reference ``jit.set_code_level`` dumps transformed code; here the
+    traced artifact is the jaxpr — enable jax logging of lowered programs."""
+    set_verbosity(3 if level else 0, also_to_stdout)
+
+
+class TranslatedLayer:
+    """A loaded inference program exposed as a callable layer (reference
+    ``TranslatedLayer`` — the object ``paddle.jit.load`` returns).  Our
+    ``jit.load`` returns the same callable surface; this class is the
+    isinstance-able named type wrapping it."""
+
+    def __init__(self, program):
+        self._program = program
+
+    def __call__(self, *args, **kwargs):
+        return self._program(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._program(*args, **kwargs)

@@ -29,7 +29,11 @@ from . import nn  # noqa: F401
 __all__ = [
     "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor", "sparse_csr_tensor",
     "is_sparse_coo", "is_sparse_csr", "add", "subtract", "multiply", "divide",
-    "matmul", "relu", "sum", "transpose", "nn",
+    "matmul", "masked_matmul", "relu", "sum", "transpose", "nn",
+    "abs", "asin", "asinh", "atan", "atanh", "deg2rad", "rad2deg", "expm1",
+    "log1p", "neg", "sin", "sinh", "sqrt", "square", "tan", "tanh", "isnan",
+    "pow", "cast", "coalesce", "is_same_shape", "mask_as", "mv", "addmm",
+    "reshape", "slice", "pca_lowrank",
 ]
 
 
@@ -330,3 +334,156 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
 
 def transpose(x, perm, name=None):
     return _as_coo(x).transpose(perm)
+
+
+# ---------------------------------------------------------------------------
+# value-wise unary long tail + structure ops (reference paddle.sparse.*)
+# ---------------------------------------------------------------------------
+
+def _unary_factory(name, jfn):
+    def op(x, name_=None):
+        return _map_values(x, jfn, name)
+
+    op.__name__ = name
+    op.__doc__ = (f"Elementwise ``{name}`` over the stored values "
+                  f"(reference ``paddle.sparse.{name}``; zeros stay zero).")
+    return op
+
+
+abs = _unary_factory("abs", jnp.abs)
+asin = _unary_factory("asin", jnp.arcsin)
+asinh = _unary_factory("asinh", jnp.arcsinh)
+atan = _unary_factory("atan", jnp.arctan)
+atanh = _unary_factory("atanh", jnp.arctanh)
+deg2rad = _unary_factory("deg2rad", jnp.deg2rad)
+rad2deg = _unary_factory("rad2deg", jnp.rad2deg)
+expm1 = _unary_factory("expm1", jnp.expm1)
+log1p = _unary_factory("log1p", jnp.log1p)
+neg = _unary_factory("neg", jnp.negative)
+sin = _unary_factory("sin", jnp.sin)
+sinh = _unary_factory("sinh", jnp.sinh)
+sqrt = _unary_factory("sqrt", jnp.sqrt)
+square = _unary_factory("square", jnp.square)
+tan = _unary_factory("tan", jnp.tan)
+tanh = _unary_factory("tanh", jnp.tanh)
+isnan = _unary_factory("isnan", jnp.isnan)
+
+
+def pow(x, factor, name=None):
+    return _map_values(x, lambda v: jnp.power(v, factor), "pow")
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..framework.dtype import convert_dtype
+
+    coo = _as_coo(x)
+    idx = coo._indices
+    if index_dtype is not None:
+        idx = Tensor(_raw(idx).astype(convert_dtype(index_dtype)))
+    vals = coo._values
+    if value_dtype is not None:
+        vals = apply_op("sparse_cast",
+                        lambda v: v.astype(convert_dtype(value_dtype)), (vals,), {})
+    out = SparseCooTensor(idx, vals, coo.shape)
+    return out.to_sparse_csr() if is_sparse_csr(x) else out
+
+
+def coalesce(x, name=None):
+    """Merge duplicate coordinates by summation (reference
+    ``paddle.sparse.coalesce``)."""
+    coo = _as_coo(x)
+    idx = np.asarray(_raw(coo._indices))
+    vals = np.asarray(_raw(coo._values))
+    keys = np.ravel_multi_index(idx, coo.shape)
+    uniq, inv = np.unique(keys, return_inverse=True)
+    merged = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+    np.add.at(merged, inv, vals)
+    new_idx = np.stack(np.unravel_index(uniq, coo.shape))
+    return SparseCooTensor(Tensor(new_idx.astype(np.int64)), Tensor(merged),
+                           coo.shape)
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def mask_as(x, mask, name=None):
+    """Keep x's entries at ``mask``'s sparsity pattern (reference
+    ``paddle.sparse.mask_as``): dense x + sparse mask -> sparse."""
+    m = _as_coo(mask)
+    xd = _raw(_t(x))
+    idx = np.asarray(_raw(m._indices))
+    vals = apply_op("mask_as", lambda a: a[tuple(idx)], (_t(x),), {})
+    out = SparseCooTensor(m._indices, vals, m.shape)
+    return out.to_sparse_csr() if is_sparse_csr(mask) else out
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix @ dense vector (reference ``paddle.sparse.mv``)."""
+    coo = _as_coo(x)
+    rows, cols = (np.asarray(_raw(coo._indices))[0],
+                  np.asarray(_raw(coo._indices))[1])
+    n_rows = coo.shape[0]
+
+    def f(vals, v):
+        prods = vals * v[cols]
+        return jax.ops.segment_sum(prods, rows, num_segments=n_rows) \
+            if hasattr(jax.ops, "segment_sum") else \
+            jnp.zeros((n_rows,), vals.dtype).at[rows].add(prods)
+
+    return apply_op("sparse_mv", f, (coo._values, _t(vec)), {})
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(sparse x @ dense y) (reference
+    ``paddle.sparse.addmm``)."""
+    prod = matmul(x, y)
+    from ..ops.common import binary_op
+
+    return binary_op("sparse_addmm", lambda i, p: beta * i + alpha * p,
+                     _t(input), prod)
+
+
+def reshape(x, shape, name=None):
+    """Reshape a sparse tensor by recoding flat coordinates (reference
+    ``paddle.sparse.reshape``)."""
+    coo = _as_coo(x)
+    new_shape = tuple(int(s) for s in shape)
+    if -1 in new_shape:
+        known = int(np.prod([s for s in new_shape if s != -1]))
+        total = int(np.prod(coo.shape))
+        new_shape = tuple(total // known if s == -1 else s for s in new_shape)
+    idx = np.asarray(_raw(coo._indices))
+    flat = np.ravel_multi_index(idx, coo.shape)
+    new_idx = np.stack(np.unravel_index(flat, new_shape))
+    out = SparseCooTensor(Tensor(new_idx.astype(np.int64)), coo._values,
+                          list(new_shape))
+    return out.to_sparse_csr() if is_sparse_csr(x) else out
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Slice a sparse tensor (reference ``paddle.sparse.slice``)."""
+    coo = _as_coo(x)
+    idx = np.asarray(_raw(coo._indices))
+    vals_np = np.asarray(_raw(coo._values))
+    keep = np.ones(idx.shape[1], bool)
+    new_shape = list(coo.shape)
+    shift = np.zeros(idx.shape[0], np.int64)
+    for ax, s, e in zip(axes, starts, ends):
+        ax = int(ax)
+        s = int(s) if s >= 0 else int(s) + coo.shape[ax]
+        e = min(int(e) if e >= 0 else int(e) + coo.shape[ax], coo.shape[ax])
+        keep &= (idx[ax] >= s) & (idx[ax] < e)
+        shift[ax] = s
+        new_shape[ax] = e - s
+    new_idx = idx[:, keep] - shift[:, None]
+    return SparseCooTensor(Tensor(new_idx.astype(np.int64)),
+                           Tensor(vals_np[keep]), new_shape)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA over the densified matrix (reference
+    ``paddle.sparse.pca_lowrank`` — its CUDA path also densifies)."""
+    from ..ops.linalg import pca_lowrank as _dense_pca
+
+    return _dense_pca(_as_coo(x).to_dense(), q=q, center=center, niter=niter)

@@ -228,3 +228,45 @@ class TestLinalgCompletions:
         np.testing.assert_allclose(rec, a, rtol=1e-2, atol=1e-2)
         U2, S2, V2 = paddle.linalg.pca_lowrank(paddle.to_tensor(a), q=3)
         assert np.asarray(S2._data).shape[-1] == 3
+
+
+# submodule parity: every reference __all__ name, with the documented
+# out-of-scope absents (parameter-server dataset/entry types — SURVEY §2.5
+# item 12 marks the brpc PS stack out of TPU scope; the fp8 fused gemm is a
+# CUDA-specific kernel entry)
+SUBMODULE_ABSENT = {
+    "distributed/__init__.py": {"InMemoryDataset", "QueueDataset",
+                                "CountFilterEntry", "ProbabilityEntry",
+                                "ShowClickEntry"},
+    "linalg.py": {"fp8_fp8_half_gemm_fused"},
+}
+
+
+@pytest.mark.skipif(not os.path.exists(REF_INIT), reason="no reference mount")
+@pytest.mark.parametrize("mod,attr", [
+    ("fft.py", "fft"), ("amp/__init__.py", "amp"),
+    ("distribution/__init__.py", "distribution"),
+    ("sparse/__init__.py", "sparse"), ("jit/__init__.py", "jit"),
+    ("metric/__init__.py", "metric"),
+    ("distributed/__init__.py", "distributed"),
+    ("vision/transforms/__init__.py", "vision.transforms"),
+    ("vision/ops.py", "vision.ops"),
+    ("nn/__init__.py", "nn"), ("nn/functional/__init__.py", "nn.functional"),
+    ("linalg.py", "linalg"), ("signal.py", "signal"),
+])
+def test_submodule_all_parity(mod, attr):
+    path = os.path.join(os.path.dirname(REF_INIT), mod)
+    ref_all = []
+    for node in ast.walk(ast.parse(open(path).read())):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgt = node.targets[0] if isinstance(node, ast.Assign) else node.target
+            if getattr(tgt, "id", "") == "__all__":
+                try:
+                    ref_all += ast.literal_eval(node.value)
+                except Exception:
+                    pass
+    obj = paddle
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    missing = {n for n in set(ref_all) if not hasattr(obj, n)}
+    assert missing <= SUBMODULE_ABSENT.get(mod, set()), sorted(missing)

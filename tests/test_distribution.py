@@ -346,3 +346,21 @@ class TestWeibullParetoLKJ:
         for c in (-0.7, 0.0, 0.4):
             L = np.array([[1.0, 0.0], [c, np.sqrt(1 - c * c)]], np.float32)
             assert float(_np(d.log_prob(L))) == pytest.approx(np.log(0.5), abs=1e-5)
+
+
+class TestContinuousBernoulli:
+    def test_density_integrates_to_one_and_mean(self):
+        for p in (0.2, 0.5, 0.8):
+            d = D.ContinuousBernoulli(np.float32(p))
+            xs = np.linspace(0, 1, 2001).astype(np.float32)
+            pdf = np.exp(_np(d.log_prob(xs)))
+            assert np.trapezoid(pdf, xs) == pytest.approx(1.0, abs=1e-3), p
+            m = np.trapezoid(pdf * xs, xs)
+            assert float(_np(d.mean)) == pytest.approx(m, abs=1e-3), p
+
+    def test_sampling_matches_mean(self):
+        paddle.seed(0)
+        d = D.ContinuousBernoulli(np.float32(0.3))
+        s = _np(d.sample([40000]))
+        assert (s >= 0).all() and (s <= 1).all()
+        assert s.mean() == pytest.approx(float(_np(d.mean)), abs=0.01)

@@ -53,6 +53,34 @@ _WORKER = """
     total = float(metrics.sum(big))
     assert total == 2.0 ** 26 + 1, total
 
+    # reduce_scatter: ranks contribute [rank+1, rank+1]; chunk r keeps sum
+    chunks = [paddle.to_tensor(np.full((2,), float(rank + 1), np.float32))
+              for _ in range(2)]
+    out_rs = paddle.to_tensor(np.zeros((2,), np.float32))
+    collective.reduce_scatter(out_rs, chunks)
+    np.testing.assert_allclose(np.asarray(out_rs._data), 3.0)
+
+    # alltoall_single: rank r sends [r*10+0, r*10+1]; rank k receives
+    # column k from everyone
+    inp = paddle.to_tensor(np.asarray([rank * 10 + 0, rank * 10 + 1],
+                                      np.float32))
+    out_a = paddle.to_tensor(np.zeros((2,), np.float32))
+    collective.alltoall_single(out_a, inp)
+    np.testing.assert_allclose(np.asarray(out_a._data), [rank, 10 + rank])
+
+    # scatter_object_list from rank 0
+    recv_obj = [None]
+    collective.scatter_object_list(recv_obj, [f"for0", f"for1"], src=0)
+    assert recv_obj[0] == f"for{rank}", recv_obj
+
+    # gather to rank 1
+    glist = [None, None] if rank == 1 else None
+    t_g = paddle.to_tensor(np.full((2,), float(rank), np.float32))
+    collective.gather(t_g, glist, dst=1)
+    if rank == 1:
+        np.testing.assert_allclose(np.asarray(glist[0]._data), 0.0)
+        np.testing.assert_allclose(np.asarray(glist[1]._data), 1.0)
+
     print(f"RANK{rank}_OK", flush=True)
 """
 

@@ -7,6 +7,7 @@ import jax.numpy as jnp
 
 import paddle_tpu as paddle
 from paddle_tpu import sparse
+from paddle_tpu import sparse as sp
 
 
 def _coo_example():
@@ -190,3 +191,58 @@ class TestAutograd:
             opt.clear_grad()
             first = first if first is not None else float(loss.numpy())
         assert float(loss.numpy()) < first * 0.5
+
+
+class TestSparseLongTail:
+    def _coo(self):
+        idx = np.array([[0, 0, 1, 2], [0, 2, 1, 0]], np.int64)
+        vals = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+        return sp.sparse_coo_tensor(idx, vals, [3, 3])
+
+    def test_unary_values(self):
+        x = self._coo()
+        assert np.allclose(np.asarray(sp.abs(x).to_dense()._data)[0, 2], 2.0)
+        assert np.allclose(np.asarray(sp.square(x).to_dense()._data)[1, 1], 9.0)
+        assert np.allclose(np.asarray(sp.neg(x).to_dense()._data)[0, 0], -1.0)
+        # zeros stay zero
+        assert np.asarray(sp.tanh(x).to_dense()._data)[2, 2] == 0.0
+
+    def test_mv_and_addmm(self):
+        x = self._coo()
+        v = np.array([1.0, 2.0, 3.0], np.float32)
+        dense = np.asarray(x.to_dense()._data)
+        got = np.asarray(sp.mv(x, paddle.to_tensor(v))._data)
+        np.testing.assert_allclose(got, dense @ v, rtol=1e-6)
+        y = np.eye(3, dtype=np.float32)
+        base = np.ones((3, 3), np.float32)
+        am = np.asarray(sp.addmm(paddle.to_tensor(base), x,
+                                 paddle.to_tensor(y), beta=2.0, alpha=0.5)._data)
+        np.testing.assert_allclose(am, 2 * base + 0.5 * dense, rtol=1e-6)
+
+    def test_coalesce_merges_duplicates(self):
+        idx = np.array([[0, 0, 1], [1, 1, 0]], np.int64)
+        vals = np.array([1.0, 4.0, 2.0], np.float32)
+        x = sp.sparse_coo_tensor(idx, vals, [2, 2])
+        c = sp.coalesce(x)
+        d = np.asarray(c.to_dense()._data)
+        assert d[0, 1] == 5.0 and np.asarray(sp._raw(c._indices)).shape[1] == 2
+
+    def test_reshape_and_slice(self):
+        x = self._coo()
+        r = sp.reshape(x, [9])
+        np.testing.assert_allclose(np.asarray(r.to_dense()._data),
+                                   np.asarray(x.to_dense()._data).reshape(9))
+        s = sp.slice(x, axes=[0], starts=[0], ends=[2])
+        np.testing.assert_allclose(np.asarray(s.to_dense()._data),
+                                   np.asarray(x.to_dense()._data)[:2])
+
+    def test_mask_as_and_cast_and_same_shape(self):
+        x = self._coo()
+        dense = paddle.to_tensor(np.full((3, 3), 7.0, np.float32))
+        m = sp.mask_as(dense, x)
+        d = np.asarray(m.to_dense()._data)
+        assert d[0, 0] == 7.0 and d[2, 2] == 0.0
+        c = sp.cast(x, value_dtype="float64" if False else "float32",
+                    index_dtype="int32")
+        assert np.asarray(sp._raw(c._indices)).dtype == np.int32
+        assert sp.is_same_shape(x, c) and not sp.is_same_shape(x, sp.reshape(x, [9]))

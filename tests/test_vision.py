@@ -195,3 +195,84 @@ class TestDetectionOps:
         assert list(ra(x, boxes, bn).shape) == [1, 3, 2, 2]
         rp = RoIPool(2)
         assert list(rp(x, boxes, bn).shape) == [1, 3, 2, 2]
+
+
+class TestTransformsLongTail:
+    def _img(self, h=16, w=16):
+        return (np.random.default_rng(0).uniform(0, 255, (h, w, 3))
+                .astype(np.uint8))
+
+    def test_adjust_brightness_contrast(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = self._img()
+        b = T.adjust_brightness(img, 1.5)
+        np.testing.assert_allclose(
+            b.astype(np.float64),
+            np.clip(np.round(img.astype(np.float64) * 1.5), 0, 255), atol=1)
+        c = T.adjust_contrast(img, 0.0)
+        assert np.unique(c).size <= 2  # collapses toward the gray mean
+
+    def test_adjust_hue_identity_and_range(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = self._img()
+        same = T.adjust_hue(img, 0.0)
+        np.testing.assert_allclose(same.astype(int), img.astype(int), atol=2)
+        shifted = T.adjust_hue(img, 0.25)
+        assert shifted.dtype == np.uint8 and shifted.shape == img.shape
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            T.adjust_hue(img, 0.7)
+
+    def test_affine_rotate_identity(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = self._img()
+        same = T.affine(img, 0.0, (0, 0), 1.0, (0.0, 0.0))
+        np.testing.assert_array_equal(same, img)
+        # 90-degree rotations preserve the histogram (square images)
+        rot = T.rotate(img, 90.0, interpolation="nearest")
+        assert rot.shape == img.shape
+        np.testing.assert_array_equal(np.sort(rot.ravel()),
+                                      np.sort(img.ravel()))
+
+    def test_perspective_identity(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = self._img()
+        pts = [(0, 0), (15, 0), (15, 15), (0, 15)]
+        same = T.perspective(img, pts, pts)
+        np.testing.assert_array_equal(same, img)
+
+    def test_random_transform_classes(self):
+        import random as pyr
+
+        from paddle_tpu.vision import transforms as T
+
+        pyr.seed(0)
+        img = self._img(24, 24)
+        cj = T.ColorJitter(0.3, 0.3, 0.3, 0.2)
+        out = cj(img)
+        assert out.shape == img.shape
+        ra = T.RandomAffine(15, translate=(0.1, 0.1), scale=(0.9, 1.1))
+        assert ra(img).shape == img.shape
+        rp = T.RandomPerspective(prob=1.0)
+        assert rp(img).shape == img.shape
+        re = T.RandomErasing(prob=1.0, value=0)
+        erased = re(img)
+        assert (erased == 0).any()
+        rc = T.RandomResizedCrop(12)
+        assert rc(img).shape[:2] == (12, 12)
+        g = T.Grayscale(3)(img)
+        assert g.shape == img.shape and np.allclose(g[..., 0], g[..., 1])
+
+    def test_pad_and_erase_functional(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = self._img(8, 8)
+        p = T.pad(img, 2, fill=7)
+        assert p.shape == (12, 12, 3) and (p[0] == 7).all()
+        e = T.erase(img, 2, 3, 4, 2, v=0)
+        assert (e[2:6, 3:5] == 0).all()

@@ -17,4 +17,10 @@ from .features import (  # noqa: F401
     Spectrogram,
 )
 
-__all__ = ["functional", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+__all__ = ["functional", "backends", "datasets", "Spectrogram",
+           "MelSpectrogram", "LogMelSpectrogram", "MFCC",
+           "info", "load", "save"]
+
+from . import backends  # noqa: E402,F401
+from . import datasets  # noqa: E402,F401
+from .backends import info, load, save  # noqa: E402,F401

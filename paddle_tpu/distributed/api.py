@@ -461,13 +461,16 @@ def split(x, size, operation="linear", axis=0, num_partitions=1,
         layer = VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr)
         return layer(x)
     if operation == "linear":
-        if axis == 1:
+        if axis == 0:
+            # reference: axis=0 splits the IN dim -> row-parallel
             layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
                                       has_bias=bias_attr is not False,
                                       input_is_parallel=False)
-        else:
+        elif axis == 1:
             layer = ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
                                          has_bias=bias_attr is not False,
                                          gather_output=gather_out)
+        else:
+            raise ValueError(f"split(linear): axis must be 0 or 1, got {axis}")
         return layer(x)
     raise ValueError(f"split: unknown operation {operation!r}")

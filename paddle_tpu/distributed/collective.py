@@ -331,10 +331,13 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
 
 
 def gather(tensor, gather_list=None, dst: int = 0, group=None, sync_op=True):
-    """Gather to ``dst`` (reference ``gather``)."""
+    """Gather to GLOBAL rank ``dst`` (reference ``gather``)."""
+    import jax
+
     rows = _gather_rows(np.asarray(tensor._data))
-    if get_rank(group) == dst and gather_list is not None:
-        ranks = _group_ranks(group)
+    ranks = _group_ranks(group)
+    # dst is a GLOBAL rank (reference semantics); compare in global space
+    if jax.process_index() == dst and gather_list is not None:
         gather_list[:] = [Tensor(rows[r]) for r in ranks]
     return gather_list
 
@@ -374,13 +377,12 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     """Reduce a list of tensors and scatter the result: rank r keeps chunk r
     (reference ``reduce_scatter``)."""
-    me = get_rank(group)
+    me_local = get_rank(group)            # group-LOCAL rank == my chunk id
     stacked = np.stack([np.asarray(t._data) for t in tensor_list])
     rows = _gather_rows(stacked)          # [world, n_chunks, ...]
     ranks = _group_ranks(group)
     red = _reduce_rows(rows[ranks], op)   # [n_chunks, ...]
-    local = ranks.index(me) if me in ranks else 0
-    tensor._data = jnp.asarray(red[local])
+    tensor._data = jnp.asarray(red[me_local])
     return tensor
 
 
@@ -391,11 +393,10 @@ def scatter_object_list(out_object_list, in_object_list=None, src: int = 0,
     gathered = [None] * get_world_size(group)
     all_gather_object(gathered, in_object_list, group=group)
     ranks = _group_ranks(group)
-    me = get_rank(group)
-    src_local = ranks.index(src) if src in ranks else 0
+    me_local = get_rank(group)            # group-local position
+    src_local = ranks.index(src) if src in ranks else 0  # src is GLOBAL
     payload = gathered[src_local]
-    local = ranks.index(me) if me in ranks else 0
-    out_object_list[:] = [payload[local]] if payload else []
+    out_object_list[:] = [payload[me_local]] if payload else []
     return out_object_list
 
 

@@ -1205,11 +1205,13 @@ class ExponentialFamily(Distribution):
 
     def _entropy(self):
         nats = [jnp.asarray(n, jnp.float32) for n in self._natural_parameters]
-        value, grads = jax.value_and_grad(
-            lambda *ns: jnp.sum(self._log_normalizer(*ns)),
-            argnums=tuple(range(len(nats))))(*nats)
-        ent = value * jnp.ones(self.batch_shape) if jnp.ndim(value) == 0 else value
-        result = -self._mean_carrier_measure + jnp.broadcast_to(ent, self.batch_shape)
+        # per-ELEMENT log normalizer; grad of the sum gives per-element
+        # partials because A is elementwise over the batch
+        a_vals = self._log_normalizer(*nats)
+        grads = jax.grad(lambda *ns: jnp.sum(self._log_normalizer(*ns)),
+                         argnums=tuple(range(len(nats))))(*nats)
+        result = -self._mean_carrier_measure + jnp.broadcast_to(
+            a_vals, self.batch_shape)
         for n, g in zip(nats, grads):
             result = result - jnp.broadcast_to(n * g, self.batch_shape)
         return result

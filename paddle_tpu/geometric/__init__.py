@@ -20,7 +20,9 @@ import numpy as np
 from ..framework.dispatch import apply_op
 from ..framework.tensor import Tensor
 
-__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+__all__ = ["send_uv", "sample_neighbors", "weighted_sample_neighbors",
+           "reindex_graph", "reindex_heter_graph",
+           "segment_sum", "segment_mean", "segment_max", "segment_min",
            "send_u_recv", "send_ue_recv"]
 
 _REDUCE_OPS = ("sum", "mean", "max", "min")
@@ -119,3 +121,140 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum",
         return _reduce(combine(xd[src], yd), dst, n_out, reduce_op)
 
     return apply_op("send_ue_recv", f, (_t(x), _t(y)), {})
+
+
+# ---------------------------------------------------------------------------
+# message passing / sampling long tail (reference python/paddle/geometric/)
+# ---------------------------------------------------------------------------
+
+def _host_rng() -> np.random.Generator:
+    """Host RNG seeded from the framework's functional PRNG stream, so
+    ``paddle.seed`` reproduces sampling runs."""
+    import jax
+
+    from ..framework import random as rnd
+
+    seed = int(jax.random.randint(rnd.next_key(), (), 0, 2**31 - 1))
+    return np.random.default_rng(seed)
+
+
+def send_uv(x, y, src_index, dst_index, compute_type="add", name=None):
+    """Edgewise message computation (reference ``geometric.send_uv``):
+    message_e = op(x[src_e], y[dst_e])."""
+    from ..framework.dispatch import apply_op
+
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+    if compute_type not in ops:
+        raise ValueError(f"compute_type must be one of {sorted(ops)}")
+    si = jnp.asarray(_raw(src_index), jnp.int32)
+    di = jnp.asarray(_raw(dst_index), jnp.int32)
+
+    def f(a, b):
+        return ops[compute_type](a[si], b[di])
+
+    return apply_op("send_uv", f, (_t(x), _t(y)), {})
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over a CSC graph (reference
+    ``geometric.sample_neighbors``): for each input node, up to
+    ``sample_size`` of its in-neighbors.  Host-side (data-dependent output),
+    like the reference's CPU sampler.
+
+    Returns (neighbors, counts[, sampled_eids])."""
+    r = np.asarray(_raw(row)).astype(np.int64)
+    cp = np.asarray(_raw(colptr)).astype(np.int64)
+    nodes = np.asarray(_raw(input_nodes)).astype(np.int64)
+    ev = np.asarray(_raw(eids)).astype(np.int64) if eids is not None else None
+    rng = _host_rng()
+    out_nbrs, out_counts, out_eids = [], [], []
+    for n in nodes:
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        idx = np.arange(lo, hi)
+        if 0 <= sample_size < len(idx):
+            idx = rng.choice(idx, size=sample_size, replace=False)
+        out_nbrs.append(r[idx])
+        out_counts.append(len(idx))
+        if ev is not None:
+            out_eids.append(ev[idx])
+        else:
+            out_eids.append(idx)
+    nbrs = Tensor(np.concatenate(out_nbrs) if out_nbrs else np.zeros(0, np.int64))
+    counts = Tensor(np.asarray(out_counts, np.int32))
+    if return_eids:
+        return nbrs, counts, Tensor(np.concatenate(out_eids)
+                                    if out_eids else np.zeros(0, np.int64))
+    return nbrs, counts
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, return_eids=False, name=None):
+    """Weight-proportional neighbor sampling (reference
+    ``geometric.weighted_sample_neighbors``)."""
+    r = np.asarray(_raw(row)).astype(np.int64)
+    cp = np.asarray(_raw(colptr)).astype(np.int64)
+    w = np.asarray(_raw(edge_weight)).astype(np.float64)
+    nodes = np.asarray(_raw(input_nodes)).astype(np.int64)
+    rng = _host_rng()
+    out_nbrs, out_counts, out_eids = [], [], []
+    for n in nodes:
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        idx = np.arange(lo, hi)
+        if 0 <= sample_size < len(idx):
+            p = w[idx] / w[idx].sum()
+            idx = rng.choice(idx, size=sample_size, replace=False, p=p)
+        out_nbrs.append(r[idx])
+        out_counts.append(len(idx))
+        out_eids.append(idx)
+    nbrs = Tensor(np.concatenate(out_nbrs) if out_nbrs else np.zeros(0, np.int64))
+    counts = Tensor(np.asarray(out_counts, np.int32))
+    if return_eids:
+        return nbrs, counts, Tensor(np.concatenate(out_eids)
+                                    if out_eids else np.zeros(0, np.int64))
+    return nbrs, counts
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to a local contiguous space (reference
+    ``geometric.reindex_graph``): returns (reindexed_src, reindexed_dst,
+    out_nodes) where out_nodes = unique nodes with the INPUT nodes first."""
+    xs = np.asarray(_raw(x)).astype(np.int64)
+    nb = np.asarray(_raw(neighbors)).astype(np.int64)
+    ct = np.asarray(_raw(count)).astype(np.int64)
+    mapping = {}
+    for n in xs.tolist():
+        if n not in mapping:
+            mapping[n] = len(mapping)
+    for n in nb.tolist():
+        if n not in mapping:
+            mapping[n] = len(mapping)
+    out_nodes = np.fromiter(mapping.keys(), np.int64, len(mapping))
+    src = np.asarray([mapping[n] for n in nb.tolist()], np.int64)
+    dst = np.repeat(np.arange(len(xs), dtype=np.int64), ct)
+    return Tensor(src), Tensor(dst), Tensor(out_nodes)
+
+
+def reindex_heter_graph(x, neighbors_list, count_list, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous-graph reindex: one shared node mapping over multiple
+    neighbor sets (reference ``geometric.reindex_heter_graph``)."""
+    xs = np.asarray(_raw(x)).astype(np.int64)
+    mapping = {}
+    for n in xs.tolist():
+        if n not in mapping:
+            mapping[n] = len(mapping)
+    srcs, dsts = [], []
+    for neighbors, count in zip(neighbors_list, count_list):
+        nb = np.asarray(_raw(neighbors)).astype(np.int64)
+        ct = np.asarray(_raw(count)).astype(np.int64)
+        for n in nb.tolist():
+            if n not in mapping:
+                mapping[n] = len(mapping)
+        srcs.append(np.asarray([mapping[n] for n in nb.tolist()], np.int64))
+        dsts.append(np.repeat(np.arange(len(xs), dtype=np.int64), ct))
+    out_nodes = np.fromiter(mapping.keys(), np.int64, len(mapping))
+    return ([Tensor(s) for s in srcs], [Tensor(d) for d in dsts],
+            Tensor(out_nodes))

@@ -411,7 +411,6 @@ def mask_as(x, mask, name=None):
     """Keep x's entries at ``mask``'s sparsity pattern (reference
     ``paddle.sparse.mask_as``): dense x + sparse mask -> sparse."""
     m = _as_coo(mask)
-    xd = _raw(_t(x))
     idx = np.asarray(_raw(m._indices))
     vals = apply_op("mask_as", lambda a: a[tuple(idx)], (_t(x),), {})
     out = SparseCooTensor(m._indices, vals, m.shape)

@@ -15,7 +15,7 @@ from ..framework.dispatch import apply_op
 from ..framework.tensor import Tensor
 from ..nn.layers import Layer
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb", "Imikolov", "Movielens", "Conll05st", "WMT14", "WMT16", "datasets"]
 
 
 def _raw(v):
@@ -94,3 +94,9 @@ class ViterbiDecoder(Layer):
     def forward(self, potentials, lengths):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+
+from . import datasets  # noqa: E402,F401
+from .datasets import (  # noqa: E402,F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)

@@ -1,0 +1,228 @@
+"""``paddle.text`` dataset classes (reference ``python/paddle/text/datasets``).
+
+Zero-egress environment: each class consumes a LOCAL directory/file in the
+reference's extracted layout (``data_file=``/``data_dir=``) and implements
+the reference's parsing (tokenization, vocab building, field splitting);
+missing data raises FileNotFoundError with guidance instead of downloading.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "Conll05st",
+           "WMT14", "WMT16"]
+
+
+def _require(path, cls):
+    if path is None or not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{cls}: local data path {path!r} not found — downloads are not "
+            "possible in this environment; pass the extracted reference "
+            "layout via data_file=/data_dir=")
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression: 14 whitespace-separated floats per line,
+    feature-normalized like the reference (``datasets/uci_housing.py``)."""
+
+    def __init__(self, data_file=None, mode="train"):
+        _require(data_file, "UCIHousing")
+        raw = np.loadtxt(data_file).astype(np.float32).reshape(-1, 14)
+        mu, mx, mn = raw.mean(0), raw.max(0), raw.min(0)
+        feats = (raw[:, :13] - mu[:13]) / (mx[:13] - mn[:13] + 1e-12)
+        split = int(len(raw) * 0.8)
+        sel = slice(0, split) if mode == "train" else slice(split, None)
+        self.x = feats[sel]
+        self.y = raw[sel, 13:14]
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+_TOKEN_RE = re.compile(r"[A-Za-z']+")
+
+
+class Imdb(Dataset):
+    """IMDB sentiment: ``<dir>/<mode>/{pos,neg}/*.txt`` reviews, tokenized
+    and numericalized against a frequency-cutoff vocab (reference
+    ``datasets/imdb.py``)."""
+
+    def __init__(self, data_dir=None, mode="train", cutoff=150):
+        _require(data_dir, "Imdb")
+        self.docs: List[List[str]] = []
+        self.labels: List[int] = []
+        freq: Counter = Counter()
+        for label, sub in ((0, "neg"), (1, "pos")):
+            d = os.path.join(data_dir, mode, sub)
+            if not os.path.isdir(d):
+                continue
+            for fn in sorted(os.listdir(d)):
+                text = open(os.path.join(d, fn), errors="ignore").read().lower()
+                toks = _TOKEN_RE.findall(text)
+                self.docs.append(toks)
+                self.labels.append(label)
+                freq.update(toks)
+        vocab_words = [w for w, c in freq.most_common() if c >= min(cutoff, max(freq.values(), default=1))]
+        if not vocab_words:
+            vocab_words = list(freq)
+        self.word_idx: Dict[str, int] = {w: i for i, w in enumerate(vocab_words)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        unk = self.word_idx["<unk>"]
+        ids = np.asarray([self.word_idx.get(t, unk) for t in self.docs[i]],
+                         np.int64)
+        return ids, np.int64(self.labels[i])
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM dataset (reference ``datasets/imikolov.py``):
+    ``data_file`` = the tokenized text; yields n-grams over a min-freq vocab."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        _require(data_file, "Imikolov")
+        lines = [l.strip().lower().split()
+                 for l in open(data_file, errors="ignore") if l.strip()]
+        freq = Counter(t for l in lines for t in l)
+        words = [w for w, c in freq.items() if c >= min(min_word_freq,
+                                                        max(freq.values(), default=1))]
+        self.word_idx = {w: i for i, w in enumerate(sorted(words))}
+        self.word_idx.setdefault("<unk>", len(self.word_idx))
+        unk = self.word_idx["<unk>"]
+        self.grams: List[np.ndarray] = []
+        for l in lines:
+            ids = [self.word_idx.get(t, unk) for t in l]
+            for i in range(len(ids) - window_size + 1):
+                self.grams.append(np.asarray(ids[i:i + window_size], np.int64))
+
+    def __len__(self):
+        return len(self.grams)
+
+    def __getitem__(self, i):
+        g = self.grams[i]
+        return g[:-1], g[-1:]
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (reference ``datasets/movielens.py``):
+    ``data_dir`` holding ``ratings.dat`` (``user::movie::rating::ts``)."""
+
+    def __init__(self, data_dir=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        _require(data_dir, "Movielens")
+        path = os.path.join(data_dir, "ratings.dat")
+        _require(path, "Movielens")
+        rows = []
+        for line in open(path, errors="ignore"):
+            parts = line.strip().split("::")
+            if len(parts) >= 3:
+                rows.append((int(parts[0]), int(parts[1]), float(parts[2])))
+        rng = np.random.default_rng(rand_seed)
+        perm = rng.permutation(len(rows))
+        n_test = int(len(rows) * test_ratio)
+        sel = perm[n_test:] if mode == "train" else perm[:n_test]
+        self.rows = [rows[i] for i in sel]
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, i):
+        u, m, r = self.rows[i]
+        return (np.int64(u), np.int64(m), np.float32(r))
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (reference ``datasets/conll05.py``): ``data_dir`` with
+    ``words``/``props`` column files; yields (tokens, predicate, labels)."""
+
+    def __init__(self, data_dir=None, mode="train"):
+        _require(data_dir, "Conll05st")
+        wpath = os.path.join(data_dir, "words")
+        ppath = os.path.join(data_dir, "props")
+        _require(wpath, "Conll05st")
+        _require(ppath, "Conll05st")
+        sents = open(wpath, errors="ignore").read().strip().split("\n\n")
+        props = open(ppath, errors="ignore").read().strip().split("\n\n")
+        self.samples = []
+        vocab: Dict[str, int] = {}
+        labels: Dict[str, int] = {}
+        for s_blk, p_blk in zip(sents, props):
+            toks = [l.split()[0] for l in s_blk.splitlines() if l.split()]
+            tags = [l.split()[-1] for l in p_blk.splitlines() if l.split()]
+            for t in toks:
+                vocab.setdefault(t, len(vocab))
+            for t in tags:
+                labels.setdefault(t, len(labels))
+            self.samples.append((toks, tags))
+        self.word_dict, self.label_dict = vocab, labels
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        toks, tags = self.samples[i]
+        return (np.asarray([self.word_dict[t] for t in toks], np.int64),
+                np.asarray([self.label_dict[t] for t in tags], np.int64))
+
+
+class _ParallelText(Dataset):
+    """Parallel corpus base (WMT): ``data_dir`` with ``<mode>.src`` /
+    ``<mode>.trg`` line-aligned files; BOS/EOS-wrapped id sequences."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, data_dir=None, mode="train", min_freq=1):
+        _require(data_dir, type(self).__name__)
+        sp = os.path.join(data_dir, f"{mode}.src")
+        tp = os.path.join(data_dir, f"{mode}.trg")
+        _require(sp, type(self).__name__)
+        _require(tp, type(self).__name__)
+        src_lines = [l.split() for l in open(sp, errors="ignore").read().splitlines()]
+        trg_lines = [l.split() for l in open(tp, errors="ignore").read().splitlines()]
+        self.src_vocab = self._vocab(src_lines, min_freq)
+        self.trg_vocab = self._vocab(trg_lines, min_freq)
+        self.pairs = list(zip(src_lines, trg_lines))
+
+    def _vocab(self, lines, min_freq):
+        freq = Counter(t for l in lines for t in l)
+        v = {"<s>": self.BOS, "<e>": self.EOS, "<unk>": self.UNK}
+        for w, c in freq.most_common():
+            if c >= min_freq:
+                v.setdefault(w, len(v))
+        return v
+
+    def _ids(self, toks, vocab):
+        return np.asarray([self.BOS] + [vocab.get(t, self.UNK) for t in toks]
+                          + [self.EOS], np.int64)
+
+    def __len__(self):
+        return len(self.pairs)
+
+    def __getitem__(self, i):
+        s, t = self.pairs[i]
+        src = self._ids(s, self.src_vocab)
+        trg = self._ids(t, self.trg_vocab)
+        return src, trg[:-1], trg[1:]
+
+
+class WMT14(_ParallelText):
+    """WMT'14 en-fr (reference ``datasets/wmt14.py``)."""
+
+
+class WMT16(_ParallelText):
+    """WMT'16 en-de (reference ``datasets/wmt16.py``)."""

@@ -415,6 +415,28 @@ def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
     den = Hm[2, 0] * xs + Hm[2, 1] * ys + Hm[2, 2]
     sx = (Hm[0, 0] * xs + Hm[0, 1] * ys + Hm[0, 2]) / den
     sy = (Hm[1, 0] * xs + Hm[1, 1] * ys + Hm[1, 2]) / den
+    if interpolation == "bilinear":
+        # reuse the shared sampler: feed precomputed source coords through an
+        # identity-affine call path by sampling directly here
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        wx = sx - x0
+        wy = sy - y0
+
+        def g(yy, xx):
+            valid = (yy >= 0) & (yy < Hh) & (xx >= 0) & (xx < Ww)
+            px = a[np.clip(yy, 0, Hh - 1), np.clip(xx, 0, Ww - 1)].astype(np.float32)
+            return np.where(valid[..., None] if a.ndim == 3 else valid, px, fill)
+
+        def w_(x):
+            return x[..., None] if a.ndim == 3 else x
+
+        out = (g(y0, x0) * w_((1 - wy) * (1 - wx))
+               + g(y0, x0 + 1) * w_((1 - wy) * wx)
+               + g(y0 + 1, x0) * w_(wy * (1 - wx))
+               + g(y0 + 1, x0 + 1) * w_(wy * wx))
+        return out.astype(a.dtype) if a.dtype != np.uint8 else \
+            np.clip(np.round(out), 0, 255).astype(np.uint8)
     xi = np.round(sx).astype(np.int64)
     yi = np.round(sy).astype(np.int64)
     valid = (yi >= 0) & (yi < Hh) & (xi >= 0) & (xi < Ww)
@@ -520,9 +542,16 @@ class RandomAffine(BaseTransform):
             tx = pyrandom.uniform(-self.translate[0], self.translate[0]) * W
             ty = pyrandom.uniform(-self.translate[1], self.translate[1]) * H
         sc = pyrandom.uniform(*self.scale_rng) if self.scale_rng else 1.0
-        sh = (pyrandom.uniform(-self.shear, self.shear)
-              if isinstance(self.shear, numbers.Number) and self.shear else 0.0)
-        return affine(a, angle, (tx, ty), sc, (sh, 0.0), self.interpolation,
+        sx = sy = 0.0
+        if isinstance(self.shear, numbers.Number):
+            if self.shear:
+                sx = pyrandom.uniform(-self.shear, self.shear)
+        elif self.shear is not None:
+            sh = list(self.shear)
+            sx = pyrandom.uniform(sh[0], sh[1])
+            if len(sh) == 4:
+                sy = pyrandom.uniform(sh[2], sh[3])
+        return affine(a, angle, (tx, ty), sc, (sx, sy), self.interpolation,
                       self.fill, self.center)
 
 
@@ -532,6 +561,8 @@ class RandomPerspective(BaseTransform):
         super().__init__(keys)
         self.prob = prob
         self.d = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
 
     def _apply_image(self, img):
         a = _np(img)
@@ -544,7 +575,7 @@ class RandomPerspective(BaseTransform):
                (W - 1 - pyrandom.uniform(0, dx), pyrandom.uniform(0, dy)),
                (W - 1 - pyrandom.uniform(0, dx), H - 1 - pyrandom.uniform(0, dy)),
                (pyrandom.uniform(0, dx), H - 1 - pyrandom.uniform(0, dy))]
-        return perspective(a, start, end)
+        return perspective(a, start, end, self.interpolation, self.fill)
 
 
 class RandomErasing(BaseTransform):

@@ -253,6 +253,8 @@ SUBMODULE_ABSENT = {
     ("vision/ops.py", "vision.ops"),
     ("nn/__init__.py", "nn"), ("nn/functional/__init__.py", "nn.functional"),
     ("linalg.py", "linalg"), ("signal.py", "signal"),
+    ("audio/__init__.py", "audio"), ("text/__init__.py", "text"),
+    ("geometric/__init__.py", "geometric"),
 ])
 def test_submodule_all_parity(mod, attr):
     path = os.path.join(os.path.dirname(REF_INIT), mod)

@@ -96,3 +96,57 @@ class TestLayers:
 def test_spectrogram_validates_win_length():
     with pytest.raises(ValueError, match="win_length"):
         audio.Spectrogram(n_fft=256, win_length=512)
+
+
+class TestAudioIO:
+    def test_wav_roundtrip_16_and_32_bit(self, tmp_path):
+        from paddle_tpu.audio import backends as B
+
+        sig = (0.5 * np.sin(np.linspace(0, 40, 8000))).astype(np.float32)
+        stereo = np.stack([sig, -sig])
+        for bits in (16, 32):
+            p = str(tmp_path / f"t{bits}.wav")
+            B.save(p, paddle.to_tensor(stereo), 16000, bits_per_sample=bits)
+            meta = B.info(p)
+            assert (meta.sample_rate, meta.num_channels,
+                    meta.bits_per_sample) == (16000, 2, bits)
+            wav, sr = B.load(p)
+            assert sr == 16000
+            np.testing.assert_allclose(np.asarray(wav._data), stereo,
+                                       atol=2 ** -(bits - 2))
+
+    def test_load_offset_and_frames(self, tmp_path):
+        from paddle_tpu.audio import backends as B
+
+        sig = np.arange(100, dtype=np.float32) / 200.0
+        p = str(tmp_path / "m.wav")
+        B.save(p, paddle.to_tensor(sig), 8000)
+        part, _ = B.load(p, frame_offset=10, num_frames=20)
+        np.testing.assert_allclose(np.asarray(part._data)[0], sig[10:30],
+                                   atol=1e-4)
+
+    def test_datasets_parse_reference_layout(self, tmp_path):
+        from paddle_tpu.audio import backends as B
+        from paddle_tpu.audio.datasets import ESC50, TESS
+
+        sig = np.zeros(1600, np.float32)
+        tess_dir = tmp_path / "tess"
+        tess_dir.mkdir()
+        B.save(str(tess_dir / "OAF_back_angry.wav"), paddle.to_tensor(sig), 16000)
+        B.save(str(tess_dir / "YAF_dog_happy.wav"), paddle.to_tensor(sig), 16000)
+        ds = TESS(str(tess_dir))
+        assert len(ds) == 2
+        arr, label = ds[0]
+        assert arr.shape[0] == 1600 and label == TESS.EMOTIONS.index("angry")
+
+        esc_dir = tmp_path / "esc"
+        esc_dir.mkdir()
+        B.save(str(esc_dir / "1-100032-A-0.wav"), paddle.to_tensor(sig), 16000)
+        B.save(str(esc_dir / "5-9032-B-42.wav"), paddle.to_tensor(sig), 16000)
+        ds2 = ESC50(str(esc_dir))
+        assert len(ds2) == 2 and sorted(ds2.labels) == [0, 42]
+
+        import pytest as _pytest
+
+        with _pytest.raises(FileNotFoundError, match="not"):
+            TESS(str(tmp_path / "absent"))

@@ -120,3 +120,146 @@ class TestReviewRegressions:
         with pytest.raises(ValueError, match="reduce_op"):
             geometric.send_ue_recv(x, x, np.asarray([0, 1]), np.asarray([0, 1]),
                                    "add", "bogus")
+
+
+class TestGraphSamplingOps:
+    def _csc(self):
+        # graph: node 0 <- {1, 2, 3}; node 1 <- {0}; node 2 <- {}
+        row = np.array([1, 2, 3, 0], np.int64)     # in-neighbors, col-major
+        colptr = np.array([0, 3, 4, 4, 4], np.int64)
+        return row, colptr
+
+    def test_sample_neighbors_all_and_limited(self):
+        import paddle_tpu.geometric as G
+
+        row, colptr = self._csc()
+        paddle.seed(0)
+        nbrs, counts = G.sample_neighbors(paddle.to_tensor(row),
+                                          paddle.to_tensor(colptr),
+                                          paddle.to_tensor(np.array([0, 1], np.int64)))
+        assert np.asarray(counts._data).tolist() == [3, 1]
+        assert set(np.asarray(nbrs._data)[:3].tolist()) == {1, 2, 3}
+        nbrs2, counts2 = G.sample_neighbors(paddle.to_tensor(row),
+                                            paddle.to_tensor(colptr),
+                                            paddle.to_tensor(np.array([0], np.int64)),
+                                            sample_size=2)
+        assert np.asarray(counts2._data).tolist() == [2]
+        assert set(np.asarray(nbrs2._data).tolist()) <= {1, 2, 3}
+
+    def test_weighted_sampling_prefers_heavy_edges(self):
+        import paddle_tpu.geometric as G
+
+        row, colptr = self._csc()
+        w = np.array([100.0, 1.0, 1.0, 1.0], np.float64)  # edge to nbr 1 heavy
+        paddle.seed(1)
+        hits = 0
+        for _ in range(50):
+            nbrs, _ = G.weighted_sample_neighbors(
+                paddle.to_tensor(row), paddle.to_tensor(colptr),
+                paddle.to_tensor(w),
+                paddle.to_tensor(np.array([0], np.int64)), sample_size=1)
+            hits += int(np.asarray(nbrs._data)[0] == 1)
+        assert hits > 35  # ~98% expected
+
+    def test_reindex_graph(self):
+        import paddle_tpu.geometric as G
+
+        x = np.array([10, 20], np.int64)
+        neighbors = np.array([30, 10, 40, 20], np.int64)
+        count = np.array([2, 2], np.int64)
+        src, dst, out_nodes = G.reindex_graph(paddle.to_tensor(x),
+                                              paddle.to_tensor(neighbors),
+                                              paddle.to_tensor(count))
+        on = np.asarray(out_nodes._data)
+        assert on[:2].tolist() == [10, 20]           # input nodes first
+        assert set(on.tolist()) == {10, 20, 30, 40}
+        # src ids map back to the original neighbor ids
+        np.testing.assert_array_equal(on[np.asarray(src._data)], neighbors)
+        np.testing.assert_array_equal(np.asarray(dst._data), [0, 0, 1, 1])
+
+    def test_send_uv(self):
+        import paddle_tpu.geometric as G
+
+        x = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+        y = paddle.to_tensor(np.array([[10.0], [20.0]], np.float32))
+        out = G.send_uv(x, y, paddle.to_tensor(np.array([0, 1], np.int32)),
+                        paddle.to_tensor(np.array([1, 0], np.int32)),
+                        compute_type="add")
+        np.testing.assert_allclose(np.asarray(out._data), [[21.0], [12.0]])
+
+
+class TestTextDatasets:
+    def test_uci_housing(self, tmp_path):
+        from paddle_tpu.text import UCIHousing
+
+        rng = np.random.default_rng(0)
+        data = rng.normal(size=(50, 14)).astype(np.float32)
+        p = tmp_path / "housing.data"
+        np.savetxt(p, data)
+        tr = UCIHousing(str(p), mode="train")
+        te = UCIHousing(str(p), mode="test")
+        assert len(tr) == 40 and len(te) == 10
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_imdb_layout(self, tmp_path):
+        from paddle_tpu.text import Imdb
+
+        for sub, texts in (("pos", ["great movie loved it", "great fun"]),
+                           ("neg", ["terrible boring movie"])):
+            d = tmp_path / "train" / sub
+            d.mkdir(parents=True)
+            for i, t in enumerate(texts):
+                (d / f"{i}.txt").write_text(t)
+        ds = Imdb(str(tmp_path), mode="train", cutoff=1)
+        assert len(ds) == 3 and sorted(set(ds.labels)) == [0, 1]
+        ids, label = ds[0]
+        assert ids.dtype == np.int64 and label in (0, 1)
+
+    def test_imikolov_ngrams(self, tmp_path):
+        from paddle_tpu.text import Imikolov
+
+        p = tmp_path / "train.txt"
+        p.write_text("a b c d e f\n a b c\n")
+        ds = Imikolov(str(p), window_size=3, min_word_freq=1)
+        ctx, nxt = ds[0]
+        assert ctx.shape == (2,) and nxt.shape == (1,)
+        assert len(ds) == 4 + 1
+
+    def test_movielens_and_wmt(self, tmp_path):
+        from paddle_tpu.text import WMT16, Movielens
+
+        ml = tmp_path / "ml"
+        ml.mkdir()
+        (ml / "ratings.dat").write_text("1::10::4.0::99\n2::20::3.5::98\n"
+                                        "3::30::5.0::97\n")
+        ds = Movielens(str(ml), mode="train", test_ratio=0.34)
+        assert len(ds) == 2
+        u, m, r = ds[0]
+        assert isinstance(r, np.float32)
+
+        wmt = tmp_path / "wmt"
+        wmt.mkdir()
+        (wmt / "train.src").write_text("hello world\nhow are you\n")
+        (wmt / "train.trg").write_text("hallo welt\nwie geht es\n")
+        w = WMT16(str(wmt))
+        src, trg_in, trg_out = w[0]
+        assert src[0] == w.BOS and src[-1] == w.EOS
+        assert (trg_in[1:] == trg_out[:-1]).all()
+
+    def test_conll_and_missing_data_error(self, tmp_path):
+        from paddle_tpu.text import Conll05st, UCIHousing
+
+        d = tmp_path / "conll"
+        d.mkdir()
+        (d / "words").write_text("The\ncat\nsat\n\nDogs\nbark\n")
+        (d / "props").write_text("B-A0\nI-A0\nB-V\n\nB-A0\nB-V\n")
+        ds = Conll05st(str(d))
+        assert len(ds) == 2
+        toks, tags = ds[0]
+        assert toks.shape == (3,) and tags.shape == (3,)
+
+        import pytest as _pytest
+
+        with _pytest.raises(FileNotFoundError, match="not"):
+            UCIHousing(str(tmp_path / "nope.data"))

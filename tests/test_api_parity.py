@@ -255,6 +255,8 @@ SUBMODULE_ABSENT = {
     ("linalg.py", "linalg"), ("signal.py", "signal"),
     ("audio/__init__.py", "audio"), ("text/__init__.py", "text"),
     ("geometric/__init__.py", "geometric"),
+    ("optimizer/__init__.py", "optimizer"), ("optimizer/lr.py", "optimizer.lr"),
+    ("incubate/__init__.py", "incubate"),
 ])
 def test_submodule_all_parity(mod, attr):
     path = os.path.join(os.path.dirname(REF_INIT), mod)

@@ -224,3 +224,58 @@ def test_dgc_rampup_step_validation():
     x = paddle.to_tensor(np.ones(4, np.float32)); x.stop_gradient = False
     with pytest.raises(ValueError, match="rampup_step"):
         DGCMomentum(sparsity=(0.75, 0.9, 0.99), rampup_step=1, parameters=[x])
+
+
+class TestRpropLBFGS:
+    def test_rprop_converges_and_adapts_steps(self):
+        x = paddle.to_tensor(np.asarray([4.0, -3.0], np.float32))
+        x.stop_gradient = False
+        opt = paddle.optimizer.Rprop(learning_rate=0.1, parameters=[x])
+        for _ in range(60):
+            loss = (x * x).sum()
+            loss.backward(); opt.step(); opt.clear_grad()
+        assert float((x * x).sum().numpy()) < 1e-2
+
+    def test_lbfgs_quadratic_few_closures(self):
+        rng = np.random.default_rng(0)
+        A = rng.normal(size=(6, 6)).astype(np.float32)
+        A = A @ A.T + 6 * np.eye(6, dtype=np.float32)
+        b = rng.normal(size=(6,)).astype(np.float32)
+        x = paddle.to_tensor(np.zeros(6, np.float32)); x.stop_gradient = False
+        At, bt = paddle.to_tensor(A), paddle.to_tensor(b)
+        opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=25,
+                                     parameters=[x])
+
+        def closure():
+            opt.clear_grad()
+            loss = 0.5 * (x @ (At @ x)) - bt @ x
+            loss.backward()
+            return loss
+
+        opt.step(closure)
+        sol = np.linalg.solve(A, b)
+        np.testing.assert_allclose(np.asarray(x._data), sol, rtol=1e-2, atol=1e-2)
+
+
+def test_incubate_top_level_names():
+    import paddle_tpu.incubate as inc
+
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .normal(size=(2, 4, 4)).astype(np.float32))
+    s = inc.softmax_mask_fuse_upper_triangle(x)
+    arr = np.asarray(s._data)
+    assert np.allclose(arr.sum(-1), 1.0, atol=1e-5)
+    assert np.allclose(np.triu(arr[0], 1), 0.0, atol=1e-6)  # causal
+    m = paddle.to_tensor(np.zeros((2, 4, 4), np.float32))
+    np.testing.assert_allclose(np.asarray(inc.softmax_mask_fuse(x, m)._data)
+                               .sum(-1), 1.0, atol=1e-5)
+    assert float(inc.identity_loss(x, "sum").numpy()) == pytest.approx(
+        float(np.asarray(x._data).sum()), rel=1e-6)
+
+    # khop sampler over a chain graph 0<-1<-2
+    row = paddle.to_tensor(np.array([1, 2], np.int64))
+    colptr = paddle.to_tensor(np.array([0, 1, 2, 2], np.int64))
+    src, dst, nodes = inc.graph_khop_sampler(
+        row, colptr, paddle.to_tensor(np.array([0], np.int64)), [1, 1])
+    n = np.asarray(nodes._data)
+    assert n[0] == 0 and set(n.tolist()) == {0, 1, 2}

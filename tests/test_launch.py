@@ -158,10 +158,12 @@ class TestElasticNodeDeath:
                                   stderr=subprocess.STDOUT, text=True, env=env)
                  for _ in range(2)]
         try:
-            time.sleep(8)  # both rendezvoused, trainers up, heartbeats running
+            # under a loaded machine rendezvous+spawn can be slow; give the
+            # launchers a generous warmup before the kill
+            time.sleep(20)
             assert procs[0].poll() is None and procs[1].poll() is None
             procs[1].kill()  # node 1 dies (heartbeat stops)
-            out0, _ = procs[0].communicate(timeout=120)
+            out0, _ = procs[0].communicate(timeout=240)
             from paddle_tpu.distributed.launch import ELASTIC_EXIT_CODE
             assert procs[0].returncode == ELASTIC_EXIT_CODE, \
                 (procs[0].returncode, out0[-2000:])

@@ -1,3 +1,73 @@
 """Utilities (reference ``paddle/utils``): alignment harness etc."""
 
 from . import align  # noqa: F401
+
+# -- reference paddle.utils surface -----------------------------------------
+
+import functools as _functools
+import importlib as _importlib
+import warnings as _warnings
+
+__all__ = ["deprecated", "require_version", "run_check", "try_import",
+           "dlpack", "unique_name"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 0):
+    """Decorator marking an API deprecated (reference
+    ``utils/deprecated.py``): warns once per call site; level>=2 raises."""
+
+    def decorate(fn):
+        msg = (f"API '{fn.__module__}.{fn.__name__}' is deprecated"
+               + (f" since {since}" if since else "")
+               + (f", use '{update_to}' instead" if update_to else "")
+               + (f". Reason: {reason}" if reason else "."))
+
+        @_functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level >= 2:
+                raise RuntimeError(msg)
+            _warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        wrapper.__doc__ = (f"(DEPRECATED) {msg}\n\n" + (fn.__doc__ or ""))
+        return wrapper
+
+    return decorate
+
+
+def try_import(module_name: str, err_msg: str = None):
+    """Import a soft dependency with a helpful error (reference
+    ``utils/lazy_import.py``)."""
+    try:
+        return _importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or (
+            f"Optional dependency {module_name!r} is required for this "
+            "feature but is not installed (installs are disabled in this "
+            "environment)"))
+
+
+def require_version(min_version: str, max_version: str = None) -> bool:
+    """Check the framework version satisfies a range (reference
+    ``utils/__init__`` require_version).  This framework tracks the
+    reference's capability set rather than its version numbers, so any
+    sane range check passes."""
+    return True
+
+
+def run_check():
+    """Sanity-check the install: run one tiny jit on the default backend
+    (reference ``utils/install_check.py`` run_check)."""
+    import jax
+    import jax.numpy as jnp
+
+    out = jax.jit(lambda a: (a @ a).sum())(jnp.eye(8))
+    backend = jax.default_backend()
+    assert float(out) == 8.0
+    print(f"paddle_tpu is installed successfully! backend={backend}, "
+          f"devices={jax.device_count()}")
+
+
+from . import dlpack  # noqa: E402,F401
+from . import unique_name  # noqa: E402,F401

@@ -1,0 +1,35 @@
+"""``paddle.utils.dlpack`` — zero-copy tensor interchange.
+
+Counterpart of the reference's ``utils/dlpack.py`` (to_dlpack/from_dlpack
+over the DLPack protocol).  Rides jax's dlpack support, so CPU tensors
+exchange zero-copy with torch/numpy and device tensors with anything
+speaking DLPack.
+"""
+
+from __future__ import annotations
+
+from ..framework.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack-protocol object.
+
+    Newer jax dropped the explicit capsule maker: jax Arrays implement
+    ``__dlpack__``/``__dlpack_device__`` themselves, which is what every
+    modern consumer (torch.from_dlpack, np.from_dlpack) accepts; fall back
+    to the raw capsule on older jax."""
+    import jax
+
+    arr = x._data if isinstance(x, Tensor) else jax.numpy.asarray(x)
+    if hasattr(jax.dlpack, "to_dlpack"):
+        return jax.dlpack.to_dlpack(arr)
+    return arr  # carries __dlpack__ / __dlpack_device__
+
+
+def from_dlpack(capsule_or_ext) -> Tensor:
+    """DLPack capsule (or any object with ``__dlpack__``) -> Tensor."""
+    import jax
+
+    return Tensor(jax.dlpack.from_dlpack(capsule_or_ext))

@@ -1,0 +1,34 @@
+"""``paddle.utils.unique_name`` — prefix-counted name generation (reference
+``base/unique_name.py``: generate/guard/switch)."""
+
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+__all__ = ["generate", "guard", "switch"]
+
+_counters = defaultdict(int)
+
+
+def generate(key: str) -> str:
+    n = _counters[key]
+    _counters[key] += 1
+    return f"{key}_{n}"
+
+
+def switch(new_generator=None):
+    """Swap the counter table; returns the old one."""
+    global _counters
+    old = _counters
+    _counters = new_generator if new_generator is not None else defaultdict(int)
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(defaultdict(int) if new_generator is None else new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -256,7 +256,7 @@ SUBMODULE_ABSENT = {
     ("audio/__init__.py", "audio"), ("text/__init__.py", "text"),
     ("geometric/__init__.py", "geometric"),
     ("optimizer/__init__.py", "optimizer"), ("optimizer/lr.py", "optimizer.lr"),
-    ("incubate/__init__.py", "incubate"),
+    ("incubate/__init__.py", "incubate"), ("utils/__init__.py", "utils"),
 ])
 def test_submodule_all_parity(mod, attr):
     path = os.path.join(os.path.dirname(REF_INIT), mod)

@@ -1,0 +1,55 @@
+"""paddle.utils: dlpack interop (vs torch), unique_name, deprecated,
+try_import, run_check (reference ``python/paddle/utils``)."""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import dlpack, unique_name
+
+
+class TestDlpack:
+    def test_torch_to_paddle_zero_copyish(self):
+        t = torch.arange(12, dtype=torch.float32).reshape(3, 4)
+        pt = dlpack.from_dlpack(t)
+        np.testing.assert_array_equal(np.asarray(pt._data), t.numpy())
+
+    def test_paddle_to_torch_roundtrip(self):
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .normal(size=(4, 5)).astype(np.float32))
+        back = torch.utils.dlpack.from_dlpack(dlpack.to_dlpack(x))
+        np.testing.assert_array_equal(back.numpy(), np.asarray(x._data))
+
+    def test_numpy_consumer(self):
+        x = paddle.to_tensor(np.arange(5, dtype=np.float32))
+        arr = np.from_dlpack(dlpack.to_dlpack(x))
+        np.testing.assert_array_equal(arr, np.arange(5, dtype=np.float32))
+
+
+class TestUniqueName:
+    def test_generate_and_guard(self):
+        with unique_name.guard():
+            assert unique_name.generate("w") == "w_0"
+            assert unique_name.generate("w") == "w_1"
+            assert unique_name.generate("b") == "b_0"
+        with unique_name.guard():
+            assert unique_name.generate("w") == "w_0"  # fresh scope
+
+
+def test_deprecated_and_try_import_and_run_check(capsys):
+    from paddle_tpu.utils import deprecated, run_check, try_import
+
+    @deprecated(update_to="paddle.new_api", since="2.0")
+    def old_api(v):
+        return v + 1
+
+    with pytest.warns(DeprecationWarning, match="new_api"):
+        assert old_api(1) == 2
+
+    with pytest.raises(ImportError, match="not installed"):
+        try_import("definitely_not_a_module_xyz")
+    assert try_import("math").sqrt(4) == 2.0
+
+    run_check()
+    assert "successfully" in capsys.readouterr().out

@@ -72,6 +72,9 @@ from . import text  # noqa: F401
 from . import audio  # noqa: F401
 from . import utils  # noqa: F401
 from . import onnx  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import hub  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model, summary, flops  # noqa: F401
 from . import linalg as _linalg_ns  # noqa: F401

@@ -21,7 +21,7 @@ from ..framework.autograd import (  # noqa: F401
 from ..framework.dispatch import unwrap, wrap
 from ..framework.tensor import Tensor
 
-__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled", "PyLayer", "PyLayerContext", "saved_tensors_hooks"]
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled", "PyLayer", "PyLayerContext", "saved_tensors_hooks", "jacobian", "hessian"]
 
 
 class PyLayerContext:
@@ -138,3 +138,61 @@ class saved_tensors_hooks:
 
     def __exit__(self, *exc):
         return False
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Full Jacobian d(ys)/d(xs) (reference ``autograd/autograd.py``
+    ``jacobian``): accepts a Tensor output and input (or lists), computed
+    with jax.jacrev over the recorded tape function is not possible — so it
+    takes CALLABLE-FREE form: differentiate ys w.r.t. xs through the eager
+    tape by replaying per-output-row backward passes.
+
+    For the functional form (recommended on TPU), pass a callable as ``ys``:
+    ``jacobian(fn, x)`` -> jax.jacrev-style full Jacobian as a Tensor.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.tensor import Tensor
+
+    if callable(ys):
+        fn = ys
+        x = xs._data if isinstance(xs, Tensor) else jnp.asarray(xs)
+
+        def raw_fn(v):
+            out = fn(Tensor(v))
+            return out._data if isinstance(out, Tensor) else out
+
+        return Tensor(jax.jacrev(raw_fn)(x))
+    # tape form: one backward per scalar output
+    out_flat = ys.reshape([-1])
+    rows = []
+    n = out_flat.shape[0]
+    for i in range(n):
+        if xs._grad is not None:
+            xs.clear_grad()
+        out_flat[i].backward(retain_graph=True)
+        g = xs.grad
+        rows.append(jnp.asarray(g._data if isinstance(g, Tensor) else g).reshape(-1))
+        xs.clear_grad()
+    import jax.numpy as jnp2
+
+    return Tensor(jnp2.stack(rows).reshape(tuple(ys.shape) + tuple(xs.shape)))
+
+
+def hessian(func, xs, batch_axis=None):
+    """Hessian of a scalar function (reference ``autograd`` ``hessian``):
+    ``hessian(fn, x)`` with fn returning a scalar Tensor."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.tensor import Tensor
+
+    x = xs._data if isinstance(xs, Tensor) else jnp.asarray(xs)
+
+    def raw_fn(v):
+        out = func(Tensor(v))
+        o = out._data if isinstance(out, Tensor) else out
+        return o.reshape(())
+
+    return Tensor(jax.hessian(raw_fn)(x))

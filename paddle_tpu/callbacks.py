@@ -1,0 +1,15 @@
+"""``paddle.callbacks`` namespace (reference ``python/paddle/callbacks.py``
+re-exporting the hapi callbacks)."""
+
+from .hapi.callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRSchedulerCallback as LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+)
+from .hapi.callbacks import ReduceLROnPlateau, VisualDL, WandbCallback  # noqa: F401
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
+           "LRScheduler", "EarlyStopping", "ReduceLROnPlateau",
+           "WandbCallback"]

@@ -167,3 +167,95 @@ class LRSchedulerCallback(Callback):
     def on_epoch_end(self, epoch, logs=None):
         if not self.by_step and (s := self._sched()) is not None:
             s.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer LR when a monitored metric plateaus (reference
+    ``callbacks.py`` ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="min", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.mode = mode
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.verbose = verbose
+        self._best = None
+        self._wait = 0
+        self._cool = 0
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        val = logs.get(self.monitor)
+        if val is None:
+            return
+        val = float(val[0] if isinstance(val, (list, tuple)) else val)
+        better = (self._best is None
+                  or (self.mode == "min" and val < self._best - self.min_delta)
+                  or (self.mode == "max" and val > self._best + self.min_delta))
+        if better:
+            self._best = val
+            self._wait = 0
+            return
+        if self._cool > 0:
+            self._cool -= 1
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                new_lr = max(float(opt.get_lr()) * self.factor, self.min_lr)
+                opt.set_lr(new_lr)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr -> {new_lr:.3e}")
+            self._wait = 0
+            self._cool = self.cooldown
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference VisualDL callback).  The visualdl
+    wheel is unavailable here; scalars land in a JSONL file under
+    ``log_dir`` readable by any dashboard."""
+
+    def __init__(self, log_dir="./vdl_log"):
+        import os
+
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._step = 0
+
+    def _write(self, tag, logs):
+        import json
+        import os
+
+        logs = logs or {}
+        path = os.path.join(self.log_dir, "scalars.jsonl")
+        with open(path, "a") as f:
+            for k, v in logs.items():
+                try:
+                    val = float(v[0] if isinstance(v, (list, tuple)) else v)
+                except (TypeError, ValueError):
+                    continue
+                f.write(json.dumps({"tag": f"{tag}/{k}", "step": self._step,
+                                    "value": val}) + "\n")
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._step = epoch
+        self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logging (reference WandbCallback): requires the wandb
+    wheel, which is not installed here — constructing raises with guidance."""
+
+    def __init__(self, *args, **kwargs):
+        from ..utils import try_import
+
+        try_import("wandb", "WandbCallback needs the wandb package, which is "
+                            "not installed in this environment")

@@ -1,0 +1,57 @@
+"""``paddle.hub`` — load models from a hubconf-carrying source.
+
+Counterpart of the reference's ``python/paddle/hub.py`` (github/gitee/local
+sources).  Zero-egress environment: ``source='local'`` is fully functional
+(imports ``hubconf.py`` from a directory, reference layout); remote sources
+raise with guidance.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_builtin_list = list
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise NotImplementedError(
+            f"hub source {source!r} needs network access, which this "
+            "environment does not have; clone the repo and use "
+            "source='local' with its directory")
+
+
+def list(repo_dir: str, source: str = "github", force_reload: bool = False):
+    """Entrypoint names exposed by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False):
+    """The entrypoint's docstring."""
+    _check_source(source)
+    return getattr(_load_hubconf(repo_dir), model).__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    """Call the entrypoint with kwargs and return the model."""
+    _check_source(source)
+    return getattr(_load_hubconf(repo_dir), model)(**kwargs)

@@ -18,7 +18,7 @@ import numpy as np
 from ..framework.tensor import Tensor
 from ..jit import load as _jit_load
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+__all__ = ["Config", "Predictor", "create_predictor", "DataType", "PlaceType", "PrecisionType", "PredictorPool", "get_num_bytes_of_data_type", "get_version", "get_trt_compile_version", "get_trt_runtime_version", "convert_to_mixed_precision"]
 
 
 class Config:
@@ -108,3 +108,114 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+# -- reference auxiliary surface --------------------------------------------
+
+class DataType:
+    """Reference ``paddle.inference.DataType`` enum values."""
+
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    BFLOAT16 = "bfloat16"
+    INT8 = "int8"
+    INT32 = "int32"
+    INT64 = "int64"
+    UINT8 = "uint8"
+    BOOL = "bool"
+
+
+class PlaceType:
+    """Reference ``PlaceType``: where a bound tensor lives.  TPU plays the
+    accelerator role; kCPU covers the host fallback."""
+
+    kUNK = -1
+    kCPU = 0
+    kGPU = 1
+    kXPU = 2
+    kNPU = 3
+    kCUSTOM = 4
+    kTPU = 5
+
+
+class PrecisionType:
+    """Reference ``PrecisionType`` (TensorRT precisions there): the serving
+    dtypes the AOT artifact was exported with."""
+
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    import numpy as np
+
+    name = dtype if isinstance(dtype, str) else str(dtype)
+    if name in ("bfloat16", "float16"):
+        return 2
+    return np.dtype(name).itemsize
+
+
+def get_version() -> str:
+    """Inference library version string (reference ``get_version``)."""
+    import jax
+
+    return f"paddle_tpu-inference (jax {jax.__version__}, AOT via jax.export)"
+
+
+def get_trt_compile_version():
+    raise NotImplementedError(
+        "TensorRT is CUDA serving infrastructure; the TPU serving path is "
+        "the jax.export AOT artifact + Predictor")
+
+
+def get_trt_runtime_version():
+    get_trt_compile_version()
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """Convert a saved inference artifact's weights to a mixed-precision
+    dtype (reference ``convert_to_mixed_precision``): loads the
+    ``jit.save`` params, casts floating weights, re-saves."""
+    import numpy as np
+
+    from ..framework.io import load as _load
+    from ..framework.io import save as _save
+
+    params = _load(params_file)
+    tgt = {None: np.float16, PrecisionType.Half: np.float16,
+           "float16": np.float16, "bfloat16": "bfloat16",
+           PrecisionType.Bfloat16: "bfloat16"}.get(mixed_precision, np.float16)
+    block = set(black_list or [])
+    out = {}
+    for k, v in params.items():
+        arr = np.asarray(v._data if hasattr(v, "_data") else v)
+        if k not in block and np.issubdtype(arr.dtype, np.floating):
+            arr = arr.astype(tgt)
+        out[k] = arr
+    _save(out, mixed_params_file)
+    # the program artifact is dtype-agnostic at the interface; copy it over
+    import shutil
+
+    if model_file != mixed_model_file:
+        shutil.copy(model_file, mixed_model_file)
+    return mixed_params_file
+
+
+class PredictorPool:
+    """A pool of Predictors over one Config (reference ``PredictorPool`` —
+    multi-stream serving; here each member is an independent callable over
+    the shared AOT artifact)."""
+
+    def __init__(self, config, size: int = 1):
+        self._predictors = [Predictor(config) for _ in range(int(size))]
+
+    def retrieve(self, idx: int):
+        return self._predictors[idx]
+
+    def __len__(self):
+        return len(self._predictors)

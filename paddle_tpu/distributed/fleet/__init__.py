@@ -28,7 +28,9 @@ from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode
 __all__ = ["init", "DistributedStrategy", "get_hybrid_communicate_group", "fleet",
            "distributed_model", "distributed_optimizer", "HybridParallelOptimizer",
            "HybridCommunicateGroup", "CommunicateTopology", "ParallelMode", "recompute",
-           "CheckpointManager", "ElasticManager", "ELASTIC_EXIT_CODE"]
+           "CheckpointManager", "ElasticManager", "ELASTIC_EXIT_CODE",
+           "Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker", "UtilBase",
+           "MultiSlotDataGenerator", "MultiSlotStringDataGenerator"]
 
 
 class DistributedStrategy:
@@ -230,3 +232,105 @@ def distributed_optimizer(optimizer, strategy=None):
     """Wrap the optimizer with hybrid-parallel grad sync (see
     :class:`HybridParallelOptimizer`)."""
     return HybridParallelOptimizer(optimizer, get_hybrid_communicate_group())
+
+
+# -- reference role-maker / util surface ------------------------------------
+
+class Role:
+    """Role constants (reference ``fleet/base/role_maker.py``): collective
+    training has only WORKER; SERVER belongs to the PS stack (out of scope)."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """Role maker reading the launcher env (reference
+    ``PaddleCloudRoleMaker``): rank/world from PADDLE_TRAINER_* (the env
+    contract ``distributed.launch`` writes)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        import os
+
+        self._is_collective = is_collective
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    def worker_index(self) -> int:
+        return self._rank
+
+    def worker_num(self) -> int:
+        return self._size
+
+    def is_worker(self) -> bool:
+        return True
+
+    def is_server(self) -> bool:
+        return False  # PS servers are out of TPU scope
+
+    def is_first_worker(self) -> bool:
+        return self._rank == 0
+
+    def role(self):
+        return Role.WORKER
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit rank/world (reference ``UserDefinedRoleMaker``)."""
+
+    def __init__(self, is_collective=True, current_id=0, worker_num=1,
+                 role=Role.WORKER, **kwargs):
+        self._is_collective = is_collective
+        self._rank = int(current_id)
+        self._size = int(worker_num)
+
+
+class UtilBase:
+    """Cross-worker utilities (reference ``fleet/base/util_factory.py``):
+    host collectives + filesystem helpers."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        return metrics.sum(input) if mode == "sum" else (
+            metrics.max(input) if mode == "max" else metrics.min(input))
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective as _coll
+
+        _coll.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        from .. import collective as _coll
+
+        out = [None] * _coll.get_world_size()
+        _coll.all_gather_object(out, input)
+        return out
+
+    def get_file_shard(self, files):
+        """Split a file list evenly over workers (reference
+        ``UtilBase.get_file_shard``)."""
+        import os
+
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        return [f for i, f in enumerate(sorted(files)) if i % size == rank]
+
+    def print_on_rank(self, message, rank_id=0):
+        import os
+
+        if int(os.environ.get("PADDLE_TRAINER_ID", "0")) == rank_id:
+            print(message)
+
+
+class MultiSlotDataGenerator:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "MultiSlotDataGenerator feeds the parameter-server dataset "
+            "pipeline (out of TPU scope; SURVEY §2.5 item 12) — use "
+            "paddle.io.DataLoader")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    pass

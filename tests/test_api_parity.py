@@ -355,3 +355,28 @@ def test_static_ema_and_callbacks(tmp_path):
 
     lines = open(tmp_path / "scalars.jsonl").read().strip().splitlines()
     assert json.loads(lines[0])["value"] == 1.5
+
+
+def test_fleet_namespace_parity():
+    _parity_check("distributed/fleet/__init__.py", "distributed.fleet")
+
+
+def test_role_maker_and_util():
+    import os
+
+    from paddle_tpu.distributed import fleet
+
+    rm = fleet.UserDefinedRoleMaker(current_id=2, worker_num=4)
+    assert rm.worker_index() == 2 and rm.worker_num() == 4
+    assert rm.is_worker() and not rm.is_server() and not rm.is_first_worker()
+
+    util = fleet.UtilBase()
+    os.environ["PADDLE_TRAINER_ID"] = "1"
+    os.environ["PADDLE_TRAINERS_NUM"] = "2"
+    try:
+        shard = util.get_file_shard(["a", "b", "c", "d"])
+        assert shard == ["b", "d"]
+    finally:
+        os.environ["PADDLE_TRAINER_ID"] = "0"
+        os.environ["PADDLE_TRAINERS_NUM"] = "1"
+    assert float(util.all_reduce(3.0)) == 3.0  # single-process identity

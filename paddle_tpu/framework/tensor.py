@@ -342,3 +342,27 @@ class Parameter(Tensor):
 def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
     """``paddle.to_tensor`` equivalent."""
     return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def _install_device_methods():
+    """paddle.Tensor device-surface methods the reference exposes: ``cuda``
+    maps to the accelerator (PJRT default device), ``ndimension`` aliases
+    ``dim``."""
+
+    def cuda(self, device_id=None, blocking=True):
+        import jax
+
+        devs = jax.devices()
+        target = devs[device_id or 0]
+        return Tensor(jax.device_put(self._data, target))
+
+    def ndimension(self):
+        return self._data.ndim
+
+    if not hasattr(Tensor, "cuda"):
+        Tensor.cuda = cuda
+    if not hasattr(Tensor, "ndimension"):
+        Tensor.ndimension = ndimension
+
+
+_install_device_methods()

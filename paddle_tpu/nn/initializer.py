@@ -19,6 +19,7 @@ __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
     "Assign", "Orthogonal", "Dirac", "calculate_gain",
+    "Bilinear", "set_global_initializer",
 ]
 
 
@@ -195,3 +196,43 @@ class Dirac(Initializer):
             for k in range(min(o // self.groups, i)):
                 out[(g * (o // self.groups) + k, k) + spatial_center] = 1.0
         return jnp.asarray(out, dtype=dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel initializer for transposed convs
+    (reference ``nn/initializer/Bilinear``): weight [C_out, C_in, kh, kw]
+    filled with the bilinear interpolation kernel."""
+
+    def __call__(self, shape, dtype):
+        shape = list(shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D conv weight")
+        kh, kw = shape[2], shape[3]
+        f_h = (kh + 1) // 2
+        f_w = (kw + 1) // 2
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        yy, xx = np.meshgrid(np.arange(kh), np.arange(kw), indexing="ij")
+        kernel = ((1 - np.abs(yy / f_h - c_h)) *
+                  (1 - np.abs(xx / f_w - c_w))).astype(np.float32)
+        w = np.zeros(shape, np.float32)
+        for o in range(shape[0]):
+            for i in range(shape[1]):
+                w[o, i] = kernel
+        return jnp.asarray(w, dtype)
+
+
+_GLOBAL_WEIGHT_INIT = None
+_GLOBAL_BIAS_INIT = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Default initializers for subsequently created parameters (reference
+    ``set_global_initializer``); pass None to reset."""
+    global _GLOBAL_WEIGHT_INIT, _GLOBAL_BIAS_INIT
+    _GLOBAL_WEIGHT_INIT = weight_init
+    _GLOBAL_BIAS_INIT = bias_init
+
+
+def _global_initializer(is_bias: bool):
+    return _GLOBAL_BIAS_INIT if is_bias else _GLOBAL_WEIGHT_INIT

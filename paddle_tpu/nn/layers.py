@@ -94,9 +94,15 @@ class Layer:
         default_initializer: Optional[Initializer] = None,
     ) -> Parameter:
         dtype = dtype or self._dtype or get_default_dtype()
-        init = default_initializer
+        # precedence (reference set_global_initializer semantics): an
+        # explicit ParamAttr initializer wins; otherwise the global override
+        # beats the layer's own default
         if attr is not None and getattr(attr, "initializer", None) is not None:
             init = attr.initializer
+        else:
+            from .initializer import _global_initializer
+
+            init = _global_initializer(is_bias) or default_initializer
         if init is None:
             init = Constant(0.0) if is_bias else XavierUniform()
         data = init(shape, convert_dtype(dtype))

@@ -305,6 +305,7 @@ def _parity_check(mod, attr, absent=frozenset()):
     ("callbacks.py", "callbacks"), ("hub.py", "hub"),
     ("regularizer.py", "regularizer"),
     ("inference/__init__.py", "inference"),
+    ("nn/initializer/__init__.py", "nn.initializer"),
 ])
 def test_namespace_parity_round2(mod, attr):
     _parity_check(mod, attr, SUBMODULE_ABSENT.get(mod, set()))

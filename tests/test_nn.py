@@ -276,3 +276,35 @@ def test_hooks():
     h.remove()
     l(paddle.randn([1, 4]))
     assert calls == [1]
+
+
+def test_bilinear_initializer_and_global_override():
+    import numpy as np
+
+    from paddle_tpu.nn import initializer as I
+
+    w = np.asarray(I.Bilinear()([1, 1, 4, 4], "float32"))
+    # symmetric bilinear kernel, peak in the center block
+    np.testing.assert_allclose(w[0, 0], w[0, 0][::-1, ::-1], rtol=1e-6)
+    assert w[0, 0, 1:3, 1:3].min() > w[0, 0, 0, 0]
+
+    I.set_global_initializer(I.Constant(0.5), I.Constant(0.1))
+    try:
+        lin = nn.Linear(3, 2)
+        assert np.allclose(np.asarray(lin.weight._data), 0.5)
+        assert np.allclose(np.asarray(lin.bias._data), 0.1)
+        lin2 = nn.Linear(3, 2,
+                         weight_attr=paddle.ParamAttr(initializer=I.Constant(9.0)))
+        assert np.allclose(np.asarray(lin2.weight._data), 9.0)  # attr wins
+    finally:
+        I.set_global_initializer(None)
+    assert not np.allclose(np.asarray(nn.Linear(3, 2).weight._data), 0.5)
+
+
+def test_tensor_device_methods():
+    import numpy as np
+
+    t = paddle.to_tensor(np.ones((2, 3), np.float32))
+    assert t.ndimension() == 2
+    c = t.cuda()  # maps to the accelerator/default device here
+    assert c.shape == [2, 3]

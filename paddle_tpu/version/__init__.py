@@ -1,0 +1,60 @@
+"""``paddle.version`` (reference ``python/paddle/version.py`` — generated at
+build time there; static here, with the accelerator-stack versions that
+actually matter on this backend)."""
+
+full_version = "3.0.0-tpu"
+major = "3"
+minor = "0"
+patch = "0"
+rc = "0"
+
+commit = "tpu-native"
+with_pip_cuda_libraries = "OFF"
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "show", "commit",
+           "cuda", "cudnn", "nccl", "xpu", "cinn", "tensorrt", "jax_version"]
+
+
+def jax_version() -> str:
+    import jax
+
+    return jax.__version__
+
+
+def cuda() -> str:
+    """The reference reports the CUDA toolkit; this backend has none."""
+    return "False"
+
+
+def cudnn() -> str:
+    return "False"
+
+
+def nccl() -> str:
+    """Collectives ride XLA/PJRT, not NCCL."""
+    return "False"
+
+
+def xpu() -> str:
+    return "False"
+
+
+def cinn() -> str:
+    """The fusion compiler role is played by XLA."""
+    return "False"
+
+
+def tensorrt() -> str:
+    return "False"
+
+
+def show() -> None:
+    import jax
+
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print(f"jax: {jax.__version__}")
+    try:
+        print(f"backend: {jax.default_backend()}")
+    except Exception:
+        print("backend: <uninitialized>")

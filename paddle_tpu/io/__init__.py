@@ -324,13 +324,8 @@ class DataLoader:
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
         self.shm_slot_bytes = shm_slot_bytes
-        if persistent_workers and num_workers > 0:
-            import warnings
-
-            warnings.warn(
-                "DataLoader: persistent_workers is accepted for API parity "
-                "but shm workers respawn per epoch in this implementation "
-                "(the per-epoch batch plan is shipped at spawn)")
+        self.persistent_workers = bool(persistent_workers)
+        self._shm_pool = None
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_size = batch_size
@@ -396,24 +391,56 @@ class DataLoader:
         batches = list(self.batch_sampler)  # sampling order fixed pre-spawn
         custom = self._custom_collate
 
-        pool = ShmWorkerPool(
-            self.dataset, batches,
-            collate=None if custom is not None else _np_collate,
-            num_workers=self.num_workers,
-            slots=max(self.prefetch_factor, 2), slot_bytes=self.shm_slot_bytes,
-            worker_init_fn=self.worker_init_fn,
-            timeout=self.timeout)  # 0 = no stall limit (reference semantics)
-        # pool construction above runs EAGERLY (it may raise PicklingError,
-        # which __iter__ turns into the thread-path fallback); only the
-        # consumption below is lazy
+        persistent = self.persistent_workers
+
+        def build_pool(plan):
+            # one construction path for both modes; timeout 0 = no stall
+            # limit (reference semantics)
+            return ShmWorkerPool(
+                self.dataset, plan,
+                collate=None if custom is not None else _np_collate,
+                num_workers=self.num_workers,
+                slots=max(self.prefetch_factor, 2),
+                slot_bytes=self.shm_slot_bytes,
+                worker_init_fn=self.worker_init_fn,
+                timeout=self.timeout, persistent=persistent)
+
+        if persistent:
+            # reference persistent_workers: spawn ONCE, ship per-epoch batch
+            # plans over a control channel
+            if self._shm_pool is None:
+                self._shm_pool = build_pool(None)
+            pool = self._shm_pool
+            pool.submit_epoch(batches)
+        else:
+            # construction runs EAGERLY (it may raise PicklingError, which
+            # __iter__ turns into the thread-path fallback); only the
+            # consumption below is lazy
+            pool = build_pool(batches)
+
         def consume():
             try:
                 for obj in pool:
                     yield _tensorize(obj) if custom is None else custom(obj)
+            except BaseException:
+                if persistent:
+                    # a dead/stalled pool must not be reused next epoch
+                    self._shm_pool = None
+                    pool.shutdown()
+                raise
             finally:
-                pool.shutdown()
+                if not persistent:
+                    pool.shutdown()
 
         return consume()
+
+    def __del__(self):
+        pool = getattr(self, "_shm_pool", None)
+        if pool is not None:
+            try:
+                pool.shutdown()
+            except Exception:
+                pass
 
     def __iter__(self):
         if self.num_workers == 0:

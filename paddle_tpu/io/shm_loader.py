@@ -39,22 +39,26 @@ def available() -> bool:
     return native.load() is not None
 
 
-def _serialize(obj) -> bytes:
-    """Frame = u32 body_len | pickle5 body | u32 nbufs | (u64 len | bytes)*.
+def _serialize(obj, prefix: bytes = b"") -> bytearray:
+    """Frame = [prefix] u32 body_len | pickle5 body | u32 nbufs |
+    (u64 len | bytes)*.
 
     Array bodies travel as out-of-band PickleBuffers copied ONCE into the
     preallocated frame (the channel then copies frame -> shm -> trainer:
     three bulk copies total, vs pickle-over-pipe's pickle + chunked writes +
-    reads)."""
+    reads).  ``prefix`` (e.g. the persistent-mode epoch tag) is packed into
+    the same frame — no extra whole-frame copy."""
     bufs: List[pickle.PickleBuffer] = []
     body = pickle.dumps(obj, protocol=5, buffer_callback=bufs.append)
     raws = [b.raw() for b in bufs]  # contiguous by PEP 574 contract
-    total = 4 + len(body) + 4 + sum(8 + r.nbytes for r in raws)
+    p = len(prefix)
+    total = p + 4 + len(body) + 4 + sum(8 + r.nbytes for r in raws)
     frame = bytearray(total)
     mv = memoryview(frame)
-    struct.pack_into("<I", frame, 0, len(body))
-    mv[4:4 + len(body)] = body
-    off = 4 + len(body)
+    mv[0:p] = prefix
+    struct.pack_into("<I", frame, p, len(body))
+    mv[p + 4:p + 4 + len(body)] = body
+    off = p + 4 + len(body)
     struct.pack_into("<I", frame, off, len(raws))
     off += 4
     for r in raws:
@@ -151,26 +155,80 @@ class _Channel:
             self._h = None
 
 
-def _worker_main(channel_name: str, spec_bytes: bytes):
+def _ctrl_has_pending(ctrl) -> bool:
+    """True when the control channel holds an unread record (a newer epoch
+    plan): producers abandon the current epoch instead of finishing it."""
+    return ctrl._lib.ptc_next_len(ctrl._h) > 0
+
+
+def _worker_main(channel_name: str, spec_bytes: bytes, control_name=None):
     """Spawned worker entry (module-level so 'spawn' can import it: forking a
     JAX-threaded parent risks deadlock on inherited locks, so workers are
     FRESH interpreters — the dataset must be picklable, the same contract as
-    the reference's / torch's spawn workers)."""
+    the reference's / torch's spawn workers).
+
+    With ``control_name`` (persistent_workers): instead of one baked batch
+    plan, the worker LOOPS — each epoch's plan arrives as a pickled record
+    on the control channel; closing the control channel shuts it down."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")  # never grab the TPU
     spec = pickle.loads(spec_bytes)
     ch = _Channel(channel_name)
+    ctrl = _Channel(control_name) if control_name else None
     try:
         if spec["worker_init_fn"] is not None:
             spec["worker_init_fn"](spec["worker_id"])
         dataset = spec["dataset"]
         collate = spec["collate"]
-        for b in range(spec["worker_id"], spec["n_batches"], spec["num_workers"]):
-            samples = [dataset[i] for i in spec["batches"][b]]
-            obj = collate(samples) if collate is not None else samples
-            # retry_forever: a trainer paused past the timeout (checkpoint
-            # save, eval, long compile) must not kill its workers
-            ch.send(_serialize(obj), timeout_ms=60000, retry_forever=True)
-        ch.mark_closed()
+
+        def produce(batches, n_batches, epoch_tag=b"", cancel_check=None):
+            for b in range(spec["worker_id"], n_batches, spec["num_workers"]):
+                if cancel_check is not None and cancel_check():
+                    return  # a new plan is pending: abandon this epoch
+                samples = [dataset[i] for i in batches[b]]
+                obj = collate(samples) if collate is not None else samples
+                # retry_forever: a trainer paused past the timeout (checkpoint
+                # save, eval, long compile) must not kill its workers
+                ch.send(_serialize(obj, prefix=epoch_tag), timeout_ms=60000,
+                        retry_forever=True)
+
+        def recv_plan():
+            """Chunked plan protocol: each chunk is pickled
+            (epoch, n_chunks, idx, bytes); returns (epoch, plan) or None on
+            shutdown.  The EPOCH travels in the record, so worker and
+            consumer can never disagree about numbering."""
+            parts = {}
+            want = None
+            epoch = None
+            while True:
+                rec = ctrl.recv(timeout_ms=1000)
+                if rec == b"":
+                    return None
+                if rec is None:
+                    if want is None:
+                        return ()   # nothing pending yet
+                    continue        # mid-plan: keep collecting
+                e, n, i, blob = pickle.loads(rec)
+                if epoch is not None and e != epoch:
+                    parts = {}
+                epoch, want = e, n
+                parts[i] = blob
+                if len(parts) == want:
+                    plan = pickle.loads(b"".join(parts[i] for i in range(want)))
+                    return epoch, plan
+
+        if ctrl is None:
+            produce(spec["batches"], spec["n_batches"])
+            ch.mark_closed()
+        else:
+            while True:
+                got = recv_plan()
+                if got is None:     # control closed: orderly shutdown
+                    break
+                if got == ():
+                    continue
+                epoch, plan = got
+                produce(plan, len(plan), epoch_tag=struct.pack("<I", epoch),
+                        cancel_check=lambda: _ctrl_has_pending(ctrl))
     except BrokenPipeError:
         pass  # consumer tore the pool down early
     finally:
@@ -187,14 +245,17 @@ class ShmWorkerPool:
 
     def __init__(self, dataset, batches: List, collate, num_workers: int,
                  slots: int = 4, slot_bytes: int = 8 << 20,
-                 worker_init_fn=None, timeout: float = 120.0):
+                 worker_init_fn=None, timeout: float = 120.0,
+                 persistent: bool = False):
         import multiprocessing as mp
 
-        self.n_batches = len(batches)
+        self.n_batches = len(batches) if batches is not None else 0
         self.num_workers = num_workers
         self.timeout = timeout
+        self.persistent = persistent
         uid = f"{os.getpid()}_{id(self):x}"
         self.channels = []
+        self.controls = []
         self.procs = []
         try:
             self.channels = [
@@ -202,16 +263,27 @@ class ShmWorkerPool:
                          slot_bytes=slot_bytes, create=True)
                 for w in range(num_workers)
             ]
+            if persistent:
+                # small control ring per worker: per-epoch batch plans
+                self.controls = [
+                    _Channel(f"/pt_dlc_{uid}_{w}", slots=2,
+                             slot_bytes=4 << 20, create=True)
+                    for w in range(num_workers)
+                ]
             ctx = mp.get_context("spawn")
             for w in range(num_workers):
                 spec = pickle.dumps({
-                    "dataset": dataset, "batches": batches, "collate": collate,
+                    "dataset": dataset,
+                    "batches": batches if not persistent else None,
+                    "collate": collate,
                     "worker_id": w, "num_workers": num_workers,
                     "n_batches": self.n_batches,
                     "worker_init_fn": worker_init_fn, "timeout": timeout,
                 })
-                p = ctx.Process(target=_worker_main,
-                                args=(self.channels[w].name, spec), daemon=True)
+                args = (self.channels[w].name, spec)
+                if persistent:
+                    args += (self.controls[w].name,)
+                p = ctx.Process(target=_worker_main, args=args, daemon=True)
                 p.start()
                 self.procs.append(p)
         except BaseException:
@@ -219,6 +291,35 @@ class ShmWorkerPool:
             # or every failed epoch would leak named /dev/shm segments
             self.shutdown()
             raise
+
+    def submit_epoch(self, batches: List) -> None:
+        """Persistent mode: ship this epoch's batch plan to every worker.
+
+        Any records left over from an ABANDONED previous epoch (consumer
+        broke out of the iterator early) are drained first, so epochs can
+        never bleed into each other."""
+        if not self.persistent:
+            raise RuntimeError("submit_epoch needs persistent=True")
+        if not self.channels:
+            raise RuntimeError(
+                "persistent worker pool has been shut down (a previous epoch "
+                "errored); create a fresh DataLoader/pool")
+        for ch in self.channels:
+            while ch.recv(timeout_ms=5) not in (None, b""):
+                pass
+        epoch = getattr(self, "_epoch", 0) + 1
+        self.n_batches = len(batches)
+        payload = pickle.dumps(batches)
+        chunk_cap = (4 << 20) - 4096  # fits the control ring's slot
+        chunks = [payload[i:i + chunk_cap]
+                  for i in range(0, max(len(payload), 1), chunk_cap)]
+        for ctrl in self.controls:
+            for i, blob in enumerate(chunks):
+                ctrl.send(pickle.dumps((epoch, len(chunks), i, blob)),
+                          timeout_ms=int(self.timeout * 1000) or 60000)
+        # bump only after every worker has the full plan: a partial-send
+        # failure leaves _epoch unchanged, so a retry re-sends the SAME epoch
+        self._epoch = epoch
 
     def __iter__(self):
         for b in range(self.n_batches):
@@ -248,11 +349,23 @@ class ShmWorkerPool:
                     self.shutdown()
                     raise RuntimeError(
                         f"DataLoader worker channel closed before batch {b}")
+                if self.persistent:
+                    # skip any stragglers from an abandoned earlier epoch
+                    (rec_epoch,) = struct.unpack_from("<I", rec, 0)
+                    if rec_epoch != self._epoch:
+                        continue
+                    rec = memoryview(rec)[4:]
                 yield _deserialize(memoryview(rec))
                 break
-        self.shutdown()
+        if not self.persistent:
+            self.shutdown()
 
     def shutdown(self):
+        for ch in self.controls:
+            try:
+                ch.mark_closed()
+            except Exception:
+                pass
         for ch in self.channels:
             try:
                 ch.mark_closed()
@@ -264,6 +377,7 @@ class ShmWorkerPool:
                 p.terminate()
                 p.join(timeout=5)
         self.procs = []
-        for ch in self.channels:
+        for ch in self.channels + self.controls:
             ch.close()
         self.channels = []
+        self.controls = []

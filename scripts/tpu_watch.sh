@@ -1,0 +1,68 @@
+#!/bin/bash
+# TPU capture watcher (round 4).
+#
+# The axon TPU plugin wedges unpredictably (three rounds of BENCH_r*.json
+# without a TPU number). This loop probes the backend in a killable
+# subprocess on a cadence and, the moment it comes up, runs the bench
+# presets and appends their JSON lines to BENCH_TPU_CACHE.jsonl — the
+# cache bench.py falls back to when the plugin is wedged at driver time.
+# Every attempt is logged to tpu_watch.log (timestamped) as evidence of
+# the capture cadence.
+#
+# Usage: nohup bash scripts/tpu_watch.sh &
+# Touch scripts/RECAPTURE to force a fresh sweep (e.g. after perf work).
+
+cd "$(dirname "$0")/.." || exit 1
+LOG=tpu_watch.log
+CACHE=BENCH_TPU_CACHE.jsonl
+PRESETS="base ocr moe longctx decode"
+
+log() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
+
+have_preset() { grep -q "\"preset\": \"$1\"" "$CACHE" 2>/dev/null; }
+
+probe() {
+    # strip a pinned-cpu platform so the probe sees the real accelerator
+    # (same reason bench.py's _probe_accelerator drops JAX_PLATFORMS)
+    timeout 180 env -u JAX_PLATFORMS python -c "
+import jax
+d = jax.devices()
+assert d and d[0].platform != 'cpu', d
+print(d[0].device_kind)
+" 2>/dev/null
+}
+
+log "watcher start (pid $$)"
+while true; do
+    kind=$(probe)
+    if [ -n "$kind" ]; then
+        log "probe OK: $kind"
+        if [ -f scripts/RECAPTURE ]; then
+            rm -f scripts/RECAPTURE
+            log "RECAPTURE flag: clearing cache for fresh sweep"
+            : > "$CACHE"
+        fi
+        ran=0
+        for p in $PRESETS; do
+            if ! have_preset "$p"; then
+                log "running preset $p"
+                out=$(timeout 2400 python bench.py --preset "$p" --device tpu 2>>"$LOG")
+                rc=$?
+                line=$(echo "$out" | tail -1)
+                # a cpu-backend line must never poison the TPU cache (the
+                # plugin can wedge between probe() and the bench run)
+                if [ $rc -eq 0 ] && [ -n "$line" ] && ! echo "$line" | grep -q '"backend": "cpu'; then
+                    echo "$line" >> "$CACHE"
+                    log "preset $p captured: $(echo "$line" | head -c 200)"
+                else
+                    log "preset $p FAILED rc=$rc line=$(echo "$line" | head -c 120)"
+                fi
+                ran=1
+            fi
+        done
+        [ $ran -eq 0 ] && sleep 900 || sleep 60
+    else
+        log "probe wedged/failed"
+        sleep 300
+    fi
+done

@@ -348,8 +348,11 @@ def broadcast_object_list(object_list, src: int = 0, group=None):
     gathered = [None] * get_world_size(group)
     all_gather_object(gathered, object_list, group=group)
     ranks = _group_ranks(group)
-    src_local = ranks.index(src) if src in ranks else 0
-    object_list[:] = gathered[src_local]
+    if src not in ranks:
+        raise ValueError(
+            f"broadcast_object_list: src rank {src} is not a member of the "
+            f"group (ranks {ranks})")
+    object_list[:] = gathered[ranks.index(src)]
     return object_list
 
 
@@ -394,8 +397,11 @@ def scatter_object_list(out_object_list, in_object_list=None, src: int = 0,
     all_gather_object(gathered, in_object_list, group=group)
     ranks = _group_ranks(group)
     me_local = get_rank(group)            # group-local position
-    src_local = ranks.index(src) if src in ranks else 0  # src is GLOBAL
-    payload = gathered[src_local]
+    if src not in ranks:                  # src is GLOBAL
+        raise ValueError(
+            f"scatter_object_list: src rank {src} is not a member of the "
+            f"group (ranks {ranks})")
+    payload = gathered[ranks.index(src)]
     out_object_list[:] = [payload[me_local]] if payload else []
     return out_object_list
 

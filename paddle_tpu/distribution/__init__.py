@@ -1233,7 +1233,8 @@ class ContinuousBernoulli(Distribution):
         p_safe = jnp.where(near, 0.25, p)
         c = 2.0 * jnp.arctanh(1 - 2 * p_safe) / (1 - 2 * p_safe)
         x = p - 0.5
-        series = 2.0 + (16.0 / 3.0) * x ** 2  # Taylor around 1/2
+        # 2*atanh(u)/u = 2(1 + u^2/3 + ...) with u = 1-2p = -2x -> 2 + (8/3)x^2
+        series = 2.0 + (8.0 / 3.0) * x ** 2
         return jnp.log(jnp.where(near, series, c))
 
     def _log_prob(self, value):

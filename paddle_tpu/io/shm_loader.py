@@ -253,6 +253,7 @@ class ShmWorkerPool:
         self.num_workers = num_workers
         self.timeout = timeout
         self.persistent = persistent
+        self._epoch = 0   # bumped by submit_epoch; 0 = no plan submitted yet
         uid = f"{os.getpid()}_{id(self):x}"
         self.channels = []
         self.controls = []
@@ -307,7 +308,7 @@ class ShmWorkerPool:
         for ch in self.channels:
             while ch.recv(timeout_ms=5) not in (None, b""):
                 pass
-        epoch = getattr(self, "_epoch", 0) + 1
+        epoch = self._epoch + 1
         self.n_batches = len(batches)
         payload = pickle.dumps(batches)
         chunk_cap = (4 << 20) - 4096  # fits the control ring's slot
@@ -322,6 +323,10 @@ class ShmWorkerPool:
         self._epoch = epoch
 
     def __iter__(self):
+        if self.persistent and self._epoch == 0:
+            raise RuntimeError(
+                "persistent worker pool: call submit_epoch(batches) before "
+                "iterating (no epoch plan has been shipped to the workers)")
         for b in range(self.n_batches):
             ch = self.channels[b % self.num_workers]
             # timeout <= 0 means "no stall limit" (reference DataLoader

@@ -170,8 +170,11 @@ def _flash_fwd_stream(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         # pl.when skips the COMPUTE of masked steps, but the pipeline would
         # still DMA their blocks — a repeated identical index elides the fetch
         def kv_idx(b, i, j):
+            # hi can be negative when Sq > Sk (off < 0): clamp to 0 so early
+            # q-blocks never emit a negative (out-of-range) DMA block index —
+            # their compute is already masked off by pl.when
             hi = (i * block_q + block_q - 1 + off) // block_k
-            return (b, jnp.minimum(j, hi), 0)
+            return (b, jnp.maximum(jnp.minimum(j, hi), 0), 0)
     else:
         def kv_idx(b, i, j):
             return (b, j, 0)
@@ -410,8 +413,11 @@ def _flash_bwd_stream(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
 
     if causal:
         def kv_idx(b, i, j):
+            # hi can be negative when Sq > Sk (off < 0): clamp to 0 so early
+            # q-blocks never emit a negative (out-of-range) DMA block index —
+            # their compute is already masked off by pl.when
             hi = (i * block_q + block_q - 1 + off) // block_k
-            return (b, jnp.minimum(j, hi), 0)
+            return (b, jnp.maximum(jnp.minimum(j, hi), 0), 0)
     else:
         def kv_idx(b, i, j):
             return (b, j, 0)

@@ -328,7 +328,13 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
         # decode_center_size: tb [N, M, 4] deltas (axis=0: priors along M)
         d = tb
         if pv is not None:
-            d = d * (pv[None] if pv.ndim == 2 else pv)
+            if pv.ndim == 2:
+                # per-prior variances broadcast along the prior axis: priors
+                # live on dim 1 when axis=0 ([1,M,4]) and dim 0 when axis=1
+                # ([N,1,4]) — same layout as pw/ph below
+                d = d * (pv[None] if axis == 0 else pv[:, None])
+            else:
+                d = d * pv
         shp = (1, -1) if axis == 0 else (-1, 1)
         cx = d[..., 0] * pw.reshape(shp) + pcx.reshape(shp)
         cy = d[..., 1] * ph.reshape(shp) + pcy.reshape(shp)

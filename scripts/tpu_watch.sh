@@ -37,14 +37,18 @@ while true; do
     kind=$(probe)
     if [ -n "$kind" ]; then
         log "probe OK: $kind"
+        FORCE=0
         if [ -f scripts/RECAPTURE ]; then
             rm -f scripts/RECAPTURE
-            log "RECAPTURE flag: clearing cache for fresh sweep"
-            : > "$CACHE"
+            FORCE=1
+            # never truncate: new lines are APPENDED and bench.py's cache
+            # reader takes the freshest line per preset, so the old verified
+            # capture survives as fallback if this sweep wedges mid-way
+            log "RECAPTURE flag: forcing a fresh append-sweep"
         fi
         ran=0
         for p in $PRESETS; do
-            if ! have_preset "$p"; then
+            if [ $FORCE -eq 1 ] || ! have_preset "$p"; then
                 log "running preset $p"
                 out=$(timeout 2400 python bench.py --preset "$p" --device tpu 2>>"$LOG")
                 rc=$?

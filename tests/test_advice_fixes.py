@@ -92,3 +92,137 @@ def test_amp_no_prefix_inheritance():
         x = Tensor(np.ones((2, 2), np.float32))
         out = dispatch.apply_op("matmul_custom_thing", lambda a: a * 2, (x,), {})
         assert out.dtype == jnp.float32
+
+
+# ---- round-3 advisor findings (ADVICE.md round 3) + VERDICT #7 --------------
+
+
+def test_continuous_bernoulli_log_norm_series():
+    # Taylor coefficient of 2*atanh(1-2p)/(1-2p) around p=0.5 is 2 + (8/3)x^2
+    from paddle_tpu.distribution import ContinuousBernoulli
+
+    d_in = ContinuousBernoulli(probs=np.float32(0.4999))   # inside series window
+    # exact C(p) slightly OUTSIDE the window, same math path as the series
+    p = 0.495
+    exact = np.log(2 * np.arctanh(1 - 2 * p) / (1 - 2 * p))
+    inside = float(np.asarray(d_in._log_norm_const()))
+    # series value at 0.4999 must be much closer to log(2) than the p=0.495
+    # exact value is: both are tiny offsets from log 2 with the right curvature
+    assert abs(inside - np.log(2.0)) < abs(exact - np.log(2.0))
+    # and agree with the true function at the window edge to ~1e-7
+    true_edge = np.log(2 * np.arctanh(1 - 2 * 0.4999) / (1 - 2 * 0.4999))
+    assert abs(inside - true_edge) < 1e-6
+
+
+def test_streaming_flash_causal_sq_gt_sk(monkeypatch):
+    # Sq > Sk (off < 0) made the causal kv block index negative for early
+    # q-blocks — an out-of-range DMA in the streaming fwd/bwd variants.
+    # Rows with no valid key are semantically undefined (the kernel returns
+    # zeros, flash-attn convention; the XLA reference returns a uniform
+    # softmax), so parity is asserted on the valid rows only.
+    from paddle_tpu.kernels import flash_attention as fa
+
+    monkeypatch.setattr(fa, "_VMEM_RESIDENT_BYTES", 1)  # force streaming
+    B, H, D = 1, 2, 64
+    Sq, Sk = 256, 128
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(B, Sq, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, Sk, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, Sk, H, D).astype(np.float32))
+    sm = 1.0 / np.sqrt(D)
+    out = np.asarray(fa._pallas_flash(q, k, v, True, sm, interpret=True))
+    ref = np.asarray(fa._attention_reference(q, k, v, True, None, sm))
+    assert np.isfinite(out).all()
+    # fully-masked rows: all-zero output (not DMA garbage)
+    np.testing.assert_array_equal(out[:, :Sq - Sk], 0.0)
+    np.testing.assert_allclose(out[:, Sq - Sk:], ref[:, Sq - Sk:],
+                               rtol=2e-3, atol=2e-3)
+
+    # grads through a loss over the VALID rows only (masked rows contribute
+    # nothing in either implementation then)
+    def loss(f):
+        return lambda q, k, v: f(q, k, v)[:, Sq - Sk:].sum()
+
+    gp = jax.grad(loss(lambda q, k, v: fa._pallas_flash(
+        q, k, v, True, sm, interpret=True)), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(lambda q, k, v: fa._attention_reference(
+        q, k, v, True, None, sm)), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3,
+                                   atol=2e-3, err_msg=f"d{name} (Sq>Sk stream)")
+
+
+def test_box_coder_decode_axis1_per_prior_variance():
+    from paddle_tpu.vision.ops import box_coder
+
+    rng = np.random.default_rng(0)
+    N, M = 3, 2
+    priors = np.abs(rng.normal(size=(N, 4))).astype(np.float32) + 1.0
+    priors[:, 2:] += priors[:, :2]  # valid boxes
+    deltas = rng.normal(size=(N, M, 4)).astype(np.float32) * 0.1
+    pv = np.abs(rng.normal(size=(N, 4))).astype(np.float32)
+
+    out = box_coder(paddle.to_tensor(priors), paddle.to_tensor(pv),
+                    paddle.to_tensor(deltas), code_type="decode_center_size",
+                    axis=1).numpy()
+    # reference: variance of prior i applies to deltas[i, :, :]
+    # (box_normalized=True default -> norm offset 0)
+    scaled = deltas * pv[:, None, :]
+    pw = priors[:, 2] - priors[:, 0]
+    ph = priors[:, 3] - priors[:, 1]
+    pcx = priors[:, 0] + pw / 2
+    pcy = priors[:, 1] + ph / 2
+    cx = scaled[..., 0] * pw[:, None] + pcx[:, None]
+    cy = scaled[..., 1] * ph[:, None] + pcy[:, None]
+    bw = np.exp(scaled[..., 2]) * pw[:, None]
+    bh = np.exp(scaled[..., 3]) * ph[:, None]
+    ref = np.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2], -1)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_broadcast_object_list_invalid_src_raises():
+    import paddle_tpu.distributed as dist
+
+    objs = [1, 2]
+    with pytest.raises(ValueError, match="not a member"):
+        dist.broadcast_object_list(objs, src=99)
+    with pytest.raises(ValueError, match="not a member"):
+        dist.scatter_object_list([None], [[0], [1]], src=99)
+
+
+def test_persistent_pool_iter_before_submit_raises():
+    from paddle_tpu.io.shm_loader import ShmWorkerPool
+
+    pool = ShmWorkerPool.__new__(ShmWorkerPool)  # no real workers needed
+    pool.persistent = True
+    pool._epoch = 0
+    pool.n_batches = 4
+    with pytest.raises(RuntimeError, match="submit_epoch"):
+        next(iter(pool))
+
+
+def test_gshard_routing_rng_varies_across_compiled_steps():
+    # VERDICT #7: the stochastic 2nd-expert keep must NOT be baked at trace
+    # time — TrainStep threads a fresh key per call through rng_guard.
+    from paddle_tpu.incubate.moe import MoELayer
+
+    paddle.seed(0)
+    layer = MoELayer(d_model=16, d_hidden=32, num_experts=4, gate="gshard")
+    # skew the router so the keep probability is far from 1 (observable)
+    w = np.zeros((16, 4), np.float32)
+    w[0, 0] = 8.0
+    w[0, 1] = 6.5
+    layer.gate_weight._data = jnp.asarray(w)
+
+    opt = paddle.optimizer.AdamW(learning_rate=0.0, parameters=layer.parameters())
+
+    def loss_fn(m, xx):
+        out, aux = m.forward_with_aux(xx)
+        return out.astype("float32").pow(2).mean() + 0.0 * aux
+
+    step = paddle.jit.TrainStep(layer, loss_fn, opt)
+    x = np.abs(np.random.default_rng(0).normal(size=(64, 16))).astype(np.float32)
+    xt = paddle.to_tensor(x)
+    losses = [float(np.asarray(step(xt)._data)) for _ in range(4)]
+    # lr=0 keeps params frozen: losses differ across steps iff routing varies
+    assert len(set(losses)) > 1, losses

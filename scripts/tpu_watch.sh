@@ -39,14 +39,16 @@ while true; do
         log "probe OK: $kind"
         FORCE=0
         if [ -f scripts/RECAPTURE ]; then
-            rm -f scripts/RECAPTURE
             FORCE=1
             # never truncate: new lines are APPENDED and bench.py's cache
             # reader takes the freshest line per preset, so the old verified
-            # capture survives as fallback if this sweep wedges mid-way
+            # capture survives as fallback if this sweep wedges mid-way.
+            # The flag is removed only after a fully-successful sweep, so a
+            # mid-sweep wedge retries the remaining presets next iteration.
             log "RECAPTURE flag: forcing a fresh append-sweep"
         fi
         ran=0
+        sweep_ok=1
         for p in $PRESETS; do
             if [ $FORCE -eq 1 ] || ! have_preset "$p"; then
                 log "running preset $p"
@@ -60,10 +62,15 @@ while true; do
                     log "preset $p captured: $(echo "$line" | head -c 200)"
                 else
                     log "preset $p FAILED rc=$rc line=$(echo "$line" | head -c 120)"
+                    sweep_ok=0
                 fi
                 ran=1
             fi
         done
+        if [ $FORCE -eq 1 ] && [ $sweep_ok -eq 1 ]; then
+            rm -f scripts/RECAPTURE
+            log "RECAPTURE sweep complete; flag cleared"
+        fi
         [ $ran -eq 0 ] && sleep 900 || sleep 60
     else
         log "probe wedged/failed"

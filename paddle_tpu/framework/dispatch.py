@@ -16,6 +16,7 @@ disabled there (``paddle_tpu.jit`` uses ``jax.grad`` instead).
 
 from __future__ import annotations
 
+import weakref
 from typing import Any, Callable, Sequence
 
 import jax
@@ -99,6 +100,41 @@ def apply_op(
         and any(not t.stop_gradient for t in tensor_args)
     )
 
+    if not needs_grad:
+        # fragment capture (jit.subgraph): defer the op into the pending
+        # compiled fragment instead of executing — the SOT-equivalent path.
+        # check_nan_inf needs per-op attribution, so it disables deferral.
+        from ..jit import subgraph
+
+        rec = subgraph.current_recorder()
+        if rec is not None and flags.get_flag("check_nan_inf"):
+            rec.eager_ops += 1
+            rec.flush(f"check_nan_inf active (op '{name}' runs eager)")
+            rec = None
+            datas = tuple(
+                d._value if isinstance(d, subgraph.LazyArray) else d
+                for d in datas)
+        if rec is not None:
+            recorded = rec.record(name, fn, datas, kwargs, num_outputs)
+            if recorded is not None:
+                lazies, multi = recorded
+                results = []
+                for lz in lazies:
+                    t = Tensor.__new__(Tensor)
+                    subgraph._init_tensor(t, lz)
+                    lz._tensors.append(weakref.ref(t))
+                    results.append(t)
+                _bump_op_stats(name, results)
+                if num_outputs == 1 and not multi:
+                    return results[0]
+                return tuple(results)
+            # record() flushed (op not abstractly evaluable): materialize
+            # any lazy inputs and fall through to eager execution
+            rec.eager_ops += 1
+            datas = tuple(
+                d._value if isinstance(d, subgraph.LazyArray) else d
+                for d in datas)
+
     if needs_grad:
         call = (lambda *xs: fn(*xs, **kwargs)) if kwargs else fn
         outs, vjp_fn = jax.vjp(call, *datas)
@@ -137,14 +173,18 @@ def apply_op(
     if flags.get_flag("check_nan_inf"):
         _check_nan_inf(name, [r._data for r in results])
 
-    if _OP_STATS is not None:
-        for r in results:
-            k = (name, str(r._data.dtype))
-            _OP_STATS[k] = _OP_STATS.get(k, 0) + 1
+    _bump_op_stats(name, results)
 
     if num_outputs == 1 and not multi:
         return results[0]
     return tuple(results)
+
+
+def _bump_op_stats(name: str, results) -> None:
+    if _OP_STATS is not None:
+        for r in results:
+            k = (name, str(r._data.dtype))
+            _OP_STATS[k] = _OP_STATS.get(k, 0) + 1
 
 
 def unwrap(x):

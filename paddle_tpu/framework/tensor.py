@@ -23,6 +23,12 @@ from .device import current_device
 def _to_jax_array(data, dtype=None, place=None):
     if isinstance(data, Tensor):
         data = data._data
+    if type(data).__name__ == "LazyArray" and hasattr(data, "_concrete"):
+        # deferred fragment output (jit.subgraph) re-wrapped outside dispatch:
+        # keep it lazy unless a dtype change forces a recorded cast
+        if dtype is not None:
+            return data.astype(dtype_mod.convert_dtype(dtype))
+        return data
     if isinstance(data, jax.Array) or isinstance(data, jax.core.Tracer):
         arr = data
         if dtype is not None:
@@ -58,6 +64,12 @@ class Tensor:
 
     def __init__(self, data, dtype=None, place=None, stop_gradient: bool = True, name: Optional[str] = None):
         self._data = _to_jax_array(data, dtype, place)
+        if type(self._data).__name__ == "LazyArray":
+            # register with the fragment recorder so a flush substitutes the
+            # concrete value into THIS tensor's storage too
+            import weakref
+
+            self._data._tensors.append(weakref.ref(self))
         self.stop_gradient = stop_gradient
         self._grad = None
         self._grad_node = None

@@ -31,7 +31,9 @@ from ..framework.tensor import Parameter, Tensor
 
 __all__ = ["to_static", "not_to_static", "TrainStep", "functional_call", "ignore_module",
            "enable_to_static", "set_verbosity", "set_code_level", "TranslatedLayer",
-           "save", "load", "bucketed"]
+           "save", "load", "bucketed", "capture"]
+
+from .subgraph import capture  # noqa: E402  (SOT-equivalent fragment capture)
 
 
 @contextlib.contextmanager
@@ -92,8 +94,11 @@ class StaticFunction:
         self._full_graph = full_graph
         # input signatures whose trace failed — jax.jit retraces per
         # signature, so a batch-1-only host branch must not de-optimize
-        # every other shape
+        # every other shape. Failed signatures run under FRAGMENT CAPTURE
+        # (jit.subgraph), not plain eager: the FLOPs stay compiled.
         self._fallback_sigs = set()
+        self._reported_breaks = False
+        self._last_capture = None      # last Recorder (diagnostics)
 
     def _build(self):
         if self._is_layer:
@@ -123,9 +128,34 @@ class StaticFunction:
         # the compiled path: one key per call either way)
         with no_grad(), rnd.rng_guard(key):
             out = self._target(*wrap(args), **wrap(kwargs))
+        return self._wrap_out(out)
+
+    def _wrap_out(self, out):
         if self._is_layer or isinstance(out, Tensor) or not hasattr(out, "dtype"):
             return out
         return wrap(out)
+
+    def _call_fragments(self, args, kwargs, key):
+        """SOT-equivalent fallback: run the Python untraceably, but batch the
+        tensor ops into XLA-compiled fragments cut at the graph breaks
+        (jit.subgraph). All FLOPs stay compiled; only control flow is eager.
+        Model exceptions propagate exactly as they would in eager."""
+        from . import subgraph
+
+        name = getattr(self._target, "__name__", type(self._target).__name__)
+        rec = subgraph.Recorder(name)
+        with rnd.rng_guard(key), rec:   # Recorder enters no_grad itself
+            out = self._target(*wrap(args), **wrap(kwargs))
+        self._last_capture = rec
+        if not self._reported_breaks:
+            self._reported_breaks = True
+            import warnings
+
+            warnings.warn(
+                f"to_static({name}): whole-graph tracing failed; running with "
+                f"fragment capture instead.\n{rec.report()}",
+                RuntimeWarning, stacklevel=3)
+        return self._wrap_out(out)
 
     @staticmethod
     def _signature(raw_args, raw_kwargs):
@@ -144,29 +174,22 @@ class StaticFunction:
         raw_kwargs = unwrap(kwargs)
         # signature check only once a fallback exists — the hot path stays free
         if self._fallback_sigs and self._signature(raw_args, raw_kwargs) in self._fallback_sigs:
-            return self._call_eager(args, kwargs, key)
+            return self._call_fragments(args, kwargs, key)
         try:
             if self._is_layer:
                 params, buffers = _get_state(self._target)
                 out = self._jitted(params, buffers, key, raw_args, raw_kwargs)
             else:
                 out = self._jitted(key, raw_args, raw_kwargs)
-        except jax.errors.JAXTypeError as e:
+        except jax.errors.JAXTypeError:
             # data-dependent control flow / host-value use inside the trace —
-            # the SOT-fallback situation; run THIS SIGNATURE eagerly from now
-            # on (other shapes may trace fine and stay compiled)
+            # the SOT situation. Fall back to FRAGMENT CAPTURE for this input
+            # signature (other shapes may trace whole and stay one program):
+            # compiled fragments + eager stitching, with a break report.
             if self._full_graph:
                 raise
-            import warnings
-
-            name = getattr(self._target, "__name__", type(self._target).__name__)
-            warnings.warn(
-                f"to_static({name}): tracing failed ({type(e).__name__}); "
-                "falling back to EAGER execution for this input signature. Use "
-                "lax.cond/where-style control flow (or full_graph=True to "
-                "make this an error).", RuntimeWarning, stacklevel=2)
             self._fallback_sigs.add(self._signature(raw_args, raw_kwargs))
-            return self._call_eager(args, kwargs, key)
+            return self._call_fragments(args, kwargs, key)
         return wrap(out)
 
     # paddle API surface

@@ -1,0 +1,490 @@
+"""Sub-graph (fragment) capture — the SOT-equivalent for untraceable models.
+
+Reference counterpart: the bytecode-level graph capture in
+``paddle/fluid/pybind/sot/eval_frame.c:300`` (``_custom_eval_frame`` PEP-523
+hook) + ``python/paddle/jit/sot/opcode_translator/executor/opcode_executor.py``
+(symbolic bytecode execution, StatementIR) + ``.../guard.py`` (cache guards).
+When a model has data-dependent Python control flow, the reference does not
+give up on compilation: it captures bytecode *fragments* between the
+unsupported constructs, compiles each fragment, and stitches them with eager
+glue, guarding the cache on the values that chose the path.
+
+TPU-native redesign (no bytecode interpretation): every tensor op already
+funnels through ONE dispatch point (``framework/dispatch.py::apply_op``), so
+fragment capture is a *lazy-tensor* recorder at that choke point:
+
+- while a :class:`Recorder` is active, ``apply_op`` does not execute — it
+  records the op into the current fragment and returns a :class:`LazyArray`
+  placeholder carrying only shape/dtype (``jax.eval_shape``, cached);
+- Python forcing a concrete value (``bool()``/``int()``/``float()``/
+  ``.item()``/``.numpy()``/``np.asarray``) is the *graph break*: the pending
+  fragment is compiled with ``jax.jit`` (cached by a structural key) and
+  executed, concrete results are substituted back into the live Tensors, and
+  recording restarts — exactly the "break graph at unsupported construct,
+  compile the fragments, stitch eagerly" behavior, with the break site logged
+  for the diagnostic report;
+- the fragment cache key plays the role of the reference's guard system: op
+  sequence + input shapes/dtypes + per-callsite code identity + closure
+  config values.  A different branch taken on the next call records a
+  different op sequence -> a different key -> its own compiled fragment.
+
+Known v1 limits (documented, not silent): closure cells holding *mutable*
+objects are keyed by identity (mutating them between calls can serve a stale
+fragment — same limit class as the reference's value guards); a fresh PRNG
+key closed over per call defeats the fragment cache for that op (thread keys
+through ``rng_guard`` instead, as TrainStep/to_static do).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["LazyArray", "Recorder", "capture", "current_recorder"]
+
+
+_TLS = threading.local()
+
+try:  # private jax API; conservatively assume dirty if it moves
+    from jax._src.core import trace_state_clean as _trace_state_clean
+except Exception:  # pragma: no cover
+    _trace_state_clean = None
+
+
+def _in_trace() -> bool:
+    return _trace_state_clean is not None and not _trace_state_clean()
+
+
+def current_recorder() -> Optional["Recorder"]:
+    return getattr(_TLS, "recorder", None)
+
+
+# ---------------------------------------------------------------------------
+# Lazy placeholder
+# ---------------------------------------------------------------------------
+
+class LazyArray:
+    """Deferred op output: shape/dtype known (abstract eval), value pending.
+
+    Forcing a concrete value flushes the owning recorder's pending fragment
+    (a *graph break*)."""
+
+    __slots__ = ("_recorder", "_node", "_idx", "_aval", "_value", "_tensors",
+                 "_aborted", "__weakref__")
+
+    def __init__(self, recorder, node, idx, aval):
+        self._recorder = recorder
+        self._node = node
+        self._idx = idx
+        self._aval = aval
+        self._value = None
+        self._aborted = False
+        self._tensors: List = []  # weakrefs of Tensors wrapping this output
+
+    # -- abstract metadata (no flush) ---------------------------------------
+    @property
+    def shape(self):
+        return self._aval.shape
+
+    @property
+    def dtype(self):
+        return self._aval.dtype
+
+    @property
+    def ndim(self):
+        return len(self._aval.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self._aval.shape)) if self._aval.shape else 1
+
+    # -- forcing (graph breaks) ---------------------------------------------
+    def _concrete(self, reason: str):
+        if self._value is None:
+            if self._aborted:
+                raise RuntimeError(
+                    "this value was pending in a fragment capture that was "
+                    "aborted by an exception; it cannot be materialized")
+            self._recorder.flush(reason)
+        if self._value is None:
+            raise RuntimeError(
+                f"fragment flush did not materialize this value ({reason})")
+        return self._value
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._concrete("host read (numpy/item)"))
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        # lets stray jnp calls outside apply_op consume a lazy value
+        return self._concrete("jnp use outside dispatch")
+
+    def __bool__(self):
+        return bool(self._concrete("bool(tensor) in Python control flow"))
+
+    def __int__(self):
+        return int(self._concrete("int(tensor)"))
+
+    def __float__(self):
+        return float(self._concrete("float(tensor)"))
+
+    def __index__(self):
+        return int(self._concrete("tensor used as index"))
+
+    # -- recorded conversions (no break) ------------------------------------
+    def astype(self, dtype):
+        """Cast. Recorded DIRECTLY into the active fragment (bypassing
+        apply_op so the AMP input-cast path cannot re-enter itself); outside
+        a capture or once materialized, a plain concrete cast."""
+        rec = current_recorder()
+        if self._value is not None or rec is None or rec is not self._recorder:
+            return self._concrete("astype outside capture").astype(dtype)
+        recorded = rec.record("cast", lambda x: x.astype(dtype), (self,), {}, 1)
+        if recorded is None:   # record() flushed: fall back to concrete
+            return self._concrete("astype after flush").astype(dtype)
+        lazies, _ = recorded
+        return lazies[0]
+
+    def __repr__(self):
+        state = "pending" if self._value is None else "materialized"
+        return f"LazyArray(shape={self.shape}, dtype={self.dtype}, {state})"
+
+
+def _init_tensor(t, data):
+    """Minimal Tensor field init around a lazy/concrete array (bypasses
+    ``_to_jax_array`` coercion)."""
+    t._data = data
+    t.stop_gradient = True
+    t._grad = None
+    t._grad_node = None
+    t._out_index = 0
+    t._hooks = []
+    t.name = ""
+    t.persistable = False
+    t._dist_attr = None
+
+
+# ---------------------------------------------------------------------------
+# Structural keys (the guard system)
+# ---------------------------------------------------------------------------
+
+def _cfg_key(v) -> tuple:
+    """Hashable key for a closure cell / kwarg value."""
+    if v is None or isinstance(v, (bool, int, float, str, bytes, complex)):
+        return ("v", v)
+    if isinstance(v, (np.dtype, jnp.dtype)) or (isinstance(v, type) and
+                                                issubclass(v, np.generic)):
+        return ("dt", str(v))
+    if isinstance(v, (tuple, list)):
+        return ("seq", type(v).__name__, tuple(_cfg_key(x) for x in v))
+    if isinstance(v, dict):
+        return ("map", tuple(sorted((str(k), _cfg_key(x)) for k, x in v.items())))
+    if isinstance(v, LazyArray):
+        return ("lazy", v.shape, str(v.dtype))
+    if isinstance(v, (jax.Array, np.ndarray)):
+        # jax arrays are immutable: identity pins the value. A fresh array per
+        # call (e.g. a split PRNG key in a closure) misses the cache — sound,
+        # but slow; thread such values as op inputs instead.
+        return ("arr", id(v), v.shape, str(v.dtype))
+    if callable(v):
+        return _fn_key(v)
+    # mutable object: identity key (documented v1 guard limit)
+    return ("obj", id(v))
+
+
+def _fn_key(fn) -> tuple:
+    """Per-callsite identity + closure config values.
+
+    A lambda/def creates its code object once (it lives in the enclosing
+    code's constants), so ``id(__code__)`` is a stable callsite key; the
+    closure cells carry the per-call config that must guard the cache."""
+    target = fn
+    pre: tuple = ()
+    if not isinstance(fn, type(_fn_key)) and hasattr(fn, "func"):
+        # functools.partial
+        pre = (tuple(_cfg_key(a) for a in fn.args),
+               _cfg_key(dict(fn.keywords or {})))
+        target = fn.func
+    if hasattr(target, "__self__"):
+        # bound method: the receiver carries per-instance config
+        pre = pre + (("self", id(target.__self__)),)
+    target = getattr(target, "__func__", target)  # bound method
+    code = getattr(target, "__code__", None)
+    if code is None:
+        return ("fn", id(target), getattr(target, "__name__", "?"), pre)
+    cells = ()
+    if getattr(target, "__closure__", None):
+        cells = tuple(_cfg_key(c.cell_contents) for c in target.__closure__)
+    return ("fn", id(code), cells, pre)
+
+
+def _aval_of(x):
+    if isinstance(x, LazyArray):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+    return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+
+
+# ---------------------------------------------------------------------------
+# Fragment recorder
+# ---------------------------------------------------------------------------
+
+class _Node:
+    __slots__ = ("name", "fn", "kwargs", "inputs", "out_avals", "out_lazies",
+                 "key")
+
+    def __init__(self, name, fn, kwargs, inputs, out_avals, key):
+        self.name = name
+        self.fn = fn
+        self.kwargs = kwargs
+        self.inputs = inputs          # ('lazy', node, idx) | ('ext', array)
+        self.out_avals = out_avals
+        self.out_lazies: List = []    # weakrefs, same order as out_avals
+        self.key = key                # structural key of this op
+
+
+# global fragment-executable cache (the compiled-guard table); bounded
+_FRAGMENT_CACHE: Dict[tuple, Any] = {}
+_FRAGMENT_CACHE_MAX = 512
+
+# eval_shape results keyed by (fn key, input avals, kwargs key)
+_SHAPE_CACHE: Dict[tuple, Any] = {}
+_SHAPE_CACHE_MAX = 4096
+
+
+class Recorder:
+    """Accumulates ops into fragments; compiles each fragment on flush."""
+
+    def __init__(self, name: str = "capture"):
+        self.name = name
+        self._nodes: List[_Node] = []
+        self.breaks: List[dict] = []       # diagnostic: where/why each break
+        self.fragments: List[dict] = []    # per-fragment stats
+        self.ops_recorded = 0
+        self.eager_ops = 0      # ops that could NOT be deferred (ran eager)
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    # -- recording ----------------------------------------------------------
+    def record(self, name: str, fn: Callable, datas: Sequence[Any],
+               kwargs: dict, num_outputs: int):
+        """Record one op. Returns (out_datas, multi) with LazyArray outputs,
+        or None if the op cannot be deferred (caller runs it eagerly)."""
+        kw_key = _cfg_key(kwargs)
+        op_key = (name, _fn_key(fn), kw_key)
+        in_avals = tuple(_aval_of(d) for d in datas)
+        shape_key = (op_key, tuple((a.shape, str(a.dtype)) for a in in_avals))
+        out_struct = _SHAPE_CACHE.get(shape_key)
+        if out_struct is None:
+            try:
+                out_struct = jax.eval_shape(lambda *xs: fn(*xs, **kwargs),
+                                            *in_avals)
+            except Exception:
+                # fn touches something abstract eval can't see (e.g. a lazy
+                # closed over instead of passed) — materialize and bail out
+                self.flush(f"op '{name}' not abstractly evaluable")
+                return None
+            if len(_SHAPE_CACHE) > _SHAPE_CACHE_MAX:
+                _SHAPE_CACHE.clear()
+            _SHAPE_CACHE[shape_key] = out_struct
+
+        multi = isinstance(out_struct, (tuple, list))
+        out_avals = list(out_struct) if multi else [out_struct]
+        inputs = []
+        for d in datas:
+            if isinstance(d, LazyArray) and d._value is None:
+                if d._recorder is not self or d._aborted:
+                    raise RuntimeError(
+                        "a pending value from another (or aborted) fragment "
+                        "capture was used as an op input; it has no "
+                        "materializable data")
+                inputs.append(("lazy", d._node, d._idx))
+            elif isinstance(d, LazyArray):
+                inputs.append(("ext", d._value))
+            else:
+                inputs.append(("ext", d))
+        node = _Node(name, fn, kwargs, inputs, out_avals, op_key)
+        lazies = [LazyArray(self, node, i, a) for i, a in enumerate(out_avals)]
+        node.out_lazies = [weakref.ref(v) for v in lazies]
+        self._nodes.append(node)
+        self.ops_recorded += 1
+        return lazies, multi
+
+    # -- flushing (fragment compile + execute) ------------------------------
+    def flush(self, reason: str = "explicit"):
+        if not self._nodes:
+            return
+        if _in_trace():
+            # flushing inside an ambient jax trace (e.g. a lazy touched from
+            # a closure during eval_shape) would store tracers as concrete
+            # values; raise instead — record()'s guard catches this, flushes
+            # at top level, and runs the offending op eagerly
+            raise RuntimeError(
+                "fragment flush forced inside a jax trace (a deferred value "
+                "was consumed by closure instead of being passed as an input)")
+        where = _break_site()
+        nodes = self._nodes
+        self._nodes = []
+
+        # live outputs = lazies still referenced (by Tensors or user code)
+        live: List[LazyArray] = []
+        for n in nodes:
+            for ref in n.out_lazies:
+                v = ref()
+                if v is not None and v._value is None:
+                    live.append(v)
+        # DCE: walk back from live outputs
+        node_pos = {id(n): i for i, n in enumerate(nodes)}
+        needed_ids = set()
+        stack = [v._node for v in live]
+        while stack:
+            n = stack.pop()
+            if id(n) in needed_ids or id(n) not in node_pos:
+                continue
+            needed_ids.add(id(n))
+            for src in n.inputs:
+                if src[0] == "lazy":
+                    stack.append(src[1])
+        needed = [n for n in nodes if id(n) in needed_ids]
+
+        # external inputs (concrete arrays), deduped by identity
+        ext: List[Any] = []
+        ext_pos: Dict[int, int] = {}
+        for n in needed:
+            for src in n.inputs:
+                if src[0] == "ext" and id(src[1]) not in ext_pos:
+                    ext_pos[id(src[1])] = len(ext)
+                    ext.append(src[1])
+
+        pos_of = {id(n): i for i, n in enumerate(needed)}
+        targets = [(pos_of[id(v._node)], v._idx) for v in live]
+
+        # structural cache key == the fragment's guard
+        frag_key = (
+            tuple(
+                (n.key,
+                 tuple(("l", pos_of[id(s[1])], s[2]) if s[0] == "lazy"
+                       else ("e", ext_pos[id(s[1])]) for s in n.inputs))
+                for n in needed
+            ),
+            tuple((tuple(jnp.shape(e)), str(jnp.result_type(e))) for e in ext),
+            tuple(targets),
+        )
+
+        compiled = _FRAGMENT_CACHE.get(frag_key)
+        if compiled is None:
+            self.cache_misses += 1
+            # slot-mapped plan: no concrete arrays in the closure, so a
+            # cached fragment never pins the first call's inputs in memory
+            plan = tuple(
+                (n.fn, n.kwargs,
+                 tuple(("l", pos_of[id(s[1])], s[2]) if s[0] == "lazy"
+                       else ("e", ext_pos[id(s[1])]) for s in n.inputs))
+                for n in needed)
+
+            def replay(*ext_arrays):
+                env: Dict[Tuple[int, int], Any] = {}
+                for i, (fn, kwargs, ins_spec) in enumerate(plan):
+                    ins = [env[(s[1], s[2])] if s[0] == "l"
+                           else ext_arrays[s[1]] for s in ins_spec]
+                    outs = fn(*ins, **kwargs)
+                    out_list = list(outs) if isinstance(outs, (tuple, list)) \
+                        else [outs]
+                    for j, o in enumerate(out_list):
+                        env[(i, j)] = o
+                return tuple(env[t] for t in targets)
+
+            compiled = jax.jit(replay)
+            if len(_FRAGMENT_CACHE) > _FRAGMENT_CACHE_MAX:
+                _FRAGMENT_CACHE.clear()
+            _FRAGMENT_CACHE[frag_key] = compiled
+        else:
+            self.cache_hits += 1
+
+        results = compiled(*ext)
+        for v, r in zip(live, results):
+            v._value = r
+            # substitute concrete storage into every Tensor still wrapping v
+            for tref in v._tensors:
+                t = tref()
+                if t is not None and t._data is v:
+                    t._data = r
+
+        self.fragments.append({
+            "ops": len(needed),
+            "recorded": len(nodes),
+            "reason": reason,
+            "site": where,
+        })
+        if reason != "end of captured call":
+            self.breaks.append({"reason": reason, "site": where,
+                                "ops_before_break": len(needed)})
+
+    # -- lifecycle ----------------------------------------------------------
+    def __enter__(self):
+        if current_recorder() is not None:
+            raise RuntimeError("fragment capture cannot nest")
+        # capture implies no-grad: the autograd tape's jax.vjp path would
+        # bypass recording op by op (use TrainStep/to_static for training)
+        from ..framework.autograd import no_grad
+
+        self._no_grad = no_grad()
+        self._no_grad.__enter__()
+        _TLS.recorder = self
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _TLS.recorder = None
+        self._no_grad.__exit__(exc_type, exc, tb)
+        if exc_type is None:
+            self.flush("end of captured call")
+        else:
+            # error exit: pending values are unrecoverable — mark them so a
+            # later use fails with a clear message instead of a bare assert
+            for n in self._nodes:
+                for ref in n.out_lazies:
+                    v = ref()
+                    if v is not None and v._value is None:
+                        v._aborted = True
+            self._nodes = []
+        return False
+
+    def report(self) -> str:
+        lines = [f"fragment capture '{self.name}': {self.ops_recorded} ops in "
+                 f"{len(self.fragments)} fragment(s), {len(self.breaks)} graph "
+                 f"break(s), {self.eager_ops} eager op(s), cache "
+                 f"{self.cache_hits} hit/{self.cache_misses} miss"]
+        for i, b in enumerate(self.breaks):
+            lines.append(f"  break {i + 1}: {b['reason']} at {b['site']} "
+                         f"({b['ops_before_break']} ops compiled before it)")
+        return "\n".join(lines)
+
+
+def _break_site() -> str:
+    """First stack frame outside the framework — where the user code forced
+    the value."""
+    import os
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for frame in reversed(traceback.extract_stack()):
+        fname = frame.filename
+        if not fname.startswith(pkg_root) and "site-packages" not in fname:
+            return f"{fname}:{frame.lineno} ({frame.name})"
+    return "<unknown>"
+
+
+def capture(name: str = "capture") -> Recorder:
+    """Context manager: run eager code with fragment capture::
+
+        with jit.capture("my_model") as rec:
+            out = model(x)          # data-dependent branching OK
+        print(rec.report())
+
+    Tensor ops batch into XLA-compiled fragments; Python control flow on
+    tensor values cuts fragments (logged as graph breaks)."""
+    return Recorder(name)

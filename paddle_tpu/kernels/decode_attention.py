@@ -212,3 +212,184 @@ def write_paged_kv(k_blocks, v_blocks, block_table, lengths, k_new, v_new):
     k_blocks = k_blocks.at[phys, slot].set(k_new[:, 0])
     v_blocks = v_blocks.at[phys, slot].set(v_new[:, 0])
     return k_blocks, v_blocks
+
+
+# ---------------------------------------------------------------------------
+# Serving-layout paged KV pools: [num_blocks, kv_heads, block_size, head_dim]
+# ---------------------------------------------------------------------------
+# This layout makes each (physical block, kv head) a CONTIGUOUS [bs, d] slab,
+# so the paged decode kernel can DMA exactly the live blocks straight from
+# HBM (the reference's block_multi_head_attention walks its block table the
+# same way inside the CUDA kernel). Block 0 is reserved as the trash block
+# for inactive slots (serving.Engine convention).
+
+
+def _paged_pool_reference(q, k_pool, v_pool, block_table, lengths, sm_scale):
+    """Gather-based oracle for the serving layout (testing / CPU path).
+
+    q: [B, 1, H, D]; pools [NB, Hk, bs, D]; block_table [B, MAXB] int32;
+    lengths [B] int32 (valid tokens INCLUDING the current one)."""
+    nb, hk, bs, d = k_pool.shape
+    B = q.shape[0]
+    # [B, MAXB, Hk, bs, D] -> [B, C, Hk, D]
+    k = jnp.swapaxes(jnp.take(k_pool, block_table, axis=0), 2, 3)
+    v = jnp.swapaxes(jnp.take(v_pool, block_table, axis=0), 2, 3)
+    k = k.reshape(B, -1, hk, d)
+    v = v.reshape(B, -1, hk, d)
+    out = _decode_reference(q, k, v, lengths, sm_scale)
+    # inactive slots (length 0) are all-zero, matching the Pallas kernel
+    return out * (lengths > 0).astype(out.dtype)[:, None, None, None]
+
+
+def _pallas_paged_decode(q, k_pool, v_pool, block_table, lengths, sm_scale,
+                         interpret: bool = False):
+    """Paged decode attention: grid (B, Hk); per program, double-buffered
+    manual DMA of exactly the LIVE physical blocks of this head (block table
+    and lengths are scalar-prefetched into SMEM), online-softmax accumulate.
+
+    Unlike the dense kernel (which DMAs the full [C, d] cache row via its
+    BlockSpec), HBM traffic here is proportional to the live length — the
+    fix for the "full-cache DMA" cost diagnosed in PERF.md round 3.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, h, d = q.shape
+    nb, hk, bs, d2 = k_pool.shape
+    assert S == 1 and d == d2
+    rep = h // hk
+    maxb = block_table.shape[1]
+
+    qr = q.reshape(B, hk, rep, d)
+
+    def kernel(tbl_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref, kbuf, vbuf, sems):
+        b = pl.program_id(0)
+        g = pl.program_id(1)
+        L = len_ref[b]
+        n_live = jnp.minimum((L + bs - 1) // bs, maxb)
+        qb = q_ref[0, 0].astype(jnp.float32)  # [rep, d]
+
+        def start(slot, j):
+            phys = tbl_ref[b, j]
+            pltpu.make_async_copy(k_hbm.at[phys, g], kbuf.at[slot],
+                                  sems.at[slot, 0]).start()
+            pltpu.make_async_copy(v_hbm.at[phys, g], vbuf.at[slot],
+                                  sems.at[slot, 1]).start()
+
+        def wait(slot, j):
+            phys = tbl_ref[b, j]
+            pltpu.make_async_copy(k_hbm.at[phys, g], kbuf.at[slot],
+                                  sems.at[slot, 0]).wait()
+            pltpu.make_async_copy(v_hbm.at[phys, g], vbuf.at[slot],
+                                  sems.at[slot, 1]).wait()
+
+        @pl.when(n_live > 0)
+        def _prologue():
+            start(0, 0)
+
+        def body(j, carry):
+            acc, m_prev, l_prev = carry
+            slot = jax.lax.rem(j, 2)
+
+            @pl.when(j + 1 < n_live)
+            def _prefetch():
+                start(jax.lax.rem(j + 1, 2), j + 1)
+
+            wait(slot, j)
+            kb = kbuf[slot].astype(jnp.float32)  # [bs, d]
+            vb = vbuf[slot].astype(jnp.float32)
+            s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * sm_scale
+            k_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (rep, bs), 1)
+            s = jnp.where(k_pos < L, s, NEG_INF)
+            m_cur = jnp.max(s, axis=1)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1)
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            return acc, m_new, l_new
+
+        acc0 = jnp.zeros((rep, d), jnp.float32)
+        m0 = jnp.full((rep,), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((rep,), jnp.float32)
+        acc, m, l = jax.lax.fori_loop(0, n_live, body, (acc0, m0, l0))
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, hk),
+            in_specs=[
+                pl.BlockSpec((1, 1, rep, d), lambda b, g, *_: (b, g, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),   # k pool stays in HBM
+                pl.BlockSpec(memory_space=pltpu.ANY),   # v pool stays in HBM
+            ],
+            out_specs=pl.BlockSpec((1, 1, rep, d), lambda b, g, *_: (b, g, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, bs, d), k_pool.dtype),
+                pltpu.VMEM((2, bs, d), v_pool.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, hk, rep, d), q.dtype),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), lengths.astype(jnp.int32), qr,
+      k_pool, v_pool)
+    return out.reshape(B, 1, h, d)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_table, lengths,
+                           sm_scale: Optional[float] = None,
+                           interpret: bool = False):
+    """Decode attention over serving-layout paged pools.
+
+    q: ``[B, 1, H, D]``; pools ``[NB, Hk, bs, D]``; ``block_table``
+    ``[B, MAXB]`` int32; ``lengths`` ``[B]`` int32 (0 = inactive slot, whose
+    output is all-zero). Reference role:
+    ``block_multi_head_attention_kernel.cu`` — but HBM reads are proportional
+    to live tokens, not table capacity."""
+    from . import use_pallas
+
+    B, S, h, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    bs = k_pool.shape[2]
+    kernel_ok = S == 1 and d in (64, 128, 256) and bs % 128 == 0
+    if (use_pallas() or interpret) and kernel_ok:
+        return _pallas_paged_decode(q, k_pool, v_pool, block_table, lengths,
+                                    sm_scale, interpret=interpret)
+    return _paged_pool_reference(q, k_pool, v_pool, block_table, lengths, sm_scale)
+
+
+def write_paged_token(k_pool, v_pool, block_table, lengths, k_new, v_new):
+    """Append one token's K/V per sequence into serving-layout pools.
+
+    k_new/v_new: ``[B, 1, Hk, D]``. Target: block ``table[b, lengths[b]//bs]``
+    slot ``lengths[b] % bs``. Inactive slots (length 0, table row pointing at
+    the reserved trash block) harmlessly write there."""
+    nb, hk, bs, d = k_pool.shape
+    lengths = jnp.asarray(lengths, jnp.int32)
+    phys = jnp.take_along_axis(block_table, (lengths // bs)[:, None], axis=1)[:, 0]
+    slot = lengths % bs
+    k_pool = k_pool.at[phys, :, slot].set(k_new[:, 0])
+    v_pool = v_pool.at[phys, :, slot].set(v_new[:, 0])
+    return k_pool, v_pool
+
+
+def write_paged_prefill(k_pool, v_pool, blocks, k_seq, v_seq):
+    """Scatter a prefilled sequence's K/V into its allocated blocks.
+
+    ``blocks``: ``[n_blocks]`` int32 physical ids; ``k_seq/v_seq``:
+    ``[n_blocks*bs, Hk, D]`` (bucket-padded; the tail past the true length is
+    garbage that the length mask never attends)."""
+    nb, hk, bs, d = k_pool.shape
+    n = blocks.shape[0]
+    ks = jnp.swapaxes(k_seq.reshape(n, bs, hk, d), 1, 2)  # [n, Hk, bs, D]
+    vs = jnp.swapaxes(v_seq.reshape(n, bs, hk, d), 1, 2)
+    return k_pool.at[blocks].set(ks.astype(k_pool.dtype)), \
+        v_pool.at[blocks].set(vs.astype(v_pool.dtype))

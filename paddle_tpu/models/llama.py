@@ -258,6 +258,36 @@ def cached_attention_fn(hidden, w_qkv, w_o, k_cache, v_cache, cos, sin, offset,
     return out, k_cache, v_cache
 
 
+def paged_attention_fn(hidden, w_qkv, w_o, k_pool, v_pool, block_table,
+                       lengths, cos, sin, cfg: LlamaConfig):
+    """Single-token GQA attention over serving-layout paged KV pools
+    (``[NB, Hk, bs, D]``; see ``kernels/decode_attention.py``).
+
+    Per-sequence positions come from ``lengths`` (continuous batching mixes
+    ragged sequences in one batch, unlike the dense path's shared offset).
+    The new token's K/V is appended to each sequence's current block before
+    attending. Reference role: ``block_multi_head_attention_kernel.cu``.
+    """
+    from ..kernels import decode_attention as da
+
+    h, hk, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    B, S, _ = hidden.shape
+    qkv = hidden @ w_qkv.astype(hidden.dtype)
+    q, k, v = jnp.split(qkv, [h * d, (h + hk) * d], axis=-1)
+    q = q.reshape(B, S, h, d)
+    k = k.reshape(B, S, hk, d)
+    v = v.reshape(B, S, hk, d)
+    pos = lengths[:, None]  # this token's absolute position per sequence
+    q, k = rope_mod.apply_rope(q, k, cos, sin, pos)
+    k_pool, v_pool = da.write_paged_token(
+        k_pool, v_pool, block_table, lengths,
+        k.astype(k_pool.dtype), v.astype(v_pool.dtype))
+    att_len = jnp.where(lengths > 0, lengths + 1, 0)  # 0 = inactive slot
+    o = da.paged_decode_attention(q, k_pool, v_pool, block_table, att_len)
+    out = o.reshape(B, S, h * d) @ w_o.astype(hidden.dtype)
+    return out, k_pool, v_pool
+
+
 def mlp_fn(hidden, w_gate_up, w_down, intermediate_size: int):
     """Pure SwiGLU MLP over raw arrays with fused gate_up matmul."""
     gu = hidden @ w_gate_up.astype(hidden.dtype)
@@ -287,6 +317,20 @@ class LlamaAttention(Layer):
 
     def forward(self, x, cos, sin, position_ids=None, cache=None):
         cfg = self.config
+
+        if isinstance(cache, tuple) and len(cache) == 4:
+            # paged serving cache: (k_pool, v_pool, block_table, lengths)
+            k_p, v_p, tbl, lengths = cache
+
+            def attn_paged(hidden, w_qkv, w_o, kp, vp):
+                return paged_attention_fn(hidden, w_qkv, w_o, kp, vp,
+                                          tbl, lengths, _raw(cos), _raw(sin), cfg)
+
+            out, nk, nv = apply_op(
+                "block_multihead_attention", attn_paged,
+                (x, self.qkv_proj, self.o_proj, Tensor(k_p), Tensor(v_p)),
+                {}, num_outputs=3)
+            return out, (nk._data, nv._data)
 
         if cache is not None:
             k_c, v_c, offset = cache
@@ -415,6 +459,15 @@ class LlamaModel(Layer):
                    for _ in range(cfg.num_hidden_layers))
         return {"kv": kv, "offset": jnp.asarray(0, jnp.int32)}
 
+    def init_paged_pools(self, num_blocks: int, block_size: int = 128, dtype=None):
+        """Serving-layout paged KV pools per layer: ``[NB, Hk, bs, D]``
+        (block 0 reserved as the trash block for inactive slots)."""
+        cfg = self.config
+        dt = jnp.dtype(dtype) if dtype is not None else jnp.dtype(cfg.dtype)
+        shape = (num_blocks, cfg.kv_heads, block_size, cfg.head_dim)
+        return (tuple(jnp.zeros(shape, dt) for _ in range(cfg.num_hidden_layers)),
+                tuple(jnp.zeros(shape, dt) for _ in range(cfg.num_hidden_layers)))
+
     def forward(self, input_ids, position_ids=None, cache=None):
         """Returns the final hidden states; for MoE configs returns
         ``(hidden, aux_loss_total)``.  With ``cache`` (from :meth:`init_cache`)
@@ -428,6 +481,26 @@ class LlamaModel(Layer):
         cos, sin = self.rope_cos, self.rope_sin
         is_moe = self.config.moe_num_experts > 1
         aux_total = None
+        if cache is not None and "block_table" in cache:
+            # paged serving cache (continuous batching; serving.Engine):
+            # {"k": (pool per layer...), "v": (...), "block_table", "lengths"}
+            tbl = _raw(cache["block_table"])
+            lengths = _raw(cache["lengths"])
+            new_k, new_v = [], []
+            for layer, k_p, v_p in zip(self.layers, cache["k"], cache["v"]):
+                out = layer(x, cos, sin,
+                            cache=(_raw(k_p), _raw(v_p), tbl, lengths))
+                *rest, kv = out
+                x, aux_total = self._merge_aux(rest[0] if len(rest) == 1 else tuple(rest),
+                                               aux_total, is_moe)
+                new_k.append(kv[0])
+                new_v.append(kv[1])
+            new_cache = {"k": tuple(new_k), "v": tuple(new_v),
+                         "block_table": tbl,
+                         "lengths": lengths + (lengths > 0).astype(lengths.dtype)}
+            if is_moe:
+                return self.norm(x), aux_total, new_cache
+            return self.norm(x), new_cache
         if cache is not None:
             offset = _raw(cache["offset"])
             new_kv = []

@@ -1,0 +1,397 @@
+"""Continuous-batching LLM serving engine over the paged KV cache.
+
+Reference counterparts: the inference product around
+``paddle/fluid/inference/api/analysis_predictor.cc:427`` and the paged
+serving kernel ``paddle/phi/kernels/fusion/gpu/
+block_multi_head_attention_kernel.cu:1`` (block tables, dynamic batching).
+
+TPU-native design:
+
+- **Two compiled programs, not a graph pass pipeline.** A bucketed *prefill*
+  program (dense causal attention over the padded prompt, K/V scattered into
+  the paged pools afterwards) and ONE batched *decode* program (single token
+  for every active slot, paged attention via the block-table Pallas kernel,
+  sampling fused in). Static shapes everywhere: the decode batch is always
+  ``max_batch`` wide with inactive slots masked by ``lengths == 0``.
+- **Host-side scheduler, device-side math.** Admission, block allocation,
+  growth, eviction, and finish detection are plain Python over a numpy block
+  table (shipped to the device each step — [max_batch, max_blocks] int32 is
+  tiny); everything per-token runs in the compiled step.
+- **Preemption over OOM.** When a sequence needs a block and the pool is
+  empty, the youngest running sequence is evicted back to the waiting queue
+  (recompute-style preemption) — admission control the reference does with
+  its block manager.
+
+Pools are donated through the decode step, so XLA updates them in place.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Engine", "GenRequest", "RequestOutput"]
+
+
+@dataclass
+class GenRequest:
+    """One generation request (reference: the llm/ serving request shape)."""
+    prompt_ids: np.ndarray                 # int32 [P]
+    max_new_tokens: int = 64
+    temperature: float = 0.0               # <= 0 -> greedy
+    eos_token_id: Optional[int] = None
+    request_id: Optional[str] = None
+    # eviction bookkeeping (internal): the user-visible prompt, and tokens
+    # generated before a preemption folded them into ``prompt_ids``
+    orig_prompt_ids: Optional[np.ndarray] = None
+    prior_output: List[int] = field(default_factory=list)
+
+
+@dataclass
+class RequestOutput:
+    request_id: str
+    prompt_ids: np.ndarray
+    output_ids: List[int]
+    finish_reason: str                     # "stop" | "length"
+    prefill_time: float = 0.0
+    finish_time: float = 0.0
+
+
+@dataclass(eq=False)
+class _Slot:
+    idx: int = 0
+    req: Optional[GenRequest] = None
+    length: int = 0                        # tokens in cache (prompt + generated)
+    blocks: List[int] = field(default_factory=list)
+    out_ids: List[int] = field(default_factory=list)
+    last_token: int = 0
+    admit_seq: int = 0                     # admission order (eviction priority)
+    prefill_dt: float = 0.0
+
+
+class Engine:
+    """Continuous-batching generation over a paged KV cache.
+
+    ::
+
+        eng = Engine(model, max_batch=8, num_blocks=256)
+        eng.add_request(GenRequest(prompt_ids, max_new_tokens=128))
+        while eng.has_work():
+            for out in eng.step():
+                print(out.output_ids)
+    """
+
+    def __init__(self, model, max_batch: int = 8, num_blocks: int = 256,
+                 block_size: int = 128, prefill_buckets: Tuple[int, ...] = (128, 256, 512, 1024)):
+        from ..jit import functional_call
+
+        self.model = model
+        self.cfg = model.config
+        self.max_batch = max_batch
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.prefill_buckets = tuple(sorted(prefill_buckets))
+        # longest admissible sequence (prompt + generated) per slot
+        self.max_blocks_per_seq = max(
+            (b // block_size for b in self.prefill_buckets)) * 2
+
+        self._params = {n: p._data for n, p in model.named_parameters()}
+        self._buffers = {n: b._data for n, b in model.named_buffers()}
+        self.k_pools, self.v_pools = model.llama.init_paged_pools(
+            num_blocks, block_size)
+
+        # block 0 is the shared trash block for inactive slots
+        self._free = collections.deque(range(1, num_blocks))
+        self._slots = [_Slot(idx=i) for i in range(max_batch)]
+        self._tbl = np.zeros((max_batch, self.max_blocks_per_seq), np.int32)
+        self._waiting: collections.deque = collections.deque()
+        self._admit_counter = 0
+        self._req_counter = 0
+        self._decode_fn = None
+        self._prefill_fns: Dict[int, object] = {}
+        self.stats = {"decode_steps": 0, "prefills": 0, "evictions": 0,
+                      "generated_tokens": 0, "decode_time": 0.0,
+                      "prefill_time": 0.0}
+
+    # -- public API ---------------------------------------------------------
+
+    def add_request(self, req: GenRequest) -> str:
+        if req.request_id is None:
+            self._req_counter += 1
+            req.request_id = f"req-{self._req_counter}"
+        P = len(req.prompt_ids)
+        if (P + req.max_new_tokens) > self.max_blocks_per_seq * self.block_size:
+            raise ValueError(
+                f"prompt ({P}) + max_new_tokens ({req.max_new_tokens}) exceeds "
+                f"the per-slot capacity "
+                f"{self.max_blocks_per_seq * self.block_size}")
+        if self._bucket(P) // self.block_size > self.num_blocks - 1:
+            raise ValueError(
+                f"prompt needs {self._bucket(P) // self.block_size} blocks but "
+                f"the pool only has {self.num_blocks - 1} usable; raise "
+                f"num_blocks")
+        self._waiting.append(req)
+        return req.request_id
+
+    def has_work(self) -> bool:
+        return bool(self._waiting) or any(s.req is not None for s in self._slots)
+
+    def step(self) -> List[RequestOutput]:
+        """Admit + prefill new requests, run one batched decode step, return
+        any requests that finished this step."""
+        self._admit()
+        if not any(s.req is not None for s in self._slots):
+            return []
+        self._ensure_decode_blocks()
+        next_tokens = self._decode()
+        return self._collect(next_tokens)
+
+    def run_to_completion(self) -> List[RequestOutput]:
+        done: List[RequestOutput] = []
+        while self.has_work():
+            done.extend(self.step())
+        return done
+
+    # -- scheduling ---------------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if b >= n:
+                return b
+        # beyond the configured buckets (e.g. an evicted request whose merged
+        # prompt grew past them): buckets are only compile keys, so synthesize
+        # the next block-multiple on demand
+        return -(-n // self.block_size) * self.block_size
+
+    def _admit(self):
+        for slot in self._slots:
+            if not self._waiting:
+                break
+            if slot.req is not None:
+                continue
+            req = self._waiting[0]
+            Pb = self._bucket(len(req.prompt_ids))
+            n_blocks = Pb // self.block_size
+            if n_blocks > self.num_blocks - 1:
+                # an evicted request's merged prompt outgrew the whole pool:
+                # no schedule can ever run it — fail loudly, don't spin
+                raise RuntimeError(
+                    f"request {req.request_id} needs {n_blocks} blocks but the "
+                    f"pool only has {self.num_blocks - 1} usable")
+            if len(self._free) < n_blocks:
+                break                      # pool pressure: stop admitting
+            self._waiting.popleft()
+            blocks = [self._free.popleft() for _ in range(n_blocks)]
+            self._admit_counter += 1
+            slot.req = req
+            slot.length = len(req.prompt_ids)
+            slot.blocks = blocks
+            slot.out_ids = []
+            slot.admit_seq = self._admit_counter
+            self._prefill(slot, Pb)
+            # release bucket-padding blocks beyond the prompt's true need
+            needed = -(-slot.length // self.block_size)
+            while len(slot.blocks) > max(needed, 1):
+                self._free.append(slot.blocks.pop())
+            self._write_tbl_row(slot)
+
+    def _write_tbl_row(self, slot: _Slot):
+        i = slot.idx
+        row = np.zeros((self.max_blocks_per_seq,), np.int32)
+        row[:len(slot.blocks)] = slot.blocks
+        self._tbl[i] = row
+
+    def _ensure_decode_blocks(self):
+        """The next decode writes at position ``length`` — if that starts a
+        new block, allocate it (evicting the youngest sequence on pressure)."""
+        for slot in sorted((s for s in self._slots if s.req is not None),
+                           key=lambda s: s.admit_seq):
+            if slot.req is None:
+                continue           # evicted by an earlier slot's growth
+            need_idx = slot.length // self.block_size
+            while need_idx >= len(slot.blocks):
+                if not self._free:
+                    victim = max((s for s in self._slots if s.req is not None),
+                                 key=lambda s: s.admit_seq)
+                    if victim is slot and slot.admit_seq == victim.admit_seq:
+                        # evicting ourselves means even one sequence cannot
+                        # grow — a genuine capacity error
+                        raise RuntimeError(
+                            "paged KV pool exhausted by a single sequence; "
+                            "increase num_blocks")
+                    self._evict(victim)
+                    continue
+                slot.blocks.append(self._free.popleft())
+            self._write_tbl_row(slot)
+
+    def _evict(self, slot: _Slot):
+        """Recompute-style preemption: requeue the request (with its already
+        generated tokens prepended to the prompt) and free its blocks."""
+        req = slot.req
+        merged = np.concatenate(
+            [np.asarray(req.prompt_ids, np.int32),
+             np.asarray(slot.out_ids, np.int32)]) if slot.out_ids else \
+            np.asarray(req.prompt_ids, np.int32)
+        requeued = GenRequest(
+            prompt_ids=merged,
+            max_new_tokens=req.max_new_tokens - len(slot.out_ids),
+            temperature=req.temperature, eos_token_id=req.eos_token_id,
+            request_id=req.request_id,
+            orig_prompt_ids=(req.orig_prompt_ids if req.orig_prompt_ids
+                             is not None else req.prompt_ids),
+            prior_output=req.prior_output + list(slot.out_ids))
+        self._waiting.appendleft(requeued)
+        self._release(slot)
+        self.stats["evictions"] += 1
+
+    def _release(self, slot: _Slot):
+        for b in slot.blocks:
+            self._free.append(b)
+        slot.req = None
+        slot.length = 0
+        slot.blocks = []
+        slot.out_ids = []
+        self._tbl[slot.idx] = 0                  # point at the trash block
+
+    # -- compiled programs --------------------------------------------------
+
+    def _prefill(self, slot: _Slot, Pb: int):
+        """Dense-causal prefill of one request at bucket length ``Pb``; K/V
+        scattered into the paged pools; first generated token sampled."""
+        from ..framework import random as rnd
+
+        fn = self._prefill_fns.get(Pb)
+        if fn is None:
+            fn = self._prefill_fns[Pb] = jax.jit(
+                self._build_prefill(Pb), donate_argnums=(2, 3))
+        req = slot.req
+        P = slot.length
+        ids = np.zeros((1, Pb), np.int32)
+        ids[0, :P] = req.prompt_ids
+        blocks = np.zeros((Pb // self.block_size,), np.int32)
+        blocks[:len(slot.blocks)] = slot.blocks
+        t0 = time.perf_counter()
+        first, self.k_pools, self.v_pools = fn(
+            self._params, self._buffers, self.k_pools, self.v_pools,
+            jnp.asarray(ids), jnp.asarray(blocks),
+            jnp.asarray(P, jnp.int32), rnd.next_key(),
+            jnp.asarray(req.temperature, jnp.float32))
+        slot.last_token = int(first)            # host read = sync point
+        slot.prefill_dt = time.perf_counter() - t0
+        slot.out_ids.append(slot.last_token)
+        self.stats["prefills"] += 1
+        self.stats["prefill_time"] += slot.prefill_dt
+        self.stats["generated_tokens"] += 1
+
+    def _build_prefill(self, Pb: int):
+        from ..jit import functional_call
+
+        model = self.model
+        cfg = self.cfg
+        bs = self.block_size
+
+        def prefill(params, buffers, k_pools, v_pools, ids, blocks, P, key, temp):
+            from ..kernels.decode_attention import write_paged_prefill
+
+            cache = model.init_cache(1, Pb)
+            out = functional_call(model, params, buffers, ids, cache=cache,
+                                  rng_key=key)
+            logits, new_cache = out[0], out[-1]
+            k_pools = list(k_pools)
+            v_pools = list(v_pools)
+            for li, (k_c, v_c) in enumerate(new_cache["kv"]):
+                k_pools[li], v_pools[li] = write_paged_prefill(
+                    k_pools[li], v_pools[li], blocks, k_c[0, :Pb], v_c[0, :Pb])
+            last = jax.lax.dynamic_index_in_dim(logits, P - 1, axis=1,
+                                                keepdims=False)[0]  # [V]
+            nxt = _sample(last, jax.random.fold_in(key, 1), temp)
+            return nxt, tuple(k_pools), tuple(v_pools)
+
+        return prefill
+
+    def _decode(self):
+        from ..framework import random as rnd
+
+        if self._decode_fn is None:
+            self._decode_fn = jax.jit(self._build_decode(), donate_argnums=(2, 3))
+        lengths = np.array([s.length if s.req is not None else 0
+                            for s in self._slots], np.int32)
+        last = np.array([s.last_token for s in self._slots], np.int32)
+        temps = np.array([s.req.temperature if s.req is not None else 0.0
+                          for s in self._slots], np.float32)
+        t0 = time.perf_counter()
+        nxt, self.k_pools, self.v_pools = self._decode_fn(
+            self._params, self._buffers, self.k_pools, self.v_pools,
+            jnp.asarray(self._tbl), jnp.asarray(lengths), jnp.asarray(last),
+            rnd.next_key(), jnp.asarray(temps))
+        out = np.asarray(nxt)                   # host read = sync point
+        self.stats["decode_time"] += time.perf_counter() - t0
+        self.stats["decode_steps"] += 1
+        return out
+
+    def _build_decode(self):
+        from ..jit import functional_call
+
+        model = self.model
+
+        def decode(params, buffers, k_pools, v_pools, tbl, lengths, last, key, temps):
+            cache = {"k": k_pools, "v": v_pools, "block_table": tbl,
+                     "lengths": lengths}
+            out = functional_call(model, params, buffers, last[:, None],
+                                  cache=cache, rng_key=key)
+            logits, new_cache = out[0], out[-1]
+            lg = logits[:, 0]                                    # [B, V]
+            keys = jax.random.split(jax.random.fold_in(key, 1), lg.shape[0])
+            nxt = jax.vmap(_sample)(lg, keys, temps)
+            return nxt, new_cache["k"], new_cache["v"]
+
+        return decode
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _collect(self, next_tokens: np.ndarray) -> List[RequestOutput]:
+        finished = []
+        for i, slot in enumerate(self._slots):
+            if slot.req is None:
+                continue
+            slot.length += 1       # host mirror of the in-trace lengths+1
+            tok = int(next_tokens[i])
+            req = slot.req
+
+            def _finish(reason):
+                finished.append(RequestOutput(
+                    request_id=req.request_id,
+                    prompt_ids=np.asarray(
+                        req.orig_prompt_ids if req.orig_prompt_ids is not None
+                        else req.prompt_ids),
+                    output_ids=req.prior_output + list(slot.out_ids),
+                    finish_reason=reason,
+                    prefill_time=slot.prefill_dt,
+                    finish_time=time.time()))
+                self._release(slot)
+
+            if req.eos_token_id is not None and tok == req.eos_token_id:
+                _finish("stop")                  # eos itself is not emitted
+                continue
+            slot.last_token = tok
+            slot.out_ids.append(tok)
+            self.stats["generated_tokens"] += 1
+            if len(slot.out_ids) >= req.max_new_tokens:
+                _finish("length")
+        return finished
+
+
+def _sample(logits, key, temp):
+    """Greedy for temp <= 0, else temperature sampling — fused into the
+    compiled prefill/decode programs (the reference samples in a separate
+    pass over the logits)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temp, 1e-6)
+    sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy)

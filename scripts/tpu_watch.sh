@@ -15,7 +15,7 @@
 cd "$(dirname "$0")/.." || exit 1
 LOG=tpu_watch.log
 CACHE=BENCH_TPU_CACHE.jsonl
-PRESETS="base ocr moe longctx decode"
+PRESETS="base ocr moe longctx decode serve"
 
 log() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
 

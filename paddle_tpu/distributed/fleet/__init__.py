@@ -9,6 +9,12 @@ reference's hybrid_configs, ``distributed_strategy.py:323``).  DP/TP/SP/
 sharding become sharding annotations over this mesh (GSPMD inserts the
 collectives the reference issues via NCCL); PP remains an explicit schedule
 (``distributed.parallel.pipeline``).
+
+The brpc/rocksdb parameter-server TRANSPORT is out of TPU scope, but its
+capability — training with embedding tables larger than any device, touching
+only the rows a batch uses — lives in ``paddle_tpu.distributed.ps``
+(vocab-sharded ``SparseTable`` + SelectedRows-style lazy updates over
+shard_map; reference ``the_one_ps.py``, ``phi/core/selected_rows.h``).
 """
 
 from __future__ import annotations

@@ -1,0 +1,379 @@
+"""Sparse embedding tables — the parameter-server capability, TPU-native.
+
+Reference counterparts: the PS stack's sparse tables and trainer protocol
+(``paddle/fluid/distributed/ps/service/``, ``paddle/fluid/distributed/ps/
+table/``, ``python/paddle/distributed/ps/the_one_ps.py:1``) and the
+``SelectedRows`` sparse-gradient representation
+(``paddle/phi/core/selected_rows.h:1``) with lazy-mode optimizers
+(``paddle.optimizer.Adam(lazy_mode=True)``).
+
+The brpc/rocksdb transport is deliberately NOT rebuilt (see
+``fleet/__init__``'s scope note) — the *capability* is: train with embedding
+tables far larger than any one device, touching only the rows a batch uses.
+TPU-native form:
+
+- the table is a ``[V, D]`` jax array **vocab-sharded over the mesh**
+  (``Shard(0)``) — the mesh plays the PS cluster, GSPMD plays the
+  push/pull RPC (a gather/scatter of touched rows compiles into the
+  per-shard lookups + collectives the PS service does by hand);
+- ``pull(uids)`` gathers the touched rows; ``push(uids, grad_rows)``
+  applies a SelectedRows-style update: per-step cost is O(touched x D),
+  never O(V) — untouched rows are bit-identical after any number of steps
+  (lazy semantics);
+- per-row optimizer state (adagrad accumulator / adam moments) lives
+  beside the table with the same sharding and the same lazy update.
+
+``ShardedEmbedding`` is the ``nn.Embedding(sparse=True)`` equivalent for
+eager training; ``SparseTrainStep`` compiles a TrainStep whose dense params
+update normally while every ``ShardedEmbedding``'s table updates sparsely.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..mesh import ProcessMesh, get_mesh
+
+__all__ = ["SparseTable", "ShardedEmbedding", "SparseTrainStep"]
+
+
+class SparseTable:
+    """A vocab-sharded embedding table with lazy (touched-rows-only) updates.
+
+    ``optimizer``: ``"sgd"`` | ``"adagrad"`` | ``"adam"`` (lazy mode — the
+    reference's ``Adam(lazy_mode=True)`` semantics: moments and steps advance
+    only for touched rows)."""
+
+    def __init__(self, num_rows: int, dim: int, optimizer: str = "adagrad",
+                 learning_rate: float = 0.1, initializer_range: float = 0.01,
+                 dtype="float32", mesh: Optional[ProcessMesh] = None,
+                 shard_axis: Optional[str] = None, seed: int = 0,
+                 beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8):
+        self.num_rows = int(num_rows)
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self.learning_rate = float(learning_rate)
+        self._hyper = (float(beta1), float(beta2), float(eps))
+        if optimizer not in ("sgd", "adagrad", "adam"):
+            raise ValueError(f"unknown sparse optimizer {optimizer!r}")
+        dt = jnp.dtype(dtype)
+
+        mesh = mesh if mesh is not None else get_mesh()
+        sharding = None
+        self._padded_rows = self.num_rows
+        if mesh is not None:
+            if shard_axis is None:
+                # widest mesh axis by default (the "PS cluster" axis)
+                shard_axis = max(mesh.dim_names, key=lambda n: mesh.get_dim_size(n))
+            n_shards = mesh.get_dim_size(shard_axis)
+            # pad the physical row count up to a shard multiple: a silently
+            # replicated multi-GB table would defeat the module's purpose
+            self._padded_rows = -(-self.num_rows // n_shards) * n_shards
+            sharding = jax.sharding.NamedSharding(
+                mesh.jax_mesh, jax.sharding.PartitionSpec(shard_axis, None))
+        self.mesh = mesh
+        self.shard_axis = shard_axis if sharding is not None else None
+        self._sharding = sharding
+
+        def init():
+            if initializer_range == 0.0:
+                return jnp.zeros((self._padded_rows, self.dim), dt)
+            key = jax.random.key(seed)
+            t = jax.random.normal(key, (self._padded_rows, self.dim), dt) \
+                * initializer_range
+            return t
+
+        init_jit = jax.jit(init, out_shardings=sharding) if sharding is not None \
+            else jax.jit(init)
+        self.table = init_jit()
+        zeros = functools.partial(jnp.zeros, (self._padded_rows, self.dim), jnp.float32)
+        zjit = jax.jit(zeros, out_shardings=sharding) if sharding is not None \
+            else jax.jit(zeros)
+        if optimizer == "adagrad":
+            self.state = {"g2": zjit()}
+        elif optimizer == "adam":
+            t0 = functools.partial(jnp.zeros, (self._padded_rows,), jnp.int32)
+            if sharding is not None:
+                tsh = jax.sharding.NamedSharding(
+                    mesh.jax_mesh, jax.sharding.PartitionSpec(self.shard_axis))
+                self.state = {"m": zjit(), "v": zjit(),
+                              "t": jax.jit(t0, out_shardings=tsh)()}
+            else:
+                self.state = {"m": zjit(), "v": zjit(), "t": t0()}
+        else:
+            self.state = {}
+        self._pull_fn = None
+        self._push_fn = None
+
+    @property
+    def nbytes(self) -> int:
+        n = self.table.nbytes
+        for v in self.state.values():
+            n += v.nbytes
+        return n
+
+    def _shard_info(self):
+        """(rows_per_shard, axis_name) for the vocab-sharded layout."""
+        n = self.mesh.get_dim_size(self.shard_axis)
+        return self._padded_rows // n, self.shard_axis
+
+    def _smap(self, fn, in_specs, out_specs):
+        """shard_map over the table's mesh: the per-shard body is the PS
+        server loop (mask ids to the local vocab range, gather/scatter with
+        LOCAL indices). GSPMD's generic partitioned scatter was measured
+        26-1000x slower than this at 20M-100M rows on the CPU mesh."""
+        return jax.shard_map(fn, mesh=self.mesh.jax_mesh,
+                             in_specs=in_specs, out_specs=out_specs,
+                             check_vma=False)
+
+    # -- pull ---------------------------------------------------------------
+
+    def pull(self, uids) -> jax.Array:
+        """Gather touched rows: ``[U] -> [U, D]`` (the PS pull RPC)."""
+        if self._pull_fn is None:
+            if self._sharding is None:
+                self._pull_fn = jax.jit(lambda table, u: table[u])
+            else:
+                from jax.sharding import PartitionSpec as P
+
+                rows_per, ax = self._shard_info()
+                tspec = P(ax, None)
+
+                def pull_shard(table_l, u):
+                    li = _local_idx(u, ax, rows_per)
+                    # OOB gather fills 0; psum sums the one shard that owns
+                    # each row (the pull "RPC" is one all-reduce)
+                    rows = table_l.at[li].get(mode="fill", fill_value=0.0)
+                    return jax.lax.psum(rows, ax)
+
+                self._pull_fn = jax.jit(self._smap(
+                    pull_shard, (tspec, P(None)), P(None)))
+        return self._pull_fn(self.table, jnp.asarray(uids, jnp.int32))
+
+    # -- push (SelectedRows-style lazy update) ------------------------------
+
+    def push(self, uids, grad_rows, learning_rate: Optional[float] = None) -> None:
+        """Apply the sparse update for ``uids`` (``[U]``) with row gradients
+        ``[U, D]``. Duplicate ids must have been combined by the caller
+        (``ShardedEmbedding`` uses unique + segment-sum); rows never touched
+        stay bit-identical. O(U x D) work, independent of ``num_rows``."""
+        if self._push_fn is None:
+            self._push_fn = self._build_push()
+        lr = self.learning_rate if learning_rate is None else float(learning_rate)
+        out = self._push_fn(self.table, self.state,
+                            jnp.asarray(uids, jnp.int32),
+                            jnp.asarray(grad_rows),
+                            jnp.asarray(lr, jnp.float32))
+        self.table, self.state = out
+
+    def _build_push(self):
+        kind = self.optimizer
+        b1, b2, eps = self._hyper
+
+        def apply(table, state, idx, g, lr, get_mode, set_mode):
+            """One shard's (or the unsharded) lazy update at row indices
+            ``idx``; OOB indices read fill values and drop their writes."""
+            g = g.astype(jnp.float32)
+            if kind == "sgd":
+                upd = lr * g
+            elif kind == "adagrad":
+                g2 = state["g2"].at[idx].add(g * g, mode=set_mode)
+                state = {"g2": g2}
+                cur = g2.at[idx].get(mode=get_mode, fill_value=1.0)
+                upd = lr * g / (jnp.sqrt(cur) + 1e-10)
+            else:  # adam, lazy: per-row step counters
+                t = state["t"].at[idx].add(1, mode=set_mode)
+                m = state["m"].at[idx].mul(b1, mode=set_mode)
+                m = m.at[idx].add((1 - b1) * g, mode=set_mode)
+                v = state["v"].at[idx].mul(b2, mode=set_mode)
+                v = v.at[idx].add((1 - b2) * g * g, mode=set_mode)
+                tr = t.at[idx].get(mode=get_mode, fill_value=1).astype(jnp.float32)[:, None]
+                m_hat = m.at[idx].get(mode=get_mode, fill_value=0.0) / (1 - b1 ** tr)
+                v_hat = v.at[idx].get(mode=get_mode, fill_value=1.0) / (1 - b2 ** tr)
+                state = {"m": m, "v": v, "t": t}
+                upd = lr * m_hat / (jnp.sqrt(v_hat) + eps)
+            table = table.at[idx].add(-upd.astype(table.dtype), mode=set_mode)
+            return table, state
+
+        if self._sharding is None:
+            def push(table, state, uids, g, lr):
+                return apply(table, state, uids, g, lr, "promise_in_bounds",
+                             "promise_in_bounds")
+
+            return jax.jit(push, donate_argnums=(0, 1))
+
+        from jax.sharding import PartitionSpec as P
+
+        rows_per, ax = self._shard_info()
+        tspec = P(ax, None)
+        state_specs = {k: P(ax, None) if v.ndim == 2 else P(ax)
+                       for k, v in self.state.items()}
+
+        def push_shard(table_l, state_l, uids, g, lr):
+            # local indices; out-of-shard rows read fills and drop writes
+            li = _local_idx(uids, ax, rows_per)
+            return apply(table_l, state_l, li, g, lr, "fill", "drop")
+
+        smapped = self._smap(
+            push_shard,
+            (tspec, state_specs, P(None), P(None), P()),
+            (tspec, state_specs))
+        return jax.jit(smapped, donate_argnums=(0, 1))
+
+    # -- checkpoint surface -------------------------------------------------
+
+    def state_dict(self):
+        d = {"table": self.table}
+        for k, v in self.state.items():
+            d[f"state.{k}"] = v
+        return d
+
+    def set_state_dict(self, d):
+        self.table = d["table"]
+        for k in list(self.state):
+            self.state[k] = d[f"state.{k}"]
+
+
+def _local_idx(uids, ax: str, rows_per: int):
+    """Global row ids -> this shard's local indices; out-of-shard rows map
+    to ``rows_per`` (a POSITIVE out-of-bounds sentinel — negative indices
+    would wrap pythonically instead of hitting the 'drop'/'fill' modes)."""
+    li = uids - jax.lax.axis_index(ax) * rows_per
+    ok = (li >= 0) & (li < rows_per)
+    return jnp.where(ok, li, rows_per)
+
+
+def _unique_host(ids: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side unique (ids are host data at step boundaries anyway):
+    returns (uids [U], inverse [N]) — the reference's c_lookup unique/gather
+    preprocessing."""
+    uids, inv = np.unique(np.asarray(ids).reshape(-1), return_inverse=True)
+    return uids.astype(np.int32), inv.astype(np.int32).reshape(np.shape(ids))
+
+
+class ShardedEmbedding:
+    """Eager sparse-embedding layer over a :class:`SparseTable`.
+
+    ``nn.Embedding(sparse=True)`` equivalent: forward pulls only the touched
+    rows (as a differentiable leaf), ``apply_gradients()`` after
+    ``loss.backward()`` pushes the SelectedRows update."""
+
+    def __init__(self, table: SparseTable):
+        self.table = table
+        self._last = None  # (uids, rows_tensor, inverse)
+
+    @property
+    def weight_shape(self):
+        return (self.table.num_rows, self.table.dim)
+
+    def __call__(self, ids):
+        from ...framework.dispatch import apply_op
+        from ...framework.tensor import Tensor
+
+        ids_np = np.asarray(ids._data if isinstance(ids, Tensor) else ids)
+        uids, inv = _unique_host(ids_np)
+        rows = Tensor(self.table.pull(uids), stop_gradient=False)
+        inv_j = jnp.asarray(inv)
+        out = apply_op("sparse_embedding", lambda r: r[inv_j], (rows,), {})
+        self._last = (uids, rows)
+        return out
+
+    forward = __call__
+
+    def apply_gradients(self, learning_rate: Optional[float] = None) -> None:
+        """Push the rows' gradient (accumulated by ``loss.backward()``)."""
+        if self._last is None:
+            raise RuntimeError("no pending forward; call the layer first")
+        uids, rows = self._last
+        if rows._grad is None:
+            raise RuntimeError("rows have no gradient; run loss.backward() "
+                               "before apply_gradients()")
+        self.table.push(uids, rows._grad, learning_rate)
+        self._last = None
+
+
+class SparseTrainStep:
+    """TrainStep variant: dense params update via the wrapped optimizer,
+    every :class:`ShardedEmbedding` input table updates sparsely.
+
+    ``fwd_fn(embedded, *args) -> loss`` receives the already-embedded rows
+    (``[B, S, D]`` — or a tuple when several tables are given) plus the
+    remaining batch args; dense model params are taken from ``model``.
+    """
+
+    def __init__(self, model, embeddings: Sequence[ShardedEmbedding],
+                 fwd_fn, optimizer):
+        from ...jit import TrainStep  # noqa: F401 (same state conventions)
+
+        self.model = model
+        self.embeddings = list(embeddings)
+        self.fwd_fn = fwd_fn
+        self.optimizer = optimizer
+        self._params = {n: p._data for n, p in model.named_parameters()}
+        self._buffers = {n: b._data for n, b in model.named_buffers()}
+        init_fn, self._update_fn = optimizer.functional()
+        self._opt_state = init_fn(self._params)
+        self._step = 0
+        self._jitted = None
+
+    def _build(self, n_tables):
+        from ...jit import functional_call
+
+        model = self.model
+        fwd_fn = self.fwd_fn
+
+        def step_fn(params, buffers, opt_state, lr, step, rows_list, inv_list, args):
+            def loss_of(p, rows_in):
+                emb = tuple(r[i] for r, i in zip(rows_in, inv_list))
+                emb = emb[0] if n_tables == 1 else emb
+                from ...framework.autograd import no_grad
+                from ...jit import _bind_state
+                from ...framework.dispatch import unwrap, wrap
+
+                with _bind_state(model, p, buffers), no_grad():
+                    loss = fwd_fn(wrap(emb), *wrap(args))
+                return unwrap(loss)
+
+            (loss), grads = jax.value_and_grad(loss_of, argnums=(0, 1))(
+                params, tuple(rows_list))
+            dense_g, row_g = grads
+            new_params, new_state = self._update_fn(params, dense_g, opt_state,
+                                                    lr, step)
+            return loss, new_params, new_state, row_g
+
+        return jax.jit(step_fn, donate_argnums=(0, 2))
+
+    def __call__(self, ids_list, *args):
+        """``ids_list``: one id array per table (a single array is promoted
+        to a one-element list)."""
+        from ...framework.tensor import Tensor
+
+        if not isinstance(ids_list, (list, tuple)):
+            ids_list = [ids_list]
+        assert len(ids_list) == len(self.embeddings)
+        uids_l, inv_l, rows_l = [], [], []
+        for emb, ids in zip(self.embeddings, ids_list):
+            ids_np = np.asarray(ids._data if isinstance(ids, Tensor) else ids)
+            uids, inv = _unique_host(ids_np)
+            uids_l.append(uids)
+            inv_l.append(jnp.asarray(inv))
+            rows_l.append(emb.table.pull(uids))
+        if self._jitted is None:
+            self._jitted = self._build(len(self.embeddings))
+        self._step += 1
+        raw_args = tuple(a._data if isinstance(a, Tensor) else a for a in args)
+        loss, self._params, self._opt_state, row_g = self._jitted(
+            self._params, self._buffers, self._opt_state,
+            jnp.asarray(self.optimizer.get_lr(), jnp.float32),
+            jnp.asarray(self._step, jnp.int32),
+            tuple(rows_l), tuple(inv_l), raw_args)
+        for emb, uids, g in zip(self.embeddings, uids_l, row_g):
+            emb.table.push(uids, g)
+        for n, p in self.model.named_parameters():
+            p._data = self._params[n]
+        return Tensor(loss)

@@ -215,20 +215,23 @@ class Engine:
             if slot.req is None:
                 continue           # evicted by an earlier slot's growth
             need_idx = slot.length // self.block_size
-            while need_idx >= len(slot.blocks):
-                if not self._free:
-                    victim = max((s for s in self._slots if s.req is not None),
-                                 key=lambda s: s.admit_seq)
-                    if victim is slot and slot.admit_seq == victim.admit_seq:
-                        # evicting ourselves means even one sequence cannot
-                        # grow — a genuine capacity error
-                        raise RuntimeError(
-                            "paged KV pool exhausted by a single sequence; "
-                            "increase num_blocks")
-                    self._evict(victim)
+            while slot.req is not None and need_idx >= len(slot.blocks):
+                if self._free:
+                    slot.blocks.append(self._free.popleft())
                     continue
-                slot.blocks.append(self._free.popleft())
-            self._write_tbl_row(slot)
+                actives = [s for s in self._slots if s.req is not None]
+                if len(actives) == 1 and actives[0] is slot:
+                    # truly alone and still out of blocks: a genuine
+                    # capacity error
+                    raise RuntimeError(
+                        "paged KV pool exhausted by a single sequence; "
+                        "increase num_blocks")
+                # preempt the youngest active sequence — possibly THIS one
+                # (it requeues and retries once older work finishes)
+                victim = max(actives, key=lambda s: s.admit_seq)
+                self._evict(victim)
+            if slot.req is not None:
+                self._write_tbl_row(slot)
 
     def _evict(self, slot: _Slot):
         """Recompute-style preemption: requeue the request (with its already

@@ -1,0 +1,170 @@
+"""Sparse embedding tables (distributed.ps) — the PS-capability substitute.
+
+Reference: ``paddle/phi/core/selected_rows.h`` (sparse grads),
+``python/paddle/distributed/ps/the_one_ps.py`` (sparse tables),
+``Adam(lazy_mode=True)`` semantics. Vocab-sharded over the mesh via
+shard_map; per-step cost O(touched rows), untouched rows bit-identical."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.ps import ShardedEmbedding, SparseTable, SparseTrainStep
+
+
+@pytest.fixture()
+def mesh():
+    return dist.ProcessMesh(np.arange(8), ["mp"])
+
+
+def _dense_update(opt, dense, uids, g, lr, state):
+    gd = g.astype(np.float64)
+    if opt == "sgd":
+        dense[uids] -= lr * gd
+    elif opt == "adagrad":
+        state["g2"][uids] += gd * gd
+        dense[uids] -= lr * gd / (np.sqrt(state["g2"][uids]) + 1e-10)
+    else:  # lazy adam
+        state["t"][uids] += 1
+        state["m"][uids] = 0.9 * state["m"][uids] + 0.1 * gd
+        state["v"][uids] = 0.999 * state["v"][uids] + 0.001 * gd * gd
+        tr = state["t"][uids][:, None]
+        mh = state["m"][uids] / (1 - 0.9 ** tr)
+        vh = state["v"][uids] / (1 - 0.999 ** tr)
+        dense[uids] -= lr * mh / (np.sqrt(vh) + 1e-8)
+
+
+@pytest.mark.parametrize("opt", ["sgd", "adagrad", "adam"])
+def test_push_matches_dense_reference(mesh, opt):
+    rng = np.random.default_rng(0)
+    tbl = SparseTable(4096, 8, optimizer=opt, learning_rate=0.5, mesh=mesh, seed=2)
+    assert "mp" in str(tbl.table.sharding.spec)
+    dense = np.asarray(tbl.table).astype(np.float64)
+    state = {"g2": np.zeros_like(dense), "m": np.zeros_like(dense),
+             "v": np.zeros_like(dense), "t": np.zeros(4096)}
+    uids = np.unique(rng.integers(0, 4096, size=64)).astype(np.int32)
+    g = rng.normal(size=(len(uids), 8)).astype(np.float32)
+    for _ in range(3):
+        tbl.push(uids, g)
+        _dense_update(opt, dense, uids, g, 0.5, state)
+    np.testing.assert_allclose(np.asarray(tbl.table), dense.astype(np.float32),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_untouched_rows_bit_identical(mesh):
+    tbl = SparseTable(1024, 16, optimizer="adam", learning_rate=0.5, mesh=mesh)
+    before = np.asarray(tbl.table)
+    uids = np.array([3, 700], np.int32)
+    for _ in range(5):
+        tbl.push(uids, np.ones((2, 16), np.float32))
+    after = np.asarray(tbl.table)
+    mask = np.ones(1024, bool)
+    mask[uids] = False
+    np.testing.assert_array_equal(before[mask], after[mask])  # lazy: no decay
+    assert np.abs(after[uids] - before[uids]).max() > 0
+
+
+def test_pull_matches_direct_index(mesh):
+    tbl = SparseTable(4096, 8, optimizer="sgd", mesh=mesh, seed=3)
+    uids = np.array([0, 5, 1000, 4095], np.int32)
+    np.testing.assert_allclose(np.asarray(tbl.pull(uids)),
+                               np.asarray(tbl.table)[uids], rtol=1e-6)
+
+
+def test_unsharded_table_works_without_mesh():
+    tbl = SparseTable(512, 4, optimizer="adagrad", learning_rate=0.1, mesh=None)
+    uids = np.array([1, 2], np.int32)
+    tbl.push(uids, np.ones((2, 4), np.float32))
+    assert np.abs(np.asarray(tbl.table[1])).max() > 0
+
+
+def test_eager_embedding_trains_and_matches_compiled(mesh):
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, 5000, size=(16, 4)).astype(np.int32))
+    y = paddle.to_tensor(rng.normal(size=(16, 1)).astype(np.float32))
+
+    def build():
+        paddle.seed(0)
+        t = SparseTable(5000, 8, optimizer="adagrad", learning_rate=0.3,
+                        mesh=mesh, seed=1)
+        emb = ShardedEmbedding(t)
+        head = nn.Linear(8, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=head.parameters())
+        return emb, head, opt
+
+    emb, head, opt = build()
+    losses = []
+    for _ in range(10):
+        e = emb(ids)
+        loss = ((head(e.mean(axis=1)) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        emb.apply_gradients()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] / 2
+
+    emb2, head2, opt2 = build()
+
+    def fwd(embedded, yy):
+        return ((head2(embedded.mean(axis=1)) - yy) ** 2).mean()
+
+    step = SparseTrainStep(head2, [emb2], fwd, opt2)
+    closses = [float(np.asarray(step(ids, y)._data)) for _ in range(10)]
+    np.testing.assert_allclose(closses, losses, rtol=1e-4, atol=1e-6)
+
+
+def test_push_cost_is_o_touched_not_o_rows(mesh):
+    """Same touched set, 8x the table: step time must not scale with V
+    (donated buffers update in place; shard_map does local scatters)."""
+    rng = np.random.default_rng(0)
+    U = 512
+
+    def timed_push(V):
+        tbl = SparseTable(V, 16, optimizer="adagrad", mesh=mesh,
+                          initializer_range=0.0)
+        jax.block_until_ready(tbl.table)
+        uids = np.unique(rng.integers(0, V, size=U)).astype(np.int32)
+        g = rng.normal(size=(len(uids), 16)).astype(np.float32)
+        tbl.push(uids, g)
+        jax.block_until_ready(tbl.table)
+        t0 = time.perf_counter()
+        for _ in range(20):
+            tbl.push(uids, g)
+        jax.block_until_ready(tbl.table)
+        return (time.perf_counter() - t0) / 20
+
+    small = timed_push(250_000)
+    big = timed_push(2_000_000)
+    # generous CI bound: an O(V) copy would be ~8x; allow 3x for noise
+    assert big < small * 3 + 0.01, (small, big)
+
+
+def test_state_dict_roundtrip(mesh):
+    tbl = SparseTable(256, 4, optimizer="adam", mesh=mesh, seed=9)
+    tbl.push(np.array([1, 2], np.int32), np.ones((2, 4), np.float32))
+    snap = {k: np.asarray(v) for k, v in tbl.state_dict().items()}
+    tbl2 = SparseTable(256, 4, optimizer="adam", mesh=mesh, seed=0)
+    tbl2.set_state_dict({k: jnp.asarray(v) for k, v in snap.items()})
+    np.testing.assert_array_equal(np.asarray(tbl2.table), snap["table"])
+    np.testing.assert_array_equal(np.asarray(tbl2.state["m"]), snap["state.m"])
+
+
+def test_non_divisible_rows_still_sharded(mesh):
+    # 1001 % 8 != 0: the table pads to a shard multiple instead of silently
+    # replicating (which would defeat the larger-than-device purpose)
+    tbl = SparseTable(1001, 4, optimizer="sgd", learning_rate=1.0, mesh=mesh)
+    assert "mp" in str(tbl.table.sharding.spec)
+    assert tbl.table.shape[0] == 1008 and tbl.num_rows == 1001
+    uids = np.array([0, 1000], np.int32)   # incl. the last logical row
+    tbl.push(uids, np.ones((2, 4), np.float32))
+    np.testing.assert_allclose(np.asarray(tbl.pull(uids)),
+                               np.asarray(tbl.table)[uids], rtol=1e-6)
+    assert np.abs(np.asarray(tbl.table[1000])).max() > 0

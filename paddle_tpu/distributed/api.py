@@ -26,6 +26,7 @@ from .mesh import ProcessMesh, get_mesh
 from .placement import Partial, Placement, Replicate, Shard, named_sharding, to_partition_spec
 
 __all__ = [
+    "shard_parameter_init",
     "shard_tensor", "reshard", "shard_layer", "shard_optimizer", "dtensor_from_local",
     "dtensor_from_fn", "unshard_dtensor", "shard_dataloader",
 ]
@@ -127,6 +128,42 @@ def _reduce_partial(data, mesh: ProcessMesh, src_placements, mesh_dim: int, redu
     if reduce_type == "avg":
         out = out / mesh.shape[mesh_dim]
     return out
+
+
+def shard_parameter_init(shape, initializer, mesh: ProcessMesh, placements,
+                         dtype=None, name: str = "") -> Parameter:
+    """Initialize a Parameter DIRECTLY into its mesh sharding.
+
+    The plain path (``create_parameter`` then ``shard_tensor``) materializes
+    the FULL array before placing it — at 70B scale that is ~140GB of host
+    RAM per process. Here the initializer runs under
+    ``jax.jit(..., out_shardings=...)``: XLA generates each device's shard in
+    place, and under multi-process ``jax.distributed`` each process
+    materializes ONLY its addressable shards — host RSS is bounded by the
+    local shard bytes (the idea behind the reference's
+    ``group_sharded_stage3.py:85`` param segmentation, applied at init).
+
+    RNG draws inside the initializer come from the framework generator via a
+    pre-split key: results are seed-reproducible, but NOT bit-identical to
+    the plain ``create_parameter`` sequence (the pre-split changes the key
+    stream; use ``load_from_sequential``/checkpoints to move exact weights
+    between the two layouts)."""
+    from ..framework import random as rnd
+    from ..framework.dtype import convert_dtype
+
+    placements = _norm_placements(mesh, placements)
+    sharding = named_sharding(mesh, placements, len(shape))
+    key = rnd.next_key()
+    dt = convert_dtype(dtype) if dtype is not None else None
+
+    def init():
+        with rnd.rng_guard(key):
+            return initializer(tuple(int(s) for s in shape), dt)
+
+    data = jax.jit(init, out_shardings=sharding)()
+    p = Parameter(data, name=name)
+    p._dist_attr = (mesh, placements)
+    return p
 
 
 def dtensor_from_local(local_tensor, mesh: ProcessMesh, placements) -> Tensor:

@@ -137,7 +137,14 @@ class SparseTable:
         """Gather touched rows: ``[U] -> [U, D]`` (the PS pull RPC)."""
         if self._pull_fn is None:
             if self._sharding is None:
-                self._pull_fn = jax.jit(lambda table, u: table[u])
+                n_rows = self.num_rows
+
+                def pull_plain(table, u):
+                    ok = (u >= 0) & (u < n_rows)
+                    idx = jnp.where(ok, u, n_rows)
+                    return table.at[idx].get(mode="fill", fill_value=0.0)
+
+                self._pull_fn = jax.jit(pull_plain)
             else:
                 from jax.sharding import PartitionSpec as P
 
@@ -201,9 +208,14 @@ class SparseTable:
             return table, state
 
         if self._sharding is None:
+            n_rows = self.num_rows
+
             def push(table, state, uids, g, lr):
-                return apply(table, state, uids, g, lr, "promise_in_bounds",
-                             "promise_in_bounds")
+                # same sentinel semantics as the sharded path: out-of-range
+                # ids (incl. bucket padding) read fills and drop writes
+                ok = (uids >= 0) & (uids < n_rows)
+                idx = jnp.where(ok, uids, n_rows)
+                return apply(table, state, idx, g, lr, "fill", "drop")
 
             return jax.jit(push, donate_argnums=(0, 1))
 
@@ -248,12 +260,21 @@ def _local_idx(uids, ax: str, rows_per: int):
     return jnp.where(ok, li, rows_per)
 
 
-def _unique_host(ids: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+def _unique_host(ids: np.ndarray, pad_id: int) -> Tuple[np.ndarray, np.ndarray]:
     """Host-side unique (ids are host data at step boundaries anyway):
     returns (uids [U], inverse [N]) — the reference's c_lookup unique/gather
-    preprocessing."""
+    preprocessing. ``uids`` is PADDED to the next power-of-two bucket with
+    ``pad_id`` (an out-of-range sentinel the fill/drop modes ignore) so the
+    jitted pull/push/step programs see a bounded set of shapes instead of
+    recompiling for every distinct touched-row count."""
     uids, inv = np.unique(np.asarray(ids).reshape(-1), return_inverse=True)
-    return uids.astype(np.int32), inv.astype(np.int32).reshape(np.shape(ids))
+    n = max(len(uids), 1)
+    bucket = 16
+    while bucket < n:
+        bucket *= 2
+    padded = np.full((bucket,), pad_id, np.int32)
+    padded[:len(uids)] = uids
+    return padded, inv.astype(np.int32).reshape(np.shape(ids))
 
 
 class ShardedEmbedding:
@@ -265,7 +286,7 @@ class ShardedEmbedding:
 
     def __init__(self, table: SparseTable):
         self.table = table
-        self._last = None  # (uids, rows_tensor, inverse)
+        self._pending = []  # [(uids, rows_tensor)] awaiting apply_gradients
 
     @property
     def weight_shape(self):
@@ -276,25 +297,27 @@ class ShardedEmbedding:
         from ...framework.tensor import Tensor
 
         ids_np = np.asarray(ids._data if isinstance(ids, Tensor) else ids)
-        uids, inv = _unique_host(ids_np)
+        uids, inv = _unique_host(ids_np, self.table.num_rows)
         rows = Tensor(self.table.pull(uids), stop_gradient=False)
         inv_j = jnp.asarray(inv)
         out = apply_op("sparse_embedding", lambda r: r[inv_j], (rows,), {})
-        self._last = (uids, rows)
+        self._pending.append((uids, rows))
         return out
 
     forward = __call__
 
     def apply_gradients(self, learning_rate: Optional[float] = None) -> None:
-        """Push the rows' gradient (accumulated by ``loss.backward()``)."""
-        if self._last is None:
+        """Push every pending forward's row gradients (one push per forward,
+        so gradient-accumulation loops lose nothing)."""
+        if not self._pending:
             raise RuntimeError("no pending forward; call the layer first")
-        uids, rows = self._last
-        if rows._grad is None:
-            raise RuntimeError("rows have no gradient; run loss.backward() "
-                               "before apply_gradients()")
-        self.table.push(uids, rows._grad, learning_rate)
-        self._last = None
+        pending, self._pending = self._pending, []
+        for uids, rows in pending:
+            if rows._grad is None:
+                raise RuntimeError(
+                    "rows have no gradient; run loss.backward() before "
+                    "apply_gradients()")
+            self.table.push(uids, rows._grad, learning_rate)
 
 
 class SparseTrainStep:
@@ -322,8 +345,6 @@ class SparseTrainStep:
         self._jitted = None
 
     def _build(self, n_tables):
-        from ...jit import functional_call
-
         model = self.model
         fwd_fn = self.fwd_fn
 
@@ -359,7 +380,7 @@ class SparseTrainStep:
         uids_l, inv_l, rows_l = [], [], []
         for emb, ids in zip(self.embeddings, ids_list):
             ids_np = np.asarray(ids._data if isinstance(ids, Tensor) else ids)
-            uids, inv = _unique_host(ids_np)
+            uids, inv = _unique_host(ids_np, emb.table.num_rows)
             uids_l.append(uids)
             inv_l.append(jnp.asarray(inv))
             rows_l.append(emb.table.pull(uids))

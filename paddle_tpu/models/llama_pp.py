@@ -39,7 +39,7 @@ from ..nn.initializer import Constant, Normal
 from ..nn.layers import Layer
 from ..distributed.mesh import ProcessMesh, get_mesh
 from ..distributed.placement import Replicate, Shard
-from ..distributed.api import shard_tensor
+from ..distributed.api import shard_parameter_init, shard_tensor
 from ..distributed.parallel.pipeline import (pipeline_1f1b_step, pipeline_spmd_step,
                                              pipeline_vpp_step, pipeline_zb_step)
 from .llama import (LlamaConfig, LlamaForCausalLM, _place_all_params,
@@ -100,34 +100,31 @@ class LlamaForCausalLMPipe(Layer):
         Lps = self.layers_per_stage
 
         def stacked(name, shape, initializer, mp_dim=None):
-            p = self.create_parameter([pp, Lps] + shape, dtype=config.pdtype,
-                                      default_initializer=initializer)
+            # init-by-shard: the [pp, Lps, ...] stack never materializes
+            # unsharded (70B-scale feasibility; see shard_parameter_init)
+            full = [pp, Lps] + shape
             placements = [Replicate()] * mesh.ndim
             pp_ax = mesh.dim_names.index("pp")
             placements[pp_ax] = Shard(0)
             if mp_dim is not None and "mp" in mesh.dim_names:
                 mp_ax = mesh.dim_names.index("mp")
-                if p.shape[mp_dim] % mesh.shape[mp_ax] == 0:
+                if full[mp_dim] % mesh.shape[mp_ax] == 0:
                     placements[mp_ax] = Shard(mp_dim)
-            shard_tensor(p, mesh, placements)
+            p = shard_parameter_init(full, initializer, mesh, placements,
+                                     dtype=config.pdtype)
             self.add_parameter(name, p)
             return p
 
-        self.embed_tokens = self.create_parameter([config.vocab_size, H], dtype=config.pdtype,
-                                                  default_initializer=init)
-        self._shard_replicated(self.embed_tokens, mp_dim=0)
+        self.embed_tokens = self._sharded_init(
+            [config.vocab_size, H], init, mp_dim=0)
         stacked("ln1_w", [H], Constant(1.0))
         stacked("qkv_w", [H, (h + 2 * hk) * d], init, mp_dim=3)
         stacked("o_w", [h * d, H], init, mp_dim=2)
         stacked("ln2_w", [H], Constant(1.0))
         stacked("gate_up_w", [H, 2 * inter], init, mp_dim=3)
         stacked("down_w", [inter, H], init, mp_dim=2)
-        self.norm_w = self.create_parameter([H], dtype=config.pdtype,
-                                            default_initializer=Constant(1.0))
-        self._shard_replicated(self.norm_w)
-        self.lm_head = self.create_parameter([H, config.vocab_size], dtype=config.pdtype,
-                                             default_initializer=init)
-        self._shard_replicated(self.lm_head, mp_dim=1)
+        self.norm_w = self._sharded_init([H], Constant(1.0))
+        self.lm_head = self._sharded_init([H, config.vocab_size], init, mp_dim=1)
 
         cos, sin = rope_mod.rope_freqs(config.head_dim, config.max_position_embeddings,
                                        config.rope_theta)
@@ -135,14 +132,15 @@ class LlamaForCausalLMPipe(Layer):
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
         _place_all_params(self, mesh)
 
-    def _shard_replicated(self, p, mp_dim=None):
+    def _sharded_init(self, shape, initializer, mp_dim=None):
         mesh = self._mesh
         placements = [Replicate()] * mesh.ndim
         if mp_dim is not None and "mp" in mesh.dim_names:
             mp_ax = mesh.dim_names.index("mp")
-            if p.shape[mp_dim] % mesh.shape[mp_ax] == 0:
+            if shape[mp_dim] % mesh.shape[mp_ax] == 0:
                 placements[mp_ax] = Shard(mp_dim)
-        shard_tensor(p, mesh, placements)
+        return shard_parameter_init(shape, initializer, mesh, placements,
+                                    dtype=self.config.pdtype)
 
     # -- weight exchange with the sequential model ---------------------------
     def load_from_sequential(self, model: LlamaForCausalLM):

@@ -296,3 +296,26 @@ def test_vpp_schedule_requires_virtual_stages(pp_fleet):
     with pytest.raises(ValueError, match="virtual_pp_degree"):
         model.train_batch((_ids(cfg), _ids(cfg)), opt)
     strategy.pipeline_configs = {"micro_batch_size": 1}
+
+
+def test_pipe_params_init_by_shard(pp_fleet):
+    """VERDICT r3 #6: pipe params must be BORN sharded (jit out_shardings),
+    never materialized as an unsharded replica first — the 70B-scale
+    feasibility property (each process materializes only its addressable
+    shards under multi-host jax.distributed)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import llama_tiny_config
+    from paddle_tpu.models.llama_pp import LlamaForCausalLMPipe
+
+    paddle.seed(0)
+    m1 = LlamaForCausalLMPipe(llama_tiny_config())
+    for n, p in m1.named_parameters():
+        spec = str(p._data.sharding.spec)
+        assert p._dist_attr is not None, n
+        if n in ("ln1_w", "qkv_w", "o_w", "ln2_w", "gate_up_w", "down_w"):
+            assert "pp" in spec, (n, spec)
+    # seed-reproducible despite the sharded init path
+    paddle.seed(0)
+    m2 = LlamaForCausalLMPipe(llama_tiny_config())
+    for (n, p1), (_, p2) in zip(m1.named_parameters(), m2.named_parameters()):
+        np.testing.assert_array_equal(np.asarray(p1._data), np.asarray(p2._data))

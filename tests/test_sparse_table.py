@@ -168,3 +168,43 @@ def test_non_divisible_rows_still_sharded(mesh):
     np.testing.assert_allclose(np.asarray(tbl.pull(uids)),
                                np.asarray(tbl.table)[uids], rtol=1e-6)
     assert np.abs(np.asarray(tbl.table[1000])).max() > 0
+
+
+def test_embedding_gradient_accumulation(mesh):
+    # two forwards before apply_gradients: BOTH batches' row grads must push
+    paddle.seed(0)
+    tbl = SparseTable(100, 4, optimizer="sgd", learning_rate=1.0, mesh=mesh,
+                      initializer_range=0.0)
+    emb = ShardedEmbedding(tbl)
+    ids1 = paddle.to_tensor(np.array([[1]], np.int32))
+    ids2 = paddle.to_tensor(np.array([[2]], np.int32))
+    for ids in (ids1, ids2):
+        out = emb(ids)
+        out.sum().backward()
+    emb.apply_gradients()
+    # d(sum)/d(row) = 1 -> both rows moved by -lr*1
+    np.testing.assert_allclose(np.asarray(tbl.table[1]), -1.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(tbl.table[2]), -1.0, rtol=1e-6)
+
+
+def test_out_of_range_ids_are_dropped_everywhere(mesh):
+    for m in (mesh, None):
+        tbl = SparseTable(64, 4, optimizer="sgd", learning_rate=1.0, mesh=m,
+                          initializer_range=0.0)
+        bad = np.array([70, -3], np.int32)
+        tbl.push(bad, np.ones((2, 4), np.float32))      # silently dropped
+        np.testing.assert_array_equal(np.asarray(tbl.table), 0.0)
+        np.testing.assert_array_equal(np.asarray(tbl.pull(bad)), 0.0)
+
+
+def test_uid_bucketing_bounds_recompiles(mesh):
+    # varying touched-row counts within one bucket share one compiled push
+    tbl = SparseTable(1024, 4, optimizer="sgd", learning_rate=1.0, mesh=mesh,
+                      initializer_range=0.0)
+    emb = ShardedEmbedding(tbl)
+    from paddle_tpu.distributed.ps import _unique_host
+
+    for n in (3, 7, 11, 16):
+        uids, _ = _unique_host(np.arange(n, dtype=np.int32), 1024)
+        assert len(uids) == 16, n                       # one bucket
+        tbl.push(uids, np.ones((16, 4), np.float32))

@@ -76,6 +76,10 @@ def init_parallel_env():
             process_id=int(os.environ.get("PADDLE_TPU_PROCESS_ID", "0")),
         )
     _INITIALIZED = True
+    if os.environ.get("PADDLE_P2P_ENDPOINT") and jax.process_index() == 0:
+        # rank 0 must HOST the p2p store even if it never does p2p itself
+        # (otherwise a send between nonzero ranks stalls on connect)
+        _p2p_store()
     _GROUPS[0] = Group(list(range(get_world_size())), 0)
 
 
@@ -270,18 +274,78 @@ def alltoall(out_tensor_list: list, in_tensor_list: list, group=None, sync_op=Tr
     return out_tensor_list
 
 
+# -- host-level point-to-point (reference send/recv) ------------------------
+# IN-GRAPH transfers ride ppermute (distributed.parallel.pipeline); these are
+# the reference's eager host p2p, carried over the native TCPStore (the same
+# transport as distributed.rpc) with per-pair sequence numbers. Endpoint:
+# PADDLE_P2P_ENDPOINT (host:port; rank 0 hosts), else a process-local queue
+# for world size 1 (matched send/recv on one process, reference loopback).
+
+_P2P = {"store": None, "seq": {}, "local": {}}
+
+
+def _p2p_store():
+    if _P2P["store"] is not None:
+        return _P2P["store"]
+    import os
+
+    ep = os.environ.get("PADDLE_P2P_ENDPOINT")
+    if not ep:
+        raise RuntimeError(
+            "host p2p send/recv across processes needs PADDLE_P2P_ENDPOINT "
+            "(host:port; rank 0 hosts the store) — the launcher sets it")
+    from .store import TCPStore
+
+    host, port = ep.rsplit(":", 1)
+    _P2P["store"] = TCPStore(host, int(port), world_size=get_world_size(),
+                             is_master=(get_rank() == 0), timeout=300.0)
+    return _P2P["store"]
+
+
+def _p2p_seq(a: int, b: int) -> int:
+    k = (a, b)
+    _P2P["seq"][k] = _P2P["seq"].get(k, 0) + 1
+    return _P2P["seq"][k]
+
+
 def send(tensor: Tensor, dst: int = 0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "host-level point-to-point send/recv is not part of the TPU backend; "
-        "in-graph transfers use ppermute (see distributed.parallel.pipeline)"
-    )
+    """Eager point-to-point send to GLOBAL rank ``dst`` (reference ``send``)."""
+    import pickle
+
+    arr = np.asarray(tensor._data)
+    me = get_rank()
+    seq = _p2p_seq(me, dst)
+    payload = pickle.dumps((arr.dtype.str, arr.shape, arr.tobytes()))
+    if jax.process_count() == 1:
+        _P2P["local"].setdefault((me, dst), []).append(payload)
+        return
+    _p2p_store().set(f"p2p/{me}->{dst}/{seq}", payload)
 
 
 def recv(tensor: Tensor, src: int = 0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "host-level point-to-point send/recv is not part of the TPU backend; "
-        "in-graph transfers use ppermute (see distributed.parallel.pipeline)"
-    )
+    """Eager point-to-point receive from GLOBAL rank ``src`` into ``tensor``
+    (in-place fill, reference ``recv`` semantics)."""
+    import pickle
+
+    me = get_rank()
+    seq = _p2p_seq(src, me)
+    if jax.process_count() == 1:
+        queue = _P2P["local"].get((src, me))
+        if not queue:
+            raise RuntimeError("recv without a matching send (world size 1)")
+        payload = queue.pop(0)
+    else:
+        store = _p2p_store()
+        key = f"p2p/{src}->{me}/{seq}"
+        payload = store.get(key)       # blocking
+        try:
+            store.delete_key(key)      # consumed: don't grow the master
+        except AttributeError:
+            pass
+    dtype_str, shape, raw = pickle.loads(payload)
+    arr = np.frombuffer(raw, dtype=np.dtype(dtype_str)).reshape(shape)
+    tensor._data = jnp.asarray(arr)
+    return tensor
 
 
 def barrier(group=None):
@@ -368,12 +432,25 @@ def is_available() -> bool:
     return True
 
 
+class _P2PTask:
+    """Completed-task handle (reference isend/irecv return a waitable; the
+    store transport completes synchronously)."""
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
 def isend(tensor, dst: int = 0, group=None):
-    return send(tensor, dst, group)
+    send(tensor, dst, group)
+    return _P2PTask()
 
 
 def irecv(tensor, src: int = 0, group=None):
-    return recv(tensor, src, group)
+    recv(tensor, src, group)
+    return _P2PTask()
 
 
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,

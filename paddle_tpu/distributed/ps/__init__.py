@@ -151,8 +151,10 @@ class SparseTable:
                 rows_per, ax = self._shard_info()
                 tspec = P(ax, None)
 
+                n_logical = self.num_rows
+
                 def pull_shard(table_l, u):
-                    li = _local_idx(u, ax, rows_per)
+                    li = _local_idx(u, ax, rows_per, n_logical)
                     # OOB gather fills 0; psum sums the one shard that owns
                     # each row (the pull "RPC" is one all-reduce)
                     rows = table_l.at[li].get(mode="fill", fill_value=0.0)
@@ -226,9 +228,11 @@ class SparseTable:
         state_specs = {k: P(ax, None) if v.ndim == 2 else P(ax)
                        for k, v in self.state.items()}
 
+        n_logical = self.num_rows
+
         def push_shard(table_l, state_l, uids, g, lr):
             # local indices; out-of-shard rows read fills and drop writes
-            li = _local_idx(uids, ax, rows_per)
+            li = _local_idx(uids, ax, rows_per, n_logical)
             return apply(table_l, state_l, li, g, lr, "fill", "drop")
 
         smapped = self._smap(
@@ -251,12 +255,14 @@ class SparseTable:
             self.state[k] = d[f"state.{k}"]
 
 
-def _local_idx(uids, ax: str, rows_per: int):
-    """Global row ids -> this shard's local indices; out-of-shard rows map
-    to ``rows_per`` (a POSITIVE out-of-bounds sentinel — negative indices
-    would wrap pythonically instead of hitting the 'drop'/'fill' modes)."""
+def _local_idx(uids, ax: str, rows_per: int, num_rows: int):
+    """Global row ids -> this shard's local indices; out-of-shard AND
+    out-of-LOGICAL-range ids (incl. the bucket-pad sentinel, which can fall
+    inside the pad rows when num_rows isn't a shard multiple) map to
+    ``rows_per`` — a POSITIVE out-of-bounds sentinel (negative indices would
+    wrap pythonically instead of hitting the 'drop'/'fill' modes)."""
     li = uids - jax.lax.axis_index(ax) * rows_per
-    ok = (li >= 0) & (li < rows_per)
+    ok = (li >= 0) & (li < rows_per) & (uids >= 0) & (uids < num_rows)
     return jnp.where(ok, li, rows_per)
 
 
@@ -296,12 +302,18 @@ class ShardedEmbedding:
         from ...framework.dispatch import apply_op
         from ...framework.tensor import Tensor
 
+        from ...framework import autograd
+
         ids_np = np.asarray(ids._data if isinstance(ids, Tensor) else ids)
         uids, inv = _unique_host(ids_np, self.table.num_rows)
-        rows = Tensor(self.table.pull(uids), stop_gradient=False)
+        track = autograd.is_grad_enabled()
+        rows = Tensor(self.table.pull(uids), stop_gradient=not track)
         inv_j = jnp.asarray(inv)
         out = apply_op("sparse_embedding", lambda r: r[inv_j], (rows,), {})
-        self._pending.append((uids, rows))
+        if track:
+            # inference forwards (no_grad) never enqueue — unbounded growth
+            # would pin every pulled rows tensor
+            self._pending.append((uids, rows))
         return out
 
     forward = __call__
@@ -312,12 +324,16 @@ class ShardedEmbedding:
         if not self._pending:
             raise RuntimeError("no pending forward; call the layer first")
         pending, self._pending = self._pending, []
+        pushed = 0
         for uids, rows in pending:
             if rows._grad is None:
-                raise RuntimeError(
-                    "rows have no gradient; run loss.backward() before "
-                    "apply_gradients()")
+                continue               # e.g. a forward whose loss was unused
             self.table.push(uids, rows._grad, learning_rate)
+            pushed += 1
+        if pushed == 0:
+            raise RuntimeError(
+                "no pending forward had a gradient; run loss.backward() "
+                "before apply_gradients()")
 
 
 class SparseTrainStep:

@@ -632,12 +632,59 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
     return apply_op("flashmask_attention", f, tuple(args), {})
 
 
-def sparse_attention(x, q, k, v=None, offset=None, columns=None, name=None):
-    raise NotImplementedError(
-        "sparse_attention (block-sparse CSR attention) is not implemented: "
-        "use flashmask_attention (compressed row masks) or "
-        "flash_attn_unpadded (segment masks) — the TPU-native sparse "
-        "patterns this framework ships")
+def sparse_attention(query, key, value, sparse_csr_offset=None,
+                     sparse_csr_columns=None, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """CSR-masked attention (reference ``nn/functional/sparse_attention.py:22``,
+    CUDA-11.3-only there).
+
+    q/k/v: ``[B, H, S, D]``; ``sparse_csr_offset`` ``[B, H, S+1]`` int32 and
+    ``sparse_csr_columns`` ``[B, H, nnz]`` describe, per row, which key
+    positions participate. TPU-native stance: the CSR layout is expanded to a
+    boolean mask and the attention runs dense under XLA — the semantics of
+    the reference kernel without its CUDA block-sparse storage (for the
+    patterns that matter on TPU use ``flashmask_attention`` /
+    ``flash_attn_unpadded``, which keep the memory savings).
+    """
+    def f(q, k, v, off, cols, *extra):
+        qf = q.astype(jnp.float32)
+        kf = k.astype(jnp.float32)
+        vf = v.astype(jnp.float32)
+        B, H, S, D = qf.shape
+        nnz = cols.shape[-1]
+
+        def build(off_bh, cols_bh):
+            kidx = jnp.arange(nnz)
+            rows = jnp.searchsorted(off_bh, kidx, side="right") - 1
+            valid = kidx < off_bh[-1]
+            rows = jnp.where(valid, rows, S)       # padding -> dropped
+            return jnp.zeros((S, S), bool).at[rows, cols_bh].set(
+                True, mode="drop")
+
+        mask = jax.vmap(jax.vmap(build))(off.astype(jnp.int32),
+                                         cols.astype(jnp.int32))
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) / jnp.sqrt(float(D))
+        s = jnp.where(mask, s, -1e30)
+        i = 0
+        if key_padding_mask is not None:
+            s = s + extra[i].astype(jnp.float32)[:, None, None, :]
+            i += 1
+        if attn_mask is not None:
+            s = s + extra[i].astype(jnp.float32)[None, None, :, :]
+        # rows with no surviving key (empty CSR row OR fully -inf padding
+        # mask) would softmax to NaN/uniform garbage: zero them
+        row_ok = (jnp.max(s, axis=-1, keepdims=True) > -1e29)
+        p = jax.nn.softmax(jnp.where(row_ok, s, 0.0), axis=-1)
+        p = jnp.where(row_ok, p, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, vf).astype(q.dtype)
+
+    args = [ensure_tensor(query), ensure_tensor(key), ensure_tensor(value),
+            ensure_tensor(sparse_csr_offset), ensure_tensor(sparse_csr_columns)]
+    if key_padding_mask is not None:
+        args.append(ensure_tensor(key_padding_mask))
+    if attn_mask is not None:
+        args.append(ensure_tensor(attn_mask))
+    return apply_op("sparse_attention", f, tuple(args), {})
 
 
 def gather_tree(ids, parents, name=None):

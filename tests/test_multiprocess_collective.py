@@ -81,6 +81,20 @@ _WORKER = """
         np.testing.assert_allclose(np.asarray(glist[0]._data), 0.0)
         np.testing.assert_allclose(np.asarray(glist[1]._data), 1.0)
 
+    # p2p send/recv over the native store (PADDLE_P2P_ENDPOINT)
+    if rank == 0:
+        collective.send(paddle.to_tensor(np.arange(4, dtype=np.float32)), dst=1)
+        r0 = paddle.to_tensor(np.zeros((2,), np.float32))
+        collective.recv(r0, src=1)
+        np.testing.assert_allclose(np.asarray(r0._data), [5.0, 6.0])
+    else:
+        r1 = paddle.to_tensor(np.zeros((4,), np.float32))
+        collective.recv(r1, src=0)
+        np.testing.assert_allclose(np.asarray(r1._data),
+                                   np.arange(4, dtype=np.float32))
+        collective.send(paddle.to_tensor(np.asarray([5.0, 6.0], np.float32)),
+                        dst=0)
+
     print(f"RANK{rank}_OK", flush=True)
 """
 
@@ -90,6 +104,9 @@ def test_two_process_allreduce_broadcast_gather(tmp_path):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        p2p_port = s.getsockname()[1]
 
     script = tmp_path / "worker.py"
     script.write_text(textwrap.dedent(_WORKER))
@@ -100,6 +117,7 @@ def test_two_process_allreduce_broadcast_gather(tmp_path):
             "PYTHONPATH": REPO,
             "JAX_PLATFORMS": "cpu",
             "PADDLE_TPU_COORDINATOR": f"127.0.0.1:{port}",
+            "PADDLE_P2P_ENDPOINT": f"127.0.0.1:{p2p_port}",
             "PADDLE_TPU_NUM_PROCESSES": "2",
             "PADDLE_TPU_PROCESS_ID": str(r),
             "PADDLE_TRAINER_ID": str(r),

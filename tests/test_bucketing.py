@@ -109,15 +109,12 @@ def test_pad_value_and_layer_forward():
 
 
 class TestSOTFallback:
-    """to_static falls back to eager on untraceable code (the reference's SOT
-    bytecode tracer falls back to dygraph the same way)."""
+    """to_static handles untraceable code via fragment capture (the
+    reference's SOT bytecode tracer captures sub-graphs the same way)."""
 
-    def test_data_dependent_branch_falls_back(self):
-        calls = []
-
+    def test_data_dependent_branch_uses_fragment_capture(self):
         @paddle.jit.to_static
         def f(x):
-            calls.append(1)
             if float(x.sum().numpy()) > 0:  # concretizes a tracer
                 return x * 2
             return x - 1
@@ -128,11 +125,17 @@ class TestSOTFallback:
         with w.catch_warnings(record=True) as rec:
             w.simplefilter("always")
             out = f(x)
-            assert any("falling back to EAGER" in str(r.message) for r in rec)
+            msgs = [str(r.message) for r in rec
+                    if "fragment capture" in str(r.message)]
+            assert msgs, "fragment-capture diagnostic not emitted"
+            assert "graph break" in msgs[0]
         np.testing.assert_allclose(np.asarray(out.numpy()), 2 * np.ones((2, 2)))
-        # negative branch works too (eager re-executes per call)
+        # the other branch records a new op sequence -> its own fragment
         out2 = f(paddle.to_tensor(-np.ones((2, 2), np.float32)))
         np.testing.assert_allclose(np.asarray(out2.numpy()), -2 * np.ones((2, 2)))
+        cap = f._last_capture
+        assert cap is not None and cap.breaks, "expected a recorded graph break"
+        assert cap.eager_ops == 0  # all ops ran inside compiled fragments
 
     def test_full_graph_raises(self):
         import jax

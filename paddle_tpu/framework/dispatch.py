@@ -107,7 +107,8 @@ def apply_op(
         from ..jit import subgraph
 
         rec = subgraph.current_recorder()
-        if rec is not None and flags.get_flag("check_nan_inf"):
+        if rec is not None and flags.get_flag("check_nan_inf") \
+                and rec.allow_eager_fallback:
             rec.eager_ops += 1
             rec.flush(f"check_nan_inf active (op '{name}' runs eager)")
             rec = None
@@ -115,6 +116,7 @@ def apply_op(
                 d._value if isinstance(d, subgraph.LazyArray) else d
                 for d in datas)
         if rec is not None:
+            rec.observe(tensor_args, datas)
             recorded = rec.record(name, fn, datas, kwargs, num_outputs)
             if recorded is not None:
                 lazies, multi = recorded

@@ -260,6 +260,10 @@ _SHAPE_CACHE_MAX = 4096
 class Recorder:
     """Accumulates ops into fragments; compiles each fragment on flush."""
 
+    # check_nan_inf may force per-op eager execution (needs concrete values);
+    # the static-graph builder overrides this off — symbolic vars have none
+    allow_eager_fallback = True
+
     def __init__(self, name: str = "capture"):
         self.name = name
         self._nodes: List[_Node] = []
@@ -269,6 +273,12 @@ class Recorder:
         self.eager_ops = 0      # ops that could NOT be deferred (ran eager)
         self.cache_hits = 0
         self.cache_misses = 0
+
+    def observe(self, tensor_args, datas) -> None:
+        """Dispatch hook: sees the Tensor inputs of each recorded op plus
+        the arrays actually recorded (``datas`` — post-AMP-cast).  The
+        static-graph builder uses it to classify program state
+        (parameters/buffers); fragment capture needs nothing."""
 
     # -- recording ----------------------------------------------------------
     def record(self, name: str, fn: Callable, datas: Sequence[Any],

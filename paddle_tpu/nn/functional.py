@@ -623,12 +623,12 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
 def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False, momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None, name=None):
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
 
-    rm = running_mean._data if isinstance(running_mean, Tensor) else jnp.asarray(running_mean)
-    rv = running_var._data if isinstance(running_var, Tensor) else jnp.asarray(running_var)
-
     use_batch_stats = training and not use_global_stats
 
-    def f(a, *wb):
+    # running stats are op INPUTS (not closed over): graph capture (fragment
+    # or static Program) then sees stat updates between calls instead of a
+    # mean/var baked at build time
+    def f(a, rm, rv, *wb):
         c_axis = a.ndim - 1 if channel_last else 1
         axes = tuple(i for i in range(a.ndim) if i != c_axis)
         if use_batch_stats:
@@ -647,19 +647,35 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
             out = out + wb[i].astype(jnp.float32).reshape(shape)
         return out.astype(a.dtype)
 
-    args = (_t(x),) + tuple(_t(v) for v in (weight, bias) if v is not None)
+    args = (_t(x), _t(running_mean), _t(running_var)) + tuple(
+        _t(v) for v in (weight, bias) if v is not None)
     out = apply_op("batch_norm", f, args, {})
 
-    # update running stats eagerly (matches reference semantics)
+    # update running stats eagerly (matches reference semantics); routed
+    # through apply_op so graph capture (fragment/static) records it as a
+    # buffer mutation instead of forcing a break
     if use_batch_stats and isinstance(running_mean, Tensor):
-        xa = _t(x)._data
-        c_axis = xa.ndim - 1 if channel_last else 1
-        axes = tuple(i for i in range(xa.ndim) if i != c_axis)
-        mu = jnp.mean(xa.astype(jnp.float32), axis=axes)
-        var = jnp.var(xa.astype(jnp.float32), axis=axes)
-        if not isinstance(xa, jax.core.Tracer):
-            running_mean._data = (momentum * rm + (1 - momentum) * mu).astype(rm.dtype)
-            running_var._data = (momentum * rv + (1 - momentum) * var).astype(rv.dtype)
+        xt = _t(x)
+        if not isinstance(xt._data, jax.core.Tracer):
+            def upd(a, rm_, rv_):
+                c_axis = a.ndim - 1 if channel_last else 1
+                axes = tuple(i for i in range(a.ndim) if i != c_axis)
+                mu = jnp.mean(a.astype(jnp.float32), axis=axes)
+                var = jnp.var(a.astype(jnp.float32), axis=axes)
+                new_rm = (momentum * rm_.astype(jnp.float32)
+                          + (1 - momentum) * mu).astype(rm_.dtype)
+                new_rv = (momentum * rv_.astype(jnp.float32)
+                          + (1 - momentum) * var).astype(rv_.dtype)
+                return new_rm, new_rv
+
+            from ..framework.autograd import no_grad
+
+            with no_grad():
+                new_rm, new_rv = apply_op(
+                    "batch_norm_stats", upd, (xt, running_mean, running_var),
+                    {}, num_outputs=2)
+            running_mean._data = new_rm._data
+            running_var._data = new_rv._data
     return out
 
 
@@ -782,17 +798,16 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None, max_norm=None, norm_type=2.0, scale_grad_by_freq=False):
-    # indices are closed over (non-differentiable); only `weight` is taped
-    idx = _t(x)._data
-
-    def g(w):
+    # indices passed as an op input (int primals take float0 cotangents the
+    # autograd zero-fills) so graph capture can record the lookup
+    def g(w, idx):
         out = jnp.take(w, idx, axis=0)
         if padding_idx is not None:
             mask = (idx == padding_idx)[..., None]
             out = jnp.where(mask, jnp.zeros((), out.dtype), out)
         return out
 
-    return apply_op("embedding", g, (_t(weight),), {})
+    return apply_op("embedding", g, (_t(weight), _t(x)), {})
 
 
 def one_hot(x, num_classes, name=None):
@@ -838,12 +853,10 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
 
         return apply_op("cross_entropy", f_soft, (it, lt), {})
 
-    idx_data = lt._data
-
-    def f_hard(logits):
+    def f_hard(logits, lab):
         lp = _logp(logits)
         n_classes = logits.shape[axis]
-        idx = idx_data.astype(jnp.int32)
+        idx = lab.astype(jnp.int32)
         if idx.ndim == lp.ndim:
             idx = jnp.squeeze(idx, axis=axis)
         oh = jax.nn.one_hot(idx, n_classes, axis=axis if axis >= 0 else lp.ndim + axis, dtype=jnp.float32)
@@ -861,7 +874,10 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
             return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
         return _reduce(loss, reduction)
 
-    return apply_op("cross_entropy", f_hard, (it,), {})
+    # label passed as an op input (not closed over): int primals take float0
+    # cotangents which autograd zero-fills, and graph capture (fragment /
+    # static Program) can record the op instead of breaking on the closure
+    return apply_op("cross_entropy", f_hard, (it, lt), {})
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1):
@@ -934,11 +950,10 @@ huber_loss = smooth_l1_loss
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
     wt = weight._data if isinstance(weight, Tensor) else weight
     lt = _t(label)
-    idx = lt._data
 
-    def f(lp):
+    def f(lp, lab):
         n_classes = lp.shape[1]
-        ii = idx.astype(jnp.int32)
+        ii = lab.astype(jnp.int32)
         gathered = jnp.take_along_axis(lp, ii[:, None] if lp.ndim == 2 else ii[:, None, ...], axis=1)
         loss = -jnp.squeeze(gathered, axis=1)
         valid = ii != ignore_index
@@ -952,7 +967,7 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", nam
             return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(lp.dtype)), 1.0)
         return _reduce(loss, reduction)
 
-    return apply_op("nll_loss", f, (_t(input),), {})
+    return apply_op("nll_loss", f, (_t(input), lt), {})
 
 
 def kl_div(input, label, reduction="mean", log_target=False, name=None):

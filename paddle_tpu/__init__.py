@@ -93,12 +93,24 @@ import numpy as _np  # noqa: E402
 
 dtype = _np.dtype
 
-# paddle-style CPU/generator seeds
-disable_static = lambda *a, **k: None  # dynamic-by-default, parity no-op
-enable_static = lambda *a, **k: None
+def enable_static():
+    """Switch to static-graph recording mode (executable trace-based
+    Program/Executor — see ``paddle_tpu.static.graph``)."""
+    from .static import graph as _sg
+
+    _sg.enable_static()
+
+
+def disable_static():
+    from .static import graph as _sg
+
+    _sg.disable_static()
+
 
 def in_dynamic_mode() -> bool:
-    return True
+    from .static import graph as _sg
+
+    return not _sg.in_static_mode()
 
 
 class CUDAPinnedPlace:  # placement shims for API parity

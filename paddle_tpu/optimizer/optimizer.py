@@ -41,7 +41,13 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, multi_precision=True, name=None):
         if parameters is None:
-            raise ValueError("parameters must be provided (eager mode, like the reference)")
+            from ..static.graph import current_builder
+
+            if current_builder() is None:
+                raise ValueError("parameters must be provided (eager mode, like the reference)")
+            # static-graph mode: minimize(loss) collects the Program's
+            # trainable slots (reference static behavior)
+            parameters = []
         self._parameter_list = list(parameters)
         self._lr = learning_rate
         self._grad_clip = grad_clip
@@ -155,6 +161,14 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static.graph import current_builder
+
+        builder = current_builder()
+        if builder is not None:
+            # static mode: attach the training directive to the Program;
+            # Executor.run compiles fwd+bwd+update into one XLA program
+            builder.set_optimizer(self, loss)
+            return None, None
         loss.backward()
         self.step()
         self.clear_grad()

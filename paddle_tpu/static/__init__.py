@@ -1,9 +1,10 @@
-"""``paddle_tpu.static`` — graph-mode compatibility shims.
+"""``paddle_tpu.static`` — executable static-graph mode.
 
 The reference's static graph mode (Program/Executor/CompiledProgram) is an
-artifact of its two-engine design; here every compiled execution is a traced
-XLA program (``paddle_tpu.jit``).  These shims keep the API importable and map
-the common patterns onto jit.
+artifact of its two-engine design; here the Program is a recorded op tape
+compiled by XLA (see :mod:`.graph` for the design).  ``enable_static()``
+turns recording on; the rest of this module is the long tail of the
+``paddle.static`` utility surface.
 """
 
 from __future__ import annotations
@@ -11,6 +12,11 @@ from __future__ import annotations
 from typing import Optional
 
 from ..framework.tensor import Tensor
+from .graph import (  # noqa: F401  (the executable core)
+    Executor, Program, data, default_main_program, default_startup_program,
+    enable_static, disable_static, in_static_mode, load_inference_model,
+    program_guard, save_inference_model,
+)
 
 __all__ = ["InputSpec", "Program", "default_main_program", "default_startup_program",
            "program_guard", "Executor", "gradients", "name_scope",
@@ -34,52 +40,13 @@ class InputSpec:
         return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
 
 
-class Program:
-    def __init__(self):
-        self.ops = []
-
-    def global_block(self):
-        return self
-
-    def clone(self, for_test=False):
-        return self
-
-
-_main = Program()
-_startup = Program()
-
-
-def default_main_program():
-    return _main
-
-
-def default_startup_program():
-    return _startup
-
-
 import contextlib
 import os
 
 
 @contextlib.contextmanager
-def program_guard(main_program, startup_program=None):
-    yield
-
-
-@contextlib.contextmanager
 def name_scope(prefix):
     yield
-
-
-class Executor:
-    def __init__(self, place=None):
-        self.place = place
-
-    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
-        raise NotImplementedError(
-            "static Program execution is not part of the TPU-native design; "
-            "use eager mode or paddle_tpu.jit.to_static"
-        )
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
@@ -376,23 +343,6 @@ def deserialize_persistables(program, data, executor=None):
     return pickle.loads(data)
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
-                         program=None, **kwargs):
-    """Serving-artifact save: on this framework the AOT path is
-    ``jit.save`` (jax.export); this name forwards a traced layer when one is
-    attached to the program."""
-    raise NotImplementedError(
-        "use paddle_tpu.jit.save(layer, path, input_spec) — the AOT "
-        "jax.export artifact is the serving format (inference.Predictor "
-        "loads it)")
-
-
-def load_inference_model(path_prefix, executor=None, **kwargs):
-    raise NotImplementedError(
-        "use paddle_tpu.jit.load(path) / inference.Predictor — the AOT "
-        "jax.export artifact is the serving format")
-
-
 def load_program_state(model_path, var_list=None):
     from ..framework.io import load as _load
 
@@ -411,12 +361,6 @@ def ctr_metric_bundle(input, label, ins_tag_weight=None):
         "scope); use static.auc / fleet.metrics for the metrics it bundles")
 
 
-def data(name, shape, dtype="float32", lod_level=0):
-    """Declare a graph input (reference ``static.data``) — equals an
-    InputSpec here."""
-    return InputSpec(shape, dtype=dtype, name=name)
-
-
 def normalize_program(program, feed_vars=None, fetch_vars=None, **kwargs):
     """Prune/normalize a program for serving (reference
     ``normalize_program``); traced jax programs are already minimal, so the
@@ -424,3 +368,6 @@ def normalize_program(program, feed_vars=None, fetch_vars=None, **kwargs):
     program._feed_vars = feed_vars
     program._fetch_vars = fetch_vars
     return program
+
+
+from . import nn  # noqa: E402,F401  (static.nn layer builders + control flow)

@@ -1,0 +1,765 @@
+"""Executable trace-based static graph: ``Program`` / ``Executor``.
+
+Reference counterpart: ``python/paddle/base/executor.py:1234`` (``Executor``),
+``python/paddle/base/framework.py`` (``Program``/``Block``/``Operator``) and
+the ``paddle.static`` Program workflow (build ops into a Program under
+``program_guard``, then ``exe.run(program, feed=..., fetch_list=[...])``).
+
+TPU-native redesign — the reference's Program is a protobuf op graph executed
+by a C++ interpreter; here the Program is a *recorded op tape* compiled by
+XLA:
+
+- ``enable_static()`` activates a :class:`StaticBuilder` (a
+  :class:`~paddle_tpu.jit.subgraph.Recorder` that never flushes) at the
+  ``apply_op`` dispatch choke point.  User code — plain layers, functional
+  ops, ``static.nn`` — then *records* ops instead of executing them;
+  ``static.data`` declares named feed sources (None dims allowed).
+- Parameters/buffers stay eagerly initialized (initializers run concrete
+  ``jax.random``), playing the role of the startup program: ``exe.run(
+  startup)`` is satisfied by construction.  Every concrete Tensor observed as
+  an op input is classified at plan time: trainable ``Parameter`` -> a
+  differentiated state slot, mutated tensor (e.g. BN running stats) -> a
+  carried state slot, anything else -> a baked constant.
+- ``optimizer.minimize(loss)`` records a training directive;
+  ``Executor.run`` then compiles ONE XLA program per feed signature:
+  replay -> ``jax.value_and_grad`` over the trainable slots -> the
+  optimizer's functional update — the same fused-step shape as
+  ``jit.TrainStep``, so static training is exactly as fast as dynamic.
+- Reading a concrete value at build time is an error (the reference's
+  "fetch a Variable outside run" is too); control flow must use recorded
+  ops — matching static-graph semantics.
+
+Known v1 limits (documented): ops that close over a fresh PRNG key at build
+time (dropout) bake that key into the program — seed it per run via
+``paddle.seed`` before building, or prefer dynamic mode for stochastic
+training; Python arithmetic on a ``None`` feed dim uses the canonical build
+dim (declare ``-1``-style reshapes instead).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Parameter, Tensor
+from ..jit import subgraph
+from ..jit.subgraph import LazyArray, Recorder, _init_tensor
+
+__all__ = [
+    "Program", "Executor", "StaticBuilder", "current_builder", "data",
+    "enable_static", "disable_static", "in_static_mode", "program_guard",
+    "default_main_program", "default_startup_program",
+    "save_inference_model", "load_inference_model",
+]
+
+# canonical concrete size substituted for None feed dims during build-time
+# shape inference (run-time shapes flow through the per-signature jit)
+_CANON_DIM = 2
+
+_MODE = threading.local()
+
+
+def in_static_mode() -> bool:
+    return getattr(_MODE, "on", False)
+
+
+def current_builder() -> Optional["StaticBuilder"]:
+    rec = subgraph.current_recorder()
+    return rec if isinstance(rec, StaticBuilder) else None
+
+
+class _FeedNode:
+    """Source node for a named graph input (``static.data``)."""
+
+    __slots__ = ("name", "declared_shape", "dtype")
+
+    def __init__(self, name, declared_shape, dtype):
+        self.name = name
+        self.declared_shape = tuple(declared_shape)
+        self.dtype = dtype
+
+
+class StaticBuilder(Recorder):
+    """A Recorder that accumulates the whole Program and never executes.
+
+    ``flush`` (forcing a concrete value) is a build-time error — the static
+    graph has no values until ``Executor.run`` feeds it.
+    """
+
+    allow_eager_fallback = False  # check_nan_inf cannot run on symbolic vars
+
+    def __init__(self, program: "Program"):
+        super().__init__(name=f"program@{id(program):x}")
+        self.program = weakref.ref(program)
+        self.optimizer = None           # (optimizer, loss LazyArray)
+        # first-seen order of concrete Tensors used as op inputs:
+        # id(tensor) -> (tensor, build-time array).  Strong refs: the
+        # Program OWNS its variables (reference Program semantics) — a
+        # weakref here silently demotes params of inline-built layers
+        # (``nn.Linear(4, 3)(x)``) to baked constants when the layer is
+        # garbage collected.
+        self._observed: Dict[int, Tuple[Any, Any]] = {}
+        self._slots: Dict[str, dict] = {}       # sticky classification
+        self._classified: set = set()           # observed ids already judged
+        # id(recorded array) -> id(owning tensor), for EVERY concrete array
+        # that entered a node (covers AMP-cast copies, and post-run rebinds
+        # when the user keeps building after Executor.run wrote back new
+        # param arrays)
+        self._arr_owner: Dict[int, int] = {}
+
+    # -- dispatch hooks ------------------------------------------------------
+    def observe(self, tensor_args, datas=()) -> None:
+        for t, d_rec in zip(tensor_args, list(datas) + [None] * len(tensor_args)):
+            d = t._data
+            if isinstance(d, LazyArray):
+                continue
+            self._observed.setdefault(id(t), (t, d))
+            self._arr_owner[id(d)] = id(t)
+            if d_rec is not None and d_rec is not d \
+                    and not isinstance(d_rec, LazyArray):
+                # AMP cast (or other dispatch-level substitution): the node
+                # recorded d_rec, but the slot belongs to t.  Note: state
+                # slots replay at the STATE's dtype (the cast is outside the
+                # recorded fn), so per-op AMP casts of parameters run fp32 at
+                # Executor time — numerically safe; use O2/bf16 parameters
+                # for static AMP perf.
+                self._arr_owner[id(d_rec)] = id(t)
+
+    def flush(self, reason: str = "explicit"):
+        if not self._nodes and reason == "end of captured call":
+            return
+        raise RuntimeError(
+            "cannot materialize a static-graph Variable at build time "
+            f"({reason}). In static mode values exist only inside "
+            "Executor.run(program, feed, fetch_list); fetch the variable "
+            "instead of reading it, and express control flow with recorded "
+            "ops (paddle.where / static.nn.cond).")
+
+    def set_optimizer(self, optimizer, loss: Tensor) -> None:
+        d = loss._data
+        if not (isinstance(d, LazyArray) and d._recorder is self):
+            raise ValueError(
+                "minimize(loss) in static mode needs a loss produced by ops "
+                "recorded in the current Program")
+        if self.optimizer is not None:
+            raise RuntimeError("this Program already has an optimizer attached")
+        self.optimizer = (optimizer, d)
+
+    # -- state classification ------------------------------------------------
+    def state_slots(self):
+        """(name -> slot) for every observed tensor that is program state.
+
+        slot = {"tensor": Tensor, "init": build-time array, "trainable": bool,
+                "carried": (node, idx) | None}
+        A tensor is state if it is a Parameter (optimizer target) or if its
+        ``_data`` was re-bound to a pending recorded value (an in-place
+        update such as BN running stats — the carried target).
+
+        Classification is STICKY: once a tensor is judged, the verdict
+        holds for the Program's lifetime — Executor.run's write-back makes
+        mutated tensors concrete again, which must not demote their slot on
+        the next run.  Newly observed tensors (continued building) are
+        classified on the next call.
+        """
+        for i, (tid, (t, arr)) in enumerate(self._observed.items()):
+            if tid in self._classified:
+                continue
+            carried = None
+            d = t._data
+            if isinstance(d, LazyArray) and d._value is None \
+                    and d._recorder is self:
+                carried = (d._node, d._idx)
+            trainable = isinstance(t, Parameter) and not t.stop_gradient
+            self._classified.add(tid)
+            if not trainable and carried is None:
+                continue  # plain constant input
+            name = t.name or f"@state_{i}"
+            while name in self._slots:
+                name += "_"
+            self._slots[name] = {"tensor": t, "init": arr,
+                                 "trainable": trainable, "carried": carried,
+                                 "arr_id": id(arr)}
+        return self._slots
+
+
+@contextlib.contextmanager
+def _suspend_capture():
+    """Run real computation (Executor.run internals) without recording."""
+    prev = subgraph._TLS.recorder if hasattr(subgraph._TLS, "recorder") else None
+    subgraph._TLS.recorder = None
+    try:
+        yield
+    finally:
+        subgraph._TLS.recorder = prev
+
+
+class Program:
+    """A recorded op graph plus its state (reference ``base.framework.Program``)."""
+
+    def __init__(self):
+        self._builder: Optional[StaticBuilder] = None
+        self._feeds: Dict[str, _FeedNode] = {}
+        self._named_vars: Dict[str, Tensor] = {}
+        self._state: Dict[str, Any] = {}      # name -> current array
+        self._opt_state = None
+        self._exec_cache: Dict[tuple, Any] = {}
+        self._feed_vars = None                # set by normalize_program
+        self._fetch_vars = None
+
+    # builder is created lazily so plain ``Program()`` objects used as
+    # compat placeholders (pre-round-5 code) stay cheap
+    def _ensure_builder(self) -> StaticBuilder:
+        if self._builder is None:
+            self._builder = StaticBuilder(self)
+        return self._builder
+
+    @property
+    def ops(self):
+        return list(self._builder._nodes) if self._builder else []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test: bool = False):
+        return self
+
+    def _add_feed(self, name, shape, dtype) -> Tensor:
+        from ..framework.dtype import convert_dtype
+
+        jdt = jax.dtypes.canonicalize_dtype(jnp.dtype(convert_dtype(dtype)))
+        node = _FeedNode(name, shape, jdt)
+        self._feeds[name] = node
+        aval = jax.ShapeDtypeStruct(
+            tuple(_CANON_DIM if (s is None or s == -1) else int(s)
+                  for s in shape), jdt)
+        lz = LazyArray(self._ensure_builder(), node, 0, aval)
+        t = Tensor.__new__(Tensor)
+        _init_tensor(t, lz)
+        t.name = name
+        lz._tensors.append(weakref.ref(t))
+        self._named_vars[name] = t
+        return t
+
+    def _var_by_name(self, name: str) -> Tensor:
+        try:
+            return self._named_vars[name]
+        except KeyError:
+            raise KeyError(f"no variable named {name!r} in this Program "
+                           f"(named: {sorted(self._named_vars)})") from None
+
+    # -- state I/O (static.save / static.load ride these) --------------------
+    def state_dict(self):
+        self._sync_state_from_tensors()
+        return {k: np.asarray(v) for k, v in self._state.items()}
+
+    def set_state_dict(self, state):
+        slots = self._builder.state_slots() if self._builder else {}
+        for k, v in state.items():
+            if k not in slots:
+                continue
+            arr = jnp.asarray(v)
+            self._state[k] = arr
+            t = slots[k]["tensor"]
+            if not isinstance(t._data, LazyArray):
+                t._data = arr
+
+    def _sync_state_from_tensors(self):
+        """Tensors are the source of truth until they go lazy (mutated)."""
+        if self._builder is None:
+            return
+        for name, slot in self._builder.state_slots().items():
+            t = slot["tensor"]
+            if not isinstance(t._data, LazyArray):
+                self._state[name] = t._data
+            elif name not in self._state:
+                self._state[name] = slot["init"]
+
+
+# ---------------------------------------------------------------------------
+# default programs + guards
+# ---------------------------------------------------------------------------
+
+_default = threading.local()
+
+
+def _defaults():
+    if not hasattr(_default, "main"):
+        _default.main = Program()
+        _default.startup = Program()
+    return _default
+
+
+def default_main_program() -> Program:
+    return _defaults().main
+
+
+def default_startup_program() -> Program:
+    return _defaults().startup
+
+
+def _activate(program: Program):
+    """Make ``program`` the recording target; returns the previous TLS state."""
+    prev = (getattr(subgraph._TLS, "recorder", None),
+            getattr(_MODE, "no_grad_ctx", None))
+    from ..framework.autograd import no_grad
+
+    ctx = no_grad()
+    ctx.__enter__()
+    _MODE.no_grad_ctx = ctx
+    subgraph._TLS.recorder = program._ensure_builder()
+    return prev
+
+
+def _restore(prev):
+    subgraph._TLS.recorder = prev[0]
+    ctx = getattr(_MODE, "no_grad_ctx", None)
+    if ctx is not None:
+        ctx.__exit__(None, None, None)
+    _MODE.no_grad_ctx = prev[1]
+
+
+def enable_static():
+    if in_static_mode():
+        return
+    _MODE.on = True
+    _MODE.prev = _activate(default_main_program())
+
+
+def disable_static():
+    if not in_static_mode():
+        return
+    _MODE.on = False
+    _restore(_MODE.prev)
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    """Scope recording into ``main_program`` (reference ``program_guard``).
+
+    Outside static mode this is the historical no-op shim, preserving the
+    dynamic-by-default behavior of earlier rounds.
+    """
+    if not in_static_mode():
+        yield
+        return
+    d = _defaults()
+    prev_progs = (d.main, d.startup)
+    d.main = main_program
+    if startup_program is not None:
+        d.startup = startup_program
+    prev = _activate(main_program)
+    try:
+        yield
+    finally:
+        _restore(prev)
+        d.main, d.startup = prev_progs
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a named graph input.
+
+    Static mode: a symbolic Variable recorded as a feed source.  Dynamic
+    mode: an ``InputSpec`` (the historical shim behavior, still what
+    ``jit.save`` consumers expect)."""
+    if in_static_mode():
+        return default_main_program()._add_feed(name, tuple(shape), dtype)
+    from . import InputSpec
+
+    return InputSpec(shape, dtype=dtype, name=name)
+
+
+# ---------------------------------------------------------------------------
+# plan construction + compilation
+# ---------------------------------------------------------------------------
+
+def _slot_resolver(builder: StaticBuilder, slots: Dict[str, dict]):
+    """arr -> state-slot name, via the build-time array id or the builder's
+    array-owner map (AMP casts, post-run rebinds)."""
+    by_arr = {s["arr_id"]: name for name, s in slots.items()}
+    by_tensor = {id(s["tensor"]): name for name, s in slots.items()}
+
+    def resolve(arr):
+        name = by_arr.get(id(arr))
+        if name is not None:
+            return name
+        tid = builder._arr_owner.get(id(arr))
+        return by_tensor.get(tid) if tid is not None else None
+
+    return resolve
+
+
+def _build_plan(builder: StaticBuilder, targets: List[Tuple[Any, int]],
+                slots: Dict[str, dict]):
+    """DCE + slot-mapped replay plan over the recorded tape.
+
+    Returns (plan, consts, feed_names, target_positions) where plan entries
+    are (fn, kwargs, input_specs); an input spec is ("l", pos, idx) |
+    ("f", feed_name) | ("s", state_name) | ("c", const_pos).
+    """
+    nodes = builder._nodes
+    node_pos = {id(n): i for i, n in enumerate(nodes)}
+    needed_ids = set()
+    stack = [n for n, _ in targets if not isinstance(n, _FeedNode)]
+    while stack:
+        n = stack.pop()
+        if id(n) in needed_ids:
+            continue
+        if id(n) not in node_pos:
+            raise ValueError("fetch target was not recorded in this Program")
+        needed_ids.add(id(n))
+        for src in n.inputs:
+            if src[0] == "lazy" and not isinstance(src[1], _FeedNode):
+                stack.append(src[1])
+    needed = [n for n in nodes if id(n) in needed_ids]
+    pos_of = {id(n): i for i, n in enumerate(needed)}
+
+    resolve_slot = _slot_resolver(builder, slots)
+    consts: List[Any] = []
+    const_pos: Dict[int, int] = {}
+    feed_names: List[str] = []
+    plan = []
+    for n in needed:
+        ins = []
+        for src in n.inputs:
+            if src[0] == "lazy":
+                if isinstance(src[1], _FeedNode):
+                    ins.append(("f", src[1].name))
+                    if src[1].name not in feed_names:
+                        feed_names.append(src[1].name)
+                else:
+                    ins.append(("l", pos_of[id(src[1])], src[2]))
+            else:
+                arr = src[1]
+                sname = resolve_slot(arr)
+                if sname is not None:
+                    ins.append(("s", sname))
+                else:
+                    if id(arr) not in const_pos:
+                        const_pos[id(arr)] = len(consts)
+                        consts.append(arr)
+                    ins.append(("c", const_pos[id(arr)]))
+        plan.append((n.fn, n.kwargs, tuple(ins)))
+
+    tpos = []
+    for n, idx in targets:
+        if isinstance(n, _FeedNode):
+            tpos.append(("f", n.name))
+            if n.name not in feed_names:
+                feed_names.append(n.name)
+        else:
+            tpos.append(("l", pos_of[id(n)], idx))
+    return plan, consts, feed_names, tpos
+
+
+def _make_replay(plan, consts, target_positions):
+    def replay(state, feeds):
+        env: Dict[Tuple[int, int], Any] = {}
+        for i, (fn, kwargs, ins) in enumerate(plan):
+            vals = [env[(s[1], s[2])] if s[0] == "l"
+                    else feeds[s[1]] if s[0] == "f"
+                    else state[s[1]] if s[0] == "s"
+                    else consts[s[1]] for s in ins]
+            outs = fn(*vals, **kwargs)
+            out_list = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+            for j, o in enumerate(out_list):
+                env[(i, j)] = o
+        return tuple(feeds[t[1]] if t[0] == "f" else env[(t[1], t[2])]
+                     for t in target_positions)
+
+    return replay
+
+
+class Executor:
+    """Compile-and-run a Program (reference ``base.executor.Executor``).
+
+    Each distinct feed signature compiles ONE fused XLA program — for a
+    training Program that is forward+backward+optimizer in a single device
+    launch, identical in shape to ``jit.TrainStep``.
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def close(self):
+        pass
+
+    def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
+            fetch_var_name="fetch", scope=None, return_numpy=True,
+            use_prune=False):
+        if program is None:
+            program = default_main_program()
+        from . import CompiledProgram
+
+        if isinstance(program, CompiledProgram):
+            program = program._program
+        if isinstance(program, _LoadedProgram):
+            return program._run(feed or {}, fetch_list, return_numpy)
+        if not isinstance(program, Program) or program._builder is None \
+                or not program._builder._nodes:
+            return []  # startup program: params are born initialized
+        with _suspend_capture():
+            return self._run_traced(program, feed or {}, fetch_list or [],
+                                    return_numpy)
+
+    # -- traced-program execution -------------------------------------------
+    def _run_traced(self, program: Program, feed, fetch_list, return_numpy):
+        b = program._builder
+        program._sync_state_from_tensors()
+        slots = b.state_slots()
+
+        # resolve fetches: recorded targets vs already-concrete passthroughs
+        fetch_entries = []   # ("t", target_index) | ("v", concrete)
+        targets: List[Tuple[Any, int]] = []
+        for f in fetch_list:
+            t = program._var_by_name(f) if isinstance(f, str) else f
+            d = t._data if isinstance(t, Tensor) else t
+            if isinstance(d, LazyArray) and d._value is None:
+                if d._recorder is not b:
+                    raise ValueError("fetch target belongs to a different Program")
+                fetch_entries.append(("t", len(targets)))
+                targets.append((d._node, d._idx))
+            else:
+                fetch_entries.append(("v", d))
+
+        train = b.optimizer is not None
+        loss_pos = None
+        if train:
+            optimizer, loss_lz = b.optimizer
+            loss_pos = len(targets)
+            targets.append((loss_lz._node, loss_lz._idx))
+        carried_names = [n for n, s in slots.items() if s["carried"] is not None]
+        carried_base = len(targets)
+        targets.extend(slots[n]["carried"] for n in carried_names)
+
+        feed_arrays = {}
+        for name, arr in feed.items():
+            node = program._feeds.get(name)
+            dt = node.dtype if node is not None else None
+            feed_arrays[name] = jnp.asarray(np.asarray(arr), dt)
+
+        key = (len(b._nodes),
+               tuple((id(n), i) for n, i in targets),
+               train,
+               tuple(sorted((k, v.shape, str(v.dtype))
+                            for k, v in feed_arrays.items())))
+        entry = program._exec_cache.get(key)
+        if entry is None:
+            plan, consts, feed_names, tpos = _build_plan(b, targets, slots)
+            missing = [n for n in feed_names if n not in feed_arrays]
+            if missing:
+                raise KeyError(f"Executor.run missing feeds: {missing}")
+            replay = _make_replay(plan, consts, tpos)
+            trainable = sorted(n for n, s in slots.items() if s["trainable"])
+            if train:
+                optimizer, _ = b.optimizer
+                init_fn, update_fn = optimizer.functional()
+                grad_clip = optimizer._grad_clip
+
+                def jfn(params, other, feeds, opt_state, lr, stepno):
+                    def loss_of(p):
+                        outs = replay({**p, **other}, feeds)
+                        return jnp.sum(outs[loss_pos]), outs
+
+                    (loss, outs), grads = jax.value_and_grad(
+                        loss_of, has_aux=True)(params)
+                    if grad_clip is not None:
+                        flat = [(None, g) for g in jax.tree.leaves(grads)]
+                        clipped = [g for _, g in grad_clip(flat)]
+                        grads = jax.tree.unflatten(
+                            jax.tree.structure(grads), clipped)
+                    new_p, new_s = update_fn(params, grads, opt_state, lr,
+                                             stepno)
+                    return outs, new_p, new_s
+            else:
+                def jfn(state, feeds):
+                    return replay(state, feeds)
+            entry = {"fn": jax.jit(jfn), "train": train,
+                     "trainable": trainable}
+            program._exec_cache[key] = entry
+
+        state_now = dict(program._state)
+        for name, slot in slots.items():
+            state_now.setdefault(name, slot["init"])
+        if entry["train"]:
+            optimizer, _ = b.optimizer
+            params = {n: state_now[n] for n in entry["trainable"]}
+            other = {n: v for n, v in state_now.items()
+                     if n not in set(entry["trainable"])}
+            if program._opt_state is None:
+                init_fn, _ = optimizer.functional()
+                program._opt_state = init_fn(params)
+            optimizer._step_count += 1
+            lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+            stepno = jnp.asarray(optimizer._step_count, jnp.int32)
+            outs, new_p, program._opt_state = entry["fn"](
+                params, other, feed_arrays, program._opt_state, lr, stepno)
+            for n, v in new_p.items():
+                self._write_back(program, slots, n, v)
+        else:
+            outs = entry["fn"](state_now, feed_arrays)
+        for j, name in enumerate(carried_names):
+            self._write_back(program, slots, name, outs[carried_base + j])
+
+        results = []
+        for kind, v in fetch_entries:
+            val = outs[v] if kind == "t" else v
+            results.append(np.asarray(val) if return_numpy else Tensor(val))
+        return results
+
+    @staticmethod
+    def _write_back(program, slots, name, value):
+        program._state[name] = value
+        slots[name]["tensor"]._data = value
+
+
+# ---------------------------------------------------------------------------
+# inference model save/load — rides the jit.save (jax.export) artifact
+# ---------------------------------------------------------------------------
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Export feeds->fetches as the standard AOT artifact.
+
+    Writes the exact ``jit.save`` file set (``.jaxir``/``.pdiparams``/
+    ``.pdmodel.json``) so ``jit.load`` and ``inference.Predictor`` open it
+    unchanged; ``load_inference_model`` returns it in the reference's
+    ``(program, feed_names, fetch_targets)`` shape.
+    """
+    import json
+
+    from jax import export as jax_export
+
+    from ..framework.io import save as _save
+
+    if program is None:
+        program = default_main_program()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    b = program._builder
+    if b is None:
+        raise ValueError("save_inference_model needs a traced Program "
+                         "(build it under paddle.enable_static())")
+    with _suspend_capture():
+        program._sync_state_from_tensors()
+        slots = b.state_slots()
+        feed_nodes = []
+        for fv in feed_vars:
+            d = fv._data
+            if not (isinstance(d, LazyArray) and isinstance(d._node, _FeedNode)):
+                raise ValueError("feed_vars must come from static.data")
+            feed_nodes.append(d._node)
+        targets = []
+        for fv in fetch_vars:
+            d = fv._data
+            targets.append((d._node, d._idx))
+        plan, consts, needed_feeds, tpos = _build_plan(b, targets, slots)
+        replay = _make_replay(plan, consts, tpos)
+        feed_names = [n.name for n in feed_nodes]
+        missing = [n for n in needed_feeds if n not in feed_names]
+        if missing:
+            raise ValueError(f"fetch_vars depend on undeclared feeds: {missing}")
+
+        state = dict(program._state)
+        for name, slot in slots.items():
+            state.setdefault(name, slot["init"])
+        state = {k: jnp.asarray(v) for k, v in state.items()}
+
+        def pure(params, buffers, *feed_arrays):
+            del buffers
+            feeds = dict(zip(feed_names, feed_arrays))
+            return replay(params, feeds)
+
+        # shape-polymorphic batch where the Program declared None dims;
+        # falls back to concrete dim 1 if an op rejects symbolic shapes
+        def structs(symbolic: bool):
+            out = []
+            for node in feed_nodes:
+                dims = []
+                for i, s in enumerate(node.declared_shape):
+                    if s is None or s == -1:
+                        dims.append(jax_export.symbolic_shape("batch")[0]
+                                    if symbolic else 1)
+                    else:
+                        dims.append(int(s))
+                out.append(jax.ShapeDtypeStruct(tuple(dims), node.dtype))
+            return tuple(out)
+
+        state_structs = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state)
+        exported = None
+        for symbolic in (True, False):
+            try:
+                exported = jax_export.export(jax.jit(pure))(
+                    state_structs, {}, *structs(symbolic))
+                break
+            except Exception:
+                if not symbolic:
+                    raise
+        with open(path_prefix + ".jaxir", "wb") as f:
+            f.write(exported.serialize())
+        _save({"params": {k: np.asarray(v) for k, v in state.items()},
+               "buffers": {}}, path_prefix + ".pdiparams")
+        meta = {
+            "inputs": [{"shape": [None if (s is None or s == -1) else int(s)
+                                  for s in n.declared_shape],
+                        "dtype": str(np.dtype(n.dtype))} for n in feed_nodes],
+            "format": "jax.export.stablehlo",
+            "feed_names": feed_names,
+            "fetch_count": len(fetch_vars),
+        }
+        with open(path_prefix + ".pdmodel.json", "w") as f:
+            json.dump(meta, f)
+
+
+class _LoadedProgram:
+    """An inference program rehydrated from the AOT artifact; runnable via
+    ``Executor.run(program, feed, fetch_list)`` like the reference's loaded
+    inference program."""
+
+    def __init__(self, path_prefix):
+        from ..jit import _LoadedFunction
+
+        self._fn = _LoadedFunction(path_prefix)
+        self.feed_names = list(self._fn.meta.get("feed_names", []))
+        self.fetch_count = int(self._fn.meta.get("fetch_count", 1))
+
+    def _run(self, feed, fetch_list, return_numpy=True):
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise KeyError(f"Executor.run missing feeds: {missing}")
+        outs = self._fn(*[feed[n] for n in self.feed_names])
+        out_list = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        if fetch_list:
+            picked = []
+            for f in fetch_list:
+                idx = f.index if isinstance(f, _FetchTarget) else int(f)
+                picked.append(out_list[idx])
+            out_list = picked
+        return [np.asarray(o.numpy()) if return_numpy else o for o in out_list]
+
+
+class _FetchTarget:
+    """Opaque fetch handle returned by ``load_inference_model``."""
+
+    __slots__ = ("index", "name")
+
+    def __init__(self, index, name=None):
+        self.index = index
+        self.name = name or f"fetch_{index}"
+
+    def __repr__(self):
+        return f"FetchTarget({self.name})"
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns ``[program, feed_target_names, fetch_targets]`` (reference
+    ``static.load_inference_model``)."""
+    prog = _LoadedProgram(path_prefix)
+    fetches = [_FetchTarget(i) for i in range(prog.fetch_count)]
+    return [prog, list(prog.feed_names), fetches]

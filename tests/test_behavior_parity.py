@@ -48,13 +48,6 @@ KNOWN_STUBS = {
         "implemented (paddle.nn.utils.weight_norm)"),
     "static.ctr_metric_bundle": (
         "fn", "CTR metric aggregation for the PS stack (out of TPU scope)"),
-    "static.Executor": ("run", "graph execution is XLA's job; trace-based "
-                               "compat Program/Executor is the remaining "
-                               "migration-surface gap"),
-    "static.load_inference_model": ("fn", "rides static.Executor (same gap); "
-                                          "use jit.save/jit.load"),
-    "static.save_inference_model": ("fn", "rides static.Executor (same gap); "
-                                          "use jit.save/jit.load"),
     "vision.ops.yolo_loss": ("fn", "legacy YOLOv3 training loss — "
                                    "documented gap (detection training ships "
                                    "the DBNet/OCR path)"),

@@ -1,0 +1,654 @@
+"""``paddle.static.nn`` — layer builders + graph control flow.
+
+Reference: ``python/paddle/static/nn/__init__.py`` (fc/batch_norm/conv2d/...
+builders that create parameters inside the Program) and
+``python/paddle/static/nn/control_flow.py`` (cond/case/switch_case/
+while_loop over the static graph).
+
+TPU-native design: the builders instantiate the ordinary eager layers —
+their parameters are concrete at creation and become trainable state slots
+of the recording Program (``static/graph.py``), so ``fc(x, 10)`` is exactly
+``nn.Linear`` + observation, not a parallel implementation.  Control flow:
+
+- ``cond``/``case``/``switch_case`` record BOTH branches and select the
+  result (`jnp.where`) — the standard XLA lowering for data-dependent
+  choice over pure branches; closures over Program variables work
+  naturally because each branch simply records more ops.
+- ``while_loop`` records ONE op whose body is ``jax.lax.while_loop``; the
+  user's ``cond``/``body`` run on the loop-carried values with capture
+  suspended, so their paddle ops trace straight into the XLA loop.  All
+  tensors the body needs must flow through ``loop_vars`` (reference
+  requirement too).
+
+The LoD ``sequence_*`` family operates on padded dense ``[batch, time, ...]``
+tensors with an optional per-row length — the TPU-native layout (LoD ragged
+tensors are a CPU PS-era representation; SURVEY §2.1 strided/LoD note).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import apply_op, unwrap, wrap
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "fc", "batch_norm", "bilinear_tensor_product", "embedding", "case",
+    "cond", "static_pylayer", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "nce", "prelu", "py_func", "row_conv",
+    "spectral_norm", "switch_case", "while_loop", "sparse_embedding",
+    "sequence_conv", "sequence_softmax", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_expand",
+]
+
+
+def _t(x) -> Tensor:
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+_ACTS = {
+    None: lambda x: x,
+    "relu": lambda x: x.relu() if hasattr(x, "relu") else x,
+    "tanh": lambda x: x.tanh(),
+    "sigmoid": lambda x: x.sigmoid(),
+    "softmax": None,  # resolved lazily below (import cycle)
+}
+
+
+def _apply_act(out, activation):
+    if activation is None:
+        return out
+    from ..nn import functional as F
+
+    return getattr(F, activation)(out)
+
+
+# ---------------------------------------------------------------------------
+# layer builders (each call creates fresh Program parameters, like the
+# reference where every builder call appends new vars to the Program)
+# ---------------------------------------------------------------------------
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Reference ``static.nn.fc``: flatten trailing dims, Linear, activation."""
+    from ..nn import Linear
+
+    xt = _t(x)
+    shape = xt.shape
+    if num_flatten_dims < 0:
+        num_flatten_dims = len(shape) + num_flatten_dims
+    in_features = int(np.prod(shape[num_flatten_dims:]))
+    if len(shape) > num_flatten_dims + 1:
+        xt = xt.reshape(list(shape[:num_flatten_dims]) + [in_features])
+    layer = Linear(in_features, size, weight_attr=weight_attr,
+                   bias_attr=bias_attr)
+    return _apply_act(layer(xt), activation)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-05,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    from ..nn import BatchNorm
+
+    xt = _t(input)
+    c_axis = len(xt.shape) - 1 if data_layout in ("NHWC", "NLC", "NDHWC") else 1
+    layer = BatchNorm(int(xt.shape[c_axis]), momentum=momentum,
+                      epsilon=epsilon, weight_attr=param_attr,
+                      bias_attr=bias_attr, data_format=data_layout,
+                      use_global_stats=use_global_stats or None)
+    layer.train() if not is_test else layer.eval()
+    return _apply_act(layer(xt), act)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    from ..nn import Conv2D
+
+    xt = _t(input)
+    c_axis = 3 if data_format == "NHWC" else 1
+    layer = Conv2D(int(xt.shape[c_axis]), num_filters, filter_size,
+                   stride=stride, padding=padding, dilation=dilation,
+                   groups=groups, weight_attr=param_attr, bias_attr=bias_attr,
+                   data_format=data_format)
+    return _apply_act(layer(xt), act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    from ..nn import Conv2DTranspose
+
+    xt = _t(input)
+    c_axis = 3 if data_format == "NHWC" else 1
+    layer = Conv2DTranspose(int(xt.shape[c_axis]), num_filters,
+                            filter_size, stride=stride, padding=padding,
+                            dilation=dilation, groups=groups,
+                            weight_attr=param_attr, bias_attr=bias_attr,
+                            data_format=data_format)
+    return _apply_act(layer(xt), act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    from ..nn import Conv3D
+
+    xt = _t(input)
+    c_axis = 4 if data_format == "NDHWC" else 1
+    layer = Conv3D(int(xt.shape[c_axis]), num_filters, filter_size,
+                   stride=stride, padding=padding, dilation=dilation,
+                   groups=groups, weight_attr=param_attr, bias_attr=bias_attr,
+                   data_format=data_format)
+    return _apply_act(layer(xt), act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    from ..nn import Conv3DTranspose
+
+    xt = _t(input)
+    c_axis = 4 if data_format == "NDHWC" else 1
+    layer = Conv3DTranspose(int(xt.shape[c_axis]), num_filters, filter_size,
+                            stride=stride, padding=padding, dilation=dilation,
+                            groups=groups, weight_attr=param_attr,
+                            bias_attr=bias_attr, data_format=data_format)
+    return _apply_act(layer(xt), act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    from ..nn import Embedding
+
+    layer = Embedding(size[0], size[1], padding_idx=padding_idx,
+                      weight_attr=param_attr)
+    return layer(_t(input))
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """Reference ``sparse_embedding`` targets the brpc PS; the TPU-native
+    big-table path is ``distributed.ps.ShardedEmbedding`` (vocab-sharded over
+    the mesh).  Single-host semantics equal a dense embedding lookup."""
+    return embedding(input, size, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ..nn import functional as F
+    from ..framework.param_attr import create_parameter
+
+    xt = _t(input)
+    norm_shape = [int(s) for s in xt.shape[begin_norm_axis:]]
+    w = create_parameter(norm_shape, "float32", attr=param_attr) if scale else None
+    b = create_parameter(norm_shape, "float32", attr=bias_attr,
+                         is_bias=True) if shift else None
+    out = F.layer_norm(xt, norm_shape, weight=w, bias=b, epsilon=epsilon)
+    return _apply_act(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    from ..nn import GroupNorm
+
+    xt = _t(input)
+    c_axis = len(xt.shape) - 1 if data_layout == "NHWC" else 1
+    layer = GroupNorm(groups, int(xt.shape[c_axis]), epsilon=epsilon,
+                      weight_attr=param_attr, bias_attr=bias_attr)
+    return _apply_act(layer(xt), act)
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn import InstanceNorm2D
+
+    xt = _t(input)
+    layer = InstanceNorm2D(int(xt.shape[1]), epsilon=epsilon,
+                           weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(xt)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from ..nn import functional as F
+    from ..framework.param_attr import create_parameter
+
+    xt = _t(x)
+    if mode == "all":
+        n = 1
+    elif mode == "channel":
+        n = int(xt.shape[-1 if data_format == "NHWC" else 1])
+    else:  # element
+        n = int(np.prod(xt.shape[1:]))
+    from ..nn.initializer import Constant
+
+    alpha = create_parameter([n], "float32", attr=param_attr,
+                             default_initializer=Constant(0.25))
+    return F.prelu(xt, alpha, data_format=data_format)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..framework.param_attr import create_parameter
+    from ..vision.ops import deform_conv2d as _dc
+
+    xt = _t(input)
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    w = create_parameter(
+        [num_filters, int(xt.shape[1]) // groups, int(k[0]), int(k[1])],
+        "float32", attr=param_attr)
+    b = create_parameter([num_filters], "float32", attr=bias_attr,
+                         is_bias=True) if bias_attr is not False else None
+    return _dc(xt, _t(offset), w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=_t(mask) if mask is not None else None)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    """out[., k] = x^T W_k y + b_k (reference ``bilinear_tensor_product``)."""
+    from ..framework.param_attr import create_parameter
+
+    xt, yt = _t(x), _t(y)
+    d1, d2 = int(xt.shape[-1]), int(yt.shape[-1])
+    w = create_parameter([size, d1, d2], "float32", attr=param_attr)
+    b = create_parameter([size], "float32", attr=bias_attr, is_bias=True)
+
+    def f(a, c, W, bias):
+        out = jnp.einsum("bi,kij,bj->bk", a.astype(jnp.float32),
+                         W.astype(jnp.float32), c.astype(jnp.float32))
+        return (out + bias.astype(jnp.float32)).astype(a.dtype)
+
+    out = apply_op("bilinear_tensor_product", f, (xt, yt, w, b), {})
+    return _apply_act(out, act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference ``row_conv``):
+    ``out[t] = sum_j w[j] * x[t + j]`` over a [B, T, D] input."""
+    from ..framework.param_attr import create_parameter
+
+    xt = _t(input)
+    d = int(xt.shape[-1])
+    k = future_context_size + 1
+    w = create_parameter([k, d], "float32", attr=param_attr)
+
+    def f(a, wt):
+        a32 = a.astype(jnp.float32)
+        pad = jnp.pad(a32, ((0, 0), (0, k - 1), (0, 0)))
+        out = sum(pad[:, j:j + a.shape[1], :] * wt[j].astype(jnp.float32)
+                  for j in range(k))
+        return out.astype(a.dtype)
+
+    out = apply_op("row_conv", f, (xt, w), {})
+    return _apply_act(out, act)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None, slot_dim=-1,
+              summary_decay_rate=0.9999999, sync_stats=False,
+              enable_scale_and_shift=False):
+    """Accumulated-statistics normalization (reference CTR ``data_norm``):
+    keeps batch_size/batch_sum/batch_square_sum accumulators as carried
+    Program state and normalizes by their implied mean/std."""
+    from ..framework.param_attr import create_parameter
+    from ..nn.initializer import Constant
+
+    xt = _t(input)
+    d = int(xt.shape[-1])
+    size = create_parameter([d], "float32", default_initializer=Constant(1e4))
+    ssum = create_parameter([d], "float32", default_initializer=Constant(0.0))
+    sqsum = create_parameter([d], "float32", default_initializer=Constant(1e4))
+    for p in (size, ssum, sqsum):
+        p.stop_gradient = True
+
+    def f(a, n, s, sq):
+        mean = s / n
+        scale = jnp.sqrt(n / jnp.maximum(sq - n * mean * mean, epsilon))
+        out = (a.astype(jnp.float32) - mean) * scale
+        bn = jnp.asarray(a.shape[0], jnp.float32)
+        new_n = n + bn
+        new_s = s + jnp.sum(a.astype(jnp.float32), axis=0)
+        new_sq = sq + jnp.sum(jnp.square(a.astype(jnp.float32)), axis=0)
+        return out.astype(a.dtype), new_n, new_s, new_sq
+
+    out, new_n, new_s, new_sq = apply_op(
+        "data_norm", f, (xt, size, ssum, sqsum), {}, num_outputs=4)
+    size._data, ssum._data, sqsum._data = new_n._data, new_s._data, new_sq._data
+    return _apply_act(out, act)
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=10, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference ``static.nn.nce``):
+    logistic discrimination of the true class against sampled noise classes.
+    Negatives are drawn once at build time from the given seed (static
+    programs re-use the sample per step — vary ``seed`` to reshuffle)."""
+    from ..framework.param_attr import create_parameter
+
+    xt, lt = _t(input), _t(label)
+    d = int(xt.shape[-1])
+    w = create_parameter([num_total_classes, d], "float32", attr=param_attr)
+    b = create_parameter([num_total_classes], "float32", attr=bias_attr,
+                         is_bias=True)
+    rng = np.random.default_rng(seed or 0)
+    if sampler == "custom_dist" and custom_dist is not None:
+        p = np.asarray(custom_dist, np.float64)
+        neg = rng.choice(num_total_classes, size=num_neg_samples,
+                         p=p / p.sum())
+    else:
+        neg = rng.integers(0, num_total_classes, size=num_neg_samples)
+    neg = jnp.asarray(neg, jnp.int32)
+
+    def f(a, lab, W, bias):
+        a32 = a.astype(jnp.float32)
+        li = lab.astype(jnp.int32).reshape(-1)
+        pos_logit = jnp.sum(a32 * W[li].astype(jnp.float32), -1) + bias[li]
+        neg_logit = a32 @ W[neg].astype(jnp.float32).T + bias[neg]
+        pos_loss = jax.nn.softplus(-pos_logit)
+        neg_loss = jnp.sum(jax.nn.softplus(neg_logit), -1)
+        return (pos_loss + neg_loss).reshape(-1, 1)
+
+    return apply_op("nce", f, (xt, lt, w, b), {})
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectral normalization of a weight (reference ``spectral_norm``):
+    power iteration estimates sigma_max; u/v vectors are carried Program
+    state updated each run (matching the reference's in-place u/v update)."""
+    from ..framework.param_attr import create_parameter
+    from ..nn.initializer import Normal
+
+    wt = _t(weight)
+    shape = [int(s) for s in wt.shape]
+    h = shape[dim]
+    w_dim = int(np.prod(shape)) // h
+    u = create_parameter([h], "float32", default_initializer=Normal(0.0, 1.0))
+    v = create_parameter([w_dim], "float32",
+                         default_initializer=Normal(0.0, 1.0))
+    u.stop_gradient = True
+    v.stop_gradient = True
+
+    def f(W, u0, v0):
+        Wm = jnp.moveaxis(W.astype(jnp.float32), dim, 0).reshape(h, w_dim)
+        uu, vv = u0, v0
+        for _ in range(max(1, power_iters)):
+            vv = Wm.T @ uu
+            vv = vv / (jnp.linalg.norm(vv) + eps)
+            uu = Wm @ vv
+            uu = uu / (jnp.linalg.norm(uu) + eps)
+        sigma = uu @ Wm @ vv
+        return (W / sigma).astype(W.dtype), uu, vv
+
+    out, new_u, new_v = apply_op("spectral_norm", f, (wt, u, v), {},
+                                 num_outputs=3)
+    u._data, v._data = new_u._data, new_v._data
+    return out
+
+
+# ---------------------------------------------------------------------------
+# control flow
+# ---------------------------------------------------------------------------
+
+def _select_leaves(pred, t_out, f_out):
+    from .. import where as _where
+
+    if t_out is None and f_out is None:
+        return None
+    if isinstance(t_out, (list, tuple)):
+        return type(t_out)(_select_leaves(pred, a, b)
+                           for a, b in zip(t_out, f_out))
+    pt = _t(pred)
+    tt, ft = _t(t_out), _t(f_out)
+
+    def f(c, a, b):
+        return jnp.where(jnp.reshape(c, (1,) * a.ndim if a.ndim else c.shape)
+                         if c.ndim <= a.ndim else c, a, b)
+
+    return apply_op("select", f, (pt, tt, ft), {})
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Data-dependent branch (reference ``static.nn.cond``).
+
+    Both branches are recorded (pure-function requirement, as the reference
+    docs also demand) and the outputs selected on ``pred`` — the XLA
+    ``select`` lowering.  Branch closures over Program variables work."""
+    t_out = true_fn() if true_fn is not None else None
+    f_out = false_fn() if false_fn is not None else None
+    if (t_out is None) != (f_out is None):
+        raise ValueError("cond branches must both return values or neither")
+    return _select_leaves(pred, t_out, f_out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-match multi-branch (reference ``static.nn.case``).  Every branch
+    is evaluated exactly ONCE (builders create params per call — a double
+    evaluation would record duplicate parameters)."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    pairs = [(pred, fn()) for pred, fn in pred_fn_pairs]
+    result = default() if default is not None else pairs[-1][1]
+    for pred, out in reversed(pairs):
+        result = _select_leaves(pred, out, result)
+    return result
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Indexed multi-branch (reference ``static.nn.switch_case``); each
+    branch evaluated exactly once."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = [(i, fn) for i, fn in enumerate(branch_fns)]
+    bi = _t(branch_index)
+    pairs = [(idx, fn()) for idx, fn in items]
+    result = default() if default is not None else pairs[-1][1]
+    for idx, out in reversed(pairs):
+        result = _select_leaves(bi == idx, out, result)
+    return result
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Graph-native loop (reference ``static.nn.while_loop``): lowers to
+    ``jax.lax.while_loop``; everything the body reads must flow through
+    ``loop_vars`` (the reference requires the same)."""
+    from ..jit.subgraph import _TLS as _sub_tls
+
+    tensors = [_t(v) for v in loop_vars]
+    n = len(tensors)
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def _suspended():
+        prev = getattr(_sub_tls, "recorder", None)
+        _sub_tls.recorder = None
+        try:
+            yield
+        finally:
+            _sub_tls.recorder = prev
+
+    def f(*vals):
+        def c(vs):
+            with _suspended():
+                out = cond(*wrap(list(vs)))
+            out = out[0] if isinstance(out, (list, tuple)) else out
+            return jnp.reshape(unwrap(out), ())
+
+        def b(vs):
+            with _suspended():
+                outs = body(*wrap(list(vs)))
+            outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+            return tuple(unwrap(o) for o in outs)
+
+        return jax.lax.while_loop(c, b, tuple(vals))
+
+    out = apply_op("while_loop", f, tensors, {}, num_outputs=n)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """Reference ``static.nn.static_pylayer``: a forward fn with an optional
+    custom backward.  Maps onto the eager PyLayer machinery (autograd is
+    jax.vjp-based either way)."""
+    if backward_fn is None:
+        from ..framework.autograd import no_grad
+
+        with no_grad():
+            return forward_fn(*inputs)
+    return forward_fn(*inputs)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    from . import py_func as _pf
+
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops over padded dense [batch, time, ...] (+ optional lengths)
+# ---------------------------------------------------------------------------
+
+def _time_mask(a32, lengths):
+    if lengths is None:
+        return None
+    t = a32.shape[1]
+    return (jnp.arange(t)[None, :] < lengths.reshape(-1, 1)).astype(jnp.float32)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, lengths=None):
+    xt = _t(input)
+    if lengths is None:
+        def f(a):
+            return jax.nn.softmax(a.astype(jnp.float32), axis=1).astype(a.dtype)
+
+        return apply_op("sequence_softmax", f, (xt,), {})
+
+    lt = _t(lengths)
+
+    def f(a, ln):
+        a32 = a.astype(jnp.float32)
+        m = _time_mask(a32, ln)
+        while m.ndim < a32.ndim:
+            m = m[..., None]
+        a32 = jnp.where(m > 0, a32, -1e30)
+        out = jax.nn.softmax(a32, axis=1) * m
+        return out.astype(a.dtype)
+
+    return apply_op("sequence_softmax", f, (xt, lt), {})
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
+                  lengths=None):
+    xt = _t(input)
+    pool_type = pool_type.lower()
+    args = (xt,) if lengths is None else (xt, _t(lengths))
+
+    def f(a, *rest):
+        a32 = a.astype(jnp.float32)
+        m = _time_mask(a32, rest[0]) if rest else None
+        if m is not None:
+            while m.ndim < a32.ndim:
+                m = m[..., None]
+        if pool_type == "max":
+            src = a32 if m is None else jnp.where(m > 0, a32, -jnp.inf)
+            out = jnp.max(src, axis=1)
+        elif pool_type in ("average", "avg"):
+            if m is None:
+                out = jnp.mean(a32, axis=1)
+            else:
+                out = jnp.sum(a32 * m, axis=1) / jnp.maximum(
+                    jnp.sum(m, axis=1), 1.0)
+        elif pool_type == "sum":
+            out = jnp.sum(a32 if m is None else a32 * m, axis=1)
+        elif pool_type == "sqrt":
+            n = (jnp.asarray(a.shape[1], jnp.float32) if m is None
+                 else jnp.sum(m, axis=1))
+            out = jnp.sum(a32 if m is None else a32 * m, axis=1) \
+                / jnp.sqrt(jnp.maximum(n, 1.0))
+        elif pool_type == "first":
+            out = a32[:, 0]
+        elif pool_type == "last":
+            if rest:
+                idx = jnp.maximum(rest[0].astype(jnp.int32) - 1, 0).reshape(-1)
+                out = jnp.take_along_axis(
+                    a32, idx.reshape(-1, *([1] * (a32.ndim - 1))), axis=1
+                )[:, 0]
+            else:
+                out = a32[:, -1]
+        else:
+            raise ValueError(f"unknown pool_type {pool_type!r}")
+        return out.astype(a.dtype)
+
+    return apply_op("sequence_pool", f, args, {})
+
+
+def sequence_first_step(input, lengths=None):
+    return sequence_pool(input, "first", lengths=lengths)
+
+
+def sequence_last_step(input, lengths=None):
+    return sequence_pool(input, "last", lengths=lengths)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Dense equivalent of LoD expand: broadcast per-row features of ``x``
+    across ``y``'s time dimension."""
+    xt, yt = _t(x), _t(y)
+
+    def f(a, b):
+        t = b.shape[1]
+        if a.ndim == 2:
+            return jnp.broadcast_to(a[:, None, :], (a.shape[0], t, a.shape[1]))
+        return jnp.broadcast_to(a, (a.shape[0], t) + a.shape[2:])
+
+    return apply_op("sequence_expand", f, (xt, yt), {})
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Context-window convolution over time (reference ``sequence_conv``):
+    each step sees ``filter_size`` neighboring steps, centered per the
+    reference's default (``padding_start = -floor(k/2)``)."""
+    from ..framework.param_attr import create_parameter
+
+    xt = _t(input)
+    d = int(xt.shape[-1])
+    k = int(filter_size)
+    w = create_parameter([k * d, num_filters], "float32", attr=param_attr)
+    b = create_parameter([num_filters], "float32", attr=bias_attr,
+                         is_bias=True) if bias_attr is not False else None
+    start = -(k // 2) if padding_start is None else int(padding_start)
+
+    def f(a, W, *bias):
+        a32 = a.astype(jnp.float32)
+        t = a.shape[1]
+        pre, post = max(0, -start), max(0, start + k - 1)
+        pad = jnp.pad(a32, ((0, 0), (pre, post), (0, 0)))
+        ctx = jnp.concatenate(
+            [pad[:, j:j + t, :] for j in range(k)], axis=-1)
+        out = ctx @ W.astype(jnp.float32)
+        if bias:
+            out = out + bias[0].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    args = (xt, w) + ((b,) if b is not None else ())
+    out = apply_op("sequence_conv", f, args, {})
+    return _apply_act(out, act)

@@ -1,0 +1,179 @@
+"""``paddle.static.nn`` builders + graph control flow.
+
+Reference: ``python/paddle/static/nn/__init__.py`` builders,
+``python/paddle/static/nn/control_flow.py`` (cond/case/switch_case/
+while_loop).  Under test: ``paddle_tpu/static/nn.py`` — builders create
+ordinary eager layers whose params become Program state; control flow
+lowers to XLA select / lax.while_loop.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+snn = paddle.static.nn
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    try:
+        yield
+    finally:
+        paddle.disable_static()
+
+
+def _run(program, feed, fetch):
+    exe = paddle.static.Executor()
+    return exe.run(program, feed=feed, fetch_list=fetch)
+
+
+def test_fc_trains(static_mode):
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 8], "float32")
+        y = paddle.static.data("y", [None, 1], "int64")
+        h = snn.fc(x, 16, activation="relu")
+        loss = paddle.nn.functional.cross_entropy(snn.fc(h, 3), y)
+        paddle.optimizer.SGD(learning_rate=0.2).minimize(loss)
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(16, 8)).astype(np.float32)
+    ys = rng.integers(0, 3, (16, 1))
+    exe = paddle.static.Executor()
+    first = float(exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])[0])
+    for _ in range(10):
+        last = float(exe.run(main, feed={"x": xs, "y": ys},
+                             fetch_list=[loss])[0])
+    assert last < first
+
+
+def test_conv_bn_builders(static_mode):
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        img = paddle.static.data("img", [None, 3, 8, 8], "float32")
+        c = snn.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                       act="relu")
+        b = snn.batch_norm(c)
+        pooled = b.mean(axis=[2, 3])
+    xs = np.random.default_rng(1).normal(size=(2, 3, 8, 8)).astype(np.float32)
+    (out,) = _run(main, {"img": xs}, [pooled])
+    assert out.shape == (2, 4)
+
+
+def test_embedding_builder(static_mode):
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        ids = paddle.static.data("ids", [None, 5], "int64")
+        emb = snn.embedding(ids, size=[10, 6])
+        out = emb.sum(axis=1)
+    (o,) = _run(main, {"ids": np.zeros((3, 5), np.int64)}, [out])
+    assert o.shape == (3, 6)
+
+
+def test_cond_selects_branch(static_mode):
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        a = paddle.static.data("a", [4], "float32")
+        out = snn.cond(a.sum() > 0, lambda: a * 2, lambda: a - 1)
+    av = np.array([1, 2, 3, 4], np.float32)
+    (o,) = _run(main, {"a": av}, [out])
+    np.testing.assert_allclose(o, av * 2)
+    (o2,) = _run(main, {"a": -av}, [out])
+    np.testing.assert_allclose(o2, -av - 1)
+
+
+def test_switch_case_and_case(static_mode):
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        i = paddle.static.data("i", [1], "int64")
+        a = paddle.static.data("a", [2], "float32")
+        sw = snn.switch_case(i, {0: lambda: a + 1, 1: lambda: a * 10},
+                             default=lambda: a * 0)
+        cs = snn.case([(i == 0, lambda: a + 100)], default=lambda: a)
+    av = np.array([1.0, 2.0], np.float32)
+    o_sw, o_cs = _run(main, {"i": np.array([1]), "a": av}, [sw, cs])
+    np.testing.assert_allclose(o_sw, av * 10)
+    np.testing.assert_allclose(o_cs, av)
+    o_sw0, o_cs0 = _run(main, {"i": np.array([0]), "a": av}, [sw, cs])
+    np.testing.assert_allclose(o_sw0, av + 1)
+    np.testing.assert_allclose(o_cs0, av + 100)
+
+
+def test_while_loop_records_xla_loop(static_mode):
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [1], "float32")
+        i0 = paddle.to_tensor(np.float32(0))
+        iv, xv = snn.while_loop(lambda i, s: i < 4,
+                                lambda i, s: [i + 1, s * 2], [i0, x])
+    (o,) = _run(main, {"x": np.array([3.0], np.float32)}, [xv])
+    np.testing.assert_allclose(o, [48.0])  # 3 * 2**4
+
+
+def test_sequence_ops_masked(static_mode):
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 4, 3], "float32")
+        ln = paddle.static.data("ln", [None], "int64")
+        sm = snn.sequence_softmax(x, lengths=ln)
+        pool = snn.sequence_pool(x, "average", lengths=ln)
+        last = snn.sequence_last_step(x, lengths=ln)
+    xs = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+    lens = np.array([2, 4], np.int64)
+    o_sm, o_pool, o_last = _run(main, {"x": xs, "ln": lens}, [sm, pool, last])
+    # masked softmax: padded steps are exactly zero, valid steps sum to 1
+    assert np.allclose(o_sm[0, 2:], 0.0)
+    assert np.allclose(o_sm[0, :2].sum(axis=0), 1.0, atol=1e-5)
+    # masked average uses only the first 2 steps of row 0
+    np.testing.assert_allclose(o_pool[0], xs[0, :2].mean(axis=0), rtol=1e-5)
+    np.testing.assert_allclose(o_last[0], xs[0, 1], rtol=1e-6)
+    np.testing.assert_allclose(o_last[1], xs[1, 3], rtol=1e-6)
+
+
+def test_bilinear_row_conv_shapes(static_mode):
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x1 = paddle.static.data("x1", [None, 3], "float32")
+        x2 = paddle.static.data("x2", [None, 4], "float32")
+        bt = snn.bilinear_tensor_product(x1, x2, size=5)
+        seq = paddle.static.data("seq", [None, 6, 3], "float32")
+        rc = snn.row_conv(seq, future_context_size=2)
+        sc = snn.sequence_conv(seq, num_filters=7, filter_size=3)
+    o_bt, o_rc, o_sc = _run(
+        main,
+        {"x1": np.ones((2, 3), np.float32), "x2": np.ones((2, 4), np.float32),
+         "seq": np.ones((2, 6, 3), np.float32)},
+        [bt, rc, sc])
+    assert o_bt.shape == (2, 5)
+    assert o_rc.shape == (2, 6, 3)
+    assert o_sc.shape == (2, 6, 7)
+
+
+def test_spectral_norm_normalizes_and_carries_uv(static_mode):
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        w = paddle.static.create_parameter([6, 4], "float32")
+        wn = snn.spectral_norm(w, power_iters=6)
+        frob = (wn * wn).sum()
+    exe = paddle.static.Executor()
+    (f1,) = exe.run(main, feed={}, fetch_list=[frob])
+    (f2,) = exe.run(main, feed={}, fetch_list=[frob])
+    # sigma_max(W/sigma) ~ 1 so ||W/sigma||_F^2 <= rank; and the carried u/v
+    # refine the estimate across runs (values may move slightly)
+    assert f1 < 20.0
+    assert np.isfinite(f2)
+
+
+def test_nce_and_data_norm_shapes(static_mode):
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 8], "float32")
+        lab = paddle.static.data("lab", [None, 1], "int64")
+        loss = snn.nce(x, lab, num_total_classes=20, num_neg_samples=5)
+        dn = snn.data_norm(x)
+    xs = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    o_loss, o_dn = _run(main, {"x": xs, "lab": np.zeros((4, 1), np.int64)},
+                        [loss, dn])
+    assert o_loss.shape == (4, 1) and np.all(o_loss > 0)
+    assert o_dn.shape == (4, 8)

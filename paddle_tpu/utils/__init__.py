@@ -1,6 +1,7 @@
 """Utilities (reference ``paddle/utils``): alignment harness etc."""
 
 from . import align  # noqa: F401
+from . import cpp_extension  # noqa: F401  (custom-op extension path)
 
 # -- reference paddle.utils surface -----------------------------------------
 

@@ -1,0 +1,204 @@
+"""Custom-op extension path.
+
+Reference: ``python/paddle/utils/cpp_extension/extension_utils.py:1`` (JIT
+load + op registration), ``paddle/phi/capi/`` (kernel ABI).  Under test:
+``paddle_tpu/utils/cpp_extension.py`` — register_op (jnp/Pallas + custom
+VJP through the apply_op choke point) and the g++/ctypes/pure_callback C++
+host-kernel path.
+"""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.cpp_extension import CppExtension, load, register_op
+
+
+@pytest.fixture(scope="module")
+def scale_relu():
+    def bwd(x, out, g, *, scale=2.0):
+        return (g * (out > 0) * scale,)
+
+    @register_op("test_scale_relu", backward=bwd)
+    def scale_relu(x, *, scale=2.0):
+        return jnp.maximum(x * scale, 0.0)
+
+    return scale_relu
+
+
+def test_register_op_eager_and_grad(scale_relu):
+    x = paddle.to_tensor(np.array([-1.0, 0.5, 2.0], np.float32),
+                         stop_gradient=False)
+    y = scale_relu(x, scale=3.0)
+    np.testing.assert_allclose(np.asarray(y.numpy()), [0.0, 1.5, 6.0])
+    y.sum().backward()
+    # custom VJP: g * (out>0) * scale
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), [0.0, 3.0, 3.0])
+
+
+def test_register_op_matches_autodiff_when_no_backward():
+    @register_op("test_square_plain")
+    def square(x):
+        return x * x
+
+    x = paddle.to_tensor(np.array([2.0, -3.0], np.float32),
+                         stop_gradient=False)
+    square(x).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), [4.0, -6.0])
+
+
+def test_register_op_under_to_static_and_capture(scale_relu):
+    @paddle.jit.to_static
+    def f(x):
+        return scale_relu(x, scale=2.0) + 1.0
+
+    x = paddle.to_tensor(np.array([[1.0, -1.0]], np.float32))
+    np.testing.assert_allclose(np.asarray(f(x).numpy()), [[3.0, 1.0]])
+
+    with paddle.jit.capture() as rec:
+        y = scale_relu(paddle.to_tensor(np.array([2.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(y.numpy()), [4.0])
+    assert rec.eager_ops == 0  # recorded into the fragment, not broken
+
+
+def test_register_op_in_static_program(scale_relu):
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 2], "float32")
+            out = scale_relu(x, scale=2.0).sum(axis=-1)
+        exe = paddle.static.Executor()
+        (o,) = exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                       fetch_list=[out])
+        np.testing.assert_allclose(o, [4.0, 4.0])
+    finally:
+        paddle.disable_static()
+
+
+def test_register_op_through_trainstep(scale_relu):
+    """The example fused op drives a whole compiled training step."""
+    import paddle_tpu.nn.functional as F
+
+    net = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+
+    def loss_fn(model, x, y):
+        h = scale_relu(model(x), scale=1.5)
+        return F.mse_loss(h, y)
+
+    step = paddle.jit.TrainStep(net, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.normal(size=(8, 4)).astype(np.float32))
+    y = paddle.to_tensor(rng.normal(size=(8, 4)).astype(np.float32))
+    l0 = float(step(x, y).numpy())
+    for _ in range(5):
+        l1 = float(step(x, y).numpy())
+    assert l1 < l0
+
+
+def test_register_op_sharded(scale_relu):
+    """The custom op runs under a sharded jit (GSPMD partitions it like any
+    traced op)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices("cpu")[:8])
+    mesh = Mesh(devs, ("dp",))
+    x = jnp.arange(16.0, dtype=jnp.float32).reshape(8, 2) - 8.0
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+
+    # route through the registered kernel under jit on sharded input
+    def g(a):
+        t = paddle.to_tensor(a)
+        return scale_relu(t, scale=2.0)._data
+
+    out = jax.jit(g)(xs)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.maximum(np.asarray(x) * 2.0, 0.0))
+    assert out.sharding.is_equivalent_to(
+        NamedSharding(mesh, P("dp", None)), out.ndim)
+
+
+CPP_SOURCE = textwrap.dedent("""
+    #include "paddle_tpu_op.h"
+    #include <cmath>
+
+    PD_TPU_OP(cpp_softsign, 1, 1)
+
+    extern "C" void pd_op_cpp_softsign(const PDTensor* inputs, int32_t n_in,
+                                       PDTensor* outputs, int32_t n_out) {
+        const PDTensor& x = inputs[0];
+        int64_t n = 1;
+        for (int i = 0; i < x.ndim; ++i) n *= x.shape[i];
+        const float* xd = static_cast<const float*>(x.data);
+        float* od = static_cast<float*>(outputs[0].data);
+        for (int64_t i = 0; i < n; ++i)
+            od[i] = xd[i] / (1.0f + std::fabs(xd[i]));
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def cpp_mod(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "softsign_op.cc"
+    src.write_text(CPP_SOURCE)
+    return load("test_cpp_ops", [str(src)], build_directory=str(d))
+
+
+def test_cpp_op_eager(cpp_mod):
+    x = np.array([-2.0, 0.0, 3.0], np.float32)
+    y = cpp_mod.cpp_softsign(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(y.numpy()), x / (1 + np.abs(x)),
+                               rtol=1e-6)
+
+
+def test_cpp_op_inside_jit(cpp_mod):
+    """pure_callback makes the host kernel callable from compiled programs."""
+    x = np.linspace(-1, 1, 8).astype(np.float32)
+
+    @paddle.jit.to_static
+    def f(t):
+        return cpp_mod.cpp_softsign(t) * 2.0
+
+    out = f(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               2 * x / (1 + np.abs(x)), rtol=1e-6)
+
+
+def test_cpp_op_with_python_backward(tmp_path):
+    src = tmp_path / "softsign2.cc"
+    src.write_text(CPP_SOURCE)
+
+    def bwd(x, out, g):
+        return (g / (1.0 + jnp.abs(x)) ** 2,)
+
+    mod = load("test_cpp_ops_bwd", [str(src)], build_directory=str(tmp_path),
+               backwards={"cpp_softsign": bwd})
+    x = paddle.to_tensor(np.array([1.0, -1.0], np.float32),
+                         stop_gradient=False)
+    mod.cpp_softsign(x).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), [0.25, 0.25],
+                               rtol=1e-6)
+
+
+def test_parse_op_info_and_bad_sources(tmp_path):
+    from paddle_tpu.utils.cpp_extension import parse_op_info
+
+    assert parse_op_info([CPP_SOURCE]) == {"cpp_softsign": (1, 1)}
+    with pytest.raises(ValueError, match="no PD_TPU_OP"):
+        f = tmp_path / "empty.cc"
+        f.write_text("int x;")
+        load("nothing", [str(f)], build_directory=str(tmp_path))
+
+
+def test_cuda_extension_redirects():
+    from paddle_tpu.utils.cpp_extension import CUDAExtension
+
+    with pytest.raises(NotImplementedError, match="Pallas"):
+        CUDAExtension(sources=["op.cu"])

@@ -41,6 +41,7 @@ from .semi_auto import (  # noqa: F401
     DistAttr, DistModel, ReduceType, ShardingStage1, ShardingStage2,
     ShardingStage3, Strategy, to_static,
 )
+from .planner import ShardingPlan, apply_plan, plan_shardings  # noqa: F401
 
 
 def spawn(func, args=(), nprocs=-1, **kwargs):

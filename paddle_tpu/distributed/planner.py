@@ -1,0 +1,412 @@
+"""Auto-parallel sharding planner: decide placements for a novel model.
+
+Reference counterpart:
+``python/paddle/distributed/auto_parallel/static/completion.py:1`` (the
+2467-line sharding-completion pass that annotates a whole static program)
+plus ``.../static/cost/cost_model.py`` (candidate scoring).  GSPMD already
+does the reference's *propagation* job inside XLA; what was missing is the
+*decision* layer — nothing chose shardings for a model without hand
+annotations.
+
+TPU-native design: instead of completing a protobuf program, the planner
+
+1. traces the model's step to a **jaxpr** (the program IS the IR),
+2. walks it with a provenance map to see HOW each parameter is consumed —
+   ``dot_general`` (which dims contract), ``gather`` (embedding lookups),
+   ``conv_general_dilated`` (filters) — through transpose/convert/bitcast
+   pass-throughs and into ``pjit``/``custom_vjp`` sub-jaxprs,
+3. emits candidate plans (pure-DP; DP + Megatron-alternating tensor
+   parallelism with column→row pairing and bias-follows-matmul; + vocab
+   sharding for big embeddings), honoring divisibility by the mesh axis,
+4. scores candidates — by MEASURING a compiled step on the real mesh
+   (default: XLA is its own best cost model) or analytically via the
+   auto_tuner cost model (``score="estimate"``) — and returns the winner.
+
+``apply_plan`` then shards the live parameters in place (``shard_tensor``),
+so ``jit.TrainStep``/``DistModel`` compile the planned distribution.
+Wire-up: ``paddle.distributed.to_static(..., auto_parallel=True)``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.extend.core import Literal as _Literal
+
+from .mesh import ProcessMesh
+from .placement import Replicate, Shard, named_sharding
+
+__all__ = ["ShardingPlan", "plan_shardings", "apply_plan"]
+
+
+# ---------------------------------------------------------------------------
+# jaxpr provenance analysis
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Use:
+    """One consumption of a parameter leaf inside the traced step."""
+
+    kind: str                 # "dot" | "gather" | "conv" | "other"
+    eqn_index: int
+    # for "dot": original param dims that are contracted / kept
+    contracted: Tuple[int, ...] = ()
+    kept: Tuple[int, ...] = ()
+    out_size: Optional[int] = None   # product of kept dims (matmul fan-out)
+
+
+_PASSTHROUGH = {"convert_element_type", "copy", "bitcast_convert_type",
+                "stop_gradient", "reduce_precision", "optimization_barrier"}
+
+
+def _analyze(jaxpr, invar_roots: Dict[Any, Tuple[str, Tuple[int, ...]]],
+             uses: Dict[str, List[_Use]], counter: List[int]):
+    """Walk eqns; ``invar_roots`` maps jaxpr vars -> (param_name, dim_map)
+    where dim_map[i] = original param dim behind var dim i (or -1)."""
+    roots = dict(invar_roots)
+    for eqn in jaxpr.eqns:
+        counter[0] += 1
+        idx = counter[0]
+        prim = eqn.primitive.name
+        traced_ins = [(i, roots[v]) for i, v in enumerate(eqn.invars)
+                      if not isinstance(v, _Literal) and v in roots]
+        if prim in _PASSTHROUGH and traced_ins:
+            roots[eqn.outvars[0]] = traced_ins[0][1]
+            continue
+        if prim == "transpose" and traced_ins:
+            name, dim_map = traced_ins[0][1]
+            perm = eqn.params["permutation"]
+            roots[eqn.outvars[0]] = (name, tuple(dim_map[p] for p in perm))
+            continue
+        if prim == "reshape" and traced_ins:
+            # only track size-preserving rank-identical reshapes
+            name, dim_map = traced_ins[0][1]
+            v_in, v_out = eqn.invars[0], eqn.outvars[0]
+            if tuple(v_in.aval.shape) == tuple(v_out.aval.shape):
+                roots[v_out] = (name, dim_map)
+            continue
+        # descend into sub-jaxprs (pjit / custom_vjp / remat / scan body)
+        sub = None
+        for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+            if key in eqn.params:
+                sub = eqn.params[key]
+                break
+        if sub is not None:
+            sub_jaxpr = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+            inner = {}
+            n_const = len(sub_jaxpr.invars) - len(eqn.invars)
+            invars = sub_jaxpr.invars[max(0, n_const):] \
+                if n_const >= 0 else sub_jaxpr.invars
+            for outer_v, inner_v in zip(eqn.invars, invars):
+                if not isinstance(outer_v, _Literal) \
+                        and outer_v in roots:
+                    inner[inner_v] = roots[outer_v]
+            _analyze(sub_jaxpr, inner, uses, counter)
+            continue
+        if prim == "dot_general":
+            (lc, rc), _ = eqn.params["dimension_numbers"]
+            for pos, (name, dim_map) in traced_ins:
+                if pos > 1:
+                    continue
+                cdims = lc if pos == 0 else rc
+                aval = eqn.invars[pos].aval
+                contracted = tuple(dim_map[d] for d in cdims
+                                   if dim_map[d] >= 0)
+                kept_pairs = [(dim_map[d], aval.shape[d])
+                              for d in range(len(aval.shape))
+                              if d not in cdims and dim_map[d] >= 0]
+                kept = tuple(d for d, _ in kept_pairs)
+                out_size = int(np.prod([s for _, s in kept_pairs])) \
+                    if kept_pairs else None
+                uses.setdefault(name, []).append(
+                    _Use("dot", idx, contracted, kept, out_size))
+            continue
+        if prim == "gather" and traced_ins and traced_ins[0][0] == 0:
+            name, dim_map = traced_ins[0][1]
+            uses.setdefault(name, []).append(_Use("gather", idx))
+            continue
+        if prim == "conv_general_dilated":
+            for pos, (name, dim_map) in traced_ins:
+                if pos == 1:
+                    uses.setdefault(name, []).append(_Use("conv", idx))
+            continue
+        for _, (name, _) in traced_ins:
+            uses.setdefault(name, []).append(_Use("other", idx))
+
+
+def _trace_uses(step_fn, params: Dict[str, Any], example_args) -> Dict[str, List[_Use]]:
+    spec = lambda a: jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a))
+    p_struct = jax.tree.map(spec, params)
+    arg_structs = tuple(jax.tree.map(spec, a) for a in example_args)
+    closed = jax.make_jaxpr(step_fn)(p_struct, *arg_structs)
+    flat_params, _ = jax.tree_util.tree_flatten_with_path(params)
+    n_param_leaves = len(flat_params)
+    invar_roots = {}
+    for (path, leaf), var in zip(flat_params, closed.jaxpr.invars[:n_param_leaves]):
+        name = ".".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+        invar_roots[var] = (name, tuple(range(len(var.aval.shape))))
+    uses: Dict[str, List[_Use]] = {}
+    _analyze(closed.jaxpr, invar_roots, uses, [0])
+    return uses
+
+
+# ---------------------------------------------------------------------------
+# candidate plans
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ShardingPlan:
+    """The planner's decision: per-parameter placements + batch placements."""
+
+    mesh: ProcessMesh
+    params: Dict[str, list] = field(default_factory=dict)   # name -> placements
+    inputs: list = field(default_factory=list)              # per example arg
+    strategy: str = "dp"
+    score_ms: Optional[float] = None
+
+    def describe(self) -> str:
+        lines = [f"plan[{self.strategy}] on mesh {self.mesh.shape} "
+                 f"{tuple(self.mesh.dim_names)}"
+                 + (f" score={self.score_ms:.2f}ms" if self.score_ms else "")]
+        for n, pl in sorted(self.params.items()):
+            if any(isinstance(p, Shard) for p in pl):
+                lines.append(f"  {n}: {pl}")
+        return "\n".join(lines)
+
+
+def _axis(mesh: ProcessMesh, *names) -> Optional[int]:
+    for n in names:
+        if n in mesh.dim_names:
+            return list(mesh.dim_names).index(n)
+    return None
+
+
+def _replicated(mesh) -> list:
+    return [Replicate() for _ in range(mesh.ndim)]
+
+
+def _candidates(params, uses, mesh, vocab_threshold=8192):
+    """Generate candidate plans: pure-DP; +Megatron TP; +vocab sharding."""
+    mp_ax = _axis(mesh, "mp", "tp", "model")
+    plans = []
+
+    def base_plan(name):
+        return ShardingPlan(mesh, {n: _replicated(mesh) for n in params},
+                            strategy=name)
+
+    dp = base_plan("dp")
+    plans.append(dp)
+    if mp_ax is None or mesh.shape[mp_ax] <= 1:
+        return plans
+    mp_size = mesh.shape[mp_ax]
+
+    def divisible(shape, dim):
+        return dim < len(shape) and shape[dim] % mp_size == 0 and shape[dim] >= mp_size
+
+    for with_vocab in ([False, True] if any(
+            any(u.kind == "gather" for u in us) for us in uses.values())
+            else [False]):
+        plan = base_plan("dp+mp" + ("+vocab" if with_vocab else ""))
+        # Megatron alternation: order matmul params by first consumption;
+        # col-shard (kept dim), then row-shard (contracted dim), repeating —
+        # col→row pairs need no activation collective between them.
+        matmuls = sorted(
+            ((min(u.eqn_index for u in us if u.kind == "dot"), n)
+             for n, us in uses.items()
+             if any(u.kind == "dot" for u in us)),
+            key=lambda t: t[0])
+        col_out_sizes = {}   # fan-out size of col-sharded matmuls (for biases)
+        make_col = True
+        for _, name in matmuls:
+            us = [u for u in uses[name] if u.kind == "dot"]
+            shape = tuple(jnp.shape(params[name]))
+            # consistent dims across uses only
+            kept = us[0].kept
+            contracted = us[0].contracted
+            if any(u.kept != kept or u.contracted != contracted for u in us):
+                continue
+            pl = _replicated(mesh)
+            if make_col and kept and divisible(shape, kept[-1]):
+                pl[mp_ax] = Shard(kept[-1])
+                col_out_sizes[us[0].out_size] = True
+                make_col = False
+            elif not make_col and contracted and divisible(shape, contracted[-1]):
+                pl[mp_ax] = Shard(contracted[-1])
+                make_col = True
+            plan.params[name] = pl
+        # biases follow their column-parallel matmul (same fan-out size)
+        for name, us in uses.items():
+            shape = tuple(jnp.shape(params[name]))
+            if len(shape) == 1 and shape[0] in col_out_sizes \
+                    and divisible(shape, 0) \
+                    and not any(u.kind == "dot" for u in us):
+                plan.params[name][mp_ax] = Shard(0)
+        if with_vocab:
+            for name, us in uses.items():
+                shape = tuple(jnp.shape(params[name]))
+                if any(u.kind == "gather" for u in us) and len(shape) >= 2 \
+                        and shape[0] >= vocab_threshold and divisible(shape, 0):
+                    plan.params[name][mp_ax] = Shard(0)
+        plans.append(plan)
+    return plans
+
+
+def _batch_placements(mesh, example_args):
+    dp_ax = _axis(mesh, "dp", "data", "sharding")
+    out = []
+    for a in example_args:
+        pl = _replicated(mesh)
+        if dp_ax is not None and jnp.ndim(a) >= 1 \
+                and jnp.shape(a)[0] % mesh.shape[dp_ax] == 0:
+            pl[dp_ax] = Shard(0)
+        out.append(pl)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scoring
+# ---------------------------------------------------------------------------
+
+def _measure(step_fn, params, example_args, plan: ShardingPlan,
+             warmup: int = 1, iters: int = 3) -> float:
+    mesh = plan.mesh
+    sh_params = {
+        n: jax.device_put(a, named_sharding(mesh, plan.params[n], jnp.ndim(a)))
+        for n, a in params.items()}
+    sh_args = tuple(
+        jax.device_put(jnp.asarray(a), named_sharding(mesh, pl, jnp.ndim(a)))
+        for a, pl in zip(example_args, plan.inputs))
+    fn = jax.jit(step_fn)
+    out = fn(sh_params, *sh_args)
+    jax.block_until_ready(out)
+    for _ in range(max(0, warmup - 1)):
+        jax.block_until_ready(fn(sh_params, *sh_args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(sh_params, *sh_args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def _estimate(plan: ShardingPlan, params) -> float:
+    """Analytic fallback via the auto_tuner cost model: map the plan onto a
+    (dp_degree, mp_degree) config."""
+    from .auto_tuner.cost_model import estimate_step_time_ms
+
+    mesh = plan.mesh
+    mp_ax = _axis(mesh, "mp", "tp", "model")
+    uses_mp = any(any(isinstance(p, Shard) for i, p in enumerate(pl)
+                      if i == mp_ax) for pl in plan.params.values())
+    dp_ax = _axis(mesh, "dp", "data", "sharding")
+    n_param = float(sum(int(np.prod(jnp.shape(a))) for a in params.values()))
+    cfg = {"dp_degree": mesh.shape[dp_ax] if dp_ax is not None else 1,
+           "mp_degree": mesh.shape[mp_ax] if (mp_ax is not None and uses_mp) else 1,
+           "pp_degree": 1, "micro_batch_size": 1, "sharding_degree": 1}
+    tuner_cfg = {"model_cfg": {"num_params": n_param,
+                               "global_batch_size": 1,
+                               "hidden_size": 1024, "num_layers": 4,
+                               "seq_length": 512, "vocab_size": 32000}}
+    try:
+        return float(estimate_step_time_ms(cfg, tuner_cfg))
+    except Exception:
+        return 0.0 if uses_mp else 1.0
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def plan_shardings(layer, example_inputs: Sequence[Any], mesh: Optional[ProcessMesh] = None,
+                   loss_fn: Optional[Callable] = None, score: str = "measure",
+                   vocab_threshold: int = 8192, verbose: bool = False) -> ShardingPlan:
+    """Choose shardings for ``layer`` on ``mesh`` from its traced step.
+
+    ``example_inputs``: example batch (Tensors/arrays; the LAST one is the
+    label when ``loss_fn`` is given).  ``score="measure"`` compiles and times
+    each candidate on the mesh (XLA as the cost model); ``"estimate"`` uses
+    the analytic auto_tuner model.
+    """
+    from ..jit import _bind_state, _get_state
+    from ..framework.autograd import no_grad
+    from ..framework.dispatch import unwrap, wrap
+
+    if mesh is None:
+        from .mesh import get_mesh
+
+        mesh = get_mesh()
+    params, buffers = _get_state(layer)
+
+    def fwd(p, *args):
+        t_args = wrap(args)
+        with _bind_state(layer, p, buffers), no_grad():
+            if loss_fn is not None:
+                out = loss_fn(layer(*t_args[:-1]), t_args[-1])
+            else:
+                out = layer(*t_args)
+        out = unwrap(out)
+        leaves = jax.tree.leaves(out)
+        return sum(jnp.sum(l) for l in leaves if jnp.issubdtype(
+            jnp.result_type(l), jnp.floating))
+
+    def step(p, *args):
+        loss, grads = jax.value_and_grad(fwd)(p, *args)
+        new_p = jax.tree.map(lambda a, g: a - 0.01 * g, p, grads)
+        return loss, new_p
+
+    raw_args = tuple(unwrap(a) if hasattr(a, "_data") else jnp.asarray(a)
+                     for a in example_inputs)
+    # analyze the FORWARD only: the backward consumes every matmul weight a
+    # second time with transposed contraction dims, which would make every
+    # use-set look inconsistent; GSPMD derives the backward shardings from
+    # the forward decision anyway
+    uses = _trace_uses(fwd, params, raw_args)
+    plans = _candidates(params, uses, mesh, vocab_threshold)
+    batch_pl = _batch_placements(mesh, raw_args)
+    for plan in plans:
+        plan.inputs = batch_pl
+    if len(plans) > 1:
+        for plan in plans:
+            plan.score_ms = (_measure(step, params, raw_args, plan)
+                             if score == "measure"
+                             else _estimate(plan, params))
+        plans.sort(key=lambda p: p.score_ms)
+    best = plans[0]
+    if verbose:
+        for p in plans:
+            print(f"  candidate {p.strategy}: {p.score_ms}")
+        print(best.describe())
+    return best
+
+
+def apply_plan(layer, plan: ShardingPlan):
+    """Shard the live parameters in place per the plan (GSPMD propagates the
+    rest once the step is jitted)."""
+    from .api import shard_tensor
+
+    for name, p in layer.named_parameters():
+        placements = plan.params.get(name)
+        if placements is not None:
+            shard_tensor(p, plan.mesh, placements)
+    return layer
+
+
+def shard_batch(plan: ShardingPlan, *args):
+    """Device-put a batch per the plan's input placements."""
+    if len(args) != len(plan.inputs):
+        raise ValueError(
+            f"batch has {len(args)} tensors but the plan was built from "
+            f"{len(plan.inputs)} — re-plan with the new batch structure")
+    out = []
+    for a, pl in zip(args, plan.inputs):
+        arr = a._data if hasattr(a, "_data") else jnp.asarray(a)
+        out.append(jax.device_put(arr, named_sharding(plan.mesh, pl, arr.ndim)))
+    from ..framework.dispatch import wrap
+
+    return tuple(wrap(o) for o in out)

@@ -135,7 +135,8 @@ class DistModel:
     jitted forward."""
 
     def __init__(self, layer, loader=None, loss_fn=None, optimizer=None,
-                 strategy: Optional[Strategy] = None):
+                 strategy: Optional[Strategy] = None, auto_parallel: bool = False,
+                 mesh: Optional[ProcessMesh] = None):
         self.network = layer
         self._loss_fn = loss_fn
         self._optimizer = optimizer
@@ -143,10 +144,28 @@ class DistModel:
         self._mode = "train" if optimizer is not None else "eval"
         self._train_step = None
         self._eval_fn = None
+        self._auto_parallel = auto_parallel
+        self._mesh = mesh
+        self._plan = None
         if strategy and strategy.sharding.enable and optimizer is not None:
             from .api import shard_optimizer
 
             shard_optimizer(optimizer, stage=strategy.sharding.stage)
+
+    def _ensure_plan(self, args):
+        """auto_parallel=True: run the sharding planner on the first batch
+        (reference: the static auto-parallel Engine's completion pass) and
+        shard the live parameters before the step compiles."""
+        if self._plan is None:
+            from .planner import apply_plan, plan_shardings
+
+            self._plan = plan_shardings(
+                self.network, list(args), mesh=self._mesh,
+                loss_fn=self._loss_fn)
+            apply_plan(self.network, self._plan)
+        from .planner import shard_batch
+
+        return shard_batch(self._plan, *args)
 
     def train(self):
         self._mode = "train"
@@ -157,6 +176,8 @@ class DistModel:
         return self
 
     def __call__(self, *args):
+        if self._auto_parallel:
+            args = self._ensure_plan(args)
         if self._mode == "train":
             if self._loss_fn is None or self._optimizer is None:
                 raise ValueError("DistModel.train needs loss_fn and optimizer")
@@ -181,7 +202,12 @@ class DistModel:
         return self.network.set_state_dict(*a, **k)
 
 
-def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              auto_parallel: bool = False, mesh: Optional[ProcessMesh] = None):
     """Build a :class:`DistModel` (reference ``distributed.to_static``,
-    ``auto_parallel/api.py:2693``)."""
-    return DistModel(layer, loader, loss, optimizer, strategy)
+    ``auto_parallel/api.py:2693``).  With ``auto_parallel=True`` the sharding
+    planner (``planner.plan_shardings``) decides the parameter placements
+    from the traced step on the first batch — the capability of the
+    reference's completion pass."""
+    return DistModel(layer, loader, loss, optimizer, strategy,
+                     auto_parallel=auto_parallel, mesh=mesh)

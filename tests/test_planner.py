@@ -1,0 +1,178 @@
+"""Auto-parallel sharding planner.
+
+Reference: ``python/paddle/distributed/auto_parallel/static/completion.py:1``
+(sharding completion) + ``.../static/cost/cost_model.py`` (scoring).  Under
+test: ``paddle_tpu/distributed/planner.py`` — jaxpr provenance analysis,
+Megatron-alternating candidate generation, measured scoring, and the
+``to_static(auto_parallel=True)`` wire-up.
+
+Acceptance (VERDICT r4 #3): a novel non-Llama model gets planner shardings
+within 10% of (or better than) the hand-specified step time on the 8-device
+CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.mesh import ProcessMesh
+from paddle_tpu.distributed.placement import Replicate, Shard
+from paddle_tpu.distributed.planner import (
+    ShardingPlan, _measure, apply_plan, plan_shardings, shard_batch,
+)
+
+
+class Tower(nn.Layer):
+    """Novel (non-Llama) model: embedding + alternating MLP tower."""
+
+    def __init__(self, vocab=16384, d=256, h=1024, classes=16):
+        super().__init__()
+        self.emb = nn.Embedding(vocab, d)
+        self.l1 = nn.Linear(d, h)
+        self.l2 = nn.Linear(h, d)
+        self.l3 = nn.Linear(d, h)
+        self.l4 = nn.Linear(h, classes)
+
+    def forward(self, ids):
+        x = self.emb(ids).mean(axis=1)
+        x = F.relu(self.l1(x))
+        x = F.relu(self.l2(x))
+        x = F.relu(self.l3(x))
+        return self.l4(x)
+
+
+def _mesh():
+    return ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+
+
+def _batch(vocab=16384, n=8, t=32, classes=16):
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, vocab, (n, t)))
+    lab = paddle.to_tensor(rng.integers(0, classes, (n, 1)))
+    return ids, lab
+
+
+@pytest.fixture(scope="module")
+def tower_plan():
+    paddle.seed(0)
+    net = Tower()
+    ids, lab = _batch()
+    plan = plan_shardings(net, [ids, lab], _mesh(), loss_fn=F.cross_entropy)
+    return net, plan
+
+
+def _mp_placement(plan, name):
+    return plan.params[name][1]   # mesh axis 1 = "mp"
+
+
+def test_planner_finds_megatron_alternation(tower_plan):
+    _, plan = tower_plan
+    # col (out dim) -> row (in dim) -> col -> row; weights are [in, out]
+    assert _mp_placement(plan, "l1.weight") == Shard(1)
+    assert _mp_placement(plan, "l2.weight") == Shard(0)
+    assert _mp_placement(plan, "l3.weight") == Shard(1)
+    assert _mp_placement(plan, "l4.weight") == Shard(0)
+    # bias follows its column-parallel matmul; row-parallel bias replicated
+    assert _mp_placement(plan, "l1.bias") == Shard(0)
+    assert isinstance(_mp_placement(plan, "l2.bias"), Replicate)
+
+
+def test_planner_vocab_shards_big_embedding(tower_plan):
+    _, plan = tower_plan
+    assert _mp_placement(plan, "emb.weight") == Shard(0)
+    assert "vocab" in plan.strategy
+
+
+def test_planner_batch_on_dp(tower_plan):
+    _, plan = tower_plan
+    assert plan.inputs[0][0] == Shard(0)      # ids batch dim on dp
+
+
+def test_planner_beats_or_matches_hand_spec(tower_plan):
+    """The acceptance gate: planned step time within 10% of the hand spec."""
+    from paddle_tpu.framework.autograd import no_grad
+    from paddle_tpu.framework.dispatch import unwrap, wrap
+    from paddle_tpu.jit import _bind_state, _get_state
+
+    net, plan = tower_plan
+    ids, lab = _batch()
+    params, buffers = _get_state(net)
+
+    def fwd(p, *args):
+        t_args = wrap(args)
+        with _bind_state(net, p, buffers), no_grad():
+            return unwrap(F.cross_entropy(net(t_args[0]), t_args[1]))
+
+    def step(p, *args):
+        loss, grads = jax.value_and_grad(fwd)(p, *args)
+        return loss, jax.tree.map(lambda a, g: a - 0.01 * g, p, grads)
+
+    # the hand spec: exactly the Megatron layout an expert would write
+    hand = ShardingPlan(plan.mesh, {n: [Replicate(), Replicate()]
+                                    for n in params}, strategy="hand")
+    for n, pl in {"emb.weight": Shard(0), "l1.weight": Shard(1),
+                  "l1.bias": Shard(0), "l2.weight": Shard(0),
+                  "l3.weight": Shard(1), "l3.bias": Shard(0),
+                  "l4.weight": Shard(0)}.items():
+        hand.params[n][1] = pl
+    hand.inputs = plan.inputs
+    raw = (ids._data, lab._data)
+    t_hand = min(_measure(step, params, raw, hand) for _ in range(2))
+    t_plan = min(_measure(step, params, raw, plan) for _ in range(2))
+    assert t_plan <= 1.10 * t_hand, (t_plan, t_hand)
+
+
+def test_small_dims_stay_replicated():
+    """Indivisible / tiny dims must not be sharded over the 4-way mp axis."""
+
+    class Tiny(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l = nn.Linear(6, 6)  # 6 % 4 != 0
+
+        def forward(self, x):
+            return self.l(x)
+
+    net = Tiny()
+    x = paddle.to_tensor(np.ones((8, 6), np.float32))
+    y = paddle.to_tensor(np.zeros((8, 6), np.float32))
+    plan = plan_shardings(net, [x, y], _mesh(), loss_fn=F.mse_loss,
+                          score="estimate")
+    assert all(isinstance(p, Replicate) for p in plan.params["l.weight"])
+
+
+def test_apply_plan_and_numerics(tower_plan):
+    """Sharded parameters produce the same loss as unsharded ones."""
+    net, plan = tower_plan
+    ids, lab = _batch()
+    want = float(F.cross_entropy(net(ids), lab).numpy())
+    apply_plan(net, plan)
+    s_ids, s_lab = shard_batch(plan, ids, lab)
+    got = float(F.cross_entropy(net(s_ids), s_lab).numpy())
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # parameters really carry the planned sharding
+    from paddle_tpu.distributed.placement import named_sharding
+
+    w = dict(net.named_parameters())["l1.weight"]._data
+    assert w.sharding.is_equivalent_to(
+        named_sharding(plan.mesh, plan.params["l1.weight"], w.ndim), w.ndim)
+
+
+def test_to_static_auto_parallel_trains():
+    """End-to-end wire-up: DistModel plans, shards, and trains."""
+    paddle.seed(1)
+    net = Tower(vocab=512, d=64, h=256, classes=8)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    dm = paddle.distributed.to_static(
+        net, loss=F.cross_entropy, optimizer=opt,
+        auto_parallel=True, mesh=_mesh())
+    ids, lab = _batch(vocab=512, classes=8)
+    l0 = float(dm(ids, lab).numpy())
+    for _ in range(5):
+        l1 = float(dm(ids, lab).numpy())
+    assert l1 < l0
+    assert dm._plan is not None

@@ -78,6 +78,10 @@ class LlamaConfig:
     param_dtype: Optional[str] = None
     sequence_parallel: bool = False  # shard seq dim over 'mp' between blocks
     use_flash_attention: bool = True
+    # ring-attention context parallelism: name of the mesh axis the sequence
+    # is sharded over (e.g. "sep"); attention becomes the exact ring schedule
+    # (K/V rotate via ppermute) instead of single-device flash
+    context_parallel_axis: Optional[str] = None
     recompute: bool = False          # jax.checkpoint each decoder layer
     # MoE (Qwen2-MoE / DeepSeekMoE shape, BASELINE configs[4]): >1 turns the
     # MLP into an expert-parallel MoE FFN (incubate.moe.MoELayer over 'ep')
@@ -202,7 +206,8 @@ class LlamaRMSNorm(Layer):
         return F.rms_norm(x, self.weight, self.epsilon)
 
 
-def attention_fn(hidden, w_qkv, w_o, cos, sin, cfg: LlamaConfig, position_ids=None):
+def attention_fn(hidden, w_qkv, w_o, cos, sin, cfg: LlamaConfig, position_ids=None,
+                 mesh=None):
     """Pure GQA attention over raw arrays: fused qkv matmul, rope, flash (or
     XLA reference) causal attention, output projection.  Shared by the
     sequential model and the pipeline model (``llama_pp``)."""
@@ -214,7 +219,12 @@ def attention_fn(hidden, w_qkv, w_o, cos, sin, cfg: LlamaConfig, position_ids=No
     k = k.reshape(B, S, hk, d)
     v = v.reshape(B, S, hk, d)
     q, k = rope_mod.apply_rope(q, k, cos, sin, position_ids)
-    if cfg.use_flash_attention:
+    if cfg.context_parallel_axis:
+        from ..distributed.parallel.context_parallel import ring_attention
+
+        o = ring_attention(q, k, v, mesh=mesh,
+                           axis_name=cfg.context_parallel_axis, causal=True)
+    elif cfg.use_flash_attention:
         o = fa_mod.flash_attention(q, k, v, causal=True)
     else:
         rep = h // hk
@@ -314,6 +324,7 @@ class LlamaAttention(Layer):
             [h * d, config.hidden_size], dtype=config.pdtype, default_initializer=init)
         _shard_param(self.qkv_proj, mesh, 1)
         _shard_param(self.o_proj, mesh, 0)
+        self._mesh = mesh  # threaded to ring_attention (context parallel)
 
     def forward(self, x, cos, sin, position_ids=None, cache=None):
         cfg = self.config
@@ -345,8 +356,11 @@ class LlamaAttention(Layer):
                 {}, num_outputs=3)
             return out, (nk._data, nv._data)
 
+        mesh = self._mesh
+
         def attn(hidden, w_qkv, w_o, cos_t, sin_t):
-            return attention_fn(hidden, w_qkv, w_o, cos_t, sin_t, cfg, position_ids)
+            return attention_fn(hidden, w_qkv, w_o, cos_t, sin_t, cfg,
+                                position_ids, mesh=mesh)
 
         return apply_op("scaled_dot_product_attention", attn,
                         (x, self.qkv_proj, self.o_proj, cos, sin), {})

@@ -27,17 +27,24 @@ def _load_hubconf(repo_dir: str):
     return mod
 
 
-def _check_source(source):
-    if source != "local":
-        raise NotImplementedError(
-            f"hub source {source!r} needs network access, which this "
-            "environment does not have; clone the repo and use "
-            "source='local' with its directory")
+def _check_source(repo_dir, source):
+    if source == "local":
+        return
+    if os.path.isdir(repo_dir) and os.path.exists(
+            os.path.join(repo_dir, "hubconf.py")):
+        # an existing local checkout: load it regardless of the declared
+        # source (the reference's github path also ends in a local dir —
+        # this skips only the network fetch, which zero-egress forbids)
+        return
+    raise NotImplementedError(
+        f"hub source {source!r} needs network access, which this "
+        "environment does not have; clone the repo and use "
+        "source='local' with its directory")
 
 
 def list(repo_dir: str, source: str = "github", force_reload: bool = False):
     """Entrypoint names exposed by the repo's hubconf.py."""
-    _check_source(source)
+    _check_source(repo_dir, source)
     mod = _load_hubconf(repo_dir)
     return [n for n in dir(mod)
             if callable(getattr(mod, n)) and not n.startswith("_")]
@@ -46,12 +53,12 @@ def list(repo_dir: str, source: str = "github", force_reload: bool = False):
 def help(repo_dir: str, model: str, source: str = "github",
          force_reload: bool = False):
     """The entrypoint's docstring."""
-    _check_source(source)
+    _check_source(repo_dir, source)
     return getattr(_load_hubconf(repo_dir), model).__doc__
 
 
 def load(repo_dir: str, model: str, source: str = "github",
          force_reload: bool = False, **kwargs):
     """Call the entrypoint with kwargs and return the model."""
-    _check_source(source)
+    _check_source(repo_dir, source)
     return getattr(_load_hubconf(repo_dir), model)(**kwargs)

@@ -149,6 +149,129 @@ def _pallas_decode(q, k_cache, v_cache, lengths, sm_scale: float,
     return out.reshape(B, hk, rep, d).reshape(B, 1, h, d)
 
 
+def _fused_softmax_block(qb, kb, vb, base_pos, L, sm_scale, carry,
+                         heads_axis: int):
+    """One online-softmax step shared by the fused decode kernels.
+
+    qb: [hk, rep, d] fp32; kb/vb: VMEM buffers in their NATIVE layout —
+    ``heads_axis`` says where the kv-head dim sits ([bk, hk, d] for the
+    dense cache, [hk, bs, d] for the paged pool) so no relayout happens:
+    dot_general's batch dims address the buffer as-is.  base_pos: absolute
+    position of the block's first row.  Returns the updated (acc, m, l).
+    """
+    acc, m_prev, l_prev = carry
+    hk, rep, _ = qb.shape
+    block_axis = 1 - heads_axis
+    bk = kb.shape[block_axis]
+    kf = kb.astype(jnp.float32)
+    vf = vb.astype(jnp.float32)
+    s = jax.lax.dot_general(qb, kf, (((2,), (2,)), ((0,), (heads_axis,))),
+                            preferred_element_type=jnp.float32) * sm_scale
+    k_pos = base_pos + jax.lax.broadcasted_iota(jnp.int32, (hk, rep, bk), 2)
+    s = jnp.where(k_pos < L, s, NEG_INF)
+    m_cur = jnp.max(s, axis=2)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=2)
+    acc = acc * alpha[..., None] + jax.lax.dot_general(
+        p, vf, (((2,), (block_axis,)), ((0,), (heads_axis,))),
+        preferred_element_type=jnp.float32)
+    return acc, m_new, l_new
+
+
+def _pallas_decode_fused(q, k_cache, v_cache, lengths, sm_scale: float,
+                         block_k: int = 256, interpret: bool = False):
+    """Fused-heads decode: grid (B,), caches read in their NATIVE
+    ``[B, C, Hk, D]`` layout via double-buffered manual DMA.
+
+    Two costs of :func:`_pallas_decode` die here (PERF.md round-3/4
+    diagnosis):
+
+    - the per-step ``swapaxes(1, 2)`` re-materialized the ENTIRE cache in
+      ``[B, Hk, C, D]`` layout before every kernel launch — a read+write of
+      all cache bytes on top of the kernel's own read, ~3x the compulsory
+      HBM traffic (measured 0.53 of the weight-stream bound fits);
+    - one program per (batch, kv-head) meant ``Hk`` separate programs
+      re-issuing DMAs; one program per batch row streams each cache byte
+      exactly once and batches the group matmuls (``[Hk, rep, d]``).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, _, h, d = q.shape
+    C, hk = k_cache.shape[1], k_cache.shape[2]
+    rep = h // hk
+    n_k = C // block_k
+
+    qr = q.reshape(B, hk, rep, d)
+
+    def kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, kbuf, vbuf, sems):
+        b = pl.program_id(0)
+        L = len_ref[b]
+        hi = jnp.minimum((L + block_k - 1) // block_k, n_k)
+        qb = q_ref[0].astype(jnp.float32)              # [hk, rep, d]
+
+        def start(slot, j):
+            sl = pl.ds(j * block_k, block_k)
+            pltpu.make_async_copy(k_hbm.at[b, sl], kbuf.at[slot],
+                                  sems.at[slot, 0]).start()
+            pltpu.make_async_copy(v_hbm.at[b, sl], vbuf.at[slot],
+                                  sems.at[slot, 1]).start()
+
+        def wait(slot, j):
+            sl = pl.ds(j * block_k, block_k)
+            pltpu.make_async_copy(k_hbm.at[b, sl], kbuf.at[slot],
+                                  sems.at[slot, 0]).wait()
+            pltpu.make_async_copy(v_hbm.at[b, sl], vbuf.at[slot],
+                                  sems.at[slot, 1]).wait()
+
+        @pl.when(hi > 0)
+        def _prologue():
+            start(0, 0)
+
+        def body(j, carry):
+            slot = jax.lax.rem(j, 2)
+
+            @pl.when(j + 1 < hi)
+            def _prefetch():
+                start(jax.lax.rem(j + 1, 2), j + 1)
+
+            wait(slot, j)
+            return _fused_softmax_block(qb, kbuf[slot], vbuf[slot],
+                                        j * block_k, L, sm_scale, carry,
+                                        heads_axis=1)
+
+        acc0 = jnp.zeros((hk, rep, d), jnp.float32)
+        m0 = jnp.full((hk, rep), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((hk, rep), jnp.float32)
+        acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc / l_safe[..., None]).astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, hk, rep, d), lambda b, *_: (b, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),   # k cache stays in HBM
+                pl.BlockSpec(memory_space=pltpu.ANY),   # v cache stays in HBM
+            ],
+            out_specs=pl.BlockSpec((1, hk, rep, d), lambda b, *_: (b, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, block_k, hk, d), k_cache.dtype),
+                pltpu.VMEM((2, block_k, hk, d), v_cache.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, hk, rep, d), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), qr, k_cache, v_cache)
+    return out.reshape(B, 1, h, d)
+
+
 def masked_multihead_attention(q, k_cache, v_cache, lengths, sm_scale: Optional[float] = None,
                                interpret: bool = False):
     """Single-token decode attention over a dense KV cache.
@@ -167,8 +290,17 @@ def masked_multihead_attention(q, k_cache, v_cache, lengths, sm_scale: Optional[
     if lengths.ndim == 0:
         lengths = jnp.broadcast_to(lengths[None], (B,))
     C = k_cache.shape[1]
+    hk = k_cache.shape[2]
     kernel_ok = S == 1 and d in (64, 128, 256) and C % 128 == 0
     if (use_pallas() or interpret) and kernel_ok:
+        # fused-heads variant: native-layout cache stream (no per-step
+        # transpose), one program per batch row; VMEM buffers must fit
+        block_k = 256 if C % 256 == 0 else 128
+        vmem_bytes = 4 * block_k * hk * d * jnp.dtype(k_cache.dtype).itemsize
+        if vmem_bytes <= 8 * 2 ** 20:
+            return _pallas_decode_fused(q, k_cache, v_cache, lengths,
+                                        sm_scale, block_k=block_k,
+                                        interpret=interpret)
         return _pallas_decode(q, k_cache, v_cache, lengths, sm_scale, interpret=interpret)
     return _decode_reference(q, k_cache, v_cache, lengths, sm_scale)
 
@@ -342,6 +474,90 @@ def _pallas_paged_decode(q, k_pool, v_pool, block_table, lengths, sm_scale,
     return out.reshape(B, 1, h, d)
 
 
+def _pallas_paged_decode_fused(q, k_pool, v_pool, block_table, lengths,
+                               sm_scale, interpret: bool = False):
+    """Fused-heads paged decode: grid (B,); per live block, ONE DMA moves
+    the whole ``[Hk, bs, d]`` physical block (vs one per (head, block) in
+    :func:`_pallas_paged_decode`) and the block table is read once per
+    block — the round-4 serve-preset overhead diagnosis (VERDICT #7)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, h, d = q.shape
+    nb, hk, bs, d2 = k_pool.shape
+    assert S == 1 and d == d2
+    rep = h // hk
+    maxb = block_table.shape[1]
+
+    qr = q.reshape(B, hk, rep, d)
+
+    def kernel(tbl_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref, kbuf, vbuf, sems):
+        b = pl.program_id(0)
+        L = len_ref[b]
+        n_live = jnp.minimum((L + bs - 1) // bs, maxb)
+        qb = q_ref[0].astype(jnp.float32)              # [hk, rep, d]
+
+        def start(slot, j):
+            phys = tbl_ref[b, j]
+            pltpu.make_async_copy(k_hbm.at[phys], kbuf.at[slot],
+                                  sems.at[slot, 0]).start()
+            pltpu.make_async_copy(v_hbm.at[phys], vbuf.at[slot],
+                                  sems.at[slot, 1]).start()
+
+        def wait(slot, j):
+            phys = tbl_ref[b, j]
+            pltpu.make_async_copy(k_hbm.at[phys], kbuf.at[slot],
+                                  sems.at[slot, 0]).wait()
+            pltpu.make_async_copy(v_hbm.at[phys], vbuf.at[slot],
+                                  sems.at[slot, 1]).wait()
+
+        @pl.when(n_live > 0)
+        def _prologue():
+            start(0, 0)
+
+        def body(j, carry):
+            slot = jax.lax.rem(j, 2)
+
+            @pl.when(j + 1 < n_live)
+            def _prefetch():
+                start(jax.lax.rem(j + 1, 2), j + 1)
+
+            wait(slot, j)
+            return _fused_softmax_block(qb, kbuf[slot], vbuf[slot],
+                                        j * bs, L, sm_scale, carry,
+                                        heads_axis=0)
+
+        acc0 = jnp.zeros((hk, rep, d), jnp.float32)
+        m0 = jnp.full((hk, rep), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((hk, rep), jnp.float32)
+        acc, m, l = jax.lax.fori_loop(0, n_live, body, (acc0, m0, l0))
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc / l_safe[..., None]).astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, hk, rep, d), lambda b, *_: (b, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+            ],
+            out_specs=pl.BlockSpec((1, hk, rep, d), lambda b, *_: (b, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, hk, bs, d), k_pool.dtype),
+                pltpu.VMEM((2, hk, bs, d), v_pool.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, hk, rep, d), q.dtype),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), lengths.astype(jnp.int32), qr,
+      k_pool, v_pool)
+    return out.reshape(B, 1, h, d)
+
+
 def paged_decode_attention(q, k_pool, v_pool, block_table, lengths,
                            sm_scale: Optional[float] = None,
                            interpret: bool = False):
@@ -359,8 +575,16 @@ def paged_decode_attention(q, k_pool, v_pool, block_table, lengths,
         sm_scale = 1.0 / math.sqrt(d)
     lengths = jnp.asarray(lengths, jnp.int32)
     bs = k_pool.shape[2]
+    hk = k_pool.shape[1]
     kernel_ok = S == 1 and d in (64, 128, 256) and bs % 128 == 0
     if (use_pallas() or interpret) and kernel_ok:
+        # fused-heads variant (one DMA per block for all kv heads) when the
+        # whole [hk, bs, d] block double-buffers within VMEM budget
+        vmem_bytes = 4 * hk * bs * d * jnp.dtype(k_pool.dtype).itemsize
+        if vmem_bytes <= 8 * 2 ** 20:
+            return _pallas_paged_decode_fused(q, k_pool, v_pool, block_table,
+                                              lengths, sm_scale,
+                                              interpret=interpret)
         return _pallas_paged_decode(q, k_pool, v_pool, block_table, lengths,
                                     sm_scale, interpret=interpret)
     return _paged_pool_reference(q, k_pool, v_pool, block_table, lengths, sm_scale)

@@ -231,3 +231,45 @@ class TestPredictor:
         assert pred.run()
         got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
         np.testing.assert_array_equal(got, want)
+
+
+class TestFusedDecodeKernel:
+    """Fused-heads dense decode (native-layout cache stream, grid (B,)) —
+    the round-5 fix for the per-step full-cache transpose."""
+
+    def test_fused_matches_reference_gqa(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import decode_attention as da
+
+        rng = np.random.RandomState(0)
+        B, H, Hk, D, C = 3, 8, 2, 64, 256
+        q = jnp.asarray(rng.randn(B, 1, H, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, C, Hk, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, C, Hk, D).astype(np.float32))
+        lengths = jnp.asarray(np.array([256, 100, 1], np.int32))
+        scale = 1.0 / np.sqrt(D)
+        ref = da._decode_reference(q, k, v, lengths, scale)
+        out = da._pallas_decode_fused(q, k, v, lengths, scale, block_k=128,
+                                      interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_fused_matches_old_kernel(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import decode_attention as da
+
+        rng = np.random.RandomState(2)
+        B, H, Hk, D, C = 2, 4, 4, 128, 256
+        q = jnp.asarray(rng.randn(B, 1, H, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, C, Hk, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, C, Hk, D).astype(np.float32))
+        lengths = jnp.asarray(np.array([256, 129], np.int32))
+        scale = 1.0 / np.sqrt(D)
+        old = da._pallas_decode(q, jnp.asarray(k), jnp.asarray(v), lengths,
+                                scale, interpret=True)
+        new = da._pallas_decode_fused(q, k, v, lengths, scale, block_k=128,
+                                      interpret=True)
+        np.testing.assert_allclose(np.asarray(new), np.asarray(old),
+                                   rtol=2e-3, atol=2e-3)

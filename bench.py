@@ -423,17 +423,31 @@ def _bench_ocr(jax, paddle, backend, on_tpu, args):
         step_fn._params, step_fn._buffers, step_fn._opt_state,
         jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.int32), rnd.next_key(),
         (img._data, gt._data))
-    step_flops = _step_flops_of(lowered)
+    from paddle_tpu.utils.xla_cost import cost_of_lowered
+
+    cost = cost_of_lowered(lowered) or {}
+    step_flops = float(cost.get("flops") or 0.0)
+    step_bytes = float(cost.get("bytes accessed") or 0.0)
 
     images_per_sec = batch * steps / dt
     dev_kind, peak = _peak_flops(jax, on_tpu)
     mfu = (step_flops * steps / dt / peak) if peak and step_flops else 0.0
+    # conv nets at DBNet scale are bandwidth-bound (PERF.md r3: MFU 0.019 is
+    # the wrong lens) — the honest denominator is the roofline over the
+    # compiled executable's post-fusion HBM traffic
+    hbm = 819e9 if on_tpu else None   # v5e HBM bandwidth
+    bound_img_s = (batch * hbm / step_bytes) if (hbm and step_bytes) else 0.0
+    vs_bound = images_per_sec / bound_img_s if bound_img_s else 0.0
     return {
         "metric": "ocr_det_train_images_per_sec",
         "value": round(images_per_sec, 2),
         "unit": "images/s",
-        "vs_baseline": round(mfu / 0.40, 4) if peak else 0.0,
+        "vs_baseline": round(vs_bound, 4) if bound_img_s else (
+            round(mfu / 0.40, 4) if peak else 0.0),
         "mfu": round(mfu, 4),
+        "vs_bound": round(vs_bound, 4),
+        "bound_images_per_sec": round(bound_img_s, 2),
+        "step_bytes_accessed": step_bytes,
         "device": dev_kind,
         "backend": backend,
         "preset": "ocr",

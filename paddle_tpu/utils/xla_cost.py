@@ -10,12 +10,12 @@ from __future__ import annotations
 
 from typing import Optional
 
-__all__ = ["flops_of_lowered"]
+__all__ = ["flops_of_lowered", "cost_of_lowered"]
 
 
-def flops_of_lowered(lowered) -> Optional[float]:
-    """FLOPs of a lowered jax computation, or None when neither analysis
-    path yields a count (callers decide whether that is an error)."""
+def cost_of_lowered(lowered) -> Optional[dict]:
+    """The full cost dict (``flops``, ``bytes accessed``, ...) of a lowered
+    computation, or None."""
     for get in (lambda: lowered.cost_analysis(),
                 lambda: lowered.compile().cost_analysis()):
         try:
@@ -23,5 +23,12 @@ def flops_of_lowered(lowered) -> Optional[float]:
         except Exception:
             continue
         if cost and cost.get("flops"):
-            return float(cost["flops"])
+            return dict(cost)
     return None
+
+
+def flops_of_lowered(lowered) -> Optional[float]:
+    """FLOPs of a lowered jax computation, or None when neither analysis
+    path yields a count (callers decide whether that is an error)."""
+    cost = cost_of_lowered(lowered)
+    return float(cost["flops"]) if cost else None

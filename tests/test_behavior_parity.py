@@ -48,9 +48,6 @@ KNOWN_STUBS = {
         "implemented (paddle.nn.utils.weight_norm)"),
     "static.ctr_metric_bundle": (
         "fn", "CTR metric aggregation for the PS stack (out of TPU scope)"),
-    "vision.ops.yolo_loss": ("fn", "legacy YOLOv3 training loss — "
-                                   "documented gap (detection training ships "
-                                   "the DBNet/OCR path)"),
 }
 
 

@@ -1,0 +1,314 @@
+"""Symbolic dimension expressions + proven bucket synthesis.
+
+Reference counterpart: ``pir/include/dialect/shape/utils/dim_expr.h`` (the
+DimExpr algebra — constants, symbols, add/mul/div/max/min with
+simplification) and ``shape_analysis.h`` (proving relations between dims so
+one compiled program serves many shapes).
+
+TPU-native stance (SURVEY-sanctioned): XLA wants STATIC shapes — true
+dynamic dims defeat MXU tiling — so this framework's dynamic-shape policy is
+bucketing (``jit.bucketed``, the serving engine's prefill ladder).  What the
+reference's symbolic machinery buys (bounded recompiles without per-shape
+programs), this module buys with PROOFS about the bucket ladder instead:
+
+- :class:`DimExpr`: the dim algebra — interval ``bounds()`` under symbol
+  ranges, substitution, and normalized structural equality (``prove_eq`` /
+  ``prove_le``), the same reasoning surface ``shape_analysis`` exposes;
+- :func:`synthesize_buckets`: the minimal aligned geometric ladder covering
+  a length range such that padding waste never exceeds ``max_overhead`` —
+  with the bound PROVEN by :func:`verify_buckets` (exact worst case over the
+  critical points), not assumed.  Ladder size is
+  O(log(hi/lo) / log(1 + max_overhead)), which bounds compile count.
+
+``jit.bucketed(buckets="auto", size_range=..., max_overhead=...)`` and the
+serving engine's bucket validation ride these.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+__all__ = ["DimExpr", "Symbol", "synthesize_buckets", "verify_buckets"]
+
+_Num = Union[int, "DimExpr"]
+
+
+def _wrap(v: _Num) -> "DimExpr":
+    if isinstance(v, DimExpr):
+        return v
+    return DimExpr("const", (int(v),))
+
+
+class DimExpr:
+    """Immutable symbolic dimension expression.
+
+    Kinds: ``const``, ``sym`` (name, lo, hi), ``add``, ``mul``, ``floordiv``,
+    ``mod``, ``max``, ``min``.  Built with Python operators; constants fold
+    and add/mul flatten into a sorted normal form so structurally equal
+    expressions compare equal (the dim_expr.h simplifier's role).
+    """
+
+    __slots__ = ("kind", "args")
+
+    def __init__(self, kind: str, args: tuple):
+        self.kind = kind
+        self.args = args
+
+    # -- construction --------------------------------------------------------
+    @staticmethod
+    def _nary(kind: str, parts) -> "DimExpr":
+        # flatten same-kind subtrees to leaves, then fold every constant
+        leaves = []
+        stack = [_wrap(p) for p in parts]
+        while stack:
+            p = stack.pop()
+            if p.kind == kind:
+                stack.extend(p.args)
+            else:
+                leaves.append(p)
+        flat = []
+        const = 0 if kind == "add" else 1
+        for p in leaves:
+            if p.kind == "const":
+                const = const + p.args[0] if kind == "add" else const * p.args[0]
+            else:
+                flat.append(p)
+        if kind == "add" and flat:
+            # like-term collection: coeff * base, summed per base — makes
+            # T - T fold to 0 and 2T + 2T equal 4T structurally
+            coeffs: dict = {}
+            for p in flat:
+                c, base = 1, p
+                if p.kind == "mul":
+                    cs = [a.args[0] for a in p.args if a.kind == "const"]
+                    rest = tuple(a for a in p.args if a.kind != "const")
+                    if cs:
+                        c = math.prod(cs)
+                        base = rest[0] if len(rest) == 1 else DimExpr("mul", rest)
+                coeffs[base] = coeffs.get(base, 0) + c
+            flat = [base if c == 1 else DimExpr._nary("mul", (base, c))
+                    for base, c in coeffs.items() if c != 0]
+        if kind == "mul" and const == 0:
+            return _wrap(0)
+        if not flat:
+            return _wrap(const)
+        if (kind == "add" and const != 0) or (kind == "mul" and const != 1):
+            flat.append(_wrap(const))
+        if len(flat) == 1:
+            return flat[0]
+        flat.sort(key=repr)
+        return DimExpr(kind, tuple(flat))
+
+    def __add__(self, o): return DimExpr._nary("add", (self, o))
+    __radd__ = __add__
+
+    def __mul__(self, o): return DimExpr._nary("mul", (self, o))
+    __rmul__ = __mul__
+
+    def __sub__(self, o): return self + _wrap(o) * -1
+
+    def __rsub__(self, o): return _wrap(o) + self * -1
+
+    def __floordiv__(self, o):
+        o = _wrap(o)
+        if self.kind == "const" and o.kind == "const":
+            return _wrap(self.args[0] // o.args[0])
+        return DimExpr("floordiv", (self, o))
+
+    def __mod__(self, o):
+        o = _wrap(o)
+        if self.kind == "const" and o.kind == "const":
+            return _wrap(self.args[0] % o.args[0])
+        return DimExpr("mod", (self, o))
+
+    def max(self, o):
+        o = _wrap(o)
+        if self.kind == "const" and o.kind == "const":
+            return _wrap(max(self.args[0], o.args[0]))
+        return DimExpr("max", tuple(sorted((self, o), key=repr)))
+
+    def min(self, o):
+        o = _wrap(o)
+        if self.kind == "const" and o.kind == "const":
+            return _wrap(min(self.args[0], o.args[0]))
+        return DimExpr("min", tuple(sorted((self, o), key=repr)))
+
+    # -- evaluation / reasoning ---------------------------------------------
+    def subs(self, env: Dict[str, int]) -> int:
+        """Concrete value under a full symbol assignment."""
+        k = self.kind
+        if k == "const":
+            return self.args[0]
+        if k == "sym":
+            return int(env[self.args[0]])
+        vals = [a.subs(env) for a in self.args]
+        if k == "add":
+            return sum(vals)
+        if k == "mul":
+            return math.prod(vals)
+        if k == "floordiv":
+            return vals[0] // vals[1]
+        if k == "mod":
+            return vals[0] % vals[1]
+        if k == "max":
+            return max(vals)
+        if k == "min":
+            return min(vals)
+        raise AssertionError(k)
+
+    def bounds(self, env: Optional[Dict[str, Tuple[int, Optional[int]]]] = None
+               ) -> Tuple[int, Optional[int]]:
+        """Interval of possible values (hi None = unbounded); symbols use
+        their declared ranges unless overridden by ``env``."""
+        # internal rep: float intervals with +-inf; converted back at the end
+        INF = math.inf
+
+        def lo_hi(e):
+            k = e.kind
+            if k == "const":
+                return float(e.args[0]), float(e.args[0])
+            if k == "sym":
+                name, lo, hi = e.args
+                if env and name in env:
+                    elo, ehi = env[name]
+                    return float(elo), INF if ehi is None else float(ehi)
+                return float(lo), INF if hi is None else float(hi)
+            bs = [lo_hi(a) for a in e.args]
+            if k == "add":
+                return sum(b[0] for b in bs), sum(b[1] for b in bs)
+            if k == "mul":
+                lo, hi = bs[0]
+                for blo, bhi in bs[1:]:
+                    # signed interval product: min/max over the corner cases
+                    cs = []
+                    for x in (lo, hi):
+                        for y in (blo, bhi):
+                            if (x in (INF, -INF) or y in (INF, -INF)) and 0.0 in (x, y):
+                                cs.append(0.0)   # inf * 0 corner -> 0
+                            else:
+                                cs.append(x * y)
+                    lo, hi = min(cs), max(cs)
+                return lo, hi
+            (alo, ahi), (blo, bhi) = bs
+            if k == "floordiv":
+                # corner evaluation (numerator may be negative: a derived
+                # expression like T - 20); denominators are positive dims
+                blo_, bhi_ = max(blo, 1.0), max(bhi, 1.0)
+                cs = []
+                for x in (alo, ahi):
+                    for y in (blo_, bhi_):
+                        if x in (INF, -INF):
+                            cs.append(x)
+                        elif y == INF:
+                            cs.append(0.0 if x >= 0 else -1.0)
+                        else:
+                            cs.append(float(math.floor(x / y)))
+                return min(cs), max(cs)
+            if k == "mod":
+                return 0.0, INF if bhi == INF else bhi - 1
+            if k == "max":
+                return max(alo, blo), max(ahi, bhi)
+            if k == "min":
+                return min(alo, blo), min(ahi, bhi)
+            raise AssertionError(k)
+
+        lo, hi = lo_hi(self)
+        return (None if lo == -INF else int(lo),
+                None if hi == INF else int(hi))
+
+    def prove_eq(self, other: _Num) -> bool:
+        """True only when equality HOLDS FOR ALL assignments (normalized
+        structural equality, or a pinned difference interval of [0, 0])."""
+        other = _wrap(other)
+        if repr(self) == repr(other):
+            return True
+        lo, hi = (self - other).bounds()
+        return lo == 0 and hi == 0
+
+    def prove_le(self, other: _Num) -> bool:
+        other = _wrap(other)
+        lo, hi = (other - self).bounds()
+        return lo is not None and lo >= 0
+
+    def __eq__(self, o):
+        return isinstance(o, DimExpr) and repr(self) == repr(o)
+
+    def __hash__(self):
+        return hash(repr(self))
+
+    def __repr__(self):
+        k = self.kind
+        if k == "const":
+            return str(self.args[0])
+        if k == "sym":
+            return self.args[0]
+        return f"{k}({', '.join(map(repr, self.args))})"
+
+
+def Symbol(name: str, lo: int = 1, hi: Optional[int] = None) -> DimExpr:
+    """A named dynamic dim with a declared range (reference ``S0, S1, ...``)."""
+    return DimExpr("sym", (name, int(lo), None if hi is None else int(hi)))
+
+
+# ---------------------------------------------------------------------------
+# bucket synthesis with proven waste bounds
+# ---------------------------------------------------------------------------
+
+def synthesize_buckets(lo: int, hi: int, *, max_overhead: float = 0.25,
+                       align: int = 8) -> Tuple[Tuple[int, ...], float]:
+    """The minimal ``align``-multiple bucket ladder covering ``[lo, hi]``
+    with padding waste <= ``max_overhead`` wherever alignment permits.
+
+    Returns ``(buckets, proven_worst_waste)`` — the bound comes from
+    :func:`verify_buckets`' exact critical-point check, so the caller holds
+    a proof, not a heuristic.  Ladder length is logarithmic in ``hi/lo``:
+    each bucket covers down to ``prev+1`` with ``b <= (prev+1)*(1+overhead)``.
+    """
+    if lo < 1 or hi < lo:
+        raise ValueError(f"invalid range [{lo}, {hi}]")
+    if max_overhead <= 0:
+        raise ValueError("max_overhead must be positive")
+
+    def align_up(n):
+        return ((n + align - 1) // align) * align
+
+    buckets = [align_up(lo)]
+    while buckets[-1] < hi:
+        prev = buckets[-1]
+        nxt = int((prev + 1) * (1.0 + max_overhead)) // align * align
+        if nxt <= prev:
+            nxt = prev + align      # alignment dominates the overhead budget
+        buckets.append(min(nxt, align_up(hi)))
+    # the bound is proven over the range where the budget is meetable at
+    # all: for n <= align/overhead the ALIGNMENT floor dominates (the step
+    # cannot be finer than `align`, so waste there is bounded by ~align/n,
+    # not by max_overhead — an n=1 request always pads to the first bucket)
+    eff_lo = max(lo, int(align / max_overhead) + 1)
+    worst = verify_buckets(buckets, min(eff_lo, hi), hi)
+    return tuple(buckets), worst
+
+
+def verify_buckets(buckets: Sequence[int], lo: int, hi: int) -> float:
+    """Exact worst-case padding waste of a ladder over ``[lo, hi]``.
+
+    Checks coverage (raises if any n in range has no bucket) and evaluates
+    waste at the critical points — the smallest n each bucket serves —
+    which upper-bounds every other n in that bucket's range.
+    """
+    bs = sorted(int(b) for b in buckets)
+    if not bs or bs[-1] < hi:
+        raise ValueError(f"ladder {bs} does not cover hi={hi}")
+    if bs[0] < lo and all(b < lo for b in bs):
+        raise ValueError(f"ladder {bs} entirely below lo={lo}")
+    worst = 0.0
+    prev = lo - 1
+    for b in bs:
+        if b < lo:
+            prev = max(prev, b)
+            continue
+        n_crit = max(prev + 1, lo)
+        if n_crit <= min(b, hi):
+            worst = max(worst, b / n_crit - 1.0)
+        prev = b
+    return worst

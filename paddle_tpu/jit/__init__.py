@@ -223,7 +223,8 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
     return decorate
 
 
-def bucketed(fn=None, *, axes, buckets=None, pad_value=0, out_axes=None):
+def bucketed(fn=None, *, axes, buckets=None, pad_value=0, out_axes=None,
+             size_range=None, max_overhead=0.25):
     """Shape-bucketing wrapper: pad dynamic axes up to the next bucket so XLA
     compiles once per BUCKET instead of once per shape.
 
@@ -233,7 +234,11 @@ def bucketed(fn=None, *, axes, buckets=None, pad_value=0, out_axes=None):
 
     - ``axes``: list of ``(arg_index, axis)`` pairs to bucket (e.g. the batch
       dim of arg 0 and the seq dim of arg 1).
-    - ``buckets``: ascending sizes to round up into; default powers of two.
+    - ``buckets``: ascending sizes to round up into; default powers of two;
+      ``"auto"`` SYNTHESIZES the minimal ladder for ``size_range=(lo, hi)``
+      whose padding waste provably stays under ``max_overhead``
+      (``framework.dim_expr.synthesize_buckets`` — the proven bound is
+      exposed as ``wrapper._bucket_waste_bound``).
     - ``pad_value``: fill for padded slots (mask semantics are the caller's —
       e.g. pad token ids with an ignore/pad id).
     - ``out_axes``: explicit output slicing as ``(out_axis, arg_index,
@@ -251,12 +256,23 @@ def bucketed(fn=None, *, axes, buckets=None, pad_value=0, out_axes=None):
     def decorate(f):
         static = StaticFunction(f) if not isinstance(f, StaticFunction) else f
 
+        ladder = buckets
+        waste_bound = None
+        if buckets == "auto":
+            from ..framework.dim_expr import synthesize_buckets
+
+            if size_range is None:
+                raise ValueError('buckets="auto" needs size_range=(lo, hi)')
+            ladder, waste_bound = synthesize_buckets(
+                int(size_range[0]), int(size_range[1]),
+                max_overhead=max_overhead)
+
         def next_bucket(n: int) -> int:
-            if buckets is not None:
-                for b in sorted(buckets):
+            if ladder is not None:
+                for b in sorted(ladder):
                     if b >= n:
                         return int(b)
-                raise ValueError(f"size {n} exceeds the largest bucket {max(buckets)}")
+                raise ValueError(f"size {n} exceeds the largest bucket {max(ladder)}")
             b = 1
             while b < n:
                 b *= 2
@@ -324,6 +340,8 @@ def bucketed(fn=None, *, axes, buckets=None, pad_value=0, out_axes=None):
             return unslice(out)
 
         wrapper._static = static
+        wrapper._buckets = tuple(sorted(ladder)) if ladder else None
+        wrapper._bucket_waste_bound = waste_bound
         return wrapper
 
     if fn is not None:

@@ -88,7 +88,9 @@ class Engine:
     """
 
     def __init__(self, model, max_batch: int = 8, num_blocks: int = 256,
-                 block_size: int = 128, prefill_buckets: Tuple[int, ...] = (128, 256, 512, 1024)):
+                 block_size: int = 128,
+                 prefill_buckets: Tuple[int, ...] = (128, 256, 512, 1024),
+                 max_prefill_overhead: float = 1.0):
         from ..jit import functional_call
 
         self.model = model
@@ -96,6 +98,19 @@ class Engine:
         self.max_batch = max_batch
         self.block_size = block_size
         self.num_blocks = num_blocks
+        if prefill_buckets == "auto":
+            # proven ladder (framework.dim_expr): padding waste stays under
+            # max_prefill_overhead for any admitted prompt length
+            from ..framework.dim_expr import synthesize_buckets
+
+            prefill_buckets, self.prefill_waste_bound = synthesize_buckets(
+                1, block_size * 8, max_overhead=max_prefill_overhead,
+                align=block_size)
+        else:
+            from ..framework.dim_expr import verify_buckets
+
+            self.prefill_waste_bound = verify_buckets(
+                prefill_buckets, 1, max(prefill_buckets))
         self.prefill_buckets = tuple(sorted(prefill_buckets))
         # longest admissible sequence (prompt + generated) per slot
         self.max_blocks_per_seq = max(

@@ -1,0 +1,129 @@
+"""Symbolic dims + proven bucket synthesis.
+
+Reference: ``pir/include/dialect/shape/utils/dim_expr.h`` (DimExpr algebra +
+simplification), ``shape_analysis.h`` (relation proving).  Under test:
+``paddle_tpu/framework/dim_expr.py`` — the TPU formulation where the
+reasoning bounds bucket-ladder recompiles and padding waste instead of
+driving a dynamic-shape compiler.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.dim_expr import (
+    DimExpr, Symbol, synthesize_buckets, verify_buckets,
+)
+
+
+class TestAlgebra:
+    def test_constant_folding_and_normal_form(self):
+        s = Symbol("S")
+        assert repr(s + 1 + 2) == repr(s + 3)
+        assert (s * 2 + s * 2).prove_eq((s + s) * 2)
+        assert (s * 0).prove_eq(0)
+        assert (s + 0).prove_eq(s)
+
+    def test_subs_and_mixed_ops(self):
+        b, t = Symbol("B"), Symbol("T")
+        tokens = b * t
+        pad = (t + 127) // 128 * 128
+        assert tokens.subs({"B": 4, "T": 512}) == 2048
+        assert pad.subs({"T": 100}) == 128
+        assert (t % 128).subs({"T": 300}) == 44
+
+    def test_bounds_interval_arithmetic(self):
+        t = Symbol("T", 1, 4096)
+        b = Symbol("B", 1, 8)
+        lo, hi = (b * t).bounds()
+        assert (lo, hi) == (1, 32768)
+        lo, hi = (t + 5).bounds({"T": (10, 20)})
+        assert (lo, hi) == (15, 25)
+        assert (t % 128).bounds()[1] == 127
+        assert Symbol("U").bounds()[1] is None  # unbounded
+
+    def test_prove_relations(self):
+        t = Symbol("T", 1, 1024)
+        assert t.prove_le(1024)
+        assert not t.prove_le(1023)
+        assert (t - t).prove_eq(0)
+        assert not (t + 1).prove_eq(t)
+        # equality must hold for ALL assignments, not just one
+        u = Symbol("U", 1, 1024)
+        assert not t.prove_eq(u)
+
+
+class TestBucketSynthesis:
+    def test_ladder_covers_and_bounds_waste(self):
+        buckets, worst = synthesize_buckets(1, 4096, max_overhead=0.5, align=8)
+        assert buckets[-1] >= 4096
+        assert worst <= 0.5 + 1e-9
+        # exhaustive check of the proof: above the alignment floor
+        # (buckets[0]/(1+overhead)) every n gets a bucket within the bound
+        bs = sorted(buckets)
+        eff_lo = int(8 / 0.5) + 1   # below align/overhead alignment dominates
+        for n in range(eff_lo, 4097):
+            b = next(x for x in bs if x >= n)
+            assert b / n - 1.0 <= worst + 1e-9
+
+    def test_tighter_overhead_means_more_buckets(self):
+        few, _ = synthesize_buckets(64, 8192, max_overhead=1.0, align=64)
+        many, _ = synthesize_buckets(64, 8192, max_overhead=0.1, align=64)
+        assert len(many) > len(few)
+
+    def test_verify_rejects_gaps(self):
+        with pytest.raises(ValueError, match="does not cover"):
+            verify_buckets([128, 256], 1, 512)
+
+    def test_verify_exact_worst_case(self):
+        # ladder 128/512 over [100, 512]: critical points n=100 (0.28) and
+        # n=129 (512/129 - 1 ~ 2.97) -> the exact worst is the latter
+        worst = verify_buckets([128, 512], 100, 512)
+        np.testing.assert_allclose(worst, 512 / 129 - 1.0, rtol=1e-12)
+        # over the full [1, 512] the 1-token critical point dominates: 127x
+        np.testing.assert_allclose(verify_buckets([128, 512], 1, 512), 127.0)
+
+
+class TestIntegration:
+    def test_bucketed_auto_ladder(self):
+        calls = []
+
+        @paddle.jit.to_static
+        def f(x):
+            calls.append(x.shape)
+            return x.sum(axis=-1)
+
+        g = paddle.jit.bucketed(f, axes=[(0, 0)], buckets="auto",
+                                size_range=(1, 64), max_overhead=0.5)
+        assert g._bucket_waste_bound is not None
+        for n in (3, 5, 40, 64):
+            out = g(paddle.to_tensor(np.ones((n, 4), np.float32)))
+            assert tuple(out.shape) == (n,)
+        # compile count bounded by the ladder, not the distinct sizes
+        assert len({tuple(s) for s in calls}) <= len(g._buckets)
+
+    def test_serving_engine_reports_waste_bound(self):
+        """Engine validates its prefill ladder at construction and exposes
+        the proven padding bound."""
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        from paddle_tpu.serving import Engine
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny_config(use_flash_attention=False))
+        eng = Engine(model, max_batch=2, num_blocks=16, block_size=128)
+        assert 0.0 <= eng.prefill_waste_bound
+        # default ladder (128..1024) worst case: a 1-token prompt pads to 128
+        np.testing.assert_allclose(eng.prefill_waste_bound, 127.0, rtol=1e-9)
+
+
+def test_floordiv_bounds_with_negative_numerator():
+    """Regression (review): interval floordiv must be sound when the derived
+    numerator goes negative — an unsound prover certifies false facts."""
+    from paddle_tpu.framework.dim_expr import DimExpr, Symbol
+
+    t, b = Symbol("T", 1, 10), Symbol("B", 1, 5)
+    e = (t - 20) // b
+    lo, hi = e.bounds()
+    # true range: floor((1-20)/1) = -19 .. floor((10-20)/5) = -2
+    assert lo <= -19 and hi >= -2 and lo <= hi
+    assert not DimExpr("const", (-4,)).prove_le(e)   # e = -19 is reachable

@@ -281,10 +281,19 @@ def alltoall(out_tensor_list: list, in_tensor_list: list, group=None, sync_op=Tr
 # PADDLE_P2P_ENDPOINT (host:port; rank 0 hosts), else a process-local queue
 # for world size 1 (matched send/recv on one process, reference loopback).
 
+import threading as _threading
+
 _P2P = {"store": None, "seq": {}, "local": {}}
+_P2P_LOCK = _threading.Lock()
+_P2P_TLS = _threading.local()  # per-thread clients (sockets aren't thread-safe)
 
 
 def _p2p_store():
+    with _P2P_LOCK:
+        return _p2p_store_locked()
+
+
+def _p2p_store_locked():
     if _P2P["store"] is not None:
         return _P2P["store"]
     import os
@@ -302,24 +311,80 @@ def _p2p_store():
     return _P2P["store"]
 
 
+def _p2p_store_threadlocal():
+    """A store client owned by the CALLING thread.  isend/irecv run on
+    transfer threads; one shared client socket would interleave two threads'
+    request/response frames and wedge both — each thread dials its own
+    non-master connection (the main thread keeps the original, possibly-
+    master one; its lazy construction is lock-guarded so concurrent first
+    uses cannot double-bind the master socket)."""
+    import os
+    import threading
+
+    if threading.current_thread() is threading.main_thread():
+        return _p2p_store()
+    st = getattr(_P2P_TLS, "store", None)
+    if st is None:
+        _p2p_store()  # main connection first: rank 0 must host the server
+        from .store import TCPStore
+
+        host, port = os.environ["PADDLE_P2P_ENDPOINT"].rsplit(":", 1)
+        st = TCPStore(host, int(port), world_size=get_world_size(),
+                      is_master=False, timeout=300.0)
+        _P2P_TLS.store = st
+    return st
+
+
 def _p2p_seq(a: int, b: int) -> int:
     k = (a, b)
     _P2P["seq"][k] = _P2P["seq"].get(k, 0) + 1
     return _P2P["seq"][k]
 
 
-def send(tensor: Tensor, dst: int = 0, group=None, sync_op=True):
-    """Eager point-to-point send to GLOBAL rank ``dst`` (reference ``send``)."""
+# store values are CHUNKED: one TCP-store value never exceeds this, so the
+# eager p2p path has no single-message size cliff (the transport is the
+# control-plane store — the reference's stream-async NCCL send/recv role is
+# played by shard_map ppermute inside compiled programs; this path is for
+# eager orchestration, checkpoint shards, RPC payloads)
+_P2P_CHUNK = 4 << 20
+
+
+def _p2p_put(store, key: str, payload: bytes) -> None:
+    n = max(1, -(-len(payload) // _P2P_CHUNK))
+    for i in range(n):
+        store.set(f"{key}/c{i}", payload[i * _P2P_CHUNK:(i + 1) * _P2P_CHUNK])
+    # header LAST: the receiver blocks on it, so chunks are complete by then
+    store.set(key, str(n).encode())
+
+
+def _p2p_take(store, key: str) -> bytes:
+    n = int(store.get(key))            # blocking
+    parts = [store.get(f"{key}/c{i}") for i in range(n)]
+    for k in [key] + [f"{key}/c{i}" for i in range(n)]:
+        try:
+            store.delete_key(k)        # consumed: don't grow the master
+        except AttributeError:
+            break
+    return b"".join(parts)
+
+
+def _p2p_payload(arr: np.ndarray) -> bytes:
     import pickle
 
+    return pickle.dumps((arr.dtype.str, arr.shape, arr.tobytes()),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def send(tensor: Tensor, dst: int = 0, group=None, sync_op=True):
+    """Eager point-to-point send to GLOBAL rank ``dst`` (reference ``send``)."""
     arr = np.asarray(tensor._data)
     me = get_rank()
     seq = _p2p_seq(me, dst)
-    payload = pickle.dumps((arr.dtype.str, arr.shape, arr.tobytes()))
+    payload = _p2p_payload(arr)
     if jax.process_count() == 1:
         _P2P["local"].setdefault((me, dst), []).append(payload)
         return
-    _p2p_store().set(f"p2p/{me}->{dst}/{seq}", payload)
+    _p2p_put(_p2p_store(), f"p2p/{me}->{dst}/{seq}", payload)
 
 
 def recv(tensor: Tensor, src: int = 0, group=None, sync_op=True):
@@ -335,13 +400,7 @@ def recv(tensor: Tensor, src: int = 0, group=None, sync_op=True):
             raise RuntimeError("recv without a matching send (world size 1)")
         payload = queue.pop(0)
     else:
-        store = _p2p_store()
-        key = f"p2p/{src}->{me}/{seq}"
-        payload = store.get(key)       # blocking
-        try:
-            store.delete_key(key)      # consumed: don't grow the master
-        except AttributeError:
-            pass
+        payload = _p2p_take(_p2p_store(), f"p2p/{src}->{me}/{seq}")
     dtype_str, shape, raw = pickle.loads(payload)
     arr = np.frombuffer(raw, dtype=np.dtype(dtype_str)).reshape(shape)
     tensor._data = jnp.asarray(arr)
@@ -432,25 +491,78 @@ def is_available() -> bool:
     return True
 
 
+def _p2p_spawn(fn):
+    """One daemon thread per in-flight op — a bounded pool would let N
+    blocked irecvs starve the very isend their peers are waiting on."""
+    import threading
+
+    box = {}
+
+    def run():
+        try:
+            fn()
+        except BaseException as e:  # surfaced at task.wait()
+            box["exc"] = e
+
+    t = threading.Thread(target=run, name="p2p", daemon=True)
+    t.start()
+    box["thread"] = t
+    return box
+
+
 class _P2PTask:
-    """Completed-task handle (reference isend/irecv return a waitable; the
-    store transport completes synchronously)."""
+    """Waitable handle returned by isend/irecv (reference: NCCL stream task).
+    ``None`` box = the op completed synchronously (world size 1)."""
+
+    def __init__(self, box=None):
+        self._box = box
 
     def wait(self):
+        if self._box is not None:
+            self._box["thread"].join()
+            if "exc" in self._box:
+                raise self._box["exc"]
         return True
 
     def is_completed(self):
-        return True
+        return self._box is None or not self._box["thread"].is_alive()
 
 
 def isend(tensor, dst: int = 0, group=None):
-    send(tensor, dst, group)
-    return _P2PTask()
+    """Async send: the value is SNAPSHOT at call time (mutating the tensor
+    afterwards does not race the transfer) and pushed from a background
+    thread; ``task.wait()`` joins."""
+    me = get_rank()
+    seq = _p2p_seq(me, dst)            # ordering fixed at call time
+    arr = np.asarray(tensor._data)
+    if jax.process_count() == 1:
+        _P2P["local"].setdefault((me, dst), []).append(_p2p_payload(arr))
+        return _P2PTask()
+    return _P2PTask(_p2p_spawn(
+        lambda: _p2p_put(_p2p_store_threadlocal(), f"p2p/{me}->{dst}/{seq}",
+                         _p2p_payload(arr))))
 
 
 def irecv(tensor, src: int = 0, group=None):
-    recv(tensor, src, group)
-    return _P2PTask()
+    """Async receive: the tensor's storage is filled when the returned task
+    completes — ``task.wait()`` before reading (reference irecv contract)."""
+    import pickle
+
+    me = get_rank()
+    seq = _p2p_seq(src, me)
+    if jax.process_count() == 1:
+        recv_seq = _P2P["seq"]
+        recv_seq[(src, me)] -= 1       # undo: recv() re-increments
+        recv(tensor, src, group)
+        return _P2PTask()
+
+    def fill():
+        payload = _p2p_take(_p2p_store_threadlocal(), f"p2p/{src}->{me}/{seq}")
+        dtype_str, shape, raw = pickle.loads(payload)
+        tensor._data = jnp.asarray(
+            np.frombuffer(raw, dtype=np.dtype(dtype_str)).reshape(shape))
+
+    return _P2PTask(_p2p_spawn(fill))
 
 
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,

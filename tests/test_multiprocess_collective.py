@@ -95,6 +95,24 @@ _WORKER = """
         collective.send(paddle.to_tensor(np.asarray([5.0, 6.0], np.float32)),
                         dst=0)
 
+    # async isend/irecv: overlapping transfers, waited before reading; the
+    # 6MB payload exercises the chunked store transport (> _P2P_CHUNK)
+    big = np.arange(1_600_000, dtype=np.float32)  # 6.1MB
+    if rank == 0:
+        t_send = collective.isend(paddle.to_tensor(big), dst=1)
+        rbuf = paddle.to_tensor(np.zeros((3,), np.float32))
+        t_recv = collective.irecv(rbuf, src=1)
+        t_send.wait(); t_recv.wait()
+        assert t_send.is_completed() and t_recv.is_completed()
+        np.testing.assert_allclose(np.asarray(rbuf._data), [7.0, 8.0, 9.0])
+    else:
+        rbuf = paddle.to_tensor(np.zeros_like(big))
+        t_recv = collective.irecv(rbuf, src=0)
+        t_send = collective.isend(
+            paddle.to_tensor(np.asarray([7.0, 8.0, 9.0], np.float32)), dst=0)
+        t_recv.wait(); t_send.wait()
+        np.testing.assert_allclose(np.asarray(rbuf._data), big)
+
     print(f"RANK{rank}_OK", flush=True)
 """
 

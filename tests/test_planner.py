@@ -119,9 +119,15 @@ def test_planner_beats_or_matches_hand_spec(tower_plan):
                   "l4.weight": Shard(0)}.items():
         hand.params[n][1] = pl
     hand.inputs = plan.inputs
+    # identical layouts compile to the identical program: the 10% gate holds
+    # by construction, no wall-clock needed (timing on a loaded CI box is
+    # noise; the structural assertions above pin the interesting decisions)
+    if {n: repr(pl) for n, pl in plan.params.items()} == \
+            {n: repr(pl) for n, pl in hand.params.items()}:
+        return
     raw = (ids._data, lab._data)
-    t_hand = min(_measure(step, params, raw, hand) for _ in range(2))
-    t_plan = min(_measure(step, params, raw, plan) for _ in range(2))
+    t_hand = min(_measure(step, params, raw, hand) for _ in range(3))
+    t_plan = min(_measure(step, params, raw, plan) for _ in range(3))
     assert t_plan <= 1.10 * t_hand, (t_plan, t_hand)
 
 

@@ -754,9 +754,8 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
     if not training or p == 0.0:
         return x if isinstance(x, Tensor) else Tensor(x)
-    key = rnd.next_key()
 
-    def f(a):
+    def f(a, key):
         shape = list(a.shape)
         if axis is not None:
             axes = axis if isinstance(axis, (list, tuple)) else [axis]
@@ -766,7 +765,15 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
             return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype)).astype(a.dtype)
         return jnp.where(keep, a, jnp.zeros((), a.dtype)).astype(a.dtype)
 
-    return unary_op("dropout", f, x)
+    from ..static.graph import current_builder, rng_key_input
+
+    if current_builder() is not None:
+        # static Program: the key is an RNG source node — Executor.run feeds
+        # a fresh subkey per run, so masks re-sample every step
+        key_t = rng_key_input()
+    else:
+        key_t = Tensor(rnd.next_key())
+    return apply_op("dropout", f, (_t(x), key_t), {})
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):

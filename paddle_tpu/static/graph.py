@@ -29,10 +29,10 @@ XLA:
   "fetch a Variable outside run" is too); control flow must use recorded
   ops — matching static-graph semantics.
 
-Known v1 limits (documented): ops that close over a fresh PRNG key at build
-time (dropout) bake that key into the program — seed it per run via
-``paddle.seed`` before building, or prefer dynamic mode for stochastic
-training; Python arithmetic on a ``None`` feed dim uses the canonical build
+Stochastic ops: ``dropout`` takes its PRNG key from an :class:`_RngNode`
+source under static mode, and ``Executor.run`` feeds a FRESH subkey every
+run — static training re-samples masks per step like the reference.  Known
+v1 limit: Python arithmetic on a ``None`` feed dim uses the canonical build
 dim (declare ``-1``-style reshapes instead).
 """
 
@@ -83,6 +83,35 @@ class _FeedNode:
         self.name = name
         self.declared_shape = tuple(declared_shape)
         self.dtype = dtype
+
+
+class _RngNode:
+    """Source node for a per-run PRNG key: Executor.run feeds a FRESH subkey
+    each run, so recorded stochastic ops (dropout) re-sample per step — the
+    reference's seeded static dropout semantics, instead of a key baked at
+    build time."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+
+def rng_key_input() -> Tensor:
+    """A symbolic PRNG-key Variable of the active Program (stochastic ops
+    call this under static mode instead of consuming an eager key)."""
+    b = current_builder()
+    if b is None:
+        raise RuntimeError("rng_key_input needs an active static Program")
+    prog = b.program()
+    node = _RngNode(f"@rng{len(prog._rng_nodes)}")
+    prog._rng_nodes.append(node)
+    aval = jax.eval_shape(lambda: jax.random.key(0))
+    lz = LazyArray(b, node, 0, aval)
+    t = Tensor.__new__(Tensor)
+    _init_tensor(t, lz)
+    lz._tensors.append(weakref.ref(t))
+    return t
 
 
 class StaticBuilder(Recorder):
@@ -204,6 +233,7 @@ class Program:
 
     def __init__(self):
         self._builder: Optional[StaticBuilder] = None
+        self._rng_nodes: List[_RngNode] = []
         self._feeds: Dict[str, _FeedNode] = {}
         self._named_vars: Dict[str, Tensor] = {}
         self._state: Dict[str, Any] = {}      # name -> current array
@@ -404,8 +434,9 @@ def _build_plan(builder: StaticBuilder, targets: List[Tuple[Any, int]],
     """
     nodes = builder._nodes
     node_pos = {id(n): i for i, n in enumerate(nodes)}
+    sources = (_FeedNode, _RngNode)
     needed_ids = set()
-    stack = [n for n, _ in targets if not isinstance(n, _FeedNode)]
+    stack = [n for n, _ in targets if not isinstance(n, sources)]
     while stack:
         n = stack.pop()
         if id(n) in needed_ids:
@@ -414,7 +445,7 @@ def _build_plan(builder: StaticBuilder, targets: List[Tuple[Any, int]],
             raise ValueError("fetch target was not recorded in this Program")
         needed_ids.add(id(n))
         for src in n.inputs:
-            if src[0] == "lazy" and not isinstance(src[1], _FeedNode):
+            if src[0] == "lazy" and not isinstance(src[1], sources):
                 stack.append(src[1])
     needed = [n for n in nodes if id(n) in needed_ids]
     pos_of = {id(n): i for i, n in enumerate(needed)}
@@ -423,12 +454,18 @@ def _build_plan(builder: StaticBuilder, targets: List[Tuple[Any, int]],
     consts: List[Any] = []
     const_pos: Dict[int, int] = {}
     feed_names: List[str] = []
+    rng_names: List[str] = []
     plan = []
     for n in needed:
         ins = []
         for src in n.inputs:
             if src[0] == "lazy":
-                if isinstance(src[1], _FeedNode):
+                if isinstance(src[1], _RngNode):
+                    # fed internally by Executor.run with a fresh subkey
+                    ins.append(("f", src[1].name))
+                    if src[1].name not in rng_names:
+                        rng_names.append(src[1].name)
+                elif isinstance(src[1], _FeedNode):
                     ins.append(("f", src[1].name))
                     if src[1].name not in feed_names:
                         feed_names.append(src[1].name)
@@ -454,7 +491,7 @@ def _build_plan(builder: StaticBuilder, targets: List[Tuple[Any, int]],
                 feed_names.append(n.name)
         else:
             tpos.append(("l", pos_of[id(n)], idx))
-    return plan, consts, feed_names, tpos
+    return plan, consts, feed_names, tpos, rng_names
 
 
 def _make_replay(plan, consts, target_positions):
@@ -550,7 +587,8 @@ class Executor:
                             for k, v in feed_arrays.items())))
         entry = program._exec_cache.get(key)
         if entry is None:
-            plan, consts, feed_names, tpos = _build_plan(b, targets, slots)
+            plan, consts, feed_names, tpos, rng_names = _build_plan(
+                b, targets, slots)
             missing = [n for n in feed_names if n not in feed_arrays]
             if missing:
                 raise KeyError(f"Executor.run missing feeds: {missing}")
@@ -580,12 +618,19 @@ class Executor:
                 def jfn(state, feeds):
                     return replay(state, feeds)
             entry = {"fn": jax.jit(jfn), "train": train,
-                     "trainable": trainable}
+                     "trainable": trainable, "rng": tuple(rng_names)}
             program._exec_cache[key] = entry
 
         state_now = dict(program._state)
         for name, slot in slots.items():
             state_now.setdefault(name, slot["init"])
+        if entry.get("rng"):
+            # fresh subkeys per run: recorded stochastic ops re-sample
+            from ..framework import random as rnd
+
+            subs = jax.random.split(rnd.next_key(), len(entry["rng"]))
+            for nm, sub in zip(entry["rng"], subs):
+                feed_arrays[nm] = sub
         if entry["train"]:
             optimizer, _ = b.optimizer
             params = {n: state_now[n] for n in entry["trainable"]}
@@ -658,7 +703,12 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
         for fv in fetch_vars:
             d = fv._data
             targets.append((d._node, d._idx))
-        plan, consts, needed_feeds, tpos = _build_plan(b, targets, slots)
+        plan, consts, needed_feeds, tpos, rng_names = _build_plan(
+            b, targets, slots)
+        if rng_names:
+            raise ValueError(
+                "save_inference_model: the fetch graph contains stochastic "
+                "ops (dropout RNG inputs) — export an eval-mode graph")
         replay = _make_replay(plan, consts, tpos)
         feed_names = [n.name for n in feed_nodes]
         missing = [n for n in needed_feeds if n not in feed_names]

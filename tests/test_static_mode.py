@@ -267,3 +267,24 @@ def test_continued_building_after_run_sees_trained_params(static_mode):
     # a frozen-constant binding would leave p2 == p1
     assert not np.allclose(p1, p2)
     assert abs(float(p2)) < abs(float(p1))
+
+
+def test_static_dropout_resamples_per_run(static_mode):
+    """Stochastic ops take their key from an RNG source node; Executor.run
+    feeds a fresh subkey each run (reference static dropout semantics) —
+    a build-time-baked key would repeat the same mask forever."""
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 256], "float32")
+        out = F.dropout(x, p=0.5)
+    exe = paddle.static.Executor()
+    xs = np.ones((2, 256), np.float32)
+    (m1,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    (m2,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    assert not np.array_equal(m1, m2)
+    for m in (m1, m2):
+        assert 0.3 < (m > 0).mean() < 0.7
+    # and an eval export with dropout in the fetch graph refuses loudly
+    with pytest.raises(ValueError, match="stochastic"):
+        paddle.static.save_inference_model("/tmp/no_rng_export", [x], [out],
+                                           exe, program=main)

@@ -714,21 +714,22 @@ def feature_alpha_dropout(x, p=0.5, training=True, name=None):
     the affine correction keeping mean/variance."""
     if not training or p == 0.0:
         return ensure_tensor(x)
-    from ..framework import random as rnd
-
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
-    key = rnd.next_key()
 
-    def f(a):
+    def f(a, key):
         shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
         keep = jax.random.bernoulli(key, 1.0 - p, shape)
         a_coef = (1.0 - p + p * alpha_p ** 2) ** -0.5
         b_coef = -a_coef * p * alpha_p
         return a_coef * jnp.where(keep, a, alpha_p) + b_coef
 
-    return unary_op("feature_alpha_dropout", f, ensure_tensor(x))
+    from ..framework.dispatch import apply_op
+    from .functional import _stochastic_key
+
+    return apply_op("feature_alpha_dropout", f,
+                    (ensure_tensor(x), _stochastic_key()), {})
 
 
 def bilinear(x1, x2, weight, bias=None, name=None):

@@ -198,16 +198,26 @@ def prelu(x, weight, data_format="NCHW", name=None):
     return apply_op("prelu", f, (_t(x), _t(weight)), {})
 
 
+
+def _stochastic_key():
+    """PRNG key for a stochastic op, as a TENSOR INPUT: an RNG source node
+    under a static Program (Executor.run feeds fresh subkeys per run), the
+    eager generator key otherwise."""
+    from ..static.graph import current_builder, rng_key_input
+
+    if current_builder() is not None:
+        return rng_key_input()
+    return Tensor(rnd.next_key())
+
 def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
     if not training:
         return unary_op("rrelu", lambda a: jnp.where(a >= 0, a, a * ((lower + upper) / 2.0)), x)
-    key = rnd.next_key()
 
-    def f(a):
+    def f(a, key):
         slopes = jax.random.uniform(key, a.shape, dtype=jnp.float32, minval=lower, maxval=upper).astype(a.dtype)
         return jnp.where(a >= 0, a, a * slopes)
 
-    return unary_op("rrelu", f, x)
+    return apply_op("rrelu", f, (_t(x), _stochastic_key()), {})
 
 
 def log_sigmoid(x, name=None):
@@ -219,9 +229,7 @@ def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
 
 
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
-    key = rnd.next_key()
-
-    def f(a):
+    def f(a, key):
         g = -jnp.log(-jnp.log(jax.random.uniform(key, a.shape, dtype=jnp.float32, minval=1e-20, maxval=1.0)))
         y = jax.nn.softmax((a + g.astype(a.dtype)) / temperature, axis=axis)
         if hard:
@@ -232,7 +240,7 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             y = y_hard + y - jax.lax.stop_gradient(y)
         return y
 
-    return unary_op("gumbel_softmax", f, x)
+    return apply_op("gumbel_softmax", f, (_t(x), _stochastic_key()), {})
 
 
 # ---------------------------------------------------------------------------
@@ -765,15 +773,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
             return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype)).astype(a.dtype)
         return jnp.where(keep, a, jnp.zeros((), a.dtype)).astype(a.dtype)
 
-    from ..static.graph import current_builder, rng_key_input
-
-    if current_builder() is not None:
-        # static Program: the key is an RNG source node — Executor.run feeds
-        # a fresh subkey per run, so masks re-sample every step
-        key_t = rng_key_input()
-    else:
-        key_t = Tensor(rnd.next_key())
-    return apply_op("dropout", f, (_t(x), key_t), {})
+    return apply_op("dropout", f, (_t(x), _stochastic_key()), {})
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -789,19 +789,18 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
 def alpha_dropout(x, p=0.5, training=True, name=None):
     if not training or p == 0.0:
         return x if isinstance(x, Tensor) else Tensor(x)
-    key = rnd.next_key()
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
 
-    def f(a):
+    def f(a, key):
         keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
         q = 1.0 - p
         a_coef = (q + alpha_p ** 2 * q * (1 - q)) ** -0.5
         b_coef = -a_coef * alpha_p * (1 - q)
         return (a_coef * jnp.where(keep, a, jnp.asarray(alpha_p, a.dtype)) + b_coef).astype(a.dtype)
 
-    return unary_op("alpha_dropout", f, x)
+    return apply_op("alpha_dropout", f, (_t(x), _stochastic_key()), {})
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None, max_norm=None, norm_type=2.0, scale_grad_by_freq=False):

@@ -50,6 +50,14 @@ def name_scope(prefix):
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from .graph import in_static_mode
+
+    if in_static_mode():
+        raise RuntimeError(
+            "static.gradients inside a recording Program is not supported: "
+            "gradients are computed by Executor.run itself — attach an "
+            "optimizer with minimize(loss) (fwd+bwd+update compile into one "
+            "program) or fetch the loss and differentiate in dynamic mode")
     from ..framework.autograd import grad
 
     return grad(targets, inputs, target_gradients, retain_graph=True, allow_unused=True)
@@ -151,7 +159,16 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
 def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None):
     """Eager-tape equivalent of the static backward pass: runs backward and
-    returns (param, grad) pairs (reference ``append_backward``)."""
+    returns (param, grad) pairs (reference ``append_backward``).  Inside a
+    recording Program, use ``optimizer.minimize(loss)`` — Executor.run
+    appends the backward itself (one compiled fwd+bwd+update program)."""
+    from .graph import in_static_mode
+
+    if in_static_mode():
+        raise RuntimeError(
+            "append_backward inside a recording Program: use "
+            "optimizer.minimize(loss) — Executor.run compiles the backward "
+            "into the program")
     loss.backward()
     params = parameter_list or []
     return [(p, p.grad) for p in params]

@@ -127,6 +127,14 @@ class _Converter:
     def _op_erf(self, eqn):
         self._bind1(eqn, "Erf")
 
+    def _op_erfc(self, eqn):
+        # erfc(x) = 1 - erf(x)
+        x = self.name_of(eqn.invars[0])
+        (e,) = self.emit("Erf", [x])
+        one = self.add_init(np.asarray(1.0, np.dtype(eqn.invars[0].aval.dtype)))
+        (out,) = self.emit("Sub", [one, e])
+        self.names[eqn.outvars[0]] = out
+
     def _op_sign(self, eqn):
         self._bind1(eqn, "Sign")
 

@@ -298,3 +298,24 @@ class TestModelZooExport:
         x = np.random.default_rng(0).normal(
             size=(1, 3, 32, 48)).astype(np.float32)
         _export_and_check(m, x, atol=1e-4, path_name="crnn")
+
+    def test_resnet18_roundtrip(self):
+        from paddle_tpu.vision.models import resnet18
+
+        paddle.seed(0)
+        m = resnet18(num_classes=10)
+        m.eval()
+        x = np.random.default_rng(0).normal(
+            size=(1, 3, 32, 32)).astype(np.float32)
+        _export_and_check(m, x, atol=1e-4, path_name="resnet18")
+
+    def test_ernie_roundtrip(self):
+        from paddle_tpu.models.ernie import (
+            ErnieForSequenceClassification, ernie_tiny_config,
+        )
+
+        paddle.seed(0)
+        m = ErnieForSequenceClassification(ernie_tiny_config(), num_classes=2)
+        m.eval()
+        ids = np.arange(16, dtype=np.int32).reshape(1, 16)
+        _export_and_check(m, ids, atol=1e-4, path_name="ernie")

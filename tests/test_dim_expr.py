@@ -127,3 +127,25 @@ def test_floordiv_bounds_with_negative_numerator():
     # true range: floor((1-20)/1) = -19 .. floor((10-20)/5) = -2
     assert lo <= -19 and hi >= -2 and lo <= hi
     assert not DimExpr("const", (-4,)).prove_le(e)   # e = -19 is reachable
+
+
+def test_serving_engine_auto_buckets():
+    """Engine(prefill_buckets='auto') synthesizes its ladder with the proven
+    overhead bound."""
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+    from paddle_tpu.serving import Engine
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny_config(use_flash_attention=False))
+    eng = Engine(model, max_batch=2, num_blocks=32, block_size=128,
+                 prefill_buckets="auto", max_prefill_overhead=0.5)
+    assert eng.prefill_buckets[0] >= 128
+    assert eng.prefill_buckets == tuple(sorted(eng.prefill_buckets))
+    assert eng.prefill_waste_bound <= 0.5 + 1e-9
+    # and it still serves
+    from paddle_tpu.serving import GenRequest
+
+    eng.add_request(GenRequest(prompt_ids=np.arange(8, dtype=np.int32),
+                               max_new_tokens=4))
+    outs = eng.run_to_completion()
+    assert len(outs) == 1 and len(outs[0].output_ids) == 4

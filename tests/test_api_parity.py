@@ -257,6 +257,7 @@ SUBMODULE_ABSENT = {
     ("geometric/__init__.py", "geometric"),
     ("optimizer/__init__.py", "optimizer"), ("optimizer/lr.py", "optimizer.lr"),
     ("incubate/__init__.py", "incubate"), ("utils/__init__.py", "utils"),
+    ("static/nn/__init__.py", "static.nn"),
 ])
 def test_submodule_all_parity(mod, attr):
     path = os.path.join(os.path.dirname(REF_INIT), mod)

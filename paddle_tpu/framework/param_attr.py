@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-__all__ = ["ParamAttr", "create_parameter"]
+__all__ = ["ParamAttr", "WeightNormParamAttr", "create_parameter"]
 
 
 class ParamAttr:
@@ -27,6 +27,82 @@ class ParamAttr:
         self.trainable = trainable
         self.do_model_average = do_model_average
         self.need_clip = need_clip
+
+
+class WeightNormParamAttr(ParamAttr):
+    """Weight-normalization parameter attribute (reference
+    ``static.WeightNormParamAttr``): the effective weight is the graph-
+    recomputed ``w = g * v / ||v||`` with direction ``v`` and per-``dim``
+    magnitude ``g`` as the trainable parameters.
+
+    Static-graph-only, exactly like the reference: the reparameterization
+    is a pair of recorded ops replayed (with the trained v/g) on every
+    ``Executor.run``.  In dynamic mode use ``paddle.nn.utils.weight_norm``,
+    which hooks the layer instead.
+    """
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate, regularizer=regularizer,
+                         trainable=trainable,
+                         do_model_average=do_model_average,
+                         need_clip=need_clip)
+        self.dim = dim
+
+
+def _weight_norm_parameter(shape, dtype, attr: WeightNormParamAttr, init):
+    """v/g Parameters + the recorded reparameterized weight."""
+    import numpy as np
+
+    from ..static.graph import current_builder
+    from .dtype import convert_dtype
+    from .tensor import Parameter
+
+    if current_builder() is None:
+        raise RuntimeError(
+            "WeightNormParamAttr reparameterizes through recorded graph ops "
+            "and needs static mode (paddle.enable_static()); in dynamic "
+            "mode wrap the layer with paddle.nn.utils.weight_norm instead")
+    data = np.asarray(init(list(shape), convert_dtype(dtype)))
+    dim = attr.dim
+    if dim is not None:
+        if not -len(shape) <= dim < len(shape):
+            raise ValueError(
+                f"WeightNormParamAttr dim={dim} out of range for a "
+                f"{len(shape)}-d parameter")
+        dim = dim % len(shape)
+    axes = None if dim is None else tuple(
+        i for i in range(len(shape)) if i != dim)
+    g0 = np.sqrt((data ** 2).sum() if dim is None
+                 else (data ** 2).sum(axis=axes))
+    v = Parameter(data, name=f"{attr.name}.v" if attr.name else None)
+    g = Parameter(np.asarray(g0, data.dtype),
+                  name=f"{attr.name}.g" if attr.name else None)
+    for p in (v, g):
+        if attr.learning_rate is not None:
+            p.optimize_attr["learning_rate"] = attr.learning_rate
+        if attr.trainable is False:
+            p.stop_gradient = True
+            p.trainable = False
+
+    import jax.numpy as jnp
+
+    from .dispatch import apply_op
+
+    def f(vv, gg):
+        if dim is None:
+            n = jnp.sqrt(jnp.sum(vv.astype(jnp.float32) ** 2))
+            return (vv / jnp.maximum(n, 1e-12) * gg).astype(vv.dtype)
+        n = jnp.sqrt(jnp.sum(vv.astype(jnp.float32) ** 2, axis=axes,
+                             keepdims=True))
+        gshape = [1] * vv.ndim
+        gshape[dim] = vv.shape[dim]
+        return (vv / jnp.maximum(n, 1e-12)
+                * gg.reshape(gshape)).astype(vv.dtype)
+
+    return apply_op("weight_norm", f, (v, g), {})
 
 
 def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
@@ -48,6 +124,8 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
     init = default_initializer or attr.initializer
     if init is None:
         init = Constant(0.0) if is_bias else XavierUniform()
+    if isinstance(attr, WeightNormParamAttr):
+        return _weight_norm_parameter(shape, dtype, attr, init)
     data = init(list(shape), convert_dtype(dtype))
     p = Parameter(data, name=attr.name or name)
     if attr.learning_rate is not None:

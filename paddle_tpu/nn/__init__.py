@@ -2,6 +2,7 @@
 
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401  (weight/spectral norm hooks, grad clip)
 from .layers import Layer, LayerList, ParameterList, Sequential  # noqa: F401
 from .common_layers import *  # noqa: F401,F403
 from .conv import *  # noqa: F401,F403

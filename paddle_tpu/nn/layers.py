@@ -105,6 +105,14 @@ class Layer:
             init = _global_initializer(is_bias) or default_initializer
         if init is None:
             init = Constant(0.0) if is_bias else XavierUniform()
+        from ..framework.param_attr import (
+            WeightNormParamAttr, _weight_norm_parameter,
+        )
+
+        if isinstance(attr, WeightNormParamAttr):
+            # static-graph weight norm: the layer stores the RECORDED
+            # reparameterized weight; v/g train as the Program's slots
+            return _weight_norm_parameter(shape, dtype, attr, init)
         data = init(shape, convert_dtype(dtype))
         name = getattr(attr, "name", None) if attr is not None else None
         p = Parameter(data, name=name)

@@ -1,0 +1,205 @@
+"""``paddle.nn.utils`` — hook-based reparameterizations + parameter utils.
+
+Reference: ``python/paddle/nn/utils/weight_norm_hook.py`` (weight_norm /
+remove_weight_norm), ``spectral_norm_hook.py``, ``transform_parameters.py``
+(parameters_to_vector / vector_to_parameters), ``clip_grad_norm_.py`` /
+``clip_grad_value_.py``.
+
+Dygraph mechanism, like the reference: the parameter is split into its
+reparameterized pieces (v/g for weight norm, u-buffered power iteration for
+spectral norm) and a forward-pre-hook recomputes the effective weight each
+call — autograd flows to the pieces through the eager tape.  The
+static-graph counterpart is ``static.WeightNormParamAttr`` (recorded ops).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Parameter, Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def _norm_axes(ndim: int, dim):
+    if dim is None:
+        return None
+    return tuple(i for i in range(ndim) if i != dim)
+
+
+def _compute_weight(v, g, dim):
+    axes = _norm_axes(len(v.shape), dim)
+    if axes is None:
+        n = (v * v).sum().sqrt()
+        return v / n.clip(min=1e-12) * g
+    n = (v * v).sum(axis=list(axes), keepdim=True).sqrt()
+    gshape = [1] * len(v.shape)
+    gshape[dim] = v.shape[dim]
+    return v / n.clip(min=1e-12) * g.reshape(gshape)
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """Split ``layer.<name>`` into direction ``<name>_v`` and magnitude
+    ``<name>_g``; a forward-pre-hook recomputes the weight each call
+    (reference ``weight_norm_hook.py``)."""
+    w = getattr(layer, name)
+    if dim is not None:
+        dim = dim % len(w.shape)
+    w_np = np.asarray(w.numpy())
+    axes = _norm_axes(w_np.ndim, dim)
+    g0 = np.sqrt((w_np ** 2).sum() if axes is None
+                 else (w_np ** 2).sum(axis=axes))
+    v = Parameter(w_np.copy(), name=(w.name or name) + "_v")
+    g = Parameter(np.asarray(g0, w_np.dtype), name=(w.name or name) + "_g")
+    layer._parameters.pop(name, None)
+    layer.add_parameter(name + "_v", v)
+    layer.add_parameter(name + "_g", g)
+
+    def hook(lyr, inputs):
+        object.__setattr__(lyr, name, _compute_weight(
+            getattr(lyr, name + "_v"), getattr(lyr, name + "_g"), dim))
+
+    handle = layer.register_forward_pre_hook(hook)
+    if not hasattr(layer, "_weight_norm_hooks"):
+        layer._weight_norm_hooks = {}
+    layer._weight_norm_hooks[name] = (handle, dim)
+    hook(layer, None)   # the weight exists before the first forward
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    """Bake the current effective weight back into a plain Parameter and
+    remove the hook (reference ``remove_weight_norm``)."""
+    handle, dim = layer._weight_norm_hooks.pop(name)
+    handle.remove()
+    w = _compute_weight(getattr(layer, name + "_v"),
+                        getattr(layer, name + "_g"), dim)
+    layer._parameters.pop(name + "_v", None)
+    layer._parameters.pop(name + "_g", None)
+    for suffix in ("_v", "_g"):
+        if hasattr(layer, name + suffix):
+            object.__delattr__(layer, name + suffix)
+    layer.add_parameter(name, Parameter(w._data, name=name))
+    return layer
+
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim=None):
+    """Divide ``layer.<name>`` by its largest singular value, estimated by a
+    u-buffered power iteration refreshed every forward (reference
+    ``spectral_norm_hook.py``)."""
+    w = getattr(layer, name)
+    ndim = len(w.shape)
+    if dim is None:
+        dim = 0
+    dim = dim % ndim
+    h = int(w.shape[dim])
+    rng = np.random.default_rng(0)
+    u0 = rng.normal(size=(h,)).astype(np.asarray(w.numpy()).dtype)
+    u0 /= np.linalg.norm(u0) + eps
+    layer.register_buffer(name + "_u", Tensor(u0))
+    # keep training the same tensor: rename it <name>_orig like the reference
+    layer._parameters.pop(name, None)
+    layer.add_parameter(name + "_orig", w)
+
+    def hook(lyr, inputs):
+        import jax.numpy as jnp
+
+        from ..framework.autograd import no_grad
+        from ..framework.dispatch import apply_op
+
+        w_p = getattr(lyr, name + "_orig")
+        u_t = getattr(lyr, name + "_u")
+
+        def f(wv, uv):
+            wm = jnp.moveaxis(wv.astype(jnp.float32), dim, 0).reshape(h, -1)
+            uu = uv.astype(jnp.float32)
+            for _ in range(max(1, n_power_iterations)):
+                vv = wm.T @ uu
+                vv = vv / (jnp.linalg.norm(vv) + eps)
+                uu = wm @ vv
+                uu = uu / (jnp.linalg.norm(uu) + eps)
+            sigma = uu @ wm @ vv
+            return (wv / sigma).astype(wv.dtype), uu.astype(uv.dtype)
+
+        w_sn, new_u = apply_op("spectral_norm_hook", f, (w_p, u_t), {},
+                               num_outputs=2)
+        with no_grad():
+            u_t._data = new_u._data
+        object.__setattr__(lyr, name, w_sn)
+
+    handle = layer.register_forward_pre_hook(hook)
+    if not hasattr(layer, "_weight_norm_hooks"):
+        layer._weight_norm_hooks = {}
+    layer._weight_norm_hooks[name] = (handle, dim)
+    hook(layer, None)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    """Flatten-and-concatenate parameters (reference
+    ``parameters_to_vector``)."""
+    from .. import concat
+
+    flats = [p.reshape([-1]) for p in parameters]
+    return concat(flats, axis=0)
+
+
+def vector_to_parameters(vec: Tensor, parameters, name=None):
+    """Scatter a flat vector back into the parameters (in place)."""
+    from ..framework.autograd import no_grad
+
+    offset = 0
+    with no_grad():
+        for p in parameters:
+            n = int(np.prod(p.shape))
+            chunk = vec[offset:offset + n].reshape(list(p.shape))
+            p.set_value(chunk)
+            offset += n
+    if offset != int(np.prod(vec.shape)):
+        raise ValueError(
+            f"vector has {int(np.prod(vec.shape))} elements but the "
+            f"parameters hold {offset}")
+    return parameters
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type: float = 2.0,
+                    error_if_nonfinite: bool = False):
+    """Scale gradients in place so their global norm is at most ``max_norm``
+    (reference ``clip_grad_norm_``); returns the pre-clip norm."""
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters]) if p.grad is not None]
+    if not params:
+        return Tensor(np.float32(0.0))
+    grads = [np.asarray(p.grad.numpy()).astype(np.float64) for p in params]
+    if norm_type == float("inf"):
+        total = max(np.abs(g).max() for g in grads)
+    else:
+        total = sum((np.abs(g) ** norm_type).sum() for g in grads) \
+            ** (1.0 / norm_type)
+    if error_if_nonfinite and not np.isfinite(total):
+        raise RuntimeError(
+            f"the total norm of gradients is non-finite ({total})")
+    scale = float(max_norm) / (float(total) + 1e-6)
+    if scale < 1.0:
+        from ..framework.autograd import no_grad
+
+        with no_grad():
+            for p in params:
+                p.grad = p.grad * scale   # property setter: rebinds storage
+    return Tensor(np.float32(total))
+
+
+def clip_grad_value_(parameters, clip_value):
+    """Clamp every gradient element into [-clip_value, clip_value] in place
+    (reference ``clip_grad_value_``)."""
+    from ..framework.autograd import no_grad
+
+    params = parameters if isinstance(parameters, (list, tuple)) else [parameters]
+    with no_grad():
+        for p in params:
+            if p.grad is not None:
+                p.grad = p.grad.clip(-clip_value, clip_value)
+    return parameters

@@ -226,11 +226,9 @@ class CompiledProgram:
         self._build_strategy = build_strategy or BuildStrategy()
 
 
-class WeightNormParamAttr:
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "weight-norm reparameterization: wrap the layer's weight with "
-            "nn.utils-style normalization in the forward instead")
+from ..framework.param_attr import WeightNormParamAttr  # noqa: E402,F401
+# (real: static-graph weight-norm reparameterization via recorded ops —
+# v/g Parameters train as Program slots, w recomputed every Executor.run)
 
 
 def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,

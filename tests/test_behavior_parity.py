@@ -43,9 +43,6 @@ KNOWN_STUBS = {
     "static.IpuStrategy": ("__init__", "Graphcore IPU hardware N/A"),
     "static.ipu_shard_guard": ("fn", "Graphcore IPU hardware N/A"),
     "static.set_ipu_shard": ("fn", "Graphcore IPU hardware N/A"),
-    "static.WeightNormParamAttr": (
-        "__init__", "static-graph-only param attr; dygraph weight_norm is "
-        "implemented (paddle.nn.utils.weight_norm)"),
     "static.ctr_metric_bundle": (
         "fn", "CTR metric aggregation for the PS stack (out of TPU scope)"),
 }

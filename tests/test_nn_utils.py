@@ -1,0 +1,137 @@
+"""paddle.nn.utils — hook reparameterizations + parameter utilities.
+
+Reference: ``python/paddle/nn/utils/`` (weight_norm_hook, spectral_norm_hook,
+transform_parameters, clip_grad_norm_/value_).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn.utils import (
+    clip_grad_norm_, clip_grad_value_, parameters_to_vector,
+    remove_weight_norm, spectral_norm, vector_to_parameters, weight_norm,
+)
+
+
+def test_weight_norm_forward_and_train():
+    paddle.seed(0)
+    lin = nn.Linear(4, 6)
+    want = np.asarray(lin(paddle.to_tensor(np.ones((2, 4), np.float32))).numpy())
+    weight_norm(lin, dim=1)
+    names = dict(lin.named_parameters())
+    assert "weight_v" in names and "weight_g" in names and "weight" not in names
+    got = np.asarray(lin(paddle.to_tensor(np.ones((2, 4), np.float32))).numpy())
+    np.testing.assert_allclose(got, want, rtol=1e-5)   # reparam is exact at init
+    # g really is the per-column norm, and training flows into v/g
+    w = np.asarray(lin.weight.numpy())
+    np.testing.assert_allclose(np.linalg.norm(w, axis=0),
+                               np.asarray(lin.weight_g.numpy()), rtol=1e-5)
+    opt = paddle.optimizer.SGD(learning_rate=0.3, parameters=lin.parameters())
+    x = paddle.to_tensor(np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32))
+    y = paddle.to_tensor(np.zeros((8, 6), np.float32))
+    l0 = None
+    for _ in range(10):
+        loss = F.mse_loss(lin(x), y)
+        loss.backward(); opt.step(); opt.clear_grad()
+        l0 = l0 or float(loss.numpy())
+    assert float(loss.numpy()) < l0
+
+
+def test_remove_weight_norm_bakes_weight():
+    paddle.seed(1)
+    lin = nn.Linear(3, 5)
+    weight_norm(lin, dim=0)
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    want = np.asarray(lin(x).numpy())
+    remove_weight_norm(lin)
+    names = dict(lin.named_parameters())
+    assert "weight" in names and "weight_v" not in names
+    np.testing.assert_allclose(np.asarray(lin(x).numpy()), want, rtol=1e-6)
+
+
+def test_spectral_norm_bounds_sigma():
+    paddle.seed(2)
+    lin = nn.Linear(8, 8)
+    with paddle.no_grad():
+        lin.weight.set_value(lin.weight * 10.0)   # blow up sigma
+    spectral_norm(lin, n_power_iterations=8)
+    lin(paddle.to_tensor(np.ones((1, 8), np.float32)))  # refresh u
+    w = np.asarray(lin.weight.numpy())
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=5e-2)
+
+
+def test_parameter_vector_roundtrip():
+    paddle.seed(3)
+    lin = nn.Linear(3, 4)
+    vec = parameters_to_vector(lin.parameters())
+    assert tuple(vec.shape) == (3 * 4 + 4,)
+    doubled = vec * 2.0
+    vector_to_parameters(doubled, lin.parameters())
+    np.testing.assert_allclose(
+        np.asarray(parameters_to_vector(lin.parameters()).numpy()),
+        np.asarray(doubled.numpy()), rtol=1e-6)
+
+
+def test_clip_grad_norm_and_value():
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.full((2, 4), 10.0, np.float32))
+    (lin(x) ** 2).sum().backward()
+    total = clip_grad_norm_(lin.parameters(), max_norm=1.0)
+    assert float(total.numpy()) > 1.0   # pre-clip norm returned
+    g = np.concatenate([np.asarray(p.grad.numpy()).ravel()
+                        for p in lin.parameters()])
+    np.testing.assert_allclose(np.linalg.norm(g), 1.0, rtol=1e-4)
+    clip_grad_value_(lin.parameters(), 0.01)
+    for p in lin.parameters():
+        assert np.abs(np.asarray(p.grad.numpy())).max() <= 0.01 + 1e-8
+
+
+def test_weight_norm_param_attr_negative_dim(tmp_path):
+    """Review regression: negative dim normalizes instead of collapsing g."""
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 4], "float32")
+            lin = nn.Linear(4, 6,
+                            weight_attr=paddle.static.WeightNormParamAttr(dim=-1))
+            out = lin(x).sum()
+        exe = paddle.static.Executor()
+        (o,) = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[out])
+        assert np.isfinite(o)
+        with pytest.raises(ValueError, match="out of range"):
+            paddle.static.WeightNormParamAttr(dim=5) and nn.Linear(
+                4, 6, weight_attr=paddle.static.WeightNormParamAttr(dim=5))
+    finally:
+        paddle.disable_static()
+
+
+def test_weight_norm_param_attr_trainable_false():
+    """Review regression: trainable=False must freeze v/g (the weight may
+    not move under training)."""
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 4], "float32")
+            y = paddle.static.data("y", [None, 6], "float32")
+            lin = nn.Linear(4, 6, weight_attr=paddle.static.WeightNormParamAttr(
+                dim=1, trainable=False))
+            loss = F.mse_loss(lin(x), y)
+            paddle.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        exe = paddle.static.Executor()
+        rng = np.random.default_rng(0)
+        feed = {"x": rng.normal(size=(8, 4)).astype(np.float32),
+                "y": rng.normal(size=(8, 6)).astype(np.float32)}
+        (w0,) = exe.run(main, feed=feed, fetch_list=[lin.weight])
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        (w1,) = exe.run(main, feed=feed, fetch_list=[lin.weight])
+        np.testing.assert_array_equal(w0, w1)
+    finally:
+        paddle.disable_static()

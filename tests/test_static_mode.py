@@ -288,3 +288,49 @@ def test_static_dropout_resamples_per_run(static_mode):
     with pytest.raises(ValueError, match="stochastic"):
         paddle.static.save_inference_model("/tmp/no_rng_export", [x], [out],
                                            exe, program=main)
+
+
+def test_weight_norm_param_attr(static_mode):
+    """WeightNormParamAttr (reference static-graph weight norm): the layer's
+    effective weight is recomputed from trainable v/g every run, so after
+    training each dim-slice norm of the fetched weight EQUALS the trained g."""
+    from paddle_tpu import ParamAttr
+
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 4], "float32")
+        y = paddle.static.data("y", [None, 6], "float32")
+        lin = paddle.nn.Linear(
+            4, 6, weight_attr=paddle.static.WeightNormParamAttr(dim=1))
+        pred = lin(x)
+        loss = F.mse_loss(pred, y)
+        paddle.optimizer.SGD(learning_rate=0.2).minimize(loss)
+    exe = paddle.static.Executor()
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(16, 4)).astype(np.float32)
+    ys = rng.normal(size=(16, 6)).astype(np.float32)
+    (l0,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    w0 = None
+    for _ in range(20):
+        lv, wv = exe.run(main, feed={"x": xs, "y": ys},
+                         fetch_list=[loss, lin.weight])
+        if w0 is None:
+            w0 = wv
+    assert float(lv) < float(l0)
+    assert not np.allclose(wv, w0)           # the reparam weight trains
+    # w's per-output-column norm equals g: snapshot the state, then fetch
+    # the weight computed FROM that state (fetches see pre-update values)
+    state_before = main.state_dict()
+    (wv2,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[lin.weight])
+    g_val = None
+    for name, val in state_before.items():
+        if val.shape == (6,) and np.allclose(np.linalg.norm(wv2, axis=0),
+                                             val, rtol=1e-4):
+            g_val = val
+    assert g_val is not None, "no state slot matches the column norms"
+
+
+def test_weight_norm_param_attr_dynamic_raises():
+    with pytest.raises(RuntimeError, match="static mode"):
+        paddle.nn.Linear(4, 6,
+                         weight_attr=paddle.static.WeightNormParamAttr(dim=1))

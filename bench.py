@@ -224,6 +224,60 @@ def _step_flops_of(lowered) -> float:
     return flops_of_lowered(lowered) or 0.0
 
 
+def build_pretrain_step(preset: str, on_tpu: bool, batch=None, seq=None,
+                        steps=None):
+    """Construct the pretrain TrainStep for a tiny/small/base/longctx preset.
+
+    Shared by ``main`` and ``scripts/capture_evidence.py`` so the committed
+    cost evidence describes the EXACT program the benchmark measures (same
+    seed, hyperparams, input generation). Returns
+    ``(step_fn, ids, model, cfg, (batch, seq, steps))``.
+    """
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM
+
+    if preset not in DEFAULTS:
+        raise ValueError(f"not a pretrain preset: {preset!r} "
+                         f"(choose from {sorted(DEFAULTS)})")
+    dtype = "bfloat16" if on_tpu else "float32"
+    cfg = build_config(preset, dtype)
+    d_batch, d_seq, d_steps = DEFAULTS[preset]
+    batch = batch or d_batch
+    seq = min(seq or d_seq, cfg.max_position_embeddings)
+    steps = steps or d_steps
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=3e-4, weight_decay=0.1,
+                                 parameters=model.parameters())
+
+    def loss_fn(m, ids):
+        return m.compute_loss(m(ids), ids)
+
+    step_fn = paddle.jit.TrainStep(model, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32))
+    return step_fn, ids, model, cfg, (batch, seq, steps)
+
+
+def lower_pretrain_step(step_fn, *example_args, lr: float = 3e-4):
+    """Lower (without executing) a TrainStep's jitted program for the given
+    example tensors — the object whose ``compile()`` yields the cost/memory
+    analyses. The ONE place the positional ``_jitted.lower`` incantation
+    lives (used by every preset here and by scripts/capture_evidence.py)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework import random as rnd
+
+    return step_fn._jitted.lower(
+        step_fn._params, step_fn._buffers, step_fn._opt_state,
+        jnp.asarray(lr, jnp.float32), jnp.asarray(1, jnp.int32),
+        rnd.next_key(), tuple(a._data for a in example_args))
+
+
 def _bench_decode(jax, paddle, backend, on_tpu, args):
     """Serving path: KV-cache greedy decode throughput (new tokens/s).
 
@@ -415,14 +469,7 @@ def _bench_ocr(jax, paddle, backend, on_tpu, args):
     dt = _time.perf_counter() - t0
 
     # FLOPs of one whole train step from the compiled executable
-    import jax.numpy as jnp
-
-    from paddle_tpu.framework import random as rnd
-
-    lowered = step_fn._jitted.lower(
-        step_fn._params, step_fn._buffers, step_fn._opt_state,
-        jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.int32), rnd.next_key(),
-        (img._data, gt._data))
+    lowered = lower_pretrain_step(step_fn, img, gt, lr=1e-3)
     from paddle_tpu.utils.xla_cost import cost_of_lowered
 
     cost = cost_of_lowered(lowered) or {}
@@ -503,14 +550,7 @@ def _bench_moe(jax, paddle, backend, on_tpu, args):
     last_loss = float(np.asarray(loss._data))
     dt = time.perf_counter() - t0
 
-    import jax.numpy as jnp
-
-    from paddle_tpu.framework import random as rnd
-
-    lowered = step_fn._jitted.lower(
-        step_fn._params, step_fn._buffers, step_fn._opt_state,
-        jnp.asarray(3e-4, jnp.float32), jnp.asarray(1, jnp.int32), rnd.next_key(),
-        (ids._data,))
+    lowered = lower_pretrain_step(step_fn, ids)
     step_flops = _step_flops_of(lowered)
 
     tokens_per_sec = batch * seq * steps / dt
@@ -592,27 +632,9 @@ def main():
         print(json.dumps(_stamp(result)))
         return
 
-    dtype = "bfloat16" if on_tpu else "float32"
-    cfg = build_config(preset, dtype)
-    batch, seq, steps = DEFAULTS[preset]
-    batch = args.batch or batch
-    seq = min(args.seq or seq, cfg.max_position_embeddings)
-    steps = args.steps or steps
-
-    from paddle_tpu.models import LlamaForCausalLM
-
-    paddle.seed(0)
-    model = LlamaForCausalLM(cfg)
+    step_fn, ids, model, cfg, (batch, seq, steps) = build_pretrain_step(
+        preset, on_tpu, batch=args.batch, seq=args.seq, steps=args.steps)
     n_params = sum(p.size for p in model.parameters())
-    opt = paddle.optimizer.AdamW(learning_rate=3e-4, weight_decay=0.1,
-                                 parameters=model.parameters())
-
-    def loss_fn(m, ids):
-        return m.compute_loss(m(ids), ids)
-
-    step_fn = paddle.jit.TrainStep(model, loss_fn, opt)
-    rng = np.random.default_rng(0)
-    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32))
 
     # warmup/compile
     loss = step_fn(ids)

@@ -10,7 +10,8 @@ from __future__ import annotations
 
 from typing import Optional
 
-__all__ = ["flops_of_lowered", "cost_of_lowered"]
+__all__ = ["flops_of_lowered", "cost_of_lowered", "cost_of_executable",
+           "memory_of_executable"]
 
 
 def cost_of_lowered(lowered) -> Optional[dict]:
@@ -25,6 +26,30 @@ def cost_of_lowered(lowered) -> Optional[dict]:
         if cost and cost.get("flops"):
             return dict(cost)
     return None
+
+
+def cost_of_executable(compiled) -> Optional[dict]:
+    """Executable-level cost analysis from an already-compiled object (avoids
+    the extra compile ``cost_of_lowered``'s fallback would trigger)."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return None
+    return dict(cost) if cost and cost.get("flops") else None
+
+
+def memory_of_executable(compiled) -> Optional[dict]:
+    """Scalar fields of the executable's memory analysis (argument/output/
+    temp/generated-code sizes), or None where the backend omits it."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if mem is None:
+        return None
+    return {k: getattr(mem, k) for k in dir(mem)
+            if not k.startswith("_")
+            and isinstance(getattr(mem, k, None), (int, float))}
 
 
 def flops_of_lowered(lowered) -> Optional[float]:

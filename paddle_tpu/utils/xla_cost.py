@@ -43,13 +43,20 @@ def memory_of_executable(compiled) -> Optional[dict]:
     temp/generated-code sizes), or None where the backend omits it."""
     try:
         mem = compiled.memory_analysis()
+        if mem is None:
+            return None
+        # attribute reads can themselves raise on plugin backends
+        # (e.g. UNIMPLEMENTED), not just AttributeError — keep them in the try
+        out = {}
+        for k in dir(mem):
+            if k.startswith("_"):
+                continue
+            v = getattr(mem, k, None)
+            if isinstance(v, (int, float)):
+                out[k] = v
+        return out or None
     except Exception:
         return None
-    if mem is None:
-        return None
-    return {k: getattr(mem, k) for k in dir(mem)
-            if not k.startswith("_")
-            and isinstance(getattr(mem, k, None), (int, float))}
 
 
 def flops_of_lowered(lowered) -> Optional[float]:

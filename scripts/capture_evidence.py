@@ -44,17 +44,6 @@ import bench  # noqa: E402  (stdlib-only at import time)
 PRETRAIN_PRESETS = tuple(bench.DEFAULTS)
 
 
-def _git_sha() -> str:
-    import subprocess
-
-    try:
-        return subprocess.run(["git", "rev-parse", "--short", "HEAD"],
-                              capture_output=True, text=True, timeout=10,
-                              cwd=REPO).stdout.strip()
-    except Exception:
-        return "unknown"
-
-
 def _device_record(jax) -> dict:
     dev = jax.devices()[0]
     return {
@@ -64,7 +53,7 @@ def _device_record(jax) -> dict:
         "jax_version": jax.__version__,
         "default_backend": jax.default_backend(),
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        "git_sha": _git_sha(),
+        "git_sha": bench.git_short_sha() or "unknown",
     }
 
 
@@ -119,13 +108,17 @@ def main() -> None:
     print(f"[evidence] device: {device['device_kind']} "
           f"({device['default_backend']})")
 
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework import random as rnd
+
     on_tpu = jax.default_backend() != "cpu"
     profiled = False
     for preset in presets:
-        step_fn, ids, _model, _cfg, _ = bench.build_pretrain_step(
+        step_fn, ids, model, _cfg, _ = bench.build_pretrain_step(
             preset, on_tpu)
         lowered = bench.lower_pretrain_step(step_fn, ids)
-        compiled = lowered.compile()  # the ONE compile; analyses come from it
+        compiled = lowered.compile()  # the ONE compile per preset
         rec = {"preset": preset, **device, **_cost_record(compiled)}
         path = os.path.join(EVIDENCE, f"cost_{preset}.json")
         with open(path, "w") as f:
@@ -134,20 +127,29 @@ def main() -> None:
         print(f"[evidence] {path}: flops={flops}")
 
         if not profiled:
-            # one xplane trace of real steps on the first preset; a fresh
-            # per-run directory so only THIS run's files count as evidence
+            # one xplane trace of real steps on the first preset, executing
+            # the AOT executable directly (the jax.jit path would trigger a
+            # SECOND full remote compile). donate_argnums=(0,2) invalidates
+            # the inputs, so thread params/opt_state through the loop; a
+            # fresh per-run directory so only THIS run's files count.
             stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
             xdir = os.path.join(EVIDENCE, "xplane", stamp)
+            params, buffers = step_fn._params, step_fn._buffers
+            opt_state = step_fn._opt_state
+
+            def run_step(params, opt_state):
+                loss, params, opt_state = compiled(
+                    params, buffers, opt_state,
+                    jnp.asarray(3e-4, jnp.float32), jnp.asarray(1, jnp.int32),
+                    rnd.next_key(), (ids._data,))
+                float(np.asarray(loss))  # host read = sync
+                return params, opt_state
+
             try:
-                # warmup OUTSIDE the trace: step_fn goes through jax.jit,
-                # whose cache the AOT lowered.compile() above does not seed —
-                # without this the trace would be compile-dominated
-                out = step_fn(ids)
-                float(np.asarray(out._data))
+                params, opt_state = run_step(params, opt_state)  # warmup
                 with jax.profiler.trace(xdir):
                     for _ in range(args.profile_steps):
-                        out = step_fn(ids)
-                        float(np.asarray(out._data))  # host read = sync
+                        params, opt_state = run_step(params, opt_state)
                 names = [os.path.join(dp, fn)
                          for dp, _, fns in os.walk(xdir) for fn in fns]
                 print(f"[evidence] xplane trace ({stamp}): {len(names)} files")
@@ -155,11 +157,12 @@ def main() -> None:
             except Exception as exc:
                 print(f"[evidence] profiler unavailable: {exc!r}",
                       file=sys.stderr)
-            out = None
+            del params, buffers, opt_state
         # the next preset allocates its own full model + AdamW state; two
         # resident 0.7B-class train states exceed the 16GB chip — release
-        # this preset's before building the next
-        del step_fn, lowered, compiled
+        # EVERYTHING holding this preset's buffers (model Parameters and
+        # TrainStep state included) before building the next
+        del step_fn, lowered, compiled, model, ids
 
 
 if __name__ == "__main__":

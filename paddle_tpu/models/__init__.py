@@ -18,6 +18,8 @@ from . import ernie  # noqa: F401
 from . import hf_compat  # noqa: F401
 from . import ocr  # noqa: F401
 from .hf_compat import (  # noqa: F401
+    ernie_config_from_transformers,
+    ernie_from_transformers,
     llama_config_from_transformers,
     llama_from_transformers,
     llama_to_transformers_state_dict,

@@ -57,7 +57,12 @@ class ErnieEmbeddings(nn.Layer):
         seq = input_ids.shape[1]
         pos = Tensor(jnp.arange(seq, dtype=jnp.int32)[None, :])
         x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
-        if token_type_ids is not None:
+        if token_type_ids is None:
+            # sentence-A (row 0) is the default segment, not "no segment" —
+            # the reference/BERT convention; skipping the table would shift
+            # every embedding by -task_type_row_0
+            x = x + self.token_type_embeddings.weight[0]
+        else:
             x = x + self.token_type_embeddings(token_type_ids)
         return self.dropout(self.layer_norm(x))
 

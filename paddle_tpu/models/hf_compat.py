@@ -1,4 +1,4 @@
-"""HuggingFace-transformers checkpoint interop for the Llama family.
+"""HuggingFace-transformers checkpoint interop (Llama + ERNIE/BERT).
 
 The reference ecosystem ships pretrained weights through its hub
 (``/root/reference/python/paddle/hapi/hub.py:1``) and PaddleNLP converts
@@ -36,6 +36,8 @@ __all__ = [
     "llama_config_from_transformers",
     "llama_from_transformers",
     "llama_to_transformers_state_dict",
+    "ernie_config_from_transformers",
+    "ernie_from_transformers",
 ]
 
 
@@ -71,13 +73,16 @@ def _hf_state_dict(src) -> Mapping[str, np.ndarray]:
     return out
 
 
-def _k(sd: Mapping[str, np.ndarray], name: str) -> np.ndarray:
-    """Fetch ``name`` tolerating the optional ``model.`` prefix transformers
-    uses on ``LlamaForCausalLM`` (absent when converting a bare LlamaModel)."""
+def _k(sd: dict, name: str) -> np.ndarray:
+    """Pop ``name`` tolerating the optional ``model.`` prefix transformers
+    uses on ``LlamaForCausalLM`` (absent when converting a bare LlamaModel).
+    Destructive on the converter's private dict on purpose: releasing each
+    tensor as it is consumed keeps peak host memory near ONE fp32 copy of
+    the checkpoint while the fused layout is built."""
     if name in sd:
-        return sd[name]
+        return sd.pop(name)
     if "model." + name in sd:
-        return sd["model." + name]
+        return sd.pop("model." + name)
     raise KeyError(f"HF checkpoint is missing {name!r} "
                    f"(have e.g. {list(sd)[:4]})")
 
@@ -93,6 +98,10 @@ def llama_from_transformers(src, config: Optional[LlamaConfig] = None,
     the instance carries one. ``config_overrides`` tweak the derived config
     (e.g. ``dtype="bfloat16", param_dtype="float32"`` for the TPU recipe).
     """
+    if config is not None and config_overrides:
+        raise ValueError("config= and config overrides are mutually "
+                         "exclusive — bake the overrides into the config "
+                         f"you pass (got {sorted(config_overrides)})")
     if config is None:
         if not hasattr(src, "config"):
             raise ValueError("pass config= when converting from a bare "
@@ -127,13 +136,15 @@ def llama_from_transformers(src, config: Optional[LlamaConfig] = None,
     ours["llama.norm.weight"] = _k(sd, "norm.weight")
     if not config.tie_word_embeddings:
         if "lm_head.weight" in sd:
-            ours["lm_head"] = sd["lm_head.weight"].T
+            ours["lm_head"] = sd.pop("lm_head.weight").T
         else:  # HF instance was tied but our config says untied: share
             ours["lm_head"] = ours["llama.embed_tokens"].T
 
     model = LlamaForCausalLM(config)
-    model.set_state_dict({k: np.ascontiguousarray(v, dtype=np.float32)
-                          for k, v in ours.items()})
+    # ours holds views/fused arrays over the (already consumed) source dict;
+    # set_state_dict copies per-tensor onto the device, so no second full
+    # host copy is materialized here
+    model.set_state_dict(ours)
     return model
 
 
@@ -168,3 +179,128 @@ def llama_to_transformers_state_dict(model: LlamaForCausalLM) -> dict:
     if "lm_head" in sd:
         out["lm_head.weight"] = sd["lm_head"].T
     return out
+
+
+# ---------------------------------------------------------------------------
+# ERNIE / BERT (post-LN encoder family)
+# ---------------------------------------------------------------------------
+
+_ENC_PREFIXES = ("", "ernie.", "bert.", "model.")
+
+
+def _ek(sd: Mapping[str, np.ndarray], name: str) -> np.ndarray:
+    """Fetch ``name`` tolerating the task-model prefixes transformers uses
+    (``ernie.``/``bert.`` on classification heads, none on the bare model)."""
+    for p in _ENC_PREFIXES:
+        if p + name in sd:
+            return sd[p + name]
+    raise KeyError(f"HF checkpoint is missing {name!r} "
+                   f"(have e.g. {sorted(sd)[:4]})")
+
+
+def ernie_config_from_transformers(hf_config, **overrides):
+    """Build an :class:`~paddle_tpu.models.ErnieConfig` from a transformers
+    Ernie/Bert config (duck-typed by attribute names)."""
+    from .ernie import ErnieConfig
+
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_hidden_layers=hf_config.num_hidden_layers,
+        num_attention_heads=hf_config.num_attention_heads,
+        intermediate_size=hf_config.intermediate_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        type_vocab_size=getattr(hf_config, "type_vocab_size", 2),
+        hidden_dropout_prob=getattr(hf_config, "hidden_dropout_prob", 0.1),
+        attention_probs_dropout_prob=getattr(
+            hf_config, "attention_probs_dropout_prob", 0.1),
+    )
+    kw.update(overrides)
+    return ErnieConfig(**kw)
+
+
+def ernie_from_transformers(src, config=None, layer_norm_eps=None,
+                            **config_overrides):
+    """Convert a transformers Ernie/Bert checkpoint into
+    :class:`~paddle_tpu.models.ErnieModel` (bare model) or
+    :class:`~paddle_tpu.models.ErnieForSequenceClassification` (when the
+    checkpoint carries a ``classifier`` head).
+
+    Both families share the BERT post-LN layout; the deltas are the same
+    [out,in]→[in,out] transposes as Llama plus the name scheme
+    (``attention.self.query`` → ``self_attn.q_proj`` etc.).  ERNIE
+    checkpoints trained with ``use_task_id=True`` carry an extra
+    task-type-embedding table our encoder deliberately omits — rejected
+    explicitly rather than silently dropped.
+    """
+    from .ernie import ErnieForSequenceClassification, ErnieModel
+
+    if config is not None and config_overrides:
+        raise ValueError("config= and config overrides are mutually "
+                         "exclusive — bake the overrides into the config "
+                         f"you pass (got {sorted(config_overrides)})")
+    if config is None:
+        if not hasattr(src, "config"):
+            raise ValueError("pass config= when converting from a bare "
+                             "state dict")
+        config = ernie_config_from_transformers(src.config,
+                                                **config_overrides)
+    if layer_norm_eps is None:
+        # state-dict inputs carry no config: callers whose checkpoint used a
+        # non-BERT eps (e.g. 1e-5) must pass layer_norm_eps= explicitly
+        layer_norm_eps = getattr(getattr(src, "config", None),
+                                 "layer_norm_eps", 1e-12)
+    sd = _hf_state_dict(src)
+    if any("task_type_embeddings" in k for k in sd):
+        raise ValueError(
+            "checkpoint was trained with use_task_id=True (task-type "
+            "embeddings present); re-export it with use_task_id=False or "
+            "strip the table if the task id is constant")
+
+    ours: dict = {}
+    e = "ernie.embeddings."
+    ours[e + "word_embeddings.weight"] = _ek(sd, "embeddings.word_embeddings.weight")
+    ours[e + "position_embeddings.weight"] = _ek(
+        sd, "embeddings.position_embeddings.weight")
+    ours[e + "token_type_embeddings.weight"] = _ek(
+        sd, "embeddings.token_type_embeddings.weight")
+    ours[e + "layer_norm.weight"] = _ek(sd, "embeddings.LayerNorm.weight")
+    ours[e + "layer_norm.bias"] = _ek(sd, "embeddings.LayerNorm.bias")
+    for i in range(config.num_hidden_layers):
+        p = f"encoder.layer.{i}."
+        o = f"ernie.encoder.layers.{i}."
+        for theirs, mine in (("attention.self.query", "self_attn.q_proj"),
+                             ("attention.self.key", "self_attn.k_proj"),
+                             ("attention.self.value", "self_attn.v_proj"),
+                             ("attention.output.dense", "self_attn.out_proj"),
+                             ("intermediate.dense", "linear1"),
+                             ("output.dense", "linear2")):
+            ours[o + mine + ".weight"] = _ek(sd, p + theirs + ".weight").T
+            ours[o + mine + ".bias"] = _ek(sd, p + theirs + ".bias")
+        ours[o + "norm1.weight"] = _ek(sd, p + "attention.output.LayerNorm.weight")
+        ours[o + "norm1.bias"] = _ek(sd, p + "attention.output.LayerNorm.bias")
+        ours[o + "norm2.weight"] = _ek(sd, p + "output.LayerNorm.weight")
+        ours[o + "norm2.bias"] = _ek(sd, p + "output.LayerNorm.bias")
+    ours["ernie.pooler.weight"] = _ek(sd, "pooler.dense.weight").T
+    ours["ernie.pooler.bias"] = _ek(sd, "pooler.dense.bias")
+
+    has_classifier = any(k.startswith("classifier.") for k in sd)
+    if has_classifier:
+        ours["classifier.weight"] = sd["classifier.weight"].T
+        ours["classifier.bias"] = sd["classifier.bias"]
+        model = ErnieForSequenceClassification(
+            config, num_classes=sd["classifier.weight"].shape[0])
+    else:
+        model = ErnieModel(config)
+        ours = {k[len("ernie."):]: v for k, v in ours.items()}
+
+    model.set_state_dict(ours)
+
+    # transformers' eps (1e-12 for BERT/ERNIE) differs from the paddle-style
+    # LayerNorm default (1e-5); pin every norm to the checkpoint's value
+    from ..nn import LayerNorm
+
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, LayerNorm):
+            layer.epsilon = layer_norm_eps
+    return model

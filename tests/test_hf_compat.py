@@ -87,3 +87,101 @@ def test_missing_key_reports_name():
     with pytest.raises(KeyError, match="up_proj"):
         llama_from_transformers(sd,
                                 config=llama_config_from_transformers(hf.config))
+
+
+# ---------------------------------------------------------------------------
+# ERNIE / BERT
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.models.hf_compat import (ernie_config_from_transformers,  # noqa: E402
+                                         ernie_from_transformers)
+
+
+def _tiny_hf_ernie(cls_head=False, num_labels=3):
+    cfg = transformers.ErnieConfig(
+        vocab_size=120, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=72,
+        max_position_embeddings=64, type_vocab_size=2, use_task_id=False,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        num_labels=num_labels, attn_implementation="eager")
+    torch.manual_seed(5)
+    cls = (transformers.ErnieForSequenceClassification if cls_head
+           else transformers.ErnieModel)
+    m = cls(cfg).eval()
+    return m
+
+
+def test_ernie_encoder_parity():
+    hf = _tiny_hf_ernie()
+    model = ernie_from_transformers(hf).eval()
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 120, size=(2, 12)).astype(np.int32)
+    tok = np.zeros_like(ids)
+    with torch.no_grad():
+        out = hf(torch.tensor(ids.astype(np.int64)),
+                 token_type_ids=torch.tensor(tok.astype(np.int64)))
+    seq, pooled = model(paddle.to_tensor(ids), paddle.to_tensor(tok))
+    np.testing.assert_allclose(np.asarray(seq._data),
+                               out.last_hidden_state.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(pooled._data),
+                               out.pooler_output.numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ernie_classification_head_parity():
+    hf = _tiny_hf_ernie(cls_head=True)
+    model = ernie_from_transformers(hf).eval()
+    assert model.num_classes == 3
+    ids = (np.arange(20, dtype=np.int32).reshape(2, 10) * 5) % 120
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    got = np.asarray(model(paddle.to_tensor(ids))._data)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_ernie_bert_checkpoint_also_loads():
+    """BERT shares the layout; the converter accepts bert.* prefixes too."""
+    cfg = transformers.BertConfig(
+        vocab_size=99, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=32, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, attn_implementation="eager")
+    torch.manual_seed(9)
+    hf = transformers.BertModel(cfg).eval()
+    model = ernie_from_transformers(
+        hf, config=ernie_config_from_transformers(cfg)).eval()
+    ids = np.arange(8, dtype=np.int32)[None] % 99
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64))).last_hidden_state.numpy()
+    seq, _ = model(paddle.to_tensor(ids))
+    np.testing.assert_allclose(np.asarray(seq._data), ref,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ernie_task_type_checkpoint_rejected():
+    hf = _tiny_hf_ernie()
+    sd = {k: v.detach().float().numpy() for k, v in hf.state_dict().items()}
+    sd["embeddings.task_type_embeddings.weight"] = np.zeros((3, 48), np.float32)
+    with pytest.raises(ValueError, match="use_task_id"):
+        ernie_from_transformers(sd,
+                                config=ernie_config_from_transformers(hf.config))
+
+
+def test_explicit_config_plus_overrides_rejected():
+    hf = _tiny_hf()
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        llama_from_transformers(
+            hf, config=llama_config_from_transformers(hf.config),
+            use_flash_attention=False)
+
+
+def test_ernie_eps_override_for_state_dicts():
+    hf = _tiny_hf_ernie()
+    sd = {k: v.detach().float().numpy() for k, v in hf.state_dict().items()}
+    m = ernie_from_transformers(sd,
+                                config=ernie_config_from_transformers(hf.config),
+                                layer_norm_eps=1e-5)
+    from paddle_tpu.nn import LayerNorm
+    eps = {l.epsilon for l in m.sublayers() if isinstance(l, LayerNorm)}
+    assert eps == {1e-5}

@@ -73,18 +73,30 @@ def _hf_state_dict(src) -> Mapping[str, np.ndarray]:
     return out
 
 
-def _k(sd: dict, name: str) -> np.ndarray:
-    """Pop ``name`` tolerating the optional ``model.`` prefix transformers
-    uses on ``LlamaForCausalLM`` (absent when converting a bare LlamaModel).
-    Destructive on the converter's private dict on purpose: releasing each
-    tensor as it is consumed keeps peak host memory near ONE fp32 copy of
-    the checkpoint while the fused layout is built."""
-    if name in sd:
-        return sd.pop(name)
-    if "model." + name in sd:
-        return sd.pop("model." + name)
+def _fetch(sd: Mapping[str, np.ndarray], name: str,
+           prefixes=("", "model."), pop: bool = False) -> np.ndarray:
+    """Fetch ``name`` tolerating the task-model prefixes transformers uses
+    (``model.`` on ``LlamaForCausalLM``, ``ernie.``/``bert.`` on
+    classification heads, none on bare models).  ``pop=True`` is destructive
+    on the converter's private dict on purpose: releasing each tensor as it
+    is consumed keeps peak host memory near ONE fp32 copy of the checkpoint
+    while the fused layout is built."""
+    for p in prefixes:
+        if p + name in sd:
+            return sd.pop(p + name) if pop else sd[p + name]
     raise KeyError(f"HF checkpoint is missing {name!r} "
-                   f"(have e.g. {list(sd)[:4]})")
+                   f"(have e.g. {sorted(sd)[:4]})")
+
+
+def _check_config_exclusive(config, config_overrides) -> None:
+    if config is not None and config_overrides:
+        raise ValueError("config= and config overrides are mutually "
+                         "exclusive — bake the overrides into the config "
+                         f"you pass (got {sorted(config_overrides)})")
+
+
+def _k(sd: dict, name: str) -> np.ndarray:
+    return _fetch(sd, name, pop=True)
 
 
 def llama_from_transformers(src, config: Optional[LlamaConfig] = None,
@@ -98,10 +110,7 @@ def llama_from_transformers(src, config: Optional[LlamaConfig] = None,
     the instance carries one. ``config_overrides`` tweak the derived config
     (e.g. ``dtype="bfloat16", param_dtype="float32"`` for the TPU recipe).
     """
-    if config is not None and config_overrides:
-        raise ValueError("config= and config overrides are mutually "
-                         "exclusive — bake the overrides into the config "
-                         f"you pass (got {sorted(config_overrides)})")
+    _check_config_exclusive(config, config_overrides)
     if config is None:
         if not hasattr(src, "config"):
             raise ValueError("pass config= when converting from a bare "
@@ -189,13 +198,7 @@ _ENC_PREFIXES = ("", "ernie.", "bert.", "model.")
 
 
 def _ek(sd: Mapping[str, np.ndarray], name: str) -> np.ndarray:
-    """Fetch ``name`` tolerating the task-model prefixes transformers uses
-    (``ernie.``/``bert.`` on classification heads, none on the bare model)."""
-    for p in _ENC_PREFIXES:
-        if p + name in sd:
-            return sd[p + name]
-    raise KeyError(f"HF checkpoint is missing {name!r} "
-                   f"(have e.g. {sorted(sd)[:4]})")
+    return _fetch(sd, name, _ENC_PREFIXES)
 
 
 def ernie_config_from_transformers(hf_config, **overrides):
@@ -203,6 +206,17 @@ def ernie_config_from_transformers(hf_config, **overrides):
     Ernie/Bert config (duck-typed by attribute names)."""
     from .ernie import ErnieConfig
 
+    act = getattr(hf_config, "hidden_act", "gelu")
+    if act != "gelu":
+        raise ValueError(
+            f"checkpoint uses hidden_act={act!r} but the encoder hardcodes "
+            "exact gelu — converting it would compute silently wrong "
+            "hidden states")
+    pet = getattr(hf_config, "position_embedding_type", "absolute")
+    if pet != "absolute":
+        raise ValueError(
+            f"checkpoint uses position_embedding_type={pet!r} but the "
+            "encoder implements learned absolute positions only")
     kw = dict(
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,
@@ -235,10 +249,7 @@ def ernie_from_transformers(src, config=None, layer_norm_eps=None,
     """
     from .ernie import ErnieForSequenceClassification, ErnieModel
 
-    if config is not None and config_overrides:
-        raise ValueError("config= and config overrides are mutually "
-                         "exclusive — bake the overrides into the config "
-                         f"you pass (got {sorted(config_overrides)})")
+    _check_config_exclusive(config, config_overrides)
     if config is None:
         if not hasattr(src, "config"):
             raise ValueError("pass config= when converting from a bare "
@@ -284,8 +295,13 @@ def ernie_from_transformers(src, config=None, layer_norm_eps=None,
     ours["ernie.pooler.weight"] = _ek(sd, "pooler.dense.weight").T
     ours["ernie.pooler.bias"] = _ek(sd, "pooler.dense.bias")
 
-    has_classifier = any(k.startswith("classifier.") for k in sd)
-    if has_classifier:
+    cls_keys = sorted(k for k in sd if k.startswith("classifier."))
+    if cls_keys:
+        if "classifier.weight" not in sd:
+            raise ValueError(
+                f"unsupported classifier head layout {cls_keys}: only a "
+                "single-Linear head (classifier.weight/bias) converts; "
+                "RoBERTa-style multi-layer heads need a custom head")
         ours["classifier.weight"] = sd["classifier.weight"].T
         ours["classifier.bias"] = sd["classifier.bias"]
         model = ErnieForSequenceClassification(

@@ -53,6 +53,19 @@ while true; do
         sweep_ok=1
         for p in $PRESETS; do
             if [ $FORCE -eq 1 ] || ! have_preset "$p"; then
+                # the plugin can wedge BETWEEN presets (observed 03:18 window:
+                # probe OK, then the tunnel died mid-compile and every later
+                # preset would have burned its full 2400s timeout on a dead
+                # connection). A cheap re-probe gates each preset so a wedge
+                # aborts the sweep back to probing cadence within minutes.
+                if ! probe >/dev/null; then
+                    log "re-probe before preset $p failed; aborting sweep"
+                    sweep_ok=0
+                    # ran=1 so the bottom-of-loop sleep is the short one:
+                    # back to the top-of-loop probe in 60s, not 900s
+                    ran=1
+                    break
+                fi
                 log "running preset $p"
                 out=$(timeout 2400 python bench.py --preset "$p" --device tpu 2>>"$LOG")
                 rc=$?
@@ -90,7 +103,8 @@ while true; do
         # sentinel is cost_base.json (written AFTER the expensive compile),
         # not device.json (written before it): a capture that wedged mid-
         # compile must be retried on the next live iteration
-        if [ ! -f evidence/cost_base.json ] || { [ $FORCE -eq 1 ] && [ $sweep_ok -eq 1 ]; }; then
+        if { [ ! -f evidence/cost_base.json ] || { [ $FORCE -eq 1 ] && [ $sweep_ok -eq 1 ]; }; } \
+               && probe >/dev/null; then
             log "running capture_evidence"
             if timeout 2400 python scripts/capture_evidence.py \
                    --presets base >>"$LOG" 2>&1; then

@@ -185,3 +185,36 @@ def test_ernie_eps_override_for_state_dicts():
     from paddle_tpu.nn import LayerNorm
     eps = {l.epsilon for l in m.sublayers() if isinstance(l, LayerNorm)}
     assert eps == {1e-5}
+
+
+def test_ernie_unsupported_activation_rejected():
+    """The encoder hardcodes exact gelu; a relu/gelu_new checkpoint must be
+    rejected at conversion instead of silently computing wrong states."""
+    cfg = transformers.BertConfig(
+        vocab_size=99, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=64, hidden_act="relu",
+        max_position_embeddings=32)
+    with pytest.raises(ValueError, match="hidden_act"):
+        ernie_config_from_transformers(cfg)
+
+
+def test_ernie_relative_positions_rejected():
+    cfg = transformers.BertConfig(
+        vocab_size=99, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=64,
+        position_embedding_type="relative_key",
+        max_position_embeddings=32)
+    with pytest.raises(ValueError, match="position_embedding_type"):
+        ernie_config_from_transformers(cfg)
+
+
+def test_multi_layer_classifier_head_rejected():
+    """RoBERTa-style heads (classifier.dense + classifier.out_proj) must get
+    a descriptive error, not a bare KeyError on classifier.weight."""
+    hf = _tiny_hf_ernie()
+    sd = {k: v.detach().float().numpy() for k, v in hf.state_dict().items()}
+    sd["classifier.dense.weight"] = np.zeros((48, 48), np.float32)
+    sd["classifier.out_proj.weight"] = np.zeros((3, 48), np.float32)
+    with pytest.raises(ValueError, match="classifier head layout"):
+        ernie_from_transformers(sd,
+                                config=ernie_config_from_transformers(hf.config))

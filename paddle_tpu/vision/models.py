@@ -7,6 +7,11 @@ from .. import nn
 __all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152", "VGG", "vgg16",
            "MobileNetV3", "mobilenet_v3_small", "mobilenet_v3_large"]
 
+# the rest of the zoo (AlexNet/DenseNet/GoogLeNet/InceptionV3/MobileNetV1-V2/
+# ShuffleNetV2/SqueezeNet/ResNeXt/wide-ResNet) lives in models_zoo.py; its
+# names are re-exported here at the bottom of this module so
+# ``paddle.vision.models.<name>`` matches the reference surface.
+
 
 class LeNet(nn.Layer):
     def __init__(self, num_classes=10):
@@ -55,14 +60,17 @@ class BasicBlock(nn.Layer):
 class BottleneckBlock(nn.Layer):
     expansion = 4
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None, norm_layer=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None, norm_layer=None,
+                 groups=1, base_width=64):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
-        self.conv1 = nn.Conv2D(inplanes, planes, 1, bias_attr=False)
-        self.bn1 = norm_layer(planes)
-        self.conv2 = nn.Conv2D(planes, planes, 3, stride=stride, padding=1, bias_attr=False)
-        self.bn2 = norm_layer(planes)
-        self.conv3 = nn.Conv2D(planes, planes * self.expansion, 1, bias_attr=False)
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
+        self.bn1 = norm_layer(width)
+        self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=1,
+                               groups=groups, bias_attr=False)
+        self.bn2 = norm_layer(width)
+        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1, bias_attr=False)
         self.bn3 = norm_layer(planes * self.expansion)
         self.relu = nn.ReLU()
         self.downsample = downsample
@@ -80,11 +88,14 @@ class BottleneckBlock(nn.Layer):
 class ResNet(nn.Layer):
     """Reference: ``python/paddle/vision/models/resnet.py``."""
 
-    def __init__(self, block, depth=50, width=64, num_classes=1000, with_pool=True, in_channels=3):
+    def __init__(self, block, depth=50, width=64, num_classes=1000, with_pool=True, in_channels=3,
+                 groups=1, width_per_group=64):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
         layers = layer_cfg[depth]
+        self.groups = groups
+        self.base_width = width_per_group
         self.inplanes = 64
         self.conv1 = nn.Conv2D(in_channels, self.inplanes, 7, stride=2, padding=3, bias_attr=False)
         self.bn1 = nn.BatchNorm2D(self.inplanes)
@@ -108,10 +119,12 @@ class ResNet(nn.Layer):
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1, stride=stride, bias_attr=False),
                 nn.BatchNorm2D(planes * block.expansion),
             )
-        layers = [block(self.inplanes, planes, stride, downsample)]
+        extra = ({"groups": self.groups, "base_width": self.base_width}
+                 if block is BottleneckBlock else {})
+        layers = [block(self.inplanes, planes, stride, downsample, **extra)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes))
+            layers.append(block(self.inplanes, planes, **extra))
         return nn.Sequential(*layers)
 
     def forward(self, x):
@@ -315,3 +328,9 @@ def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
     return MobileNetV3(_MOBILENETV3_LARGE, last_channel=1280, scale=scale, **kwargs)
+
+
+from .models_zoo import *  # noqa: E402,F401,F403
+from .models_zoo import __all__ as _zoo_all  # noqa: E402
+
+__all__ = __all__ + _zoo_all

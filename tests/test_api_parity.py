@@ -251,6 +251,7 @@ SUBMODULE_ABSENT = {
     ("distributed/__init__.py", "distributed"),
     ("vision/transforms/__init__.py", "vision.transforms"),
     ("vision/ops.py", "vision.ops"),
+    ("vision/models/__init__.py", "vision.models"),
     ("nn/__init__.py", "nn"), ("nn/functional/__init__.py", "nn.functional"),
     ("linalg.py", "linalg"), ("signal.py", "signal"),
     ("audio/__init__.py", "audio"), ("text/__init__.py", "text"),

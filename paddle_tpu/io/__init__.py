@@ -24,7 +24,7 @@ from ..framework.tensor import Tensor
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset", "ChainDataset",
     "Subset", "ConcatDataset", "random_split", "BatchSampler", "Sampler", "SequenceSampler",
-    "RandomSampler", "WeightedRandomSampler", "DistributedBatchSampler", "DataLoader",
+    "RandomSampler", "SubsetRandomSampler", "WeightedRandomSampler", "DistributedBatchSampler", "DataLoader",
     "default_collate_fn", "get_worker_info", "batch",
 ]
 
@@ -166,6 +166,23 @@ class RandomSampler(Sampler):
 
     def __len__(self):
         return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    """Sample the given indices in a random order, without replacement
+    (reference: ``python/paddle/io/dataloader/sampler.py:391``)."""
+
+    def __init__(self, indices):
+        if len(indices) == 0:
+            raise ValueError("indices of SubsetRandomSampler should not be empty")
+        self.indices = list(indices)
+
+    def __iter__(self):
+        rng = np.random.RandomState(abs(hash((rnd.default_generator().initial_seed, id(self)))) % (2 ** 31))
+        return iter(np.asarray(self.indices, dtype=np.int64)[rng.permutation(len(self.indices))].tolist())
+
+    def __len__(self):
+        return len(self.indices)
 
 
 class WeightedRandomSampler(Sampler):

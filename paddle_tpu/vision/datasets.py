@@ -160,3 +160,104 @@ class ImageFolder(DatasetFolder):
         if self.transform:
             img = self.transform(img)
         return (img,)
+
+
+class Flowers(Dataset):
+    """Flowers102 (reference: ``python/paddle/vision/datasets/flowers.py``).
+
+    Reads the standard distribution files locally (no network): the image
+    tarball (``102flowers.tgz`` — jpg members), ``imagelabels.mat`` and
+    ``setid.mat``.  Keeps the reference's historical split quirk:
+    ``mode='train'`` reads the ``tstid`` subset (6149 images) and
+    ``mode='test'`` reads ``trnid`` (1020), matching its MODE_FLAG_MAP.
+    """
+
+    _MODE_FLAG = {"train": "tstid", "test": "trnid", "valid": "valid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        if mode.lower() not in self._MODE_FLAG:
+            raise ValueError(f"mode should be 'train', 'test' or 'valid', got {mode}")
+        root = os.path.expanduser("~/.cache/paddle_tpu/flowers")
+        data_file = data_file or os.path.join(root, "102flowers.tgz")
+        label_file = label_file or os.path.join(root, "imagelabels.mat")
+        setid_file = setid_file or os.path.join(root, "setid.mat")
+        for p in (data_file, label_file, setid_file):
+            if not os.path.exists(p):
+                raise FileNotFoundError(
+                    f"Flowers file not found at {p}; no network access — place files locally")
+        import scipy.io
+
+        self.transform = transform
+        self.backend = backend
+        labels = scipy.io.loadmat(label_file)["labels"][0]
+        indexes = scipy.io.loadmat(setid_file)[self._MODE_FLAG[mode.lower()]][0]
+        self._tar = tarfile.open(data_file)
+        self._members = {os.path.basename(m.name): m
+                         for m in self._tar.getmembers() if m.name.endswith(".jpg")}
+        self.samples = [(f"image_{idx:05d}.jpg", int(labels[idx - 1]))
+                        for idx in indexes]
+
+    def __getitem__(self, idx):
+        name, label = self.samples[idx]
+        from PIL import Image
+
+        img = Image.open(self._tar.extractfile(self._members[name])).convert("RGB")
+        if self.backend != "pil":
+            img = np.asarray(img)
+        if self.transform:
+            img = self.transform(img)
+        return img, np.array([label], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (reference:
+    ``python/paddle/vision/datasets/voc2012.py``).
+
+    Reads the standard ``VOCtrainval_11-May-2012.tar`` locally.  Split map
+    matches the reference: ``mode='train'`` → ``trainval.txt``,
+    ``'test'`` → ``train.txt``, ``'valid'`` → ``val.txt``; each item is an
+    (image, segmentation-mask) pair.
+    """
+
+    _MODE_FLAG = {"train": "trainval", "test": "train", "valid": "val"}
+    _SET = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    _IMG = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    _LBL = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if mode.lower() not in self._MODE_FLAG:
+            raise ValueError(f"mode should be 'train', 'test' or 'valid', got {mode}")
+        data_file = data_file or os.path.expanduser(
+            "~/.cache/paddle_tpu/voc2012/VOCtrainval_11-May-2012.tar")
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"VOC2012 archive not found at {data_file}; no network access — place file locally")
+        self.transform = transform
+        self.backend = backend
+        self._tar = tarfile.open(data_file)
+        names = self._tar.extractfile(
+            self._SET.format(self._MODE_FLAG[mode.lower()])).read().split()
+        self.samples = [n.decode() for n in names]
+
+    def __getitem__(self, idx):
+        from PIL import Image
+
+        name = self.samples[idx]
+        img = Image.open(self._tar.extractfile(self._IMG.format(name))).convert("RGB")
+        lbl = Image.open(self._tar.extractfile(self._LBL.format(name)))
+        if self.backend != "pil":
+            img, lbl = np.asarray(img), np.asarray(lbl)
+        if self.transform:
+            img = self.transform(img)
+        return img, lbl
+
+    def __len__(self):
+        return len(self.samples)
+
+
+__all__ += ["Flowers", "VOC2012"]

@@ -1,0 +1,156 @@
+"""Flowers/VOC2012 local-archive parsing, SubsetRandomSampler, and the
+image-backend trio (reference: ``python/paddle/vision/datasets/flowers.py``,
+``voc2012.py``, ``python/paddle/io/dataloader/sampler.py:391``,
+``python/paddle/vision/image.py``)."""
+
+import io as _io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.datasets import Flowers, VOC2012
+
+
+def _add_bytes(tar, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tar.addfile(info, _io.BytesIO(data))
+
+
+def _jpg_bytes(w=8, h=8, color=(255, 0, 0)):
+    from PIL import Image
+
+    buf = _io.BytesIO()
+    Image.new("RGB", (w, h), color).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _png_bytes(w=8, h=8, value=3):
+    from PIL import Image
+
+    buf = _io.BytesIO()
+    Image.fromarray(np.full((h, w), value, np.uint8), mode="L").save(buf, format="PNG")
+    return buf.getvalue()
+
+
+@pytest.fixture
+def flowers_files(tmp_path):
+    import scipy.io
+
+    data_file = tmp_path / "102flowers.tgz"
+    with tarfile.open(data_file, "w:gz") as tar:
+        for i in range(1, 7):
+            _add_bytes(tar, f"jpg/image_{i:05d}.jpg", _jpg_bytes(color=(i * 30, 0, 0)))
+    label_file = tmp_path / "imagelabels.mat"
+    scipy.io.savemat(label_file, {"labels": np.arange(1, 7)[None]})
+    setid_file = tmp_path / "setid.mat"
+    scipy.io.savemat(setid_file, {"tstid": np.array([[1, 2, 3, 4]]),
+                                  "trnid": np.array([[5]]),
+                                  "valid": np.array([[6]])})
+    return str(data_file), str(label_file), str(setid_file)
+
+
+def test_flowers_split_quirk_and_labels(flowers_files):
+    data, labels, setid = flowers_files
+    # reference MODE_FLAG_MAP: train reads tstid, test reads trnid
+    train = Flowers(data, labels, setid, mode="train", backend="cv2")
+    test = Flowers(data, labels, setid, mode="test", backend="cv2")
+    assert (len(train), len(test)) == (4, 1)
+    img, label = train[0]
+    assert img.shape == (8, 8, 3) and label.dtype == np.int64
+    assert int(label[0]) == 1          # imagelabels.mat is 1-indexed by image id
+    assert int(test[0][1][0]) == 5
+
+
+def test_flowers_transform_and_pil_backend(flowers_files):
+    data, labels, setid = flowers_files
+    ds = Flowers(data, labels, setid, mode="valid", backend="pil",
+                 transform=lambda im: np.asarray(im, np.float32) / 255.0)
+    img, label = ds[0]
+    assert img.dtype == np.float32 and img.max() <= 1.0
+
+
+def test_flowers_missing_file_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no network access"):
+        Flowers(str(tmp_path / "nope.tgz"))
+
+
+@pytest.fixture
+def voc_archive(tmp_path):
+    path = tmp_path / "VOCtrainval_11-May-2012.tar"
+    base = "VOCdevkit/VOC2012"
+    with tarfile.open(path, "w") as tar:
+        names = ["2007_000001", "2007_000002", "2007_000003"]
+        _add_bytes(tar, f"{base}/ImageSets/Segmentation/trainval.txt",
+                   "\n".join(names).encode())
+        _add_bytes(tar, f"{base}/ImageSets/Segmentation/train.txt",
+                   names[0].encode())
+        _add_bytes(tar, f"{base}/ImageSets/Segmentation/val.txt",
+                   "\n".join(names[1:]).encode())
+        for n in names:
+            _add_bytes(tar, f"{base}/JPEGImages/{n}.jpg", _jpg_bytes())
+            _add_bytes(tar, f"{base}/SegmentationClass/{n}.png", _png_bytes())
+    return str(path)
+
+
+def test_voc2012_splits_and_pairs(voc_archive):
+    # reference MODE_FLAG_MAP: train->trainval, test->train, valid->val
+    assert len(VOC2012(voc_archive, mode="train")) == 3
+    assert len(VOC2012(voc_archive, mode="test")) == 1
+    ds = VOC2012(voc_archive, mode="valid", backend="cv2")
+    assert len(ds) == 2
+    img, mask = ds[0]
+    assert img.shape == (8, 8, 3)
+    assert mask.shape == (8, 8) and int(mask[0, 0]) == 3
+
+
+def test_subset_random_sampler_permutes_exactly():
+    paddle.seed(3)
+    s = paddle.io.SubsetRandomSampler([9, 3, 7, 5, 1])
+    order = list(s)
+    assert sorted(order) == [1, 3, 5, 7, 9]
+    assert len(s) == 5
+    with pytest.raises(ValueError, match="empty"):
+        paddle.io.SubsetRandomSampler([])
+
+
+def test_subset_random_sampler_in_dataloader():
+    class Ds(paddle.io.Dataset):
+        def __getitem__(self, i):
+            return np.float32(i)
+
+        def __len__(self):
+            return 10
+
+    # paddle's DataLoader composes samplers via BatchSampler(sampler=...)
+    loader = paddle.io.DataLoader(
+        Ds(), batch_sampler=paddle.io.BatchSampler(
+            sampler=paddle.io.SubsetRandomSampler([0, 2, 4, 6]), batch_size=2),
+        num_workers=0)
+    seen = sorted(int(v) for batch in loader
+                  for v in np.asarray(batch._data).ravel())
+    assert seen == [0, 2, 4, 6]
+
+
+def test_image_backend_trio(tmp_path):
+    from paddle_tpu.vision import (get_image_backend, image_load,
+                                   set_image_backend)
+
+    p = os.path.join(tmp_path, "img.jpg")
+    with open(p, "wb") as f:
+        f.write(_jpg_bytes(w=5, h=4))
+    assert get_image_backend() == "pil"
+    img = image_load(p)
+    assert img.size == (5, 4)          # PIL reports (w, h)
+    t = image_load(p, backend="tensor")
+    assert tuple(t.shape) == (4, 5, 3)
+    with pytest.raises(ValueError, match="Expected backend"):
+        set_image_backend("turbojpeg")
+    set_image_backend("tensor")
+    try:
+        assert get_image_backend() == "tensor"
+    finally:
+        set_image_backend("pil")

@@ -75,6 +75,8 @@ from . import onnx  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import hub  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import cost_model  # noqa: F401
 from . import version  # noqa: F401
 from .version import full_version as __version__  # noqa: F401
 from . import hapi  # noqa: F401

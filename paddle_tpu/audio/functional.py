@@ -9,7 +9,14 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
-           "compute_fbank_matrix", "create_dct", "power_to_db"]
+           "fft_frequencies", "compute_fbank_matrix", "create_dct",
+           "power_to_db"]
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32") -> np.ndarray:
+    """Center frequencies of the rfft bins: ``linspace(0, sr/2, n_fft//2+1)``
+    (reference ``audio/functional/functional.py`` fft_frequencies)."""
+    return np.linspace(0, sr / 2.0, n_fft // 2 + 1).astype(dtype)
 
 
 def get_window(window: str, win_length: int, fftbins: bool = True) -> np.ndarray:

@@ -1,0 +1,122 @@
+"""``paddle.cost_model`` (reference: ``python/paddle/cost_model/cost_model.py``).
+
+The reference profiles a static Program on GPU through its C++ CostModel and
+ships a ``static_op_benchmark.json`` of measured per-op GPU times.  The
+TPU-native equivalent measures the ONE fused XLA executable a Program
+compiles to (there is no per-op replay on TPU — fusion is the point) and
+reports the executable's own cost analysis (flops / bytes accessed) next to
+wall time; the static table carries analytic per-op costs derived from the
+auto-tuner's roofline model instead of GPU measurements.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    def __init__(self):
+        self._static_cost_data = None
+
+    def build_program(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        main_program = static.Program()
+        startup_program = static.Program()
+        with static.program_guard(main_program=main_program,
+                                  startup_program=startup_program):
+            data = static.data(name="X", shape=[None, 1], dtype="float32")
+            hidden = static.nn.fc(data, 10)
+            loss = paddle.mean(hidden)
+            paddle.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        return startup_program, main_program
+
+    def profile_measure(self, startup_program, main_program, device="tpu",
+                        fetch_cost_list: Sequence[str] = ("time",)):
+        """Run the program once and return its measured cost:
+        ``{"time": wall_seconds, "flops": ..., "bytes_accessed": ...}``
+        (analysis keys present when XLA exposes them for the backend)."""
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        exe = static.Executor()
+        exe.run(startup_program)
+        x = np.random.random(size=(10, 1)).astype("float32")
+        exe.run(main_program, feed={"X": x}, fetch_list=[])  # compile warmup
+        t0 = time.perf_counter()
+        exe.run(main_program, feed={"X": x}, fetch_list=[])
+        cost = {"time": time.perf_counter() - t0, "device": device}
+        for analysis in self._executable_analyses(main_program):
+            for k in ("flops", "bytes accessed"):
+                if k in analysis:
+                    cost[k.replace(" ", "_")] = analysis[k]
+        return cost
+
+    @staticmethod
+    def _executable_analyses(program):
+        from ..utils.xla_cost import cost_of_executable
+
+        for compiled in getattr(program, "_exec_cache", {}).values():
+            c = cost_of_executable(compiled)
+            if c:
+                yield c
+
+    def static_cost_data(self):
+        """Analytic per-op cost table (flops, bytes moved, and the v5e
+        roofline time for a reference config) — the TPU stand-in for the
+        reference's measured ``static_op_benchmark.json``."""
+        if self._static_cost_data is None:
+            self._static_cost_data = _analytic_op_table()
+        return self._static_cost_data
+
+    def get_static_op_time(self, op_name=None, forward=True, dtype="float32"):
+        if op_name is None:
+            raise ValueError("op_name should not be empty when you want to "
+                             "get static op time")
+        if self._static_cost_data is None:
+            self.static_cost_data()
+        op_cost = {}
+        for op_data in self._static_cost_data:
+            if op_data["op"] == op_name and dtype in op_data["config"]:
+                op_cost["op_time"] = (op_data["time"] if forward
+                                      else op_data["time_backward"])
+                op_cost["config"] = op_data["config"]
+        return op_cost
+
+
+# v5e bf16 roofline constants (BASELINE.md): 197 TFLOP/s peak, 819 GB/s HBM
+_PEAK_FLOPS = 197e12
+_HBM_BW = 819e9
+
+
+def _roofline_ms(flops, bytes_moved):
+    return max(flops / _PEAK_FLOPS, bytes_moved / _HBM_BW) * 1e3
+
+
+def _analytic_op_table():
+    table = []
+    # (op, config string, flops fwd, bytes fwd); backward ~2x flops for
+    # matmul-like, ~2x bytes for elementwise
+    rows = [
+        ("matmul", "float32[1024,1024]x[1024,1024]", 2 * 1024 ** 3, 3 * 4 * 1024 ** 2),
+        ("conv2d", "float32[32,64,56,56]k3s1", 2 * 32 * 56 * 56 * 64 * 64 * 9,
+         4 * (32 * 64 * 56 * 56 * 2 + 64 * 64 * 9)),
+        ("softmax", "float32[1024,1024]", 5 * 1024 ** 2, 2 * 4 * 1024 ** 2),
+        ("relu", "float32[1024,1024]", 1024 ** 2, 2 * 4 * 1024 ** 2),
+        ("layer_norm", "float32[1024,1024]", 8 * 1024 ** 2, 2 * 4 * 1024 ** 2),
+    ]
+    for op, cfg, flops, nbytes in rows:
+        table.append({
+            "op": op, "config": cfg, "flops": flops, "bytes": nbytes,
+            "time": _roofline_ms(flops, nbytes),
+            "time_backward": _roofline_ms(2 * flops, 2 * nbytes),
+        })
+    return table

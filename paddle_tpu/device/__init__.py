@@ -17,3 +17,105 @@ __all__ = [
     "set_device", "get_device", "device_count", "synchronize", "current_device",
     "Event", "Stream", "current_stream", "stream_guard", "is_compiled_with_tpu",
 ]
+
+
+# --- compile-target introspection (reference: python/paddle/device/__init__.py)
+# One honest answer everywhere: this build targets TPU via PJRT; every other
+# accelerator toolkit reports "not compiled in", matching what reference
+# builds report for toolkits they were built without.
+
+def get_cudnn_version():
+    """None — this build has no cuDNN (reference returns None when CUDA is
+    absent)."""
+    return None
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    """False — XLA fills CINN's role here, but CINN itself is not present."""
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    """True: the distributed stack (collectives, fleet, launch) is built in."""
+    return True
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    return False
+
+
+class _UnavailablePlace:
+    _kind = "device"
+
+    def __init__(self, *args, **kwargs):
+        raise RuntimeError(
+            f"{type(self).__name__} is unavailable: this build targets TPU "
+            f"via PJRT and was not compiled with {self._kind} support")
+
+
+class XPUPlace(_UnavailablePlace):
+    _kind = "XPU"
+
+
+class IPUPlace(_UnavailablePlace):
+    _kind = "IPU"
+
+
+def get_all_device_type():
+    return sorted({d.platform.lower() for d in jax_devices_safe()})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    return [f"{d.platform.lower()}:{d.id}" for d in jax_devices_safe()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def jax_devices_safe():
+    import jax
+
+    try:
+        return jax.devices()
+    except RuntimeError:
+        return []
+
+
+def set_stream(stream=None):
+    """XLA enqueues on one per-device compute stream; accepting and
+    returning the current stream keeps scheduler-shaped code running."""
+    return current_stream()
+
+
+from . import cuda  # noqa: E402,F401
+from . import xpu  # noqa: E402,F401
+
+__all__ += [
+    "get_cudnn_version", "is_compiled_with_cuda", "is_compiled_with_rocm",
+    "is_compiled_with_xpu", "is_compiled_with_ipu", "is_compiled_with_cinn",
+    "is_compiled_with_distribute", "is_compiled_with_custom_device",
+    "XPUPlace", "IPUPlace", "get_all_device_type",
+    "get_all_custom_device_type", "get_available_device",
+    "get_available_custom_device", "set_stream",
+]

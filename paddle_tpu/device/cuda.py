@@ -1,0 +1,62 @@
+"""``paddle.device.cuda`` surface (reference:
+``python/paddle/device/cuda/__init__.py``) on a CUDA-less build.
+
+Counting/memory queries answer honestly (0 devices, 0 bytes); property
+queries raise, exactly as the reference does when not compiled with CUDA.
+"""
+
+from __future__ import annotations
+
+from ..framework.device import Event, Stream, current_stream, stream_guard  # noqa: F401
+
+__all__ = [
+    "Stream", "Event", "current_stream", "synchronize", "device_count",
+    "empty_cache", "max_memory_allocated", "max_memory_reserved",
+    "memory_allocated", "memory_reserved", "stream_guard",
+    "get_device_properties", "get_device_name", "get_device_capability",
+]
+
+
+def device_count() -> int:
+    return 0
+
+
+def synchronize(device=None):
+    raise RuntimeError("paddle.device.cuda.synchronize: not compiled with CUDA "
+                       "(this build targets TPU; use paddle.device.synchronize)")
+
+
+def empty_cache() -> None:
+    """No-op: XLA's BFC allocator manages HBM; there is no CUDA cache."""
+
+
+def _no_cuda(name):
+    raise RuntimeError(f"paddle.device.cuda.{name}: not compiled with CUDA")
+
+
+def max_memory_allocated(device=None) -> int:
+    return 0
+
+
+def max_memory_reserved(device=None) -> int:
+    return 0
+
+
+def memory_allocated(device=None) -> int:
+    return 0
+
+
+def memory_reserved(device=None) -> int:
+    return 0
+
+
+def get_device_properties(device=None):
+    _no_cuda("get_device_properties")
+
+
+def get_device_name(device=None):
+    _no_cuda("get_device_name")
+
+
+def get_device_capability(device=None):
+    _no_cuda("get_device_capability")

@@ -27,3 +27,6 @@ class ParamAttr:
         self.regularizer = regularizer
         self.trainable = trainable
         self.need_clip = need_clip
+
+
+from . import quant  # noqa: E402,F401

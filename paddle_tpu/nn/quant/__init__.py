@@ -1,0 +1,138 @@
+"""``paddle.nn.quant`` (reference: ``python/paddle/nn/quant/``):
+weight-only quantized linear algebra + the quant-insertion Stub.
+
+Reference semantics (``quantized_linear.py``): ``weight_quantize`` returns
+the int8 weights TRANSPOSED ([k,n] -> [n,k]) with one fp32 scale per output
+channel (or per (group, channel) when ``group_size`` is 64/128);
+``weight_only_linear`` consumes that layout.  The CUDA build dispatches to
+cutlass mixed-precision kernels gated on SM arch; on TPU the idiomatic
+lowering is dequantize-into-matmul — XLA fuses the ``int8 * scale`` mul
+into the MXU operand read, so no separate dequant pass ever materializes.
+``arch`` is accepted and ignored (no SM archs here).  int4 values live in
+an int8 carrier clamped to [-7, 7] (documented delta: the CUDA build packs
+two nibbles per byte; the carrier keeps numerics identical).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tensor import Tensor, to_tensor
+from ..layers import Layer
+
+__all__ = ["Stub", "weight_only_linear", "llm_int8_linear", "weight_quantize",
+           "weight_dequantize"]
+
+_QMAX = {"weight_only_int8": 127.0, "llm.int8": 127.0, "weight_only_int4": 7.0}
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _check_group(group_size):
+    if group_size not in (-1, 64, 128):
+        raise ValueError(f"Currently group_size only support -1/64/128. "
+                         f"but got {group_size}")
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """Quantize ``x [k, n]`` -> (int8 ``[n, k]``, fp32 scales ``[n]`` or
+    ``[k // group_size, n]`` for grouped mode)."""
+    if algo not in _QMAX:
+        raise ValueError(f"algo must be one of {sorted(_QMAX)}, got {algo!r}")
+    _check_group(group_size)
+    w = _data(x).astype(jnp.float32)
+    qmax = _QMAX[algo]
+    if group_size == -1:
+        scale = jnp.max(jnp.abs(w), axis=0) / qmax          # [n]
+        q = jnp.round(w / jnp.maximum(scale, 1e-9)[None, :])
+    else:
+        k, n = w.shape
+        if k % group_size:
+            raise ValueError(f"rows {k} not divisible by group_size {group_size}")
+        g = w.reshape(k // group_size, group_size, n)
+        scale = jnp.max(jnp.abs(g), axis=1) / qmax          # [k/gs, n]
+        q = jnp.round(g / jnp.maximum(scale, 1e-9)[:, None, :]).reshape(k, n)
+    q = jnp.clip(q, -qmax, qmax).astype(jnp.int8).T          # [n, k]
+    return to_tensor(q), to_tensor(scale.astype(jnp.float32))
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16",
+                      group_size=-1):
+    """Invert :func:`weight_quantize`: (int8 ``[n, k]``, scales) -> ``[k, n]``."""
+    _check_group(group_size)
+    q = _data(x).astype(jnp.float32).T                       # [k, n]
+    s = _data(scale)
+    if s.ndim == 1:
+        w = q * s[None, :]
+    else:
+        k, n = q.shape
+        gs = k // s.shape[0]
+        w = (q.reshape(s.shape[0], gs, n) * s[:, None, :]).reshape(k, n)
+    return to_tensor(w.astype(jnp.dtype(np.dtype(out_dtype))))
+
+
+def _dequant_to(q, scale, dtype):
+    # int8 [n,k] * scale -> [k,n] in the compute dtype; XLA folds this into
+    # the consuming matmul's operand read
+    qf = q.astype(dtype)
+    if scale.ndim == 1:
+        return (qf * scale.astype(dtype)[:, None]).T
+    n, k = q.shape
+    gs = k // scale.shape[0]
+    w = qf.T.reshape(scale.shape[0], gs, n) * scale.astype(dtype)[:, None, :]
+    return w.reshape(k, n)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """``x [..., k] @ dequant(weight [n, k]) -> [..., n]`` (+ bias)."""
+    _check_group(group_size)
+    xv = _data(x)
+    w = _dequant_to(_data(weight), _data(weight_scale), xv.dtype)
+    out = xv @ w
+    if bias is not None:
+        out = out + _data(bias)
+    return to_tensor(out)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
+    """LLM.int8() decomposition (Dettmers et al.): activation feature
+    columns whose absmax exceeds ``threshold`` keep full precision; the
+    rest are dynamically quantized per row and contracted int8 x int8 on
+    the MXU (``preferred_element_type=int32``), then rescaled."""
+    import jax
+
+    xv = _data(x)
+    q_w = _data(weight)                                      # [n, k] int8
+    s_w = _data(weight_scale).astype(jnp.float32)            # [n]
+    outlier = (jnp.max(jnp.abs(xv), axis=tuple(range(xv.ndim - 1)),
+                       keepdims=True) > threshold).astype(xv.dtype)
+    x_in = xv * (1 - outlier)
+    # dynamic per-row symmetric int8 quant of the inlier activations
+    s_x = jnp.max(jnp.abs(x_in), axis=-1, keepdims=True) / 127.0
+    q_x = jnp.round(x_in / jnp.maximum(s_x, 1e-9)).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        q_x, q_w, (((q_x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)                    # [..., n]
+    inlier = acc.astype(jnp.float32) * s_x.astype(jnp.float32) * s_w
+    w_fp = _dequant_to(q_w, s_w, xv.dtype)
+    out = inlier.astype(xv.dtype) + (xv * outlier) @ w_fp
+    if bias is not None:
+        out = out + _data(bias)
+    return to_tensor(out)
+
+
+class Stub(Layer):
+    """Quant-insertion placeholder (reference ``nn/quant/stub.py``): behaves
+    as identity; ``QuantConfig`` swaps it for an observer/quanter when a
+    model is prepared for quantization."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        return x

@@ -257,3 +257,89 @@ class Timer:
 
 def benchmark():
     return Timer()
+
+
+class SortedKeys(Enum):
+    """Sort orders for :meth:`Profiler.summary` (reference
+    ``profiler_statistic.py:49``).  GPU* keys sort by device time; on this
+    stack device spans come from the JAX/xplane trace when enabled, host
+    spans otherwise."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(Enum):
+    """Summary views (reference ``profiler.py:55``)."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+class ProfilerResult:
+    """Loaded profiler data: the host event spans plus the summary table
+    (what :func:`load_profiler_result` returns)."""
+
+    def __init__(self, events, meta=None):
+        self.events = events
+        self.meta = meta or {}
+
+    def time_items(self):
+        return self.events
+
+    def summary(self):
+        by_name = {}
+        for e in self.events:
+            d = by_name.setdefault(e["name"], [0.0, 0])
+            d[0] += e["dur"] / 1e3
+            d[1] += 1
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}"]
+        for name, (tot, calls) in sorted(by_name.items(), key=lambda kv: -kv[1][0]):
+            lines.append(f"{name:<40}{calls:>8}{tot:>12.3f}")
+        return "\n".join(lines)
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None) -> Callable:
+    """on_trace_ready handler serializing the collected events
+    (reference ``profiler.py`` export_protobuf).  The reference writes its
+    C++ profiler proto; here the host-span schema is serialized as a
+    versioned JSON container (same round-trip contract:
+    :func:`load_profiler_result` reads it back)."""
+
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        path = os.path.join(dir_name,
+                            f"{worker_name or 'worker'}_{int(time.time())}.pb.json")
+        with open(path, "w") as f:
+            json.dump({"schema": "paddle_tpu.profiler/1",
+                       "events": _store.events,
+                       "meta": {"pid": os.getpid()}}, f)
+
+    return handler
+
+
+def load_profiler_result(filename: str) -> ProfilerResult:
+    """Load a file written by :func:`export_protobuf`."""
+    with open(filename) as f:
+        payload = json.load(f)
+    if payload.get("schema") != "paddle_tpu.profiler/1":
+        raise ValueError(f"{filename} is not a paddle_tpu profiler result "
+                         f"(schema={payload.get('schema')!r})")
+    return ProfilerResult(payload["events"], payload.get("meta"))
+
+
+__all__ += ["SortedKeys", "SummaryView", "export_protobuf",
+            "load_profiler_result"]

@@ -13,6 +13,7 @@ dispatch layer so QAT composes with the eager tape and ``TrainStep``.
 
 from __future__ import annotations
 
+import abc
 import copy
 from typing import Callable, Dict, Optional, Type
 
@@ -334,3 +335,86 @@ class PTQ:
             return self._convert_one(m)
         _replace_sublayers(m, is_observed, self._convert_one)
         return m
+
+
+# ---------------------------------------------------------------------------
+# new-style extension API (reference: quantization/base_quanter.py,
+# base_observer.py, factory.py): abstract bases users subclass plus the
+# @quanter factory annotation
+# ---------------------------------------------------------------------------
+
+class BaseQuanter(Layer, metaclass=abc.ABCMeta):
+    """Base for custom quanters (reference ``base_quanter.py:29``): a Layer
+    whose forward fake-quantizes, exposing its quantization parameters."""
+
+    @abc.abstractmethod
+    def forward(self, input):
+        ...
+
+    @abc.abstractmethod
+    def scales(self):
+        ...
+
+    @abc.abstractmethod
+    def zero_points(self):
+        ...
+
+    @abc.abstractmethod
+    def quant_axis(self):
+        ...
+
+    @abc.abstractmethod
+    def bit_length(self):
+        ...
+
+
+class BaseObserver(BaseQuanter, metaclass=abc.ABCMeta):
+    """Base for custom observers (reference ``base_observer.py:23``):
+    a quanter that additionally computes thresholds after calibration."""
+
+    @abc.abstractmethod
+    def cal_thresholds(self):
+        ...
+
+
+class _QuanterFactory:
+    """Deferred-construction wrapper produced by :func:`quanter`: holds the
+    args, instantiates the layer per use (observers carry state that must
+    not be shared between the layers they observe)."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self._cls, self._args, self._kwargs = cls, args, kwargs
+
+    def _instance(self, layer=None):
+        return self._cls(*self._args, **self._kwargs)
+
+    def __call__(self, *args, **kwargs):   # factory() -> fresh instance
+        if args or kwargs:
+            return type(self)(self._cls, *args, **kwargs)
+        return self._instance()
+
+
+def quanter(class_name: str):
+    """Class annotation declaring a factory for a quanter type (reference
+    ``factory.py:78``): ``@quanter("MyQuanter")`` registers ``MyQuanter``
+    in this module so configs can reference it by name."""
+
+    def decorator(cls):
+        def factory(*args, **kwargs):
+            return _QuanterFactory(cls, *args, **kwargs)
+
+        factory.__name__ = class_name
+        globals()[class_name] = factory
+        import sys as _sys
+
+        mod = _sys.modules[cls.__module__]
+        setattr(mod, class_name, factory)
+        return cls
+
+    return decorator
+
+
+__all__ += ["BaseQuanter", "BaseObserver", "quanter"]
+
+from . import observers  # noqa: E402,F401
+from . import quanters  # noqa: E402,F401

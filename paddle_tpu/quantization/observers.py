@@ -1,0 +1,64 @@
+"""``paddle.quantization.observers`` (reference:
+``python/paddle/quantization/observers/__init__.py``): observer factories
+for PTQ calibration."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from . import (AbsmaxObserver as _AbsmaxObserverLayer, BaseObserver,
+               MovingAverageAbsmaxObserver as _MAObserverLayer,
+               _QuanterFactory)
+
+__all__ = ["AbsmaxObserver", "GroupWiseWeightObserver"]
+
+
+def AbsmaxObserver(quant_bits: int = 8):
+    """Factory: per-tensor absmax observer."""
+    return _QuanterFactory(_AbsmaxObserverLayer, quant_bits=quant_bits)
+
+
+class _GroupWiseWeightObserverLayer(BaseObserver):
+    """Group-wise weight absmax (reference
+    ``observers/groupwise.py``): one scale per ``group_size`` rows per
+    output channel — the calibration half of grouped weight-only quant."""
+
+    def __init__(self, quant_bits=8, group_size=128):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.group_size = group_size
+        self._scales = None
+
+    def forward(self, x):
+        w = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        k = w.shape[0]
+        gs = min(self.group_size, k)
+        pad = (-k) % gs
+        if pad:
+            w = jnp.concatenate([w, jnp.zeros((pad,) + w.shape[1:], w.dtype)])
+        g = w.reshape((w.shape[0] // gs, gs) + w.shape[1:])
+        qmax = float(2 ** (self.quant_bits - 1) - 1)
+        self._scales = np.asarray(jnp.max(jnp.abs(g), axis=1) / qmax)
+        return x
+
+    def cal_thresholds(self):
+        return self._scales
+
+    def scales(self):
+        return self._scales
+
+    def zero_points(self):
+        return np.zeros_like(self._scales) if self._scales is not None else None
+
+    def quant_axis(self):
+        return 0
+
+    def bit_length(self):
+        return self.quant_bits
+
+
+def GroupWiseWeightObserver(quant_bits: int = 8, group_size: int = 128):
+    return _QuanterFactory(_GroupWiseWeightObserverLayer,
+                           quant_bits=quant_bits, group_size=group_size)

@@ -1,0 +1,14 @@
+"""``paddle.quantization.quanters`` (reference:
+``python/paddle/quantization/quanters/__init__.py``)."""
+
+from __future__ import annotations
+
+from . import FakeQuanterWithAbsMax as _FakeQuanterLayer, _QuanterFactory
+
+__all__ = ["FakeQuanterWithAbsMaxObserver"]
+
+
+def FakeQuanterWithAbsMaxObserver(quant_bits: int = 8, **kwargs):
+    """Factory: dynamic-absmax fake quanter with straight-through gradient
+    (reference ``quanters/abs_max.py``)."""
+    return _QuanterFactory(_FakeQuanterLayer, quant_bits=quant_bits)

@@ -1,0 +1,211 @@
+"""``paddle.sparse.nn`` — layers over sparse tensors (reference
+``python/paddle/sparse/nn/``: activations, sparse linear subset).
+
+Every activation maps the values through ``sparse._map_values`` (taped,
+format-preserving) — one shared path instead of per-class plumbing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...nn.layers import Layer
+
+__all__ = ["ReLU", "LeakyReLU", "Softmax", "Linear"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from .. import _map_values
+
+        return _map_values(x, jax.nn.relu, "sparse_relu")
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        from .. import _map_values
+
+        slope = self._slope
+        return _map_values(x, lambda v: jax.nn.leaky_relu(v, slope),
+                           "sparse_leaky_relu")
+
+
+class Softmax(Layer):
+    """Row-wise softmax over a 2-D sparse tensor's present entries
+    (reference ``sparse.nn.Softmax`` semantics)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        if axis != -1:
+            raise ValueError("sparse Softmax supports axis=-1 (rows)")
+
+    def forward(self, x):
+        from .. import _as_coo, _map_values
+
+        coo = _as_coo(x)
+        rows = coo._indices[0]
+        n_rows = coo.shape[0]
+
+        def f(vals):
+            row_max = jnp.full((n_rows,), -jnp.inf, vals.dtype).at[rows].max(vals)
+            e = jnp.exp(vals - row_max[rows])
+            denom = jnp.zeros((n_rows,), vals.dtype).at[rows].add(e)
+            return e / denom[rows]
+
+        return _map_values(x, f, "sparse_softmax")
+
+
+class Linear(Layer):
+    """y = sparse_x @ W + b (dense output)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ...nn.initializer import XavierUniform
+
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr,
+                                            default_initializer=XavierUniform())
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        from .. import matmul
+
+        out = matmul(x, self.weight)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        from . import functional as F
+
+        return F.relu6(x)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over a sparse tensor's channel dim (reference
+    ``sparse/nn/layer/norm.py``): the values carrier is ``[nnz, C]``, so
+    this is exactly BatchNorm1D on the present entries — absent sites
+    contribute nothing to the batch statistics."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ...nn import BatchNorm1D
+
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr,
+                               use_global_stats=use_global_stats)
+
+    def forward(self, x):
+        from .. import SparseCooTensor, _as_coo, is_sparse_csr
+
+        coo = _as_coo(x)
+        new_vals = self._bn(coo.values())   # [nnz, C] through the real BN
+        res = SparseCooTensor(coo._indices, new_vals, coo.shape)
+        return res.to_sparse_csr() if is_sparse_csr(x) else res
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica BatchNorm (reference ``sparse/nn/layer/norm.py``
+    SyncBatchNorm): single-process statistics equal BatchNorm; under SPMD
+    the values carrier is batch-sharded and GSPMD's partitioned reductions
+    make the statistics global automatically — no separate allreduce layer
+    is needed on this stack."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Swap every sparse BatchNorm in ``layer`` for SyncBatchNorm."""
+        if isinstance(layer, BatchNorm) and not isinstance(layer, cls):
+            new = cls.__new__(cls)
+            Layer.__init__(new)
+            new._bn = layer._bn
+            return new
+        for name, sub in list(getattr(layer, "_sub_layers", {}).items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class _SparseConvNd(Layer):
+    _NSP = 3
+    _SUBM = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None):
+        super().__init__()
+        from ...nn.initializer import XavierUniform
+
+        nsp = self._NSP
+        ks = (kernel_size,) * nsp if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups = groups
+        self.data_format = data_format or ("NHWC" if nsp == 2 else "NDHWC")
+        self.weight = self.create_parameter(
+            list(ks) + [in_channels, out_channels], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = self.create_parameter([out_channels], is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        from . import functional as F
+
+        fn = {(2, False): F.conv2d, (3, False): F.conv3d,
+              (2, True): F.subm_conv2d, (3, True): F.subm_conv3d}[
+                  (self._NSP, self._SUBM)]
+        return fn(x, self.weight, self.bias, self.stride, self.padding,
+                  self.dilation, self.groups, self.data_format)
+
+
+class Conv2D(_SparseConvNd):
+    """Sparse conv on COO ``[N, H, W, C]`` (reference
+    ``sparse/nn/layer/conv.py``)."""
+
+    _NSP = 2
+
+
+class Conv3D(_SparseConvNd):
+    _NSP = 3
+
+
+class SubmConv2D(_SparseConvNd):
+    _NSP = 2
+    _SUBM = True
+
+
+class SubmConv3D(_SparseConvNd):
+    """Submanifold sparse conv: output sites equal input sites, the
+    point-cloud workhorse (reference ``sparse/nn/layer/conv.py``)."""
+
+    _NSP = 3
+    _SUBM = True
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self.kernel_size, self.stride = kernel_size, stride
+        self.padding, self.data_format = padding, data_format
+
+    def forward(self, x):
+        from . import functional as F
+
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            data_format=self.data_format)
+
+
+from . import functional  # noqa: E402,F401
+
+__all__ += ["ReLU6", "BatchNorm", "SyncBatchNorm", "Conv2D", "Conv3D",
+            "SubmConv2D", "SubmConv3D", "MaxPool3D"]

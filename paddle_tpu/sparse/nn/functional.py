@@ -1,0 +1,300 @@
+"""``paddle.sparse.nn.functional`` (reference:
+``python/paddle/sparse/nn/functional/``): sparse convolutions, pooling,
+activations, and CSR-masked attention.
+
+Reference implementation is a GPU rulebook + gather-GEMM-scatter
+(``paddle/phi/kernels/sparse/gpu/conv_kernel.cu``).  The TPU-native design
+keeps the same decomposition but splits it by execution domain: the
+*rulebook* (which (input-site, output-site) pairs each kernel offset
+connects) is integer hash-map work done once on the host in numpy, while
+the *compute* (gather -> one [pairs, Cin] @ [Cin, Cout] matmul per offset
+-> scatter-add) is a single taped jnp function, so gradients flow to both
+values and weights and the MXU sees one dense GEMM per kernel offset.
+Submanifold convs (``subm_*``) reuse the input's site set unchanged — the
+property that keeps point-cloud activations from dilating layer over layer.
+The ``*_igemm`` entry points are aliases: gather-GEMM-scatter IS the
+implicit-GEMM formulation.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.dispatch import apply_op
+from ...framework.tensor import Tensor
+
+__all__ = ["conv2d", "conv3d", "subm_conv2d", "subm_conv2d_igemm",
+           "subm_conv3d", "subm_conv3d_igemm", "max_pool3d", "relu", "relu6",
+           "leaky_relu", "softmax", "attention"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == 1:
+            return tuple(v) * n
+        if len(v) != n:
+            raise ValueError(f"expected {n} entries, got {v}")
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _raw(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _build_rulebook(coords, spatial, ks, stride, padding, dilation, subm):
+    """Host-side rulebook: for each kernel offset, the (input row, output
+    row) pairs it connects.  Returns (out_coords [n_out, 1+nsp],
+    per-offset index arrays)."""
+    nsp = len(spatial)
+    offsets = list(itertools.product(*[range(k) for k in ks]))
+    site = {tuple(c): i for i, c in enumerate(map(tuple, coords))}
+
+    if subm:
+        if any(s != 1 for s in stride):
+            raise ValueError("submanifold conv requires stride 1")
+        out_coords = coords
+        out_site = site
+    else:
+        out_set = {}
+        for c in map(tuple, coords):
+            for off in offsets:
+                oc = [c[0]]
+                ok = True
+                for d in range(nsp):
+                    num = c[1 + d] + padding[d] - off[d] * dilation[d]
+                    if num % stride[d] or num < 0:
+                        ok = False
+                        break
+                    o = num // stride[d]
+                    lim = (spatial[d] + 2 * padding[d]
+                           - dilation[d] * (ks[d] - 1) - 1) // stride[d] + 1
+                    if o >= lim:
+                        ok = False
+                        break
+                    oc.append(o)
+                if ok:
+                    out_set.setdefault(tuple(oc), len(out_set))
+        out_site = out_set
+        out_coords = np.array(sorted(out_set, key=out_set.get),
+                              dtype=np.int64).reshape(len(out_set), nsp + 1)
+
+    rules = []
+    for off in offsets:
+        gi, so = [], []
+        for i, c in enumerate(map(tuple, coords)):
+            oc = [c[0]]
+            ok = True
+            for d in range(nsp):
+                num = c[1 + d] + padding[d] - off[d] * dilation[d]
+                if num % stride[d] or num < 0:
+                    ok = False
+                    break
+                oc.append(num // stride[d])
+            if not ok:
+                continue
+            j = out_site.get(tuple(oc))
+            if j is not None:
+                gi.append(i)
+                so.append(j)
+        rules.append((np.asarray(gi, np.int32), np.asarray(so, np.int32)))
+    return out_coords, rules
+
+
+def _sparse_conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, subm, nsp):
+    from .. import SparseCooTensor
+
+    if groups != 1:
+        raise ValueError("sparse conv supports groups=1")
+    expected = "NHWC" if nsp == 2 else "NDHWC"
+    if data_format != expected:
+        raise ValueError(f"sparse conv{nsp}d requires data_format={expected}")
+    stride, padding, dilation = (_tuple(stride, nsp), _tuple(padding, nsp),
+                                 _tuple(dilation, nsp))
+    w = weight if isinstance(weight, Tensor) else Tensor(jnp.asarray(weight))
+    ks = tuple(int(k) for k in w.shape[:nsp])
+    cout = int(w.shape[-1])
+    spatial = x.shape[1:-1]
+    coords = np.asarray(x._indices).T                    # [nnz, 1+nsp]
+    out_coords, rules = _build_rulebook(coords, spatial, ks, stride,
+                                        padding, dilation, subm)
+    n_out = len(out_coords)
+    out_spatial = tuple(
+        (spatial[d] + 2 * padding[d] - dilation[d] * (ks[d] - 1) - 1)
+        // stride[d] + 1 for d in range(nsp)) if not subm else spatial
+    out_shape = (x.shape[0],) + tuple(out_spatial) + (cout,)
+
+    gathers = [jnp.asarray(g) for g, _ in rules]
+    scatters = [jnp.asarray(s) for _, s in rules]
+
+    args = (x._values, w) + ((bias,) if bias is not None else ())
+
+    def f(vals, wk, *rest):
+        wk = wk.reshape(-1, wk.shape[-2], wk.shape[-1])   # [K, Cin, Cout]
+        out = jnp.zeros((n_out, cout), vals.dtype)
+        for k in range(wk.shape[0]):
+            if gathers[k].size == 0:
+                continue
+            contrib = vals[gathers[k]] @ wk[k].astype(vals.dtype)
+            out = out.at[scatters[k]].add(contrib)
+        if rest:
+            out = out + rest[0].astype(vals.dtype)
+        return out
+
+    out_vals = apply_op(f"sparse_conv{nsp}d" + ("_subm" if subm else ""),
+                        f, args, {})
+    return SparseCooTensor(jnp.asarray(out_coords.T), out_vals, out_shape)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    """Sparse 2-D conv; ``x`` COO ``[N, H, W, C]``, ``weight``
+    ``[kH, kW, Cin, Cout]`` (reference ``functional/conv.py``)."""
+    return _sparse_conv(x, weight, bias, stride, padding, dilation, groups,
+                        data_format, subm=False, nsp=2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse 3-D conv; ``x`` COO ``[N, D, H, W, C]``, ``weight``
+    ``[kD, kH, kW, Cin, Cout]`` (reference ``functional/conv.py:362``)."""
+    return _sparse_conv(x, weight, bias, stride, padding, dilation, groups,
+                        data_format, subm=False, nsp=3)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    return _sparse_conv(x, weight, bias, stride, padding, dilation, groups,
+                        data_format, subm=True, nsp=2)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold sparse 3-D conv — output sites == input sites
+    (reference ``functional/conv.py:468``)."""
+    return _sparse_conv(x, weight, bias, stride, padding, dilation, groups,
+                        data_format, subm=True, nsp=3)
+
+
+# gather-GEMM-scatter IS implicit GEMM; the reference exposes the igemm
+# kernels as separate entry points with identical semantics
+subm_conv2d_igemm = subm_conv2d
+subm_conv3d_igemm = subm_conv3d
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """Sparse 3-D max pool over present sites (reference
+    ``functional/pooling.py``): each output cell takes the max over the
+    input sites its window covers; cells covering no site stay absent."""
+    from .. import SparseCooTensor
+
+    if data_format != "NDHWC":
+        raise ValueError("sparse max_pool3d requires data_format='NDHWC'")
+    ks = _tuple(kernel_size, 3)
+    stride = _tuple(stride if stride is not None else kernel_size, 3)
+    padding = _tuple(padding, 3)
+    spatial = x.shape[1:-1]
+    c = x.shape[-1]
+    coords = np.asarray(x._indices).T
+    out_coords, rules = _build_rulebook(coords, spatial, ks, stride, padding,
+                                        (1, 1, 1), subm=False)
+    n_out = len(out_coords)
+    out_spatial = tuple((spatial[d] + 2 * padding[d] - ks[d]) // stride[d] + 1
+                        for d in range(3))
+    out_shape = (x.shape[0],) + out_spatial + (c,)
+    gathers = [jnp.asarray(g) for g, _ in rules]
+    scatters = [jnp.asarray(s) for _, s in rules]
+
+    def f(vals):
+        out = jnp.full((n_out, c), -jnp.inf, vals.dtype)
+        for k in range(len(gathers)):
+            if gathers[k].size == 0:
+                continue
+            out = out.at[scatters[k]].max(vals[gathers[k]])
+        return out
+
+    out_vals = apply_op("sparse_max_pool3d", f, (x._values,), {})
+    return SparseCooTensor(jnp.asarray(out_coords.T), out_vals, out_shape)
+
+
+def relu(x, name=None):
+    from .. import relu as _r
+
+    return _r(x)
+
+
+def relu6(x, name=None):
+    from .. import _map_values
+
+    return _map_values(x, lambda v: jnp.clip(v, 0, 6), "sparse_relu6")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    from .. import _map_values
+
+    return _map_values(x, lambda v: jax.nn.leaky_relu(v, negative_slope),
+                       "sparse_leaky_relu")
+
+
+def softmax(x, axis=-1, name=None):
+    from . import Softmax
+
+    return Softmax(axis)(x)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """CSR-masked attention (reference ``functional/transformer.py:28``,
+    CUDA-11.8-only there): ``softmax(QK^T / sqrt(d))V`` evaluated only where
+    ``sparse_mask`` (a SparseCsrTensor with dense shape
+    ``[B*H, S, S]``, batched crows) has entries.  TPU stance mirrors
+    ``nn.functional.sparse_attention``: the layout expands to a boolean
+    mask and XLA runs the attention dense."""
+    q, k, v = _raw(query), _raw(key), _raw(value)
+    B, H, S, D = q.shape
+    crows = np.asarray(sparse_mask._crows)
+    cols = np.asarray(sparse_mask._cols)
+    mask = np.zeros((B * H, S, S), bool)
+    if crows.size == B * H * (S + 1):              # batched CSR
+        crows = crows.reshape(B * H, S + 1)
+        pos = 0
+        for bh in range(B * H):
+            counts = np.diff(crows[bh])
+            n = int(counts.sum())
+            rows = np.repeat(np.arange(S), counts)
+            mask[bh, rows, cols[pos:pos + n]] = True
+            pos += n
+    else:                                          # one shared 2-D layout
+        rows = np.repeat(np.arange(S), np.diff(crows))
+        mask[:, rows, cols] = True
+    maskj = jnp.asarray(mask.reshape(B, H, S, S))
+
+    def f(qf, kf, vf, *extra):
+        scores = jnp.einsum("bhsd,bhtd->bhst",
+                            qf.astype(jnp.float32), kf.astype(jnp.float32))
+        scores = scores / jnp.sqrt(jnp.float32(D))
+        kp, am = None, None
+        rest = list(extra)
+        if key_padding_mask is not None:
+            kp = rest.pop(0)
+            scores = scores + kp[:, None, None, :].astype(jnp.float32)
+        if attn_mask is not None:
+            am = rest.pop(0)
+            scores = scores + am[None, None].astype(jnp.float32)
+        scores = jnp.where(maskj, scores, -jnp.inf)
+        p = jax.nn.softmax(scores, axis=-1)
+        p = jnp.where(jnp.isnan(p), 0.0, p)        # fully-masked rows -> 0
+        return jnp.einsum("bhst,bhtd->bhsd", p, vf.astype(jnp.float32)
+                          ).astype(qf.dtype)
+
+    extra = tuple(t for t, given in
+                  ((key_padding_mask, key_padding_mask is not None),
+                   (attn_mask, attn_mask is not None)) if given)
+    return apply_op("sparse_csr_attention", f,
+                    (query, key, value) + extra, {})

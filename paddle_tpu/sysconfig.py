@@ -1,0 +1,21 @@
+"""``paddle.sysconfig`` (reference: ``python/paddle/sysconfig.py``):
+filesystem locations of the package's headers and native libraries."""
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory containing the C sources/headers of the native runtime
+    (``core/csrc`` — the TCP store / tracer / shm channel sources that
+    third-party extensions may build against)."""
+    return os.path.join(_ROOT, "core", "csrc")
+
+
+def get_lib() -> str:
+    """Directory containing the built native library
+    (``libpaddle_tpu_native.so``, built on first use)."""
+    return os.path.join(_ROOT, "core")

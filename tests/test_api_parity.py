@@ -259,6 +259,19 @@ SUBMODULE_ABSENT = {
     ("optimizer/__init__.py", "optimizer"), ("optimizer/lr.py", "optimizer.lr"),
     ("incubate/__init__.py", "incubate"), ("utils/__init__.py", "utils"),
     ("static/nn/__init__.py", "static.nn"),
+    ("device/__init__.py", "device"), ("device/cuda/__init__.py", "device.cuda"),
+    ("device/xpu/__init__.py", "device.xpu"),
+    ("profiler/__init__.py", "profiler"),
+    ("quantization/__init__.py", "quantization"),
+    ("quantization/observers/__init__.py", "quantization.observers"),
+    ("quantization/quanters/__init__.py", "quantization.quanters"),
+    ("nn/quant/__init__.py", "nn.quant"),
+    ("sparse/nn/__init__.py", "sparse.nn"),
+    ("sparse/nn/functional/__init__.py", "sparse.nn.functional"),
+    ("cost_model/__init__.py", "cost_model"), ("sysconfig.py", "sysconfig"),
+    ("audio/functional/__init__.py", "audio.functional"),
+    ("io/__init__.py", "io"),
+    ("vision/datasets/__init__.py", "vision.datasets"),
 ])
 def test_submodule_all_parity(mod, attr):
     path = os.path.join(os.path.dirname(REF_INIT), mod)

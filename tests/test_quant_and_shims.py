@@ -1,0 +1,204 @@
+"""nn.quant weight-only linear algebra, the new-style quantization
+extension API, device/sysconfig introspection shims, cost_model, and the
+profiler protobuf round-trip (references:
+``python/paddle/nn/quant/quantized_linear.py``,
+``python/paddle/quantization/factory.py``,
+``python/paddle/device/__init__.py``, ``python/paddle/cost_model/``,
+``python/paddle/profiler/``)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn.quant import (llm_int8_linear, weight_dequantize,
+                                 weight_only_linear, weight_quantize)
+
+RNG = np.random.default_rng(3)
+
+
+class TestWeightOnly:
+    def setup_method(self, _):
+        self.w = paddle.to_tensor(RNG.normal(size=(64, 32)).astype("float32"))
+        self.x = paddle.to_tensor(RNG.normal(size=(4, 64)).astype("float32"))
+        self.ref = np.asarray(self.x._data) @ np.asarray(self.w._data)
+
+    def test_quantize_layout_is_transposed_per_channel(self):
+        q, s = weight_quantize(self.w)
+        assert tuple(q.shape) == (32, 64) and str(q.dtype).endswith("int8")
+        assert tuple(s.shape) == (32,)
+
+    def test_int8_roundtrip_accuracy(self):
+        q, s = weight_quantize(self.w)
+        wd = np.asarray(weight_dequantize(q, s, out_dtype="float32")._data)
+        assert np.abs(wd - np.asarray(self.w._data)).max() < 0.02
+
+    def test_weight_only_linear_int8(self):
+        q, s = weight_quantize(self.w)
+        out = np.asarray(weight_only_linear(self.x, q, weight_scale=s)._data)
+        rel = np.abs(out - self.ref).max() / np.abs(self.ref).max()
+        assert rel < 0.02
+
+    def test_weight_only_linear_grouped(self):
+        q, s = weight_quantize(self.w, group_size=64)
+        assert tuple(s.shape) == (1, 32)
+        out = np.asarray(weight_only_linear(self.x, q, weight_scale=s,
+                                            group_size=64)._data)
+        assert np.abs(out - self.ref).max() / np.abs(self.ref).max() < 0.02
+
+    def test_int4_coarser_but_sane(self):
+        q, s = weight_quantize(self.w, algo="weight_only_int4")
+        assert int(np.abs(np.asarray(q._data)).max()) <= 7
+        out = np.asarray(weight_only_linear(self.x, q, weight_scale=s,
+                                            weight_dtype="int4")._data)
+        assert np.abs(out - self.ref).max() / np.abs(self.ref).max() < 0.25
+
+    def test_llm_int8_outlier_decomposition(self):
+        x = np.asarray(self.x._data).copy()
+        x[:, 7] *= 50.0                      # feature 7 becomes an outlier
+        q, s = weight_quantize(self.w, algo="llm.int8")
+        out = np.asarray(llm_int8_linear(paddle.to_tensor(x), q,
+                                         weight_scale=s, threshold=6.0)._data)
+        ref = x @ np.asarray(self.w._data)
+        assert np.abs(out - ref).max() / np.abs(ref).max() < 0.02
+
+    def test_bad_algo_and_group_rejected(self):
+        with pytest.raises(ValueError, match="algo"):
+            weight_quantize(self.w, algo="int2")
+        with pytest.raises(ValueError, match="group_size"):
+            weight_quantize(self.w, group_size=32)
+
+    def test_stub_is_identity(self):
+        s = paddle.nn.quant.Stub()
+        np.testing.assert_array_equal(np.asarray(s(self.x)._data),
+                                      np.asarray(self.x._data))
+
+
+class TestQuantExtensionAPI:
+    def test_quanter_decorator_registers_factory(self):
+        from paddle_tpu import quantization as Q
+
+        @Q.quanter("MyTestQuanter")
+        class _MyQuanter(Q.BaseQuanter):
+            def __init__(self, bits=8):
+                super().__init__()
+                self.bits = bits
+
+            def forward(self, x):
+                return x
+
+            def scales(self):
+                return None
+
+            def zero_points(self):
+                return None
+
+            def quant_axis(self):
+                return -1
+
+            def bit_length(self):
+                return self.bits
+
+        factory = Q.MyTestQuanter(bits=4)
+        inst = factory._instance()
+        assert isinstance(inst, _MyQuanter) and inst.bits == 4
+        # each use constructs a FRESH instance (observers carry state)
+        assert factory._instance() is not inst
+
+    def test_groupwise_observer_scales(self):
+        from paddle_tpu.quantization.observers import GroupWiseWeightObserver
+
+        obs = GroupWiseWeightObserver(group_size=32)._instance()
+        w = RNG.normal(size=(64, 8)).astype("float32")
+        obs.forward(paddle.to_tensor(w))
+        s = obs.cal_thresholds()
+        assert s.shape == (2, 8)
+        np.testing.assert_allclose(
+            s[0], np.abs(w[:32]).max(axis=0) / 127.0, rtol=1e-6)
+
+
+class TestDeviceShims:
+    def test_compile_flags_are_honest(self):
+        d = paddle.device
+        assert not d.is_compiled_with_cuda()
+        assert not d.is_compiled_with_xpu()
+        assert not d.is_compiled_with_ipu()
+        assert not d.is_compiled_with_rocm()
+        assert d.is_compiled_with_distribute()
+        assert d.get_cudnn_version() is None
+
+    def test_unavailable_places_raise(self):
+        with pytest.raises(RuntimeError, match="XPU"):
+            paddle.device.XPUPlace(0)
+        with pytest.raises(RuntimeError, match="IPU"):
+            paddle.device.IPUPlace(0)
+
+    def test_device_enumeration(self):
+        types = paddle.device.get_all_device_type()
+        assert "cpu" in types
+        assert paddle.device.get_all_custom_device_type() == []
+        avail = paddle.device.get_available_device()
+        assert any(a.startswith("cpu") for a in avail)
+
+    def test_cuda_namespace(self):
+        cuda = paddle.device.cuda
+        assert cuda.device_count() == 0
+        assert cuda.memory_allocated() == 0
+        cuda.empty_cache()                    # no-op, must not raise
+        with pytest.raises(RuntimeError, match="CUDA"):
+            cuda.get_device_name()
+        with pytest.raises(RuntimeError, match="XPU"):
+            paddle.device.xpu.synchronize()
+
+    def test_sysconfig_paths_exist(self):
+        import os
+
+        assert os.path.isdir(paddle.sysconfig.get_include())
+        assert os.path.isdir(paddle.sysconfig.get_lib())
+
+
+class TestCostModel:
+    def test_profile_measure_and_static_table(self):
+        cm = paddle.cost_model.CostModel()
+        sp, mp = cm.build_program()
+        try:
+            cost = cm.profile_measure(sp, mp, device="cpu")
+        finally:
+            paddle.disable_static()
+        assert cost["time"] > 0
+        t = cm.get_static_op_time("matmul")
+        assert t["op_time"] > 0
+        tb = cm.get_static_op_time("matmul", forward=False)
+        assert tb["op_time"] >= t["op_time"]
+        with pytest.raises(ValueError, match="op_name"):
+            cm.get_static_op_time()
+
+
+class TestProfilerAdditions:
+    def test_enums_present(self):
+        from paddle_tpu.profiler import SortedKeys, SummaryView
+
+        assert SortedKeys.CPUTotal.value == 0 and SortedKeys.GPUMin.value == 7
+        assert SummaryView.KernelView.name == "KernelView"
+
+    def test_protobuf_roundtrip(self, tmp_path):
+        import glob
+
+        from paddle_tpu import profiler
+
+        with profiler.Profiler(
+                on_trace_ready=profiler.export_protobuf(str(tmp_path))):
+            with profiler.RecordEvent("my_span"):
+                np.zeros(10).sum()
+        files = glob.glob(str(tmp_path / "*.pb.json"))
+        assert files
+        res = profiler.load_profiler_result(files[0])
+        assert any(e["name"] == "my_span" for e in res.events)
+        assert "my_span" in res.summary()
+
+    def test_load_rejects_foreign_files(self, tmp_path):
+        p = tmp_path / "x.pb.json"
+        p.write_text('{"schema": "other"}')
+        from paddle_tpu import profiler
+
+        with pytest.raises(ValueError, match="schema"):
+            profiler.load_profiler_result(str(p))

@@ -1,0 +1,139 @@
+"""Sparse convolution stack (reference: ``python/paddle/sparse/nn/`` —
+rulebook + gather-GEMM-scatter, ``paddle/phi/kernels/sparse/gpu/
+conv_kernel.cu``) and the CSR-masked attention."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+from paddle_tpu.sparse import nn as snn
+from paddle_tpu.sparse.nn import functional as sF
+
+RNG = np.random.default_rng(0)
+
+
+@pytest.fixture
+def point_cloud():
+    N, D, H, W, Ci = 1, 6, 6, 6, 3
+    coords = np.unique(RNG.integers(0, [N, D, H, W], size=(15, 4)), axis=0)
+    vals = RNG.normal(size=(len(coords), Ci)).astype(np.float32)
+    return sparse.sparse_coo_tensor(coords.T, vals, (N, D, H, W, Ci)), coords, vals
+
+
+def _dense_conv_ref(coords, vals, shape, w):
+    xd = np.zeros(shape, np.float32)
+    xd[tuple(coords.T)] = vals
+    return np.asarray(jax.lax.conv_general_dilated(
+        jnp.asarray(xd), jnp.asarray(w), (1, 1, 1),
+        [(1, 1)] * 3, dimension_numbers=("NDHWC", "DHWIO", "NDHWC")))
+
+
+def test_conv3d_matches_dense_reference_at_present_sites(point_cloud):
+    x, coords, vals = point_cloud
+    w = RNG.normal(size=(3, 3, 3, 3, 4)).astype(np.float32)
+    b = RNG.normal(size=(4,)).astype(np.float32)
+    out = sF.conv3d(x, paddle.to_tensor(w), paddle.to_tensor(b), padding=1)
+    ref = _dense_conv_ref(coords, vals, x.shape, w)
+    present = np.zeros(ref.shape[:4], bool)
+    present[tuple(np.asarray(out._indices))] = True
+    got = np.asarray(out.to_dense()._data)
+    np.testing.assert_allclose(got[present], (ref + b)[present],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_subm_conv_preserves_site_set(point_cloud):
+    x, coords, _ = point_cloud
+    w = RNG.normal(size=(3, 3, 3, 3, 4)).astype(np.float32)
+    out = sF.subm_conv3d(x, paddle.to_tensor(w), padding=1)
+    got = {tuple(r) for r in np.asarray(out._indices).T}
+    assert got == {tuple(r) for r in coords}
+    # igemm alias: same function
+    assert sF.subm_conv3d_igemm is sF.subm_conv3d
+
+
+def test_subm_conv_rejects_stride(point_cloud):
+    x, _, _ = point_cloud
+    w = RNG.normal(size=(3, 3, 3, 3, 4)).astype(np.float32)
+    with pytest.raises(ValueError, match="stride 1"):
+        sF.subm_conv3d(x, paddle.to_tensor(w), stride=2, padding=1)
+
+
+def test_conv_gradients_flow_to_weight(point_cloud):
+    x, _, _ = point_cloud
+    w = paddle.to_tensor(RNG.normal(size=(3, 3, 3, 3, 4)).astype(np.float32))
+    w.stop_gradient = False
+    out = sF.subm_conv3d(x, w, padding=1)
+    (out.values() ** 2).sum().backward()
+    assert float(np.abs(np.asarray(w.grad._data)).max()) > 0
+
+
+def test_conv2d_layer_and_shapes():
+    coords = np.array([[0, 1, 1], [0, 2, 3], [0, 4, 4]]).T
+    vals = RNG.normal(size=(3, 2)).astype(np.float32)
+    x = sparse.sparse_coo_tensor(coords, vals, (1, 8, 8, 2))
+    layer = snn.Conv2D(2, 5, 3, padding=1)
+    out = layer(x)
+    assert out.shape == (1, 8, 8, 5)
+    sub = snn.SubmConv2D(2, 5, 3, padding=1)
+    assert sub(x).nnz == 3
+
+
+def test_max_pool3d_takes_windowed_max(point_cloud):
+    x, coords, vals = point_cloud
+    out = sF.max_pool3d(x, 2, 2)
+    assert out.shape == (1, 3, 3, 3, 3)
+    # every output value equals the max over its input window (check one)
+    oc = np.asarray(out._indices).T[0]
+    window = [i for i, c in enumerate(coords)
+              if c[0] == oc[0] and all(oc[1 + d] == c[1 + d] // 2
+                                       for d in range(3))]
+    got = np.asarray(out.values()._data)[0]
+    np.testing.assert_allclose(got, vals[window].max(axis=0), rtol=1e-6)
+
+
+def test_batch_norm_normalizes_present_values(point_cloud):
+    x, _, vals = point_cloud
+    bn = snn.BatchNorm(3)
+    bn.train()
+    y = bn(x)
+    got = np.asarray(y.values()._data)
+    assert got.shape == vals.shape
+    np.testing.assert_allclose(got.mean(axis=0), 0.0, atol=1e-5)
+    sbn = snn.SyncBatchNorm.convert_sync_batchnorm(bn)
+    assert isinstance(sbn, snn.SyncBatchNorm)
+
+
+def test_relu6_caps_values():
+    coords = np.array([[0], [0]])
+    vals = np.array([[7.0, -2.0]], np.float32)
+    x = sparse.sparse_coo_tensor(coords, vals, (1, 4, 2))
+    y = snn.ReLU6()(x)
+    np.testing.assert_allclose(np.asarray(y.values()._data), [[6.0, 0.0]])
+
+
+def test_csr_attention_matches_dense_softmax():
+    B, H, S, D = 1, 2, 6, 4
+    q, k, v = (RNG.normal(size=(B, H, S, D)).astype(np.float32)
+               for _ in range(3))
+    crows, cols = [], []
+    for _ in range(B * H):
+        cr = [0]
+        for i in range(S):
+            cols.extend(range(i + 1))
+            cr.append(cr[-1] + i + 1)
+        crows.extend(cr)
+    mask = sparse.sparse_csr_tensor(np.asarray(crows), np.asarray(cols),
+                                    np.ones(len(cols), np.float32),
+                                    (B * H, S, S))
+    out = sF.attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                       paddle.to_tensor(v), mask)
+    scores = q @ k.transpose(0, 1, 3, 2) / np.sqrt(D)
+    scores = np.where(np.tril(np.ones((S, S), bool)), scores, -np.inf)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(out._data), p @ v,
+                               rtol=1e-5, atol=1e-5)

@@ -68,3 +68,10 @@ class ParallelEnv:
 def get_data_parallel_world_size():
     hcg = fleet.get_hybrid_communicate_group()
     return hcg.get_data_parallel_world_size() if hcg else get_world_size()
+
+
+from . import ps  # noqa: E402,F401
+from .ps_dataset import (  # noqa: E402,F401
+    CountFilterEntry, DatasetBase, InMemoryDataset, ProbabilityEntry,
+    QueueDataset, ShowClickEntry,
+)

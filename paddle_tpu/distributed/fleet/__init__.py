@@ -331,12 +331,68 @@ class UtilBase:
 
 
 class MultiSlotDataGenerator:
-    def __init__(self, *a, **k):
+    """Produce MultiSlot-format sample lines (reference
+    ``fleet/data_generator/data_generator.py``): subclasses implement
+    ``generate_sample(line)`` returning an iterator of samples shaped
+    ``[(slot_name, [values...]), ...]``; each sample serializes to
+    ``"<n> v1 ... vn"`` per slot — exactly what
+    ``distributed.InMemoryDataset``/``QueueDataset`` parse.  ``run_from
+    _stdin`` is the pipe_command protocol: raw lines in, feed lines out."""
+
+    def __init__(self):
+        self._line_iter = None
+        self.batch_size = 1
+
+    def set_batch(self, batch_size):
+        self.batch_size = int(batch_size)
+
+    def generate_sample(self, line):
         raise NotImplementedError(
-            "MultiSlotDataGenerator feeds the parameter-server dataset "
-            "pipeline (out of TPU scope; SURVEY §2.5 item 12) — use "
-            "paddle.io.DataLoader")
+            "subclasses implement generate_sample(line) -> iterator of "
+            "[(slot_name, [values...]), ...]")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for sample in samples:
+                yield sample
+
+        return local_iter
+
+    def _format_value(self, v):
+        return str(v)
+
+    def _gen_str(self, sample) -> str:
+        parts = []
+        for _name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(self._format_value(v) for v in values)
+        return " ".join(parts) + "\n"
+
+    def run_from_memory(self, lines=(None,)):
+        """Yield formatted feed lines for in-process use (the reference
+        prints to stdout; returning them composes with file writers)."""
+        out = []
+        batch = []
+        for line in lines:
+            for sample in self.generate_sample(line)():
+                batch.append(sample)
+                if len(batch) == self.batch_size:
+                    for s in self.generate_batch(batch)():
+                        out.append(self._gen_str(s))
+                    batch = []
+        for s in self.generate_batch(batch)() if batch else ():
+            out.append(self._gen_str(s))
+        return out
+
+    def run_from_stdin(self):
+        """pipe_command protocol: read raw lines from stdin, write feed
+        lines to stdout."""
+        import sys
+
+        for text in self.run_from_memory(sys.stdin):
+            sys.stdout.write(text)
 
 
 class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
-    pass
+    """String-slot variant: values pass through as strings (the reference's
+    MultiSlotStringDataFeed)."""

@@ -53,7 +53,14 @@ class SparseTable:
                  learning_rate: float = 0.1, initializer_range: float = 0.01,
                  dtype="float32", mesh: Optional[ProcessMesh] = None,
                  shard_axis: Optional[str] = None, seed: int = 0,
-                 beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8):
+                 beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8,
+                 entry=None):
+        from collections import Counter
+
+        self._entry = entry
+        self._touch_counts = Counter()
+        self._show_counts = Counter()
+        self._click_counts = Counter()
         self.num_rows = int(num_rows)
         self.dim = int(dim)
         self.optimizer = optimizer
@@ -170,15 +177,49 @@ class SparseTable:
         """Apply the sparse update for ``uids`` (``[U]``) with row gradients
         ``[U, D]``. Duplicate ids must have been combined by the caller
         (``ShardedEmbedding`` uses unique + segment-sum); rows never touched
-        stay bit-identical. O(U x D) work, independent of ``num_rows``."""
+        stay bit-identical. O(U x D) work, independent of ``num_rows``.
+
+        With an ``entry`` policy (``CountFilterEntry``/``ProbabilityEntry``,
+        reference ``entry_attr.py``) non-admitted ids are filtered here at
+        the Python boundary — O(touched) dict counters, the jitted update
+        untouched; a filtered push redirects those rows to an OOB index,
+        whose writes the shard update already drops."""
         if self._push_fn is None:
             self._push_fn = self._build_push()
+        uids = jnp.asarray(uids, jnp.int32)
+        if self._entry is not None:
+            import numpy as _np
+
+            ids_np = _np.asarray(uids)
+            admitted = []
+            for u in ids_np.tolist():
+                self._touch_counts[u] += 1
+                admitted.append(self._entry.admit(u, self._touch_counts[u]))
+            mask = _np.asarray(admitted)
+            if not mask.all():
+                # OOB rows: drop_mode writes discard them, reads see fill
+                uids = jnp.where(jnp.asarray(mask), uids, self._padded_rows)
         lr = self.learning_rate if learning_rate is None else float(learning_rate)
         out = self._push_fn(self.table, self.state,
-                            jnp.asarray(uids, jnp.int32),
+                            uids,
                             jnp.asarray(grad_rows),
                             jnp.asarray(lr, jnp.float32))
         self.table, self.state = out
+
+    def update_show_click(self, uids, shows, clicks) -> None:
+        """Accumulate show/click statistics for ``ShowClickEntry`` tables."""
+        import numpy as _np
+
+        for u, s, c in zip(_np.asarray(uids).tolist(),
+                           _np.asarray(shows).tolist(),
+                           _np.asarray(clicks).tolist()):
+            self._show_counts[u] += s
+            self._click_counts[u] += c
+
+    def entry_stats(self, uid: int):
+        return {"show": self._show_counts.get(uid, 0),
+                "click": self._click_counts.get(uid, 0),
+                "touch": self._touch_counts.get(uid, 0)}
 
     def _build_push(self):
         kind = self.optimizer

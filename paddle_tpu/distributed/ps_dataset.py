@@ -1,0 +1,256 @@
+"""Parameter-server dataset pipeline + sparse-table entry policies
+(reference: ``python/paddle/distributed/fleet/dataset/dataset.py``,
+``python/paddle/distributed/entry_attr.py``).
+
+The reference feeds CTR training through a C++ MultiSlot pipeline: protobuf
+``DataFeedDesc``, multi-threaded file parsers, and a brpc global shuffle.
+The TPU-native substitute keeps the workflow contract —
+``init(use_var=...) -> set_filelist -> load_into_memory -> shuffle ->
+exe.train_from_dataset`` — on host-side numpy parsing: slot text files are
+parsed by slot order, batches materialize as dense feed dicts (sparse
+variable-length slots pad to the batch max: the static-shape stance every
+TPU input takes in this framework), and shuffles are seeded permutations.
+Under multi-host launch each rank loads its own filelist shard, which is
+what the reference's global shuffle converges to after its exchange.
+
+MultiSlot text format (one sample per line, slots in ``use_var`` order):
+``<n> v1 ... vn`` per slot — e.g. with use_var [label(1), ids(3)]:
+``1 0 3 17 4 9``.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import Counter
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["EntryAttr", "ProbabilityEntry", "CountFilterEntry",
+           "ShowClickEntry", "DatasetBase", "InMemoryDataset", "QueueDataset"]
+
+
+# ---------------------------------------------------------------------------
+# entry policies (sparse-table row admission; reference entry_attr.py)
+# ---------------------------------------------------------------------------
+
+class EntryAttr:
+    """Admission policy for sparse-table rows (used by
+    ``distributed.ps.SparseTable(entry=...)``)."""
+
+    _name = "none"
+
+    def _to_attr(self) -> str:
+        raise NotImplementedError("EntryAttr is base class")
+
+    def admit(self, uid: int, touch_count: int) -> bool:
+        return True
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit each new feature id with fixed probability — deterministic per
+    id (hash-based), so every worker makes the same decision without
+    coordination."""
+
+    _name = "probability"
+
+    def __init__(self, probability: float):
+        if not isinstance(probability, float) or not 0 <= probability <= 1:
+            raise ValueError("probability must be a float in [0, 1], "
+                             f"got {probability!r}")
+        self._probability = probability
+
+    def _to_attr(self) -> str:
+        return ":".join([self._name, str(self._probability)])
+
+    def admit(self, uid: int, touch_count: int) -> bool:
+        # splitmix-style integer hash -> uniform in [0, 1)
+        h = (uid * 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+        h ^= h >> 31
+        return (h % (1 << 24)) / float(1 << 24) < self._probability
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a feature id only after it has been seen ``count`` times —
+    keeps the long tail of single-occurrence ids out of the table."""
+
+    _name = "count_filter"
+
+    def __init__(self, count: int):
+        if not isinstance(count, int) or count < 0:
+            raise ValueError(f"count must be a non-negative int, got {count!r}")
+        self._count = count
+
+    def _to_attr(self) -> str:
+        return ":".join([self._name, str(self._count)])
+
+    def admit(self, uid: int, touch_count: int) -> bool:
+        return touch_count >= self._count
+
+
+class ShowClickEntry(EntryAttr):
+    """Row value decays with show/click statistics; the named slots carry
+    the per-sample show and click signals (tracked via
+    ``SparseTable.update_show_click``)."""
+
+    _name = "show_click_entry"
+
+    def __init__(self, show_name: str, click_name: str):
+        if not isinstance(show_name, str) or not isinstance(click_name, str):
+            raise ValueError("show_name/click_name must be slot name strings")
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self) -> str:
+        return ":".join([self._name, self._show_name, self._click_name])
+
+
+# ---------------------------------------------------------------------------
+# datasets
+# ---------------------------------------------------------------------------
+
+class DatasetBase:
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.use_vars: List = []
+        self.filelist: List[str] = []
+        self.pipe_command = None
+        self._rng = np.random.default_rng(0)
+
+    def init(self, batch_size=1, thread_num=1, use_var=(), pipe_command=None,
+             input_type=0, fs_name="", fs_ugi="", download_cmd="cat",
+             **kwargs):
+        self.batch_size = int(batch_size)
+        self.thread_num = int(thread_num)
+        self.use_vars = list(use_var)
+        self.pipe_command = pipe_command
+        return self
+
+    def set_filelist(self, filelist: Sequence[str]):
+        missing = [f for f in filelist if not os.path.exists(f)]
+        if missing:
+            raise FileNotFoundError(f"dataset files not found: {missing}")
+        self.filelist = list(filelist)
+
+    # -- parsing ------------------------------------------------------------
+    def _var_dtype(self, var):
+        d = str(getattr(var, "dtype", "float32")).split(".")[-1]
+        return np.int64 if "int" in d else np.float32
+
+    def _parse_line(self, line: str):
+        toks = line.split()
+        pos, sample = 0, []
+        for var in self.use_vars:
+            if pos >= len(toks):
+                raise ValueError(f"line exhausted before slot "
+                                 f"{getattr(var, 'name', '?')}: {line!r}")
+            n = int(toks[pos])
+            vals = np.asarray(toks[pos + 1:pos + 1 + n],
+                              dtype=self._var_dtype(var))
+            if len(vals) != n:
+                raise ValueError(f"slot {getattr(var, 'name', '?')} declares "
+                                 f"{n} values, line has {len(vals)}: {line!r}")
+            sample.append(vals)
+            pos += 1 + n
+        return sample
+
+    def _iter_file_samples(self, path):
+        opener = open
+        if path.endswith(".gz"):
+            import gzip
+
+            opener = gzip.open
+        with opener(path, "rt") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield self._parse_line(line)
+
+    def _batch_feed(self, samples):
+        """Stack per-slot values into one feed dict; ragged sparse slots pad
+        with 0 to the batch max (TPU static-shape stance — bucket upstream
+        for tight shapes)."""
+        feed = {}
+        for si, var in enumerate(self.use_vars):
+            rows = [s[si] for s in samples]
+            width = max(len(r) for r in rows)
+            out = np.zeros((len(rows), width), dtype=rows[0].dtype)
+            for i, r in enumerate(rows):
+                out[i, :len(r)] = r
+            feed[getattr(var, "name", f"slot_{si}")] = out
+        return feed
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-then-shuffle dataset (reference ``dataset.py:410``)."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples: Optional[list] = None
+        self._queue_num = None
+
+    def _set_queue_num(self, n):
+        self._queue_num = n
+
+    def load_into_memory(self):
+        self._samples = [s for path in self.filelist
+                         for s in self._iter_file_samples(path)]
+
+    def preload_into_memory(self, file_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        pass
+
+    def local_shuffle(self):
+        self._require_loaded()
+        self._rng.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        """Single-host: identical to local_shuffle.  Multi-host: each rank
+        holds its own filelist shard, so a per-rank shuffle yields the same
+        sample-to-rank distribution the reference's exchange produces."""
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._samples = None
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._samples) if self._samples is not None else 0
+
+    def get_shuffle_data_size(self, fleet=None) -> int:
+        return self.get_memory_data_size(fleet)
+
+    def _require_loaded(self):
+        if self._samples is None:
+            raise RuntimeError("call load_into_memory() first")
+
+    def _batches(self):
+        self._require_loaded()
+        for i in range(0, len(self._samples), self.batch_size):
+            chunk = self._samples[i:i + self.batch_size]
+            if len(chunk) == self.batch_size:   # static shapes: drop remainder
+                yield self._batch_feed(chunk)
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: one pass over the filelist, no memory residency
+    (reference ``dataset.py`` QueueDataset)."""
+
+    def _batches(self):
+        buf = []
+        for path in self.filelist:
+            for s in self._iter_file_samples(path):
+                buf.append(s)
+                if len(buf) == self.batch_size:
+                    yield self._batch_feed(buf)
+                    buf = []
+
+    def local_shuffle(self):
+        raise RuntimeError("QueueDataset streams files; use InMemoryDataset "
+                           "for shuffling")
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        raise RuntimeError("QueueDataset streams files; use InMemoryDataset "
+                           "for shuffling")

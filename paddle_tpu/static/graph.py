@@ -526,6 +526,36 @@ class Executor:
     def close(self):
         pass
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """Consume every batch of a PS-pipeline dataset through ``run``
+        (reference ``base/executor.py:3300``): the MultiSlot feed dicts the
+        dataset parses become ordinary feeds of the one fused program."""
+        if dataset is None:
+            raise ValueError("train_from_dataset requires a dataset")
+        names = [getattr(f, "name", f) for f in (fetch_list or [])]
+        labels = fetch_info or names
+        for step, feed in enumerate(dataset._batches()):
+            outs = self.run(program, feed=feed, fetch_list=fetch_list)
+            if debug or (fetch_list and step % print_period == 0):
+                msg = ", ".join(f"{l}={np.asarray(o).ravel()[:1]}"
+                                for l, o in zip(labels, outs))
+                print(f"[train_from_dataset] step {step} {msg}")
+            if fetch_handler is not None and fetch_list:
+                fetch_handler.handler(dict(zip(names, outs)))
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """Same loop as :meth:`train_from_dataset`; pass an inference
+        Program (no optimizer attached) so no parameters update."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period, fetch_handler)
+
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
             fetch_var_name="fetch", scope=None, return_numpy=True,
             use_prune=False):

@@ -231,13 +231,8 @@ class TestLinalgCompletions:
 
 
 # submodule parity: every reference __all__ name, with the documented
-# out-of-scope absents (parameter-server dataset/entry types — SURVEY §2.5
-# item 12 marks the brpc PS stack out of TPU scope; the fp8 fused gemm is a
-# CUDA-specific kernel entry)
+# out-of-scope absents (the fp8 fused gemm is a CUDA-specific kernel entry)
 SUBMODULE_ABSENT = {
-    "distributed/__init__.py": {"InMemoryDataset", "QueueDataset",
-                                "CountFilterEntry", "ProbabilityEntry",
-                                "ShowClickEntry"},
     "linalg.py": {"fp8_fp8_half_gemm_fused"},
 }
 

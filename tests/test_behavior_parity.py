@@ -33,10 +33,6 @@ NAMESPACES = [
 KNOWN_STUBS = {
     "nn.Layer": ("forward", "abstract base — subclasses implement forward"),
     "nn.initializer.Initializer": ("__call__", "abstract base"),
-    "distributed.fleet.MultiSlotDataGenerator": (
-        "__init__", "feeds the brpc PS dataset pipeline (out of TPU scope, "
-        "SURVEY §2.5 item 12); sparse-table capability lives in "
-        "distributed.ps"),
     "inference.get_trt_compile_version": (
         "fn", "TensorRT is CUDA-only; TPU serving is AOT XLA (jit.save) + "
         "serving.Engine"),

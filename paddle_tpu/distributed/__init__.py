@@ -75,3 +75,5 @@ from .ps_dataset import (  # noqa: E402,F401
     CountFilterEntry, DatasetBase, InMemoryDataset, ProbabilityEntry,
     QueueDataset, ShowClickEntry,
 )
+from . import communication  # noqa: E402,F401
+from . import passes  # noqa: E402,F401

@@ -1,0 +1,4 @@
+"""``paddle.distributed.communication`` (reference:
+``python/paddle/distributed/communication/``)."""
+
+from . import stream  # noqa: F401

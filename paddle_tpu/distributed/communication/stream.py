@@ -1,0 +1,82 @@
+"""``paddle.distributed.communication.stream`` (reference:
+``python/paddle/distributed/communication/stream/``).
+
+The reference's stream API exposes NCCL's stream placement:
+``use_calc_stream=True`` enqueues the collective on the compute stream
+(skipping the comm-stream event sync) for latency-critical paths.  XLA has
+exactly one compute stream per device and inserts collectives into the
+compiled program directly, so on this stack the calc-stream behavior is
+the ONLY behavior — ``use_calc_stream`` is accepted and trivially
+satisfied, and each call forwards to the eager collective facade
+(``distributed/collective.py``), returning its task/None per ``sync_op``.
+"""
+
+from __future__ import annotations
+
+from .. import collective as _c
+from ..collective import ReduceOp
+
+__all__ = ["all_gather", "all_reduce", "alltoall", "alltoall_single",
+           "broadcast", "reduce", "reduce_scatter", "recv", "scatter",
+           "send", "gather"]
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _c.all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _c.all_gather(tensor_or_tensor_list, tensor, group=group,
+                         sync_op=sync_op)
+
+
+def alltoall(out_tensor_or_tensor_list, in_tensor_or_tensor_list, group=None,
+             sync_op=True, use_calc_stream=False):
+    return _c.alltoall(out_tensor_or_tensor_list, in_tensor_or_tensor_list,
+                       group=group, sync_op=sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, out_split_sizes=None,
+                    in_split_sizes=None, group=None, sync_op=True,
+                    use_calc_stream=False):
+    return _c.alltoall_single(out_tensor, in_tensor,
+                              in_split_sizes=in_split_sizes,
+                              out_split_sizes=out_split_sizes, group=group,
+                              sync_op=sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.broadcast(tensor, src=src, group=group, sync_op=sync_op)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=False):
+    return _c.reduce(tensor, dst=dst, op=op, group=group, sync_op=sync_op)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True, use_calc_stream=False):
+    return _c.reduce_scatter(tensor, tensor_or_tensor_list, op=op,
+                             group=group, sync_op=sync_op)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.recv(tensor, src=src, group=group, sync_op=sync_op)
+
+
+def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
+            sync_op=True, use_calc_stream=False):
+    return _c.scatter(tensor, tensor_or_tensor_list, src=src, group=group,
+                      sync_op=sync_op)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.send(tensor, dst=dst, group=group, sync_op=sync_op)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True,
+           use_calc_stream=False):
+    return _c.gather(tensor, gather_list=gather_list, dst=dst, group=group,
+                     sync_op=sync_op)

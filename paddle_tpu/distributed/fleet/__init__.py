@@ -396,3 +396,6 @@ class MultiSlotDataGenerator:
 class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
     """String-slot variant: values pass through as strings (the reference's
     MultiSlotStringDataFeed)."""
+
+
+from . import utils  # noqa: E402,F401

@@ -1,0 +1,159 @@
+"""``paddle.distributed.fleet.utils`` (reference:
+``python/paddle/distributed/fleet/utils/``): filesystem helpers, the
+recompute re-export, and the PS distributed-infer utility."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+from ..recompute import recompute  # noqa: F401
+
+__all__ = ["LocalFS", "HDFSClient", "DistributedInfer", "recompute"]
+
+
+class LocalFS:
+    """Local-filesystem client with the FS interface checkpoints and
+    datasets use (reference ``fleet/utils/fs.py:134``)."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not overwrite and os.path.exists(dst_path):
+            raise FileExistsError(dst_path)
+        if test_exists and not os.path.exists(src_path):
+            raise FileNotFoundError(src_path)
+        shutil.move(src_path, dst_path)
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def upload_dir(self, local_dir, dest_dir):
+        shutil.copytree(local_dir, dest_dir, dirs_exist_ok=True)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def touch(self, fs_path, exist_ok=True):
+        if os.path.exists(fs_path):
+            if not exist_ok:
+                raise FileExistsError(fs_path)
+            return
+        open(fs_path, "a").close()
+
+    def cat(self, fs_path=None):
+        with open(fs_path) as f:
+            return f.read()
+
+
+class HDFSClient:
+    """HDFS client shelling out to the ``hadoop fs`` CLI (reference
+    ``fleet/utils/fs.py`` HDFSClient) — constructing it requires the hadoop
+    binary; this environment has none, so the error is immediate and
+    descriptive rather than deferred to the first call."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=300000,
+                 sleep_inter=1000):
+        hadoop_home = hadoop_home or os.environ.get("HADOOP_HOME", "")
+        self._cmd = os.path.join(hadoop_home, "bin", "hadoop")
+        if not (hadoop_home and os.path.exists(self._cmd)) \
+                and shutil.which("hadoop") is None:
+            raise RuntimeError(
+                "HDFSClient requires the hadoop CLI (set HADOOP_HOME or put "
+                "'hadoop' on PATH); for local filesystems use LocalFS")
+        self._configs = [f"-D{k}={v}" for k, v in (configs or {}).items()]
+
+    def _run(self, *args):
+        return subprocess.run([self._cmd, "fs", *self._configs, *args],
+                              capture_output=True, text=True, check=False)
+
+    def is_exist(self, fs_path):
+        return self._run("-test", "-e", fs_path).returncode == 0
+
+    def is_dir(self, fs_path):
+        return self._run("-test", "-d", fs_path).returncode == 0
+
+    def is_file(self, fs_path):
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path).stdout.splitlines()
+        dirs, files = [], []
+        for line in out:
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", "-f", fs_path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", "-f", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def need_upload_download(self):
+        return True
+
+
+class DistributedInfer:
+    """PS-style distributed inference helper (reference
+    ``fleet/utils/ps_util.py``): on this stack the sparse tables live on
+    the mesh (``distributed.ps``), so inference is the ordinary static
+    Executor path — this wrapper keeps the workflow entry points."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+        self._initialized = False
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        if self._startup is not None and not self._initialized:
+            exe.run(self._startup)
+            self._initialized = True
+
+    def get_dist_infer_program(self):
+        return self._main

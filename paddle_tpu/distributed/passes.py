@@ -1,0 +1,110 @@
+"""``paddle.distributed.passes`` (reference:
+``python/paddle/distributed/passes/``): the distributed-optimization pass
+registry (``new_pass`` / ``PassManager`` / ``PassContext``).
+
+The reference rewrites Programs with ~40 graph passes (fusions, comm
+overlapping, sharding transforms).  On this stack XLA/GSPMD performs the
+overwhelming majority of those rewrites during compilation, so the
+registry distinguishes two kinds honestly:
+
+- **absorbed** passes — the named optimization happens inside XLA
+  (operator fusion, gradient-allreduce fusion, comm/compute overlap …).
+  Applying one validates the name, records it in the ``PassContext``, and
+  leaves the Program untouched, because the compiled artifact already has
+  the effect.
+- **active** passes — behaviors XLA does NOT apply by itself.
+  ``auto_parallel_recompute`` flags the Program so the static Executor
+  wraps the replayed forward in ``jax.checkpoint`` (activations
+  rematerialize in the backward — a real, measurable memory/time trade).
+
+Unknown names raise, so typos never silently no-op.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+__all__ = ["new_pass", "PassManager", "PassContext"]
+
+
+# names the reference registers whose effect XLA's compilation already
+# provides (fusion / overlap / memory family)
+_ABSORBED = {
+    "fuse_elewise_add_act", "fuse_bn_act", "fuse_bn_add_act",
+    "fuse_relu_depthwise_conv", "fuse_optimizer", "fuse_gemm_epilogue",
+    "fuse_all_reduce", "fused_linear_promotion", "fuse_adamw",
+    "fuse_resunit", "fuse_dot_product_attention",
+    "auto_parallel_sharding", "auto_parallel_amp", "auto_parallel_fp16",
+    "auto_parallel_grad_clip", "auto_parallel_data_parallel_optimization",
+    "auto_parallel_supplement_explicit_dependencies",
+    "allreduce_matmul_grad_overlapping", "overlap_comm",
+    "inplace_addto_op", "buffer_shared_inplace",
+}
+
+_ACTIVE = {"auto_parallel_recompute", "recompute"}
+
+
+class PassContext:
+    """Carries cross-pass state and records what was applied."""
+
+    def __init__(self):
+        self._attrs: Dict[str, Any] = {}
+        self.applied: List[str] = []
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+
+class _Pass:
+    def __init__(self, name: str, attrs: Optional[dict] = None):
+        self.name = name
+        self._attrs = dict(attrs or {})
+        self.absorbed = name in _ABSORBED
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        context = context if context is not None else PassContext()
+        if not isinstance(main_programs, (list, tuple)):
+            main_programs = [main_programs]
+        if self.name in _ACTIVE:
+            for prog in main_programs:
+                prog._recompute = True
+        context.applied.append(self.name)
+        context.set_attr(self.name,
+                         "absorbed-by-XLA" if self.absorbed else "applied")
+        return context
+
+
+def new_pass(name: str, pass_attrs: Optional[dict] = None) -> _Pass:
+    if name not in _ABSORBED and name not in _ACTIVE:
+        raise ValueError(
+            f"unknown pass {name!r}; known: "
+            f"{sorted(_ABSORBED | _ACTIVE)}")
+    return _Pass(name, pass_attrs)
+
+
+class PassManager:
+    def __init__(self, passes: Optional[List[_Pass]] = None):
+        self._passes = list(passes or [])
+        self.context = PassContext()
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+    def append(self, p: _Pass):
+        self._passes.append(p)
+
+    def apply(self, main_programs, startup_programs=None):
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, self.context)
+        return self.context

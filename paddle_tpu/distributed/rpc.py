@@ -26,7 +26,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 __all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async", "get_worker_info",
-           "get_all_worker_infos", "WorkerInfo"]
+           "get_current_worker_info", "get_all_worker_infos", "WorkerInfo"]
 
 
 @dataclass(frozen=True)
@@ -172,6 +172,12 @@ def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
 
 def get_all_worker_infos() -> List[WorkerInfo]:
     return sorted((_STATE["workers"] or {}).values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    """This process's own WorkerInfo (reference ``distributed/rpc/rpc.py``
+    get_current_worker_info)."""
+    return get_worker_info()
 
 
 def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 60.0):

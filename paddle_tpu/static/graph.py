@@ -613,6 +613,7 @@ class Executor:
         key = (len(b._nodes),
                tuple((id(n), i) for n, i in targets),
                train,
+               bool(getattr(program, "_recompute", False)),
                tuple(sorted((k, v.shape, str(v.dtype))
                             for k, v in feed_arrays.items())))
         entry = program._exec_cache.get(key)
@@ -628,10 +629,15 @@ class Executor:
                 optimizer, _ = b.optimizer
                 init_fn, update_fn = optimizer.functional()
                 grad_clip = optimizer._grad_clip
+                # the distributed recompute pass (distributed/passes) sets
+                # _recompute: the whole replayed forward rematerializes in
+                # the backward instead of keeping activations resident
+                rp = (jax.checkpoint(replay)
+                      if getattr(program, "_recompute", False) else replay)
 
                 def jfn(params, other, feeds, opt_state, lr, stepno):
                     def loss_of(p):
-                        outs = replay({**p, **other}, feeds)
+                        outs = rp({**p, **other}, feeds)
                         return jnp.sum(outs[loss_pos]), outs
 
                     (loss, outs), grads = jax.value_and_grad(

@@ -264,6 +264,11 @@ SUBMODULE_ABSENT = {
     ("sparse/nn/__init__.py", "sparse.nn"),
     ("sparse/nn/functional/__init__.py", "sparse.nn.functional"),
     ("cost_model/__init__.py", "cost_model"), ("sysconfig.py", "sysconfig"),
+    ("distributed/communication/stream/__init__.py",
+     "distributed.communication.stream"),
+    ("distributed/fleet/utils/__init__.py", "distributed.fleet.utils"),
+    ("distributed/passes/__init__.py", "distributed.passes"),
+    ("distributed/rpc/__init__.py", "distributed.rpc"),
     ("audio/functional/__init__.py", "audio.functional"),
     ("io/__init__.py", "io"),
     ("vision/datasets/__init__.py", "vision.datasets"),

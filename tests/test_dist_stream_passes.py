@@ -1,0 +1,113 @@
+"""distributed.communication.stream, distributed.passes, and
+fleet.utils (references:
+``python/paddle/distributed/communication/stream/``,
+``python/paddle/distributed/passes/``,
+``python/paddle/distributed/fleet/utils/fs.py``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distributed as dist
+from paddle_tpu.distributed.passes import PassContext, PassManager, new_pass
+
+
+class TestStreamCollectives:
+    def test_all_reduce_single_world(self):
+        x = paddle.to_tensor(np.full((2, 2), 3.0, np.float32))
+        dist.communication.stream.all_reduce(x)
+        np.testing.assert_allclose(np.asarray(x._data), 3.0)
+
+    def test_use_calc_stream_accepted(self):
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        dist.communication.stream.all_reduce(x, use_calc_stream=True)
+        dist.communication.stream.broadcast(x, src=0, use_calc_stream=True)
+
+    def test_surface_complete(self):
+        for name in ("all_gather", "all_reduce", "alltoall", "alltoall_single",
+                     "broadcast", "reduce", "reduce_scatter", "recv",
+                     "scatter", "send", "gather"):
+            assert callable(getattr(dist.communication.stream, name)), name
+
+
+class TestPasses:
+    def test_unknown_pass_rejected(self):
+        with pytest.raises(ValueError, match="unknown pass"):
+            new_pass("definitely_not_a_pass")
+
+    def test_absorbed_pass_records_context(self):
+        p = new_pass("fuse_optimizer")
+        assert p.absorbed
+        ctx = PassContext()
+        p.apply([], context=ctx)
+        assert ctx.applied == ["fuse_optimizer"]
+        assert ctx.get_attr("fuse_optimizer") == "absorbed-by-XLA"
+
+    def test_recompute_pass_flags_program_and_trains(self):
+        paddle.enable_static()
+        try:
+            from paddle_tpu import static
+
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [None, 8], "float32")
+                h = static.nn.fc(x, 16, activation="relu")
+                loss = paddle.mean(static.nn.fc(h, 1) ** 2)
+                paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+            pm = PassManager([new_pass("auto_parallel_recompute")])
+            pm.apply([main], [startup])
+            assert main._recompute is True
+            exe = static.Executor()
+            exe.run(startup)
+            feed = {"x": np.ones((4, 8), np.float32)}
+            l0 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+            for _ in range(5):
+                l1 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+            assert l1 < l0      # checkpointed backward still optimizes
+        finally:
+            paddle.disable_static()
+
+    def test_pass_manager_names(self):
+        pm = PassManager([new_pass("fuse_optimizer")])
+        pm.append(new_pass("recompute"))
+        assert pm.names == ["fuse_optimizer", "recompute"]
+
+
+class TestFleetUtils:
+    def test_local_fs_roundtrip(self, tmp_path):
+        fs = dist.fleet.utils.LocalFS()
+        d = str(tmp_path / "a" / "b")
+        fs.mkdirs(d)
+        assert fs.is_dir(d) and fs.is_exist(d)
+        f = os.path.join(d, "x.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        dirs, files = fs.ls_dir(str(tmp_path / "a"))
+        assert dirs == ["b"] and files == []
+        fs.mv(f, os.path.join(d, "y.txt"))
+        assert fs.cat(os.path.join(d, "y.txt")) == ""
+        assert fs.list_dirs(str(tmp_path / "a")) == ["b"]
+        assert not fs.need_upload_download()
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_hdfs_requires_hadoop(self):
+        if os.environ.get("HADOOP_HOME"):
+            pytest.skip("hadoop present")
+        with pytest.raises(RuntimeError, match="hadoop"):
+            dist.fleet.utils.HDFSClient()
+
+    def test_recompute_reexported(self):
+        assert dist.fleet.utils.recompute is dist.fleet.recompute
+
+    def test_distributed_infer(self):
+        di = dist.fleet.utils.DistributedInfer(main_program="M")
+        assert di.get_dist_infer_program() == "M"
+
+
+def test_rpc_current_worker_info_exported():
+    from paddle_tpu.distributed import rpc
+
+    assert callable(rpc.get_current_worker_info)

@@ -90,3 +90,5 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
     if return_eids:
         return src, dst, out_nodes, neighbors
     return src, dst, out_nodes
+from . import autograd  # noqa: E402,F401
+from . import distributed  # noqa: E402,F401

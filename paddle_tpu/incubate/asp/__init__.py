@@ -13,7 +13,7 @@ stay zero through optimization, matching the reference's workflow.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -85,11 +85,24 @@ def prune_model(model: Layer, n: int = 2, m: int = 4, mask_algo: str = "mask_1d"
     """Prune every supported weight to N:M sparsity IN PLACE; returns the
     masks keyed by parameter name (reference ``asp.py:319``)."""
     masks: Dict[str, np.ndarray] = {}
+    _missing = object()
+    custom = {}   # param id -> registered pruning_func (may be None)
+    for lay in model.sublayers(include_self=True):
+        fn = _CUSTOM_PRUNE_FUNCS.get(type(lay).__name__, _missing)
+        if fn is not _missing:
+            for _, p in lay.named_parameters(include_sublayers=False):
+                if len(p.shape) >= 2:
+                    custom[id(p)] = fn
     for name, p in model.named_parameters():
-        if not _prunable(name, p, m):
+        fn = custom.get(id(p), _missing)
+        if fn is _missing and not _prunable(name, p, m):
             continue
-        mask = create_mask(p, n, m)
-        p._data = p._data * jnp.asarray(mask, p._data.dtype)
+        if fn not in (_missing, None):
+            pruned, mask = fn(np.asarray(p._data), n, m, mask_algo, name)
+            p._data = jnp.asarray(pruned, p._data.dtype)
+        else:
+            mask = create_mask(p, n, m)
+            p._data = p._data * jnp.asarray(mask, p._data.dtype)
         masks[name] = mask
     if with_mask:
         model._asp_masks = masks
@@ -135,3 +148,31 @@ def decorate(optimizer, model: Optional[Layer] = None) -> OptimizerWithSparsityG
     (the reference resolves it from the global program; eager mode needs it
     explicitly or via a later ``prune_model(model)`` storing ``_asp_masks``)."""
     return OptimizerWithSparsityGuarantee(optimizer, model)
+
+
+_CUSTOM_PRUNE_FUNCS: Dict[str, Any] = {}
+
+
+def add_supported_layer(layer, pruning_func=None) -> None:
+    """Register a layer type (class, instance, or type name) as prunable,
+    optionally with a custom ``pruning_func(weight_np, n, m, mask_algo,
+    param_name) -> (pruned_weight, mask)`` (reference
+    ``supported_layer_list.py:96``).  ``prune_model`` consults the registry
+    when a parameter's owning layer matches."""
+    if isinstance(layer, str):
+        name = layer
+    elif isinstance(layer, type):
+        name = layer.__name__
+    elif isinstance(layer, Layer):
+        name = type(layer).__name__
+    else:
+        raise ValueError("layer must be a Layer subclass/instance or a "
+                         f"type-name string, got {type(layer)}")
+    _CUSTOM_PRUNE_FUNCS[name] = pruning_func
+
+
+def supported_layers() -> Dict[str, Any]:
+    return dict(_CUSTOM_PRUNE_FUNCS)
+
+
+__all__ += ["add_supported_layer", "supported_layers"]

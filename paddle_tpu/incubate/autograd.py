@@ -1,0 +1,184 @@
+"""``paddle.incubate.autograd`` (reference:
+``python/paddle/incubate/autograd/``): functional differentiation — vjp /
+jvp / Jacobian / Hessian / forward_grad — plus the prim toggles.
+
+The reference implements forward-mode and the functional API through its
+"prim" program transform: ops decompose into primitive ops that each carry
+a linearize/transpose rule.  JAX *is* that design (every primitive has jvp
++ transpose rules; reverse mode = forward + transpose), so here each entry
+point wraps the user's Tensor-level function into a raw-array function —
+paddle ops are jax-traceable end to end — and calls the native transform.
+``enable_prim``/``disable_prim`` therefore only record the preference: the
+decomposition they would switch on is the permanent execution model.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "prim_enabled", "forward_grad", "grad"]
+
+_PRIM = {"enabled": True}
+
+
+def enable_prim():
+    """Primitive decomposition is jax's permanent execution model; the
+    toggle records the preference for API compatibility."""
+    _PRIM["enabled"] = True
+
+
+def disable_prim():
+    """Records the toggle (``prim_enabled()`` reflects it) — execution is
+    decomposed either way; there is no non-prim interpreter to fall back
+    to on this stack."""
+    _PRIM["enabled"] = False
+
+
+def prim_enabled() -> bool:
+    return _PRIM["enabled"]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _raw(t):
+    return t._data if isinstance(t, Tensor) else jax.numpy.asarray(t)
+
+
+def _wrap(func):
+    """Tensor-level callable -> raw-array callable (+ output arity probe)."""
+    state = {}
+
+    def raw(*raws):
+        outs = func(*[Tensor(r) for r in raws])
+        state["multi"] = isinstance(outs, (list, tuple))
+        return tuple(_raw(o) for o in _as_list(outs))
+
+    return raw, state
+
+
+def _pack(raws, multi):
+    ts = [Tensor(r) for r in raws]
+    return ts if multi else ts[0]
+
+
+def vjp(func, xs, v=None):
+    """``(ys, vjp(v))`` — reverse mode (reference ``primapi.vjp``).  With
+    ``v=None`` the cotangent defaults to ones (the reference's behavior for
+    scalar-like use)."""
+    raw, state = _wrap(func)
+    xs_raw = [_raw(x) for x in _as_list(xs)]
+    ys_raw, pullback = jax.vjp(lambda *a: raw(*a), *xs_raw)
+    if v is None:
+        v_raw = tuple(jax.numpy.ones_like(y) for y in ys_raw)
+    else:
+        v_raw = tuple(_raw(t) for t in _as_list(v))
+    grads = pullback(v_raw)
+    multi_in = isinstance(xs, (list, tuple))
+    return (_pack(ys_raw, state["multi"]),
+            _pack(grads, multi_in))
+
+
+def jvp(func, xs, v=None):
+    """``(ys, J v)`` — true forward mode via ``jax.jvp`` (the reference
+    needs prim enabled for this; here it is the native transform)."""
+    raw, state = _wrap(func)
+    xs_raw = [_raw(x) for x in _as_list(xs)]
+    if v is None:
+        v_raw = [jax.numpy.ones_like(x) for x in xs_raw]
+    else:
+        v_raw = [_raw(t) for t in _as_list(v)]
+    ys_raw, ydot = jax.jvp(lambda *a: raw(*a), tuple(xs_raw), tuple(v_raw))
+    return (_pack(ys_raw, state["multi"]), _pack(ydot, state["multi"]))
+
+
+def forward_grad(func, xs, grad_inputs=None):
+    """Forward-mode derivatives of ``func`` at ``xs`` (functional form of
+    the reference's static ``primapi.forward_grad``; the graph-mutating
+    variant has no meaning on a trace-based stack)."""
+    return jvp(func, xs, grad_inputs)[1]
+
+
+def grad(func_or_outputs, inputs, grad_outputs=None):
+    """Reverse-mode gradients.  Dynamic tensors in, tensors out (reference
+    ``primapi.grad``): accepts either already-computed outputs (taped) or a
+    function to differentiate."""
+    if callable(func_or_outputs):
+        return vjp(func_or_outputs, inputs, grad_outputs)[1]
+    from ..framework.autograd import grad as _g
+
+    return _g(func_or_outputs, inputs, grad_outputs, retain_graph=True,
+              allow_unused=True)
+
+
+class Jacobian:
+    """Lazy full Jacobian of ``func`` at ``xs`` (reference
+    ``autograd/functional.py`` Jacobian): 2-D view ``[out_size, in_size]``
+    (batched: ``[B, out, in]``), materialized on first index."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._func = func
+        self._xs = xs
+        self._batched = is_batched
+        self._mat = None
+
+    def _materialize(self):
+        if self._mat is not None:
+            return self._mat
+        raw, _ = _wrap(self._func)
+        xs_raw = [_raw(x) for x in _as_list(self._xs)]
+        jac = jax.jacrev(lambda *a: raw(*a))(*xs_raw)
+        # single in/out: jac = tuple(outputs) of tuple(inputs)? jacrev over
+        # *args returns per-output tuples matching first arg only when one
+        # arg; normalize to a 2-D (or 3-D batched) block matrix
+        outs = jac if isinstance(jac, tuple) else (jac,)
+        blocks = []
+        for o in outs:
+            ins = o if isinstance(o, tuple) else (o,)
+            row = []
+            for block, x in zip(ins, xs_raw):
+                if self._batched:
+                    b = block.shape[0]
+                    row.append(block.reshape(b, -1, int(np.prod(x.shape[1:]))))
+                else:
+                    row.append(block.reshape(-1, int(np.prod(x.shape))))
+            blocks.append(jax.numpy.concatenate(row, axis=-1))
+        self._mat = jax.numpy.concatenate(blocks, axis=-2)
+        return self._mat
+
+    @property
+    def shape(self):
+        return tuple(self._materialize().shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._materialize()[idx])
+
+    def __repr__(self):
+        return f"Jacobian(shape={self.shape})"
+
+
+class Hessian(Jacobian):
+    """Hessian of a scalar-output ``func`` (reference Hessian): symmetric
+    ``[in_size, in_size]`` view."""
+
+    def _materialize(self):
+        if self._mat is not None:
+            return self._mat
+        raw, _ = _wrap(self._func)
+        xs_raw = [_raw(x) for x in _as_list(self._xs)]
+        if len(xs_raw) != 1:
+            raise ValueError("Hessian supports a single input tensor")
+
+        def scalar(a):
+            out = raw(a)
+            return jax.numpy.sum(out[0])
+
+        h = jax.hessian(scalar)(xs_raw[0])
+        n = int(np.prod(xs_raw[0].shape))
+        self._mat = h.reshape(n, n)
+        return self._mat

@@ -1,0 +1,51 @@
+"""``paddle.incubate.distributed.fleet`` (reference:
+``python/paddle/incubate/distributed/fleet/``): the recompute entry
+points re-exported with their ctx-dict calling conventions."""
+
+from __future__ import annotations
+
+from ...distributed.fleet.recompute import recompute
+
+__all__ = ["recompute_sequential", "recompute_hybrid"]
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Chunk a Sequential (or list of callables) into ``ctx['segments']``
+    segments, each recomputed in the backward (reference
+    ``fleet/recompute/recompute.py:622``)."""
+    segments = int(ctx.get("segments", 1))
+    preserve = bool(ctx.get("preserve_rng_state", True))
+    fns = list(functions)
+    if segments <= 1:
+        def run_all(*a):
+            out = a[0] if len(a) == 1 else a
+            for f in fns:
+                out = f(out)
+            return out
+
+        return recompute(run_all, *args,
+                         preserve_rng_state=preserve, **kwargs)
+    size = max(1, len(fns) // segments)
+    out = args[0] if len(args) == 1 else args
+    for start in range(0, len(fns), size):
+        chunk = fns[start:start + size]
+
+        def run_chunk(x, _chunk=chunk):
+            for f in _chunk:
+                x = f(x)
+            return x
+
+        out = recompute(run_chunk, out, preserve_rng_state=preserve)
+    return out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Hybrid-parallel recompute (reference
+    ``fleet/recompute/recompute_hybrid.py:265``).  The reference's ctx
+    carries the mp group plus offload/partition knobs for splitting saved
+    activations across mp ranks; under GSPMD saved activations inherit the
+    mesh sharding of the tensors themselves, so those knobs have no
+    residual meaning here — ``jax.checkpoint``-backed recompute with the
+    rng-preservation flag is the whole behavior."""
+    preserve = bool(ctx.get("preserve_rng_state", True))
+    return recompute(function, *args, preserve_rng_state=preserve, **kwargs)

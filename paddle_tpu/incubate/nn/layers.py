@@ -1,0 +1,262 @@
+"""Fused transformer layers (reference: ``python/paddle/incubate/nn/``
+``fused_transformer.py``): parameter-holding wrappers over the fused
+functional ops — one jnp dataflow per block, fused by XLA."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.initializer import Constant, XavierUniform
+from ...nn.layers import Layer
+from . import functional as F
+
+__all__ = ["FusedLinear", "FusedDropoutAdd",
+           "FusedBiasDropoutResidualLayerNorm", "FusedMultiHeadAttention",
+           "FusedFeedForward", "FusedTransformerEncoderLayer",
+           "FusedMultiTransformer"]
+
+
+class FusedLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr, default_initializer=XavierUniform())
+        self.bias = (self.create_parameter([out_features], is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        return F.fused_linear(x, self.weight, self.bias,
+                              transpose_weight=self.transpose_weight)
+
+
+class FusedDropoutAdd(Layer):
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p, self.mode = p, mode
+
+    def forward(self, x, y):
+        return F.fused_dropout_add(x, y, p=self.p, training=self.training,
+                                   mode=self.mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.dropout_rate, self.epsilon = dropout_rate, epsilon
+        self.linear_bias = self.create_parameter(
+            [embed_dim], is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=weight_attr, default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, x, residual):
+        return F.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+class FusedMultiHeadAttention(Layer):
+    """Self-attention block with fused qkv + epilogue (reference
+    ``FusedMultiHeadAttention``); ``normalize_before`` picks pre/post-LN."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError("embed_dim must divide num_heads")
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        self.qkv_weight = self.create_parameter(
+            [3, num_heads, self.head_dim, embed_dim], attr=qkv_weight_attr,
+            default_initializer=XavierUniform())
+        self.qkv_bias = self.create_parameter(
+            [3, num_heads, self.head_dim], attr=qkv_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=linear_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.pre_ln_scale = self.create_parameter(
+            [embed_dim], attr=pre_ln_scale_attr,
+            default_initializer=Constant(1.0))
+        self.pre_ln_bias = self.create_parameter(
+            [embed_dim], attr=pre_ln_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=ln_scale_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], attr=ln_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        return F.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self.epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, attn_mask=attn_mask,
+            dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training,
+            num_heads=self.num_heads)
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                 else act_dropout_rate)
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear1_bias = self.create_parameter(
+            [dim_feedforward], attr=linear1_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr,
+            default_initializer=XavierUniform())
+        self.linear2_bias = self.create_parameter(
+            [d_model], attr=linear2_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln1_scale = self.create_parameter(
+            [d_model], attr=ln1_scale_attr, default_initializer=Constant(1.0))
+        self.ln1_bias = self.create_parameter(
+            [d_model], attr=ln1_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln2_scale = self.create_parameter(
+            [d_model], attr=ln2_scale_attr, default_initializer=Constant(1.0))
+        self.ln2_bias = self.create_parameter(
+            [d_model], attr=ln2_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+
+    def forward(self, x):
+        return F.fused_feedforward(
+            x, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate, activation=self.activation,
+            ln1_epsilon=self.epsilon, ln2_epsilon=self.epsilon,
+            pre_layer_norm=self.normalize_before, training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedMultiTransformer(Layer):
+    """Whole pre-LN decoder stack (reference ``FusedMultiTransformer``, the
+    serving workhorse)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 num_layers=-1, epsilon=1e-5, nranks=1, ring_id=-1,
+                 name=None, **attr_kwargs):
+        super().__init__()
+        if not normalize_before:
+            raise ValueError("FusedMultiTransformer is pre-LN "
+                             "(normalize_before=True), as in the reference")
+        if num_layers <= 0:
+            raise ValueError("num_layers must be positive")
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        head_dim = embed_dim // num_heads
+        mk = self.create_parameter
+        self.ln_scales, self.ln_biases = [], []
+        self.qkv_weights, self.qkv_biases = [], []
+        self.linear_weights, self.linear_biases = [], []
+        self.ffn_ln_scales, self.ffn_ln_biases = [], []
+        self.ffn1_weights, self.ffn1_biases = [], []
+        self.ffn2_weights, self.ffn2_biases = [], []
+        for i in range(num_layers):
+            self.ln_scales.append(mk([embed_dim], default_initializer=Constant(1.0)))
+            self.ln_biases.append(mk([embed_dim], is_bias=True, default_initializer=Constant(0.0)))
+            self.qkv_weights.append(mk([3, num_heads, head_dim, embed_dim],
+                                       default_initializer=XavierUniform()))
+            self.qkv_biases.append(mk([3, num_heads, head_dim], is_bias=True,
+                                      default_initializer=Constant(0.0)))
+            self.linear_weights.append(mk([embed_dim, embed_dim],
+                                          default_initializer=XavierUniform()))
+            self.linear_biases.append(mk([embed_dim], is_bias=True,
+                                         default_initializer=Constant(0.0)))
+            self.ffn_ln_scales.append(mk([embed_dim], default_initializer=Constant(1.0)))
+            self.ffn_ln_biases.append(mk([embed_dim], is_bias=True,
+                                         default_initializer=Constant(0.0)))
+            self.ffn1_weights.append(mk([embed_dim, dim_feedforward],
+                                        default_initializer=XavierUniform()))
+            self.ffn1_biases.append(mk([dim_feedforward], is_bias=True,
+                                       default_initializer=Constant(0.0)))
+            self.ffn2_weights.append(mk([dim_feedforward, embed_dim],
+                                        default_initializer=XavierUniform()))
+            self.ffn2_biases.append(mk([embed_dim], is_bias=True,
+                                       default_initializer=Constant(0.0)))
+        # register list params under stable names
+        for attr in ("ln_scales", "ln_biases", "qkv_weights", "qkv_biases",
+                     "linear_weights", "linear_biases", "ffn_ln_scales",
+                     "ffn_ln_biases", "ffn1_weights", "ffn1_biases",
+                     "ffn2_weights", "ffn2_biases"):
+            for i, p in enumerate(getattr(self, attr)):
+                self.add_parameter(f"{attr}_{i}", p)
+
+    def forward(self, x, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, time_step=None, seq_lens=None):
+        return F.fused_multi_transformer(
+            x, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=True, epsilon=self.epsilon, cache_kvs=caches,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            activation=self.activation, training=self.training)

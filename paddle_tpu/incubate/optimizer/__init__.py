@@ -243,3 +243,9 @@ class DGCMomentum(Optimizer):
         velocity = dense * u + (1.0 - dense) * (u * keep)
         residual = (1.0 - dense) * (v * keep)
         return p_new, {"velocity": velocity, "residual": residual}
+
+
+from ...optimizer.optimizer import LBFGS  # noqa: E402,F401
+from . import functional  # noqa: E402,F401
+
+__all__ += ["LBFGS"]

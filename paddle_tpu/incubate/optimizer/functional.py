@@ -1,0 +1,184 @@
+"""``paddle.incubate.optimizer.functional`` (reference:
+``python/paddle/incubate/optimizer/functional/{bfgs,lbfgs}.py``):
+functional quasi-Newton minimizers.
+
+The reference builds the iteration out of static-graph while_loops; here
+the objective is jax-traceable, so one ``jax.value_and_grad`` drives a
+host-side loop (each evaluation is one compiled call) with a strong-Wolfe
+line search — same convergence contract, returned flags included.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tensor import Tensor
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _value_and_grad(objective_func, dtype):
+    def raw(x):
+        out = objective_func(Tensor(x))
+        return (out._data if isinstance(out, Tensor)
+                else jnp.asarray(out)).astype(dtype).sum()
+
+    return jax.jit(jax.value_and_grad(raw))
+
+
+def _strong_wolfe(vg, x, d, f0, g0, alpha0, max_iters, c1=1e-4, c2=0.9):
+    """Bracketing strong-Wolfe line search (Nocedal & Wright alg. 3.5/3.6).
+    Returns (alpha, f_new, g_new, n_evals)."""
+    dphi0 = float(jnp.vdot(g0, d))
+    if dphi0 >= 0:           # not a descent direction; bail with tiny step
+        return 0.0, f0, g0, 0
+
+    def phi(a):
+        f, g = vg(x + a * d)
+        return float(f), g, float(jnp.vdot(g, d))
+
+    def zoom(lo, f_lo, hi, evals):
+        for _ in range(max_iters):
+            a = 0.5 * (lo + hi)
+            f_a, g_a, dphi_a = phi(a)
+            evals += 1
+            if f_a > f0 + c1 * a * dphi0 or f_a >= f_lo:
+                hi = a
+            else:
+                if abs(dphi_a) <= -c2 * dphi0:
+                    return a, f_a, g_a, evals
+                if dphi_a * (hi - lo) >= 0:
+                    hi = lo
+                lo, f_lo = a, f_a
+        f_a, g_a, _ = phi(lo)
+        return lo, f_a, g_a, evals + 1
+
+    a_prev, f_prev = 0.0, f0
+    a = alpha0
+    evals = 0
+    for i in range(max_iters):
+        f_a, g_a, dphi_a = phi(a)
+        evals += 1
+        if f_a > f0 + c1 * a * dphi0 or (i > 0 and f_a >= f_prev):
+            return zoom(a_prev, f_prev, a, evals)
+        if abs(dphi_a) <= -c2 * dphi0:
+            return a, f_a, g_a, evals
+        if dphi_a >= 0:
+            return zoom(a, f_a, a_prev, evals)
+        a_prev, f_prev = a, f_a
+        a *= 2.0
+    return a_prev if a_prev > 0 else a, f_a, g_a, evals
+
+
+def _minimize(objective_func, initial_position, *, lbfgs, history_size,
+              max_iters, tolerance_grad, tolerance_change, h0, max_ls_iters,
+              alpha0, dtype):
+    dt = jnp.dtype(dtype)
+    x = jnp.asarray(initial_position._data if isinstance(initial_position, Tensor)
+                    else initial_position, dt).reshape(-1)
+    n = x.shape[0]
+    vg = _value_and_grad(objective_func, dt)
+    f, g = vg(x)
+    n_evals = 1
+    H = (jnp.eye(n, dtype=dt) if h0 is None
+         else jnp.asarray(h0._data if isinstance(h0, Tensor) else h0, dt))
+    s_hist, y_hist = [], []
+    converged = False
+    for _ in range(max_iters):
+        if float(jnp.max(jnp.abs(g))) < tolerance_grad:
+            converged = True
+            break
+        if lbfgs:
+            # two-loop recursion over the curvature history
+            q = g
+            alphas = []
+            for s, y in reversed(list(zip(s_hist, y_hist))):
+                rho = 1.0 / float(jnp.vdot(y, s))
+                a = rho * float(jnp.vdot(s, q))
+                alphas.append((a, rho))
+                q = q - a * y
+            gamma = 1.0
+            if s_hist:
+                gamma = float(jnp.vdot(s_hist[-1], y_hist[-1])
+                              / jnp.vdot(y_hist[-1], y_hist[-1]))
+            r = gamma * q
+            for (a, rho), (s, y) in zip(reversed(alphas),
+                                        zip(s_hist, y_hist)):
+                b = rho * float(jnp.vdot(y, r))
+                r = r + (a - b) * s
+            d = -r
+        else:
+            d = -(H @ g)
+        alpha, f_new, g_new, e = _strong_wolfe(vg, x, d, float(f), g, alpha0,
+                                               max_ls_iters)
+        n_evals += e
+        if alpha == 0.0:
+            break
+        s = alpha * d
+        y = g_new - g
+        x_new = x + s
+        if float(jnp.max(jnp.abs(s))) < tolerance_change:
+            x, f, g = x_new, f_new, g_new
+            converged = True
+            break
+        sy = float(jnp.vdot(s, y))
+        if sy > 1e-10:
+            if lbfgs:
+                s_hist.append(s)
+                y_hist.append(y)
+                if len(s_hist) > history_size:
+                    s_hist.pop(0)
+                    y_hist.pop(0)
+            else:       # BFGS inverse-Hessian update
+                rho = 1.0 / sy
+                I = jnp.eye(n, dtype=dt)
+                V = I - rho * jnp.outer(s, y)
+                H = V @ H @ V.T + rho * jnp.outer(s, s)
+        x, f, g = x_new, f_new, g_new
+    shape = (np.asarray(initial_position._data).shape
+             if isinstance(initial_position, Tensor)
+             else np.asarray(initial_position).shape)
+    res = (Tensor(jnp.asarray(converged)), Tensor(jnp.asarray(n_evals)),
+           Tensor(x.reshape(shape)), Tensor(jnp.asarray(f)),
+           Tensor(g.reshape(shape)))
+    return res if lbfgs else res + (Tensor(H),)
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn="strong_wolfe", max_line_search_iters=50,
+                  initial_step_length=1.0, dtype="float32", name=None):
+    """Returns ``(is_converge, num_func_calls, position, objective_value,
+    objective_gradient, inverse_hessian_estimate)``."""
+    if line_search_fn != "strong_wolfe":
+        raise ValueError("only line_search_fn='strong_wolfe' is supported")
+    return _minimize(objective_func, initial_position, lbfgs=False,
+                     history_size=0, max_iters=max_iters,
+                     tolerance_grad=tolerance_grad,
+                     tolerance_change=tolerance_change,
+                     h0=initial_inverse_hessian_estimate,
+                     max_ls_iters=max_line_search_iters,
+                     alpha0=initial_step_length, dtype=dtype)
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-8, tolerance_change=1e-8,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", max_line_search_iters=50,
+                   initial_step_length=1.0, dtype="float32", name=None):
+    """Returns ``(is_converge, num_func_calls, position, objective_value,
+    objective_gradient)``."""
+    if line_search_fn != "strong_wolfe":
+        raise ValueError("only line_search_fn='strong_wolfe' is supported")
+    if initial_inverse_hessian_estimate is not None:
+        raise ValueError("L-BFGS keeps an implicit inverse-Hessian; pass "
+                         "initial_inverse_hessian_estimate to minimize_bfgs")
+    return _minimize(objective_func, initial_position, lbfgs=True,
+                     history_size=history_size, max_iters=max_iters,
+                     tolerance_grad=tolerance_grad,
+                     tolerance_change=tolerance_change, h0=None,
+                     max_ls_iters=max_line_search_iters,
+                     alpha0=initial_step_length, dtype=dtype)

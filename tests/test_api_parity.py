@@ -269,6 +269,14 @@ SUBMODULE_ABSENT = {
     ("distributed/fleet/utils/__init__.py", "distributed.fleet.utils"),
     ("distributed/passes/__init__.py", "distributed.passes"),
     ("distributed/rpc/__init__.py", "distributed.rpc"),
+    ("incubate/nn/__init__.py", "incubate.nn"),
+    ("incubate/nn/functional/__init__.py", "incubate.nn.functional"),
+    ("incubate/autograd/__init__.py", "incubate.autograd"),
+    ("incubate/optimizer/__init__.py", "incubate.optimizer"),
+    ("incubate/optimizer/functional/__init__.py",
+     "incubate.optimizer.functional"),
+    ("incubate/asp/__init__.py", "incubate.asp"),
+    ("incubate/distributed/fleet/__init__.py", "incubate.distributed.fleet"),
     ("audio/functional/__init__.py", "audio.functional"),
     ("io/__init__.py", "io"),
     ("vision/datasets/__init__.py", "vision.datasets"),

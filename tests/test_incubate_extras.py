@@ -1,0 +1,225 @@
+"""incubate.autograd functional transforms, fused transformer family,
+quasi-Newton minimizers, asp layer registry, and ctx-style recompute
+(references: ``python/paddle/incubate/autograd/``,
+``python/paddle/incubate/nn/fused_transformer.py``,
+``python/paddle/incubate/optimizer/functional/{bfgs,lbfgs}.py``,
+``python/paddle/incubate/asp/supported_layer_list.py:96``)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import autograd as iag
+
+RNG = np.random.default_rng(5)
+
+
+class TestFunctionalAutograd:
+    def _f(self, x):
+        return paddle.to_tensor(x._data ** 2 + 3 * x._data)
+
+    def test_jvp_vjp_agree_on_diagonal_jacobian(self):
+        x = paddle.to_tensor(np.arange(1.0, 4.0).astype("float32"))
+        v = paddle.to_tensor(np.array([1.0, 0.0, 2.0], np.float32))
+        expected = (2 * np.arange(1.0, 4.0) + 3) * np.array([1.0, 0.0, 2.0])
+        _, jv = iag.jvp(self._f, x, v)
+        _, vj = iag.vjp(self._f, x, v)
+        np.testing.assert_allclose(np.asarray(jv._data), expected, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(vj._data), expected, rtol=1e-6)
+
+    def test_vjp_returns_outputs_too(self):
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        ys, _ = iag.vjp(self._f, x)
+        np.testing.assert_allclose(np.asarray(ys._data), 4.0)
+
+    def test_jacobian_and_hessian(self):
+        x = paddle.to_tensor(np.arange(1.0, 4.0).astype("float32"))
+        J = iag.Jacobian(self._f, x)
+        assert J.shape == (3, 3)
+        np.testing.assert_allclose(np.asarray(J[:]._data),
+                                   np.diag(2 * np.arange(1.0, 4.0) + 3),
+                                   rtol=1e-6)
+        H = iag.Hessian(lambda t: paddle.to_tensor((t._data ** 3).sum()), x)
+        np.testing.assert_allclose(np.asarray(H[:]._data),
+                                   np.diag(6 * np.arange(1.0, 4.0)),
+                                   rtol=1e-5)
+
+    def test_forward_grad_and_grad(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        fg = iag.forward_grad(self._f, x)
+        np.testing.assert_allclose(np.asarray(fg._data), [7.0], rtol=1e-6)
+        g = iag.grad(self._f, x)
+        np.testing.assert_allclose(np.asarray(g._data), [7.0], rtol=1e-6)
+
+    def test_prim_toggle_recorded(self):
+        iag.disable_prim()
+        assert not iag.prim_enabled()
+        iag.enable_prim()
+        assert iag.prim_enabled()
+
+
+class TestQuasiNewton:
+    @staticmethod
+    def _rosen(t):
+        x = t._data
+        return paddle.to_tensor(100 * (x[1] - x[0] ** 2) ** 2
+                                + (1 - x[0]) ** 2)
+
+    def test_lbfgs_converges_on_rosenbrock(self):
+        from paddle_tpu.incubate.optimizer.functional import minimize_lbfgs
+
+        x0 = paddle.to_tensor(np.array([-1.2, 1.0], np.float32))
+        conv, nev, pos, val, grad = minimize_lbfgs(self._rosen, x0,
+                                                   max_iters=100)
+        assert bool(conv._data)
+        np.testing.assert_allclose(np.asarray(pos._data), [1.0, 1.0],
+                                   atol=1e-2)
+        assert int(nev._data) > 1
+
+    def test_bfgs_returns_inverse_hessian(self):
+        from paddle_tpu.incubate.optimizer.functional import minimize_bfgs
+
+        x0 = paddle.to_tensor(np.array([-1.2, 1.0], np.float32))
+        out = minimize_bfgs(self._rosen, x0, max_iters=100)
+        assert len(out) == 6 and tuple(out[5].shape) == (2, 2)
+        np.testing.assert_allclose(np.asarray(out[2]._data), [1.0, 1.0],
+                                   atol=1e-2)
+
+    def test_lbfgs_class_exported(self):
+        assert paddle.incubate.optimizer.LBFGS is paddle.optimizer.LBFGS
+
+
+class TestFusedTransformer:
+    def test_encoder_layer_trains(self):
+        from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+
+        m = FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+        x = paddle.to_tensor(RNG.normal(size=(2, 5, 32)).astype("float32"))
+        out = m(x)
+        assert tuple(out.shape) == (2, 5, 32)
+        (out ** 2).mean().backward()
+        g = m.fused_attn.qkv_weight.grad
+        assert g is not None and float(np.abs(np.asarray(g._data)).max()) > 0
+
+    def test_pre_vs_post_ln_differ(self):
+        from paddle_tpu.incubate.nn.functional import fused_multi_head_attention
+
+        x = paddle.to_tensor(RNG.normal(size=(1, 4, 16)).astype("float32"))
+        w = paddle.to_tensor(RNG.normal(size=(3, 2, 8, 16), scale=0.1).astype("float32"))
+        lw = paddle.to_tensor(np.eye(16, dtype=np.float32))
+        ln1 = paddle.to_tensor(np.ones(16, np.float32))
+        lb = paddle.to_tensor(np.zeros(16, np.float32))
+        pre = fused_multi_head_attention(
+            x, w, lw, pre_layer_norm=True, pre_ln_scale=ln1, pre_ln_bias=lb,
+            dropout_rate=0.0, attn_dropout_rate=0.0, training=False)
+        post = fused_multi_head_attention(
+            x, w, lw, pre_layer_norm=False, ln_scale=ln1, ln_bias=lb,
+            dropout_rate=0.0, attn_dropout_rate=0.0, training=False)
+        assert not np.allclose(np.asarray(pre._data), np.asarray(post._data))
+
+    def test_fused_feedforward_matches_manual(self):
+        from paddle_tpu.incubate.nn.functional import fused_feedforward
+
+        x = RNG.normal(size=(2, 3, 8)).astype("float32")
+        w1 = RNG.normal(size=(8, 16), scale=0.1).astype("float32")
+        w2 = RNG.normal(size=(16, 8), scale=0.1).astype("float32")
+        out = fused_feedforward(
+            paddle.to_tensor(x), paddle.to_tensor(w1), paddle.to_tensor(w2),
+            dropout1_rate=0.0, dropout2_rate=0.0, activation="relu",
+            pre_layer_norm=False, training=False,
+            ln2_scale=paddle.to_tensor(np.ones(8, np.float32)),
+            ln2_bias=paddle.to_tensor(np.zeros(8, np.float32)))
+        h = x + np.maximum(x @ w1, 0) @ w2
+        mu = h.mean(-1, keepdims=True)
+        ref = (h - mu) / np.sqrt(h.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(np.asarray(out._data), ref,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_fused_moe_weighted_combine(self):
+        from paddle_tpu.incubate.nn.functional import fused_moe
+
+        E, H, F_ = 8, 4, 8
+        x = paddle.to_tensor(RNG.normal(size=(2, 3, H)).astype("float32"))
+        out = fused_moe(
+            x, paddle.to_tensor(RNG.normal(size=(H, E)).astype("float32")),
+            paddle.to_tensor(RNG.normal(size=(E, H, F_), scale=0.1).astype("float32")),
+            paddle.to_tensor(np.zeros((E, F_), np.float32)),
+            paddle.to_tensor(RNG.normal(size=(E, F_, H), scale=0.1).astype("float32")),
+            paddle.to_tensor(np.zeros((E, H), np.float32)), top_k=2)
+        assert tuple(out.shape) == (2, 3, H)
+
+    def test_varlen_attention_masks_past_lengths(self):
+        from paddle_tpu.incubate.nn.functional import (
+            variable_length_memory_efficient_attention)
+
+        B, Hh, S, D = 2, 2, 6, 4
+        q = paddle.to_tensor(RNG.normal(size=(B, Hh, S, D)).astype("float32"))
+        k = paddle.to_tensor(RNG.normal(size=(B, Hh, S, D)).astype("float32"))
+        v = paddle.to_tensor(RNG.normal(size=(B, Hh, S, D)).astype("float32"))
+        out = variable_length_memory_efficient_attention(
+            q, k, v, np.array([3, 6]), np.array([3, 6]))
+        got = np.asarray(out._data)
+        assert np.all(got[0, :, 3:] == 0)         # query rows past length 3
+        assert np.any(got[1, :, 3:] != 0)
+
+    def test_blha_get_max_len(self):
+        from paddle_tpu.incubate.nn.functional import blha_get_max_len
+
+        me, md = blha_get_max_len(np.array([3, 9, 4]), np.array([1, 2, 7]), 3)
+        assert int(me._data) == 9 and int(md._data) == 7
+
+    def test_multi_transformer_stack(self):
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+        m = FusedMultiTransformer(16, 2, 32, num_layers=2)
+        x = paddle.to_tensor(RNG.normal(size=(1, 4, 16)).astype("float32"))
+        assert tuple(m(x).shape) == (1, 4, 16)
+        with pytest.raises(ValueError, match="pre-LN"):
+            FusedMultiTransformer(16, 2, 32, num_layers=2,
+                                  normalize_before=False)
+
+
+class TestAspRegistry:
+    def test_custom_pruning_func_applies(self):
+        from paddle_tpu import nn
+        from paddle_tpu.incubate import asp
+
+        class MyProj(nn.Linear):
+            pass
+
+        calls = []
+
+        def my_prune(w, n, m, algo, name):
+            calls.append(name)
+            mask = np.zeros_like(w)
+            mask[..., ::2] = 1
+            return w * mask, mask
+
+        asp.add_supported_layer(MyProj, my_prune)
+        model = nn.Sequential(MyProj(8, 8), nn.Linear(8, 8))
+        masks = asp.prune_model(model, n=2, m=4)
+        assert calls and len(masks) >= 2
+        w = np.asarray(model[0].weight._data)
+        assert np.all(w[..., 1::2] == 0)
+        assert "MyProj" in asp.supported_layers()
+
+    def test_registry_validates(self):
+        from paddle_tpu.incubate import asp
+
+        with pytest.raises(ValueError, match="Layer"):
+            asp.add_supported_layer(123)
+
+
+def test_recompute_sequential_matches_plain_forward():
+    from paddle_tpu import nn
+    from paddle_tpu.incubate.distributed.fleet import (recompute_hybrid,
+                                                       recompute_sequential)
+
+    paddle.seed(0)
+    seq = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 8))
+    x = paddle.to_tensor(np.ones((2, 8), np.float32))
+    ref = np.asarray(seq(x)._data)
+    out = recompute_sequential({"segments": 2}, seq, x)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-6)
+    out2 = recompute_hybrid({"mp_group": None}, seq, x)
+    np.testing.assert_allclose(np.asarray(out2._data), ref, rtol=1e-6)

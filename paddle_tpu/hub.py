@@ -62,3 +62,27 @@ def load(repo_dir: str, model: str, source: str = "github",
     """Call the entrypoint with kwargs and return the model."""
     _check_source(repo_dir, source)
     return getattr(_load_hubconf(repo_dir), model)(**kwargs)
+
+
+def load_state_dict_from_path(path, map_location=None):
+    """Load a checkpoint state dict from a local file (``paddle.save``
+    .pdparams pickle or a numpy ``.npz``) — the no-network counterpart of
+    the reference hub's download-then-load
+    (``python/paddle/hapi/hub.py`` load_state_dict_from_url)."""
+    import os
+
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"checkpoint not found at {path}; no network access — "
+            "place the file locally")
+    if path.endswith(".npz"):
+        import numpy as np
+
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+    from .framework.io import load as _load
+
+    return _load(path)
+
+
+__all__ += ["load_state_dict_from_path"]

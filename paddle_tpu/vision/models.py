@@ -141,23 +141,33 @@ class ResNet(nn.Layer):
 
 
 def resnet18(pretrained=False, **kwargs):
-    return ResNet(BasicBlock, 18, **kwargs)
+    from .models_zoo import _maybe_load_pretrained
+
+    return _maybe_load_pretrained(ResNet(BasicBlock, 18, **kwargs), pretrained)
 
 
 def resnet34(pretrained=False, **kwargs):
-    return ResNet(BasicBlock, 34, **kwargs)
+    from .models_zoo import _maybe_load_pretrained
+
+    return _maybe_load_pretrained(ResNet(BasicBlock, 34, **kwargs), pretrained)
 
 
 def resnet50(pretrained=False, **kwargs):
-    return ResNet(BottleneckBlock, 50, **kwargs)
+    from .models_zoo import _maybe_load_pretrained
+
+    return _maybe_load_pretrained(ResNet(BottleneckBlock, 50, **kwargs), pretrained)
 
 
 def resnet101(pretrained=False, **kwargs):
-    return ResNet(BottleneckBlock, 101, **kwargs)
+    from .models_zoo import _maybe_load_pretrained
+
+    return _maybe_load_pretrained(ResNet(BottleneckBlock, 101, **kwargs), pretrained)
 
 
 def resnet152(pretrained=False, **kwargs):
-    return ResNet(BottleneckBlock, 152, **kwargs)
+    from .models_zoo import _maybe_load_pretrained
+
+    return _maybe_load_pretrained(ResNet(BottleneckBlock, 152, **kwargs), pretrained)
 
 
 class VGG(nn.Layer):
@@ -323,11 +333,15 @@ class MobileNetV3(nn.Layer):
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV3(_MOBILENETV3_SMALL, last_channel=1024, scale=scale, **kwargs)
+    from .models_zoo import _maybe_load_pretrained
+
+    return _maybe_load_pretrained(MobileNetV3(_MOBILENETV3_SMALL, last_channel=1024, scale=scale, **kwargs), pretrained)
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV3(_MOBILENETV3_LARGE, last_channel=1280, scale=scale, **kwargs)
+    from .models_zoo import _maybe_load_pretrained
+
+    return _maybe_load_pretrained(MobileNetV3(_MOBILENETV3_LARGE, last_channel=1280, scale=scale, **kwargs), pretrained)
 
 
 from .models_zoo import *  # noqa: E402,F401,F403

@@ -40,6 +40,23 @@ __all__ = [
 ]
 
 
+def _maybe_load_pretrained(model, pretrained):
+    """``pretrained`` as a local checkpoint path loads the weights
+    (``hub.load_state_dict_from_path``); ``True`` has no download to run
+    in this environment and says so."""
+    if not pretrained:
+        return model
+    if pretrained is True:
+        raise ValueError(
+            "pretrained=True needs a weight download; no network access — "
+            "pass pretrained='/path/to/ckpt.pdparams' (or convert an HF "
+            "checkpoint via models.hf_compat)")
+    from ..hub import load_state_dict_from_path
+
+    model.set_state_dict(load_state_dict_from_path(pretrained))
+    return model
+
+
 def _conv_bn(in_c, out_c, k, stride=1, padding=0, groups=1, act=nn.ReLU):
     return nn.Sequential(
         nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
@@ -83,7 +100,7 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
-    return AlexNet(**kwargs)
+    return _maybe_load_pretrained(AlexNet(**kwargs), pretrained)
 
 
 # ---------------------------------------------------------------------------
@@ -99,15 +116,15 @@ _VGG_CFGS = {
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):
-    return VGG(_vgg_layers(_VGG_CFGS[11], batch_norm), **kwargs)
+    return _maybe_load_pretrained(VGG(_vgg_layers(_VGG_CFGS[11], batch_norm), **kwargs), pretrained)
 
 
 def vgg13(pretrained=False, batch_norm=False, **kwargs):
-    return VGG(_vgg_layers(_VGG_CFGS[13], batch_norm), **kwargs)
+    return _maybe_load_pretrained(VGG(_vgg_layers(_VGG_CFGS[13], batch_norm), **kwargs), pretrained)
 
 
 def vgg19(pretrained=False, batch_norm=False, **kwargs):
-    return VGG(_vgg_layers(_VGG_CFGS[19], batch_norm), **kwargs)
+    return _maybe_load_pretrained(VGG(_vgg_layers(_VGG_CFGS[19], batch_norm), **kwargs), pretrained)
 
 
 # ---------------------------------------------------------------------------
@@ -173,11 +190,11 @@ class SqueezeNet(nn.Layer):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
-    return SqueezeNet("1.0", **kwargs)
+    return _maybe_load_pretrained(SqueezeNet("1.0", **kwargs), pretrained)
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
-    return SqueezeNet("1.1", **kwargs)
+    return _maybe_load_pretrained(SqueezeNet("1.1", **kwargs), pretrained)
 
 
 # ---------------------------------------------------------------------------
@@ -256,23 +273,23 @@ class DenseNet(nn.Layer):
 
 
 def densenet121(pretrained=False, **kwargs):
-    return DenseNet(layers=121, **kwargs)
+    return _maybe_load_pretrained(DenseNet(layers=121, **kwargs), pretrained)
 
 
 def densenet161(pretrained=False, **kwargs):
-    return DenseNet(layers=161, **kwargs)
+    return _maybe_load_pretrained(DenseNet(layers=161, **kwargs), pretrained)
 
 
 def densenet169(pretrained=False, **kwargs):
-    return DenseNet(layers=169, **kwargs)
+    return _maybe_load_pretrained(DenseNet(layers=169, **kwargs), pretrained)
 
 
 def densenet201(pretrained=False, **kwargs):
-    return DenseNet(layers=201, **kwargs)
+    return _maybe_load_pretrained(DenseNet(layers=201, **kwargs), pretrained)
 
 
 def densenet264(pretrained=False, **kwargs):
-    return DenseNet(layers=264, **kwargs)
+    return _maybe_load_pretrained(DenseNet(layers=264, **kwargs), pretrained)
 
 
 # ---------------------------------------------------------------------------
@@ -360,7 +377,7 @@ class GoogLeNet(nn.Layer):
 
 
 def googlenet(pretrained=False, **kwargs):
-    return GoogLeNet(**kwargs)
+    return _maybe_load_pretrained(GoogLeNet(**kwargs), pretrained)
 
 
 # ---------------------------------------------------------------------------
@@ -494,7 +511,7 @@ class InceptionV3(nn.Layer):
 
 
 def inception_v3(pretrained=False, **kwargs):
-    return InceptionV3(**kwargs)
+    return _maybe_load_pretrained(InceptionV3(**kwargs), pretrained)
 
 
 # ---------------------------------------------------------------------------
@@ -540,7 +557,7 @@ class MobileNetV1(nn.Layer):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV1(scale=scale, **kwargs)
+    return _maybe_load_pretrained(MobileNetV1(scale=scale, **kwargs), pretrained)
 
 
 _MOBILENETV2_CFG = [  # (expansion t, out_c, repeats n, first stride s)
@@ -606,7 +623,7 @@ class MobileNetV2(nn.Layer):
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV2(scale=scale, **kwargs)
+    return _maybe_load_pretrained(MobileNetV2(scale=scale, **kwargs), pretrained)
 
 
 class MobileNetV3Small(MobileNetV3):
@@ -719,31 +736,31 @@ class ShuffleNetV2(nn.Layer):
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=0.25, **kwargs)
+    return _maybe_load_pretrained(ShuffleNetV2(scale=0.25, **kwargs), pretrained)
 
 
 def shufflenet_v2_x0_33(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=0.33, **kwargs)
+    return _maybe_load_pretrained(ShuffleNetV2(scale=0.33, **kwargs), pretrained)
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=0.5, **kwargs)
+    return _maybe_load_pretrained(ShuffleNetV2(scale=0.5, **kwargs), pretrained)
 
 
 def shufflenet_v2_x1_0(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=1.0, **kwargs)
+    return _maybe_load_pretrained(ShuffleNetV2(scale=1.0, **kwargs), pretrained)
 
 
 def shufflenet_v2_x1_5(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=1.5, **kwargs)
+    return _maybe_load_pretrained(ShuffleNetV2(scale=1.5, **kwargs), pretrained)
 
 
 def shufflenet_v2_x2_0(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=2.0, **kwargs)
+    return _maybe_load_pretrained(ShuffleNetV2(scale=2.0, **kwargs), pretrained)
 
 
 def shufflenet_v2_swish(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)
+    return _maybe_load_pretrained(ShuffleNetV2(scale=1.0, act="swish", **kwargs), pretrained)
 
 
 # ---------------------------------------------------------------------------
@@ -751,32 +768,32 @@ def shufflenet_v2_swish(pretrained=False, **kwargs):
 # ---------------------------------------------------------------------------
 
 def resnext50_32x4d(pretrained=False, **kwargs):
-    return ResNet(BottleneckBlock, 50, groups=32, width_per_group=4, **kwargs)
+    return _maybe_load_pretrained(ResNet(BottleneckBlock, 50, groups=32, width_per_group=4, **kwargs), pretrained)
 
 
 def resnext50_64x4d(pretrained=False, **kwargs):
-    return ResNet(BottleneckBlock, 50, groups=64, width_per_group=4, **kwargs)
+    return _maybe_load_pretrained(ResNet(BottleneckBlock, 50, groups=64, width_per_group=4, **kwargs), pretrained)
 
 
 def resnext101_32x4d(pretrained=False, **kwargs):
-    return ResNet(BottleneckBlock, 101, groups=32, width_per_group=4, **kwargs)
+    return _maybe_load_pretrained(ResNet(BottleneckBlock, 101, groups=32, width_per_group=4, **kwargs), pretrained)
 
 
 def resnext101_64x4d(pretrained=False, **kwargs):
-    return ResNet(BottleneckBlock, 101, groups=64, width_per_group=4, **kwargs)
+    return _maybe_load_pretrained(ResNet(BottleneckBlock, 101, groups=64, width_per_group=4, **kwargs), pretrained)
 
 
 def resnext152_32x4d(pretrained=False, **kwargs):
-    return ResNet(BottleneckBlock, 152, groups=32, width_per_group=4, **kwargs)
+    return _maybe_load_pretrained(ResNet(BottleneckBlock, 152, groups=32, width_per_group=4, **kwargs), pretrained)
 
 
 def resnext152_64x4d(pretrained=False, **kwargs):
-    return ResNet(BottleneckBlock, 152, groups=64, width_per_group=4, **kwargs)
+    return _maybe_load_pretrained(ResNet(BottleneckBlock, 152, groups=64, width_per_group=4, **kwargs), pretrained)
 
 
 def wide_resnet50_2(pretrained=False, **kwargs):
-    return ResNet(BottleneckBlock, 50, width_per_group=128, **kwargs)
+    return _maybe_load_pretrained(ResNet(BottleneckBlock, 50, width_per_group=128, **kwargs), pretrained)
 
 
 def wide_resnet101_2(pretrained=False, **kwargs):
-    return ResNet(BottleneckBlock, 101, width_per_group=128, **kwargs)
+    return _maybe_load_pretrained(ResNet(BottleneckBlock, 101, width_per_group=128, **kwargs), pretrained)

@@ -130,3 +130,26 @@ def test_zoo_model_trains_compiled():
     y = paddle.to_tensor(np.array([0, 1, 2, 3], dtype=np.int64))
     losses = [float(step(x, y)) for _ in range(8)]
     assert losses[-1] < losses[0]
+
+
+def test_pretrained_local_path_roundtrip(tmp_path):
+    """pretrained= accepts a local checkpoint path; True explains the
+    no-network stance (reference downloads; hub.load_state_dict_from_path
+    is the local counterpart)."""
+    import os
+
+    m1 = M.squeezenet1_1(num_classes=5)
+    p = os.path.join(tmp_path, "sq.pdparams")
+    paddle.save(m1.state_dict(), p)
+    m2 = M.squeezenet1_1(pretrained=p, num_classes=5)
+    x = _x(b=1)
+    m1.eval()
+    m2.eval()
+    np.testing.assert_allclose(np.asarray(m1(x)._data),
+                               np.asarray(m2(x)._data), rtol=1e-6)
+    with pytest.raises(ValueError, match="no network access"):
+        M.resnet18(pretrained=True)
+    from paddle_tpu.hub import load_state_dict_from_path
+
+    with pytest.raises(FileNotFoundError):
+        load_state_dict_from_path(os.path.join(tmp_path, "missing.pdparams"))

@@ -46,6 +46,9 @@ class TestPasses:
         assert ctx.get_attr("fuse_optimizer") == "absorbed-by-XLA"
 
     def test_recompute_pass_flags_program_and_trains(self):
+        # pinned seed: the tiny-net SGD trajectory is init-sensitive at this
+        # lr, and other tests legitimately advance the global RNG stream
+        paddle.seed(0)
         paddle.enable_static()
         try:
             from paddle_tpu import static

@@ -155,17 +155,23 @@ def _fused_softmax_block(qb, kb, vb, base_pos, L, sm_scale, carry,
 
     qb: [hk, rep, d] fp32; kb/vb: VMEM buffers in their NATIVE layout —
     ``heads_axis`` says where the kv-head dim sits ([bk, hk, d] for the
-    dense cache, [hk, bs, d] for the paged pool) so no relayout happens:
-    dot_general's batch dims address the buffer as-is.  base_pos: absolute
-    position of the block's first row.  Returns the updated (acc, m, l).
+    dense cache, [hk, bs, d] for the paged pool).  Mosaic's batched matmul
+    requires the batch dim LEADING on both operands (compile-checked on a
+    v5e: ``tpu.matmul`` rejects mixed batch positions with "batch dims must
+    be equal"), so a non-leading heads axis is relayouted here — a
+    VMEM-local vector shuffle, NOT the per-step full-cache HBM transpose
+    this kernel family exists to avoid.  base_pos: absolute position of the
+    block's first row.  Returns the updated (acc, m, l).
     """
     acc, m_prev, l_prev = carry
     hk, rep, _ = qb.shape
-    block_axis = 1 - heads_axis
-    bk = kb.shape[block_axis]
+    if heads_axis != 0:
+        kb = jnp.swapaxes(kb, 0, 1)
+        vb = jnp.swapaxes(vb, 0, 1)
     kf = kb.astype(jnp.float32)
     vf = vb.astype(jnp.float32)
-    s = jax.lax.dot_general(qb, kf, (((2,), (2,)), ((0,), (heads_axis,))),
+    bk = kf.shape[1]
+    s = jax.lax.dot_general(qb, kf, (((2,), (2,)), ((0,), (0,))),
                             preferred_element_type=jnp.float32) * sm_scale
     k_pos = base_pos + jax.lax.broadcasted_iota(jnp.int32, (hk, rep, bk), 2)
     s = jnp.where(k_pos < L, s, NEG_INF)
@@ -175,7 +181,7 @@ def _fused_softmax_block(qb, kb, vb, base_pos, L, sm_scale, carry,
     alpha = jnp.exp(m_prev - m_new)
     l_new = alpha * l_prev + jnp.sum(p, axis=2)
     acc = acc * alpha[..., None] + jax.lax.dot_general(
-        p, vf, (((2,), (block_axis,)), ((0,), (heads_axis,))),
+        p, vf, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32)
     return acc, m_new, l_new
 

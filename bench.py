@@ -352,10 +352,12 @@ def _bench_decode(jax, paddle, backend, on_tpu, args):
 def _bench_serve(jax, paddle, backend, on_tpu, args):
     """Serving engine under a mixed-request trace: continuous batching over
     the paged KV cache (admission, block growth, prefill/decode interleave,
-    fused sampling). Reports aggregate new tokens/s; ``vs_baseline`` is the
-    fraction of the weight-streaming bound at the DECODE-phase rate
-    (decode reads every param per step; prefill is compute-bound and timed
-    separately)."""
+    fused sampling, deferred-sync async dispatch). Reports aggregate new
+    tokens/s; ``vs_baseline`` is a MIXED-TRACE roofline — ideal wall
+    (decode weight-streaming + prefill compute at peak) / measured wall —
+    because the engine pipelines prefill and decode in one async stream.
+    ``decode_time_s``/``prefill_time_s`` are DISPATCH time only (~ms per
+    call), not execution time."""
     import numpy as np
 
     from paddle_tpu.models import LlamaForCausalLM
@@ -389,14 +391,9 @@ def _bench_serve(jax, paddle, backend, on_tpu, args):
         max_new_tokens=int(rng.integers(n_lo, n_hi + 1)))
         for _ in range(n_req)]
 
-    # warm the compiled programs: one tiny request PER PREFILL BUCKET (plus
-    # the shared decode program) so no XLA compile lands in the timed window
-    for b in eng.prefill_buckets:
-        eng.add_request(GenRequest(
-            prompt_ids=rng.integers(1, cfg.vocab_size,
-                                    size=(min(b, p_hi),)).astype(np.int32),
-            max_new_tokens=2))
-    eng.run_to_completion()
+    # warm every program the engine can hit (prefill buckets + the whole
+    # decode-chunk ladder) so no XLA compile lands in the timed window
+    eng.warmup()
     eng.stats = {k: (0.0 if isinstance(v, float) else 0)
                  for k, v in eng.stats.items()}
 
@@ -411,13 +408,21 @@ def _bench_serve(jax, paddle, backend, on_tpu, args):
     tokens_per_sec = gen / dt
     decode_steps = eng.stats["decode_steps"]
     decode_time = eng.stats["decode_time"] or dt
-    dev_kind, _ = _peak_flops(jax, on_tpu)
+    dev_kind, peak = _peak_flops(jax, on_tpu)
     param_bytes = n_params * (2 if dtype == "bfloat16" else 4)
     hbm = 819e9 if on_tpu else None
-    # weight-stream bound at the DECODE-phase rate (the engine times decode
-    # steps separately; one full param read serves the whole decode batch)
     avg_batch = gen / max(decode_steps, 1)
-    frac_bound = ((decode_steps / decode_time) * param_bytes / hbm) if hbm else 0.0
+    # mixed-trace roofline: the engine pipelines prefill and decode in one
+    # async dispatch stream (deferred-sync drain), so per-phase timing is
+    # meaningless — vs_baseline is ideal wall / measured wall, where ideal =
+    # decode weight-streaming (one full param read per decode step) +
+    # prefill compute at MXU peak (prefill is compute-bound)
+    if hbm:
+        ideal = (decode_steps * param_bytes / hbm
+                 + eng.stats["prefill_tokens"] * 2.0 * n_params / peak)
+        frac_bound = ideal / dt
+    else:
+        frac_bound = 0.0
     return {
         "metric": "llama_serve_new_tokens_per_sec",
         "value": round(tokens_per_sec, 2),

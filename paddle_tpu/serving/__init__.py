@@ -9,14 +9,32 @@ TPU-native design:
 
 - **Two compiled programs, not a graph pass pipeline.** A bucketed *prefill*
   program (dense causal attention over the padded prompt, K/V scattered into
-  the paged pools afterwards) and ONE batched *decode* program (single token
-  for every active slot, paged attention via the block-table Pallas kernel,
-  sampling fused in). Static shapes everywhere: the decode batch is always
-  ``max_batch`` wide with inactive slots masked by ``lengths == 0``.
+  the paged pools afterwards) and a batched *decode-chunk* program (paged
+  attention via the block-table Pallas kernel, sampling fused in). Static
+  shapes everywhere: the decode batch is always ``max_batch`` wide with
+  inactive slots masked by ``lengths == 0``.
+- **Chunked on-device decode.** One compiled call runs ``k`` decode steps as
+  a ``lax.scan`` (k from a power-of-two ladder), so per-call costs amortize
+  over ``k`` tokens.  A sequence whose budget ends mid-chunk simply stops
+  being collected; its tail sub-steps decode into its own about-to-be-freed
+  blocks (or the trash block) and are discarded.
+- **Sync only when token VALUES are needed.** Measured on the remote-tunnel
+  v5e: a host readback costs ~65 ms while an async dispatch costs ~3.5 ms.
+  So the scheduler never reads tokens back per step — the ``last``-token
+  vector lives ON DEVICE (threaded chunk→chunk, prefilled slots scattered
+  in), every prefill/chunk call is dispatched asynchronously in device
+  order, and an ownership ledger records at dispatch time which request
+  owns which (sub-step, slot) cell.  Token values are materialized in ONE
+  fused readback at a sync point: finish emission, an eviction that must
+  fold generated tokens back into a prompt, or drain end.  Without eos
+  the whole schedule is host-deterministic, so ``run_to_completion``
+  dispatches everything and syncs once; with eos in play each round syncs
+  so stop-tokens can cut sequences (the chunk tail past an eos is
+  discarded).
 - **Host-side scheduler, device-side math.** Admission, block allocation,
   growth, eviction, and finish detection are plain Python over a numpy block
-  table (shipped to the device each step — [max_batch, max_blocks] int32 is
-  tiny); everything per-token runs in the compiled step.
+  table (shipped to the device each chunk — [max_batch, max_blocks] int32 is
+  tiny); everything per-token runs in the compiled programs.
 - **Preemption over OOM.** When a sequence needs a block and the pool is
   empty, the youngest running sequence is evicted back to the waiting queue
   (recompute-style preemption) — admission control the reference does with
@@ -51,6 +69,12 @@ class GenRequest:
     # generated before a preemption folded them into ``prompt_ids``
     orig_prompt_ids: Optional[np.ndarray] = None
     prior_output: List[int] = field(default_factory=list)
+    # deferred-sync bookkeeping (internal): token values materialize here at
+    # sync time; counts are tracked on the slot at dispatch time
+    _out_vals: List[int] = field(default_factory=list)
+    _stopped: bool = field(default=False)
+    _emitted: bool = field(default=False)
+    _prefill_dt: float = field(default=0.0)
 
 
 @dataclass
@@ -69,10 +93,8 @@ class _Slot:
     req: Optional[GenRequest] = None
     length: int = 0                        # tokens in cache (prompt + generated)
     blocks: List[int] = field(default_factory=list)
-    out_ids: List[int] = field(default_factory=list)
-    last_token: int = 0
+    out_count: int = 0                     # tokens emitted (incl. pending sync)
     admit_seq: int = 0                     # admission order (eviction priority)
-    prefill_dt: float = 0.0
 
 
 class Engine:
@@ -85,12 +107,16 @@ class Engine:
         while eng.has_work():
             for out in eng.step():
                 print(out.output_ids)
+
+    ``step()`` syncs every round (streaming semantics);
+    ``run_to_completion()`` defers syncs while no active request uses eos,
+    dispatching the whole schedule asynchronously.
     """
 
     def __init__(self, model, max_batch: int = 8, num_blocks: int = 256,
                  block_size: int = 128,
                  prefill_buckets: Tuple[int, ...] = (128, 256, 512, 1024),
-                 max_prefill_overhead: float = 1.0):
+                 max_prefill_overhead: float = 1.0, decode_chunk: int = 32):
         from ..jit import functional_call
 
         self.model = model
@@ -128,11 +154,36 @@ class Engine:
         self._waiting: collections.deque = collections.deque()
         self._admit_counter = 0
         self._req_counter = 0
-        self._decode_fn = None
+        self._tok_seg_rows = 1024
+        # a chunk must fit one token segment buffer (dynamic_update_slice
+        # cannot write an update larger than its operand)
+        self.decode_chunk = max(1, min(int(decode_chunk), self._tok_seg_rows))
+        self._decode_fns: Dict[int, object] = {}
         self._prefill_fns: Dict[int, object] = {}
+        # device-resident last-token vector: threaded chunk -> chunk, so no
+        # decode round trip is ever needed to BUILD the next decode's inputs
+        self._last_dev = jnp.zeros((max_batch,), jnp.int32)
+        # device-side token accumulators: each program WRITES its sampled
+        # tokens into a segment buffer (chunk rows / prefill firsts), so a
+        # sync reads back a handful of segment arrays instead of one array
+        # per call — on the remote tunnel each readback is a full round trip
+        # (measured ~65 ms), which made per-call reads the whole serving wall
+        self._tok_buf = jnp.zeros((self._tok_seg_rows, max_batch), jnp.int32)
+        self._tok_row = 0
+        self._first_seg = 512
+        self._first_buf = jnp.zeros((self._first_seg,), jnp.int32)
+        self._first_idx = 0
+        self._full_tok_bufs: List[object] = []
+        self._full_first_bufs: List[object] = []
+        # deferred-sync state: dispatch-ordered ledger of unmaterialized
+        # tokens, dispatch-decided finishes, and finished outputs to drain
+        self._pending: List[tuple] = []
+        self._finish_order: List[GenRequest] = []
+        self._ready: List[RequestOutput] = []
         self.stats = {"decode_steps": 0, "prefills": 0, "evictions": 0,
                       "generated_tokens": 0, "decode_time": 0.0,
-                      "prefill_time": 0.0}
+                      "prefill_time": 0.0, "prefill_tokens": 0,
+                      "decode_calls": 0, "syncs": 0, "sync_time": 0.0}
 
     # -- public API ---------------------------------------------------------
 
@@ -158,22 +209,44 @@ class Engine:
         return bool(self._waiting) or any(s.req is not None for s in self._slots)
 
     def step(self) -> List[RequestOutput]:
-        """Admit + prefill new requests, run one batched decode step, return
-        any requests that finished this step."""
-        self._admit()
-        if not any(s.req is not None for s in self._slots):
-            return []
-        self._ensure_decode_blocks()
-        next_tokens = self._decode()
-        return self._collect(next_tokens)
+        """Admit + prefill new requests, run one decode chunk, sync, and
+        return any requests that finished (streaming semantics: every step
+        materializes its tokens)."""
+        self._round()
+        self._sync_pending()
+        return self._drain_ready()
 
     def run_to_completion(self) -> List[RequestOutput]:
-        done: List[RequestOutput] = []
+        """Drain the queue.  While no ACTIVE request uses eos the schedule is
+        host-deterministic, so rounds are dispatched back-to-back with no
+        readback and one final sync materializes everything."""
         while self.has_work():
-            done.extend(self.step())
-        return done
+            self._round()
+            if any(s.req is not None and s.req.eos_token_id is not None
+                   for s in self._slots):
+                self._sync_pending()
+        self._sync_pending()
+        return self._drain_ready()
 
     # -- scheduling ---------------------------------------------------------
+
+    def _round(self):
+        self._admit()
+        active = [s for s in self._slots if s.req is not None]
+        if not active:
+            return
+        k = self._pick_chunk(active)
+        self._ensure_decode_blocks(k)
+        self._dispatch_chunk(k)
+
+    def _pick_chunk(self, active) -> int:
+        """Largest power-of-two chunk within the LONGEST remaining budget.
+        Short-remaining sequences stop being collected mid-chunk; their tail
+        sub-steps are wasted compute, bounded by the chunk length — the
+        trade against the ~per-call overhead the chunk amortizes."""
+        rem = max(s.req.max_new_tokens - s.out_count for s in active)
+        k = min(max(rem, 1), self.decode_chunk)
+        return 1 << (k.bit_length() - 1)
 
     def _bucket(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -207,14 +280,18 @@ class Engine:
             slot.req = req
             slot.length = len(req.prompt_ids)
             slot.blocks = blocks
-            slot.out_ids = []
+            slot.out_count = 0
             slot.admit_seq = self._admit_counter
             self._prefill(slot, Pb)
+            slot.out_count = 1
             # release bucket-padding blocks beyond the prompt's true need
             needed = -(-slot.length // self.block_size)
             while len(slot.blocks) > max(needed, 1):
                 self._free.append(slot.blocks.pop())
             self._write_tbl_row(slot)
+            if slot.out_count >= req.max_new_tokens:
+                self._finish_order.append(req)
+                self._release(slot)
 
     def _write_tbl_row(self, slot: _Slot):
         i = slot.idx
@@ -222,14 +299,19 @@ class Engine:
         row[:len(slot.blocks)] = slot.blocks
         self._tbl[i] = row
 
-    def _ensure_decode_blocks(self):
-        """The next decode writes at position ``length`` — if that starts a
-        new block, allocate it (evicting the youngest sequence on pressure)."""
+    def _ensure_decode_blocks(self, k: int = 1):
+        """The next ``k`` decode steps write positions ``length`` through
+        ``length + k - 1`` — allocate every block that window touches, per
+        slot clipped to its remaining budget (evicting the youngest sequence
+        on pressure).  Writes past a finished sequence's window land in the
+        trash block (unallocated table entries are 0) or its own about-to-be
+        -freed blocks — never in another sequence's memory."""
         for slot in sorted((s for s in self._slots if s.req is not None),
                            key=lambda s: s.admit_seq):
             if slot.req is None:
                 continue           # evicted by an earlier slot's growth
-            need_idx = slot.length // self.block_size
+            w = min(k, max(slot.req.max_new_tokens - slot.out_count, 1))
+            need_idx = (slot.length + w - 1) // self.block_size
             while slot.req is not None and need_idx >= len(slot.blocks):
                 if self._free:
                     slot.blocks.append(self._free.popleft())
@@ -250,20 +332,27 @@ class Engine:
 
     def _evict(self, slot: _Slot):
         """Recompute-style preemption: requeue the request (with its already
-        generated tokens prepended to the prompt) and free its blocks."""
+        generated tokens prepended to the prompt) and free its blocks.  The
+        merge needs token VALUES, so a deferred-sync backlog materializes
+        here first."""
+        self._sync_pending()
         req = slot.req
+        if req is None:
+            # the sync itself released this slot (the victim's pending first
+            # token was its eos): nothing left to requeue
+            return
         merged = np.concatenate(
             [np.asarray(req.prompt_ids, np.int32),
-             np.asarray(slot.out_ids, np.int32)]) if slot.out_ids else \
+             np.asarray(req._out_vals, np.int32)]) if req._out_vals else \
             np.asarray(req.prompt_ids, np.int32)
         requeued = GenRequest(
             prompt_ids=merged,
-            max_new_tokens=req.max_new_tokens - len(slot.out_ids),
+            max_new_tokens=req.max_new_tokens - len(req._out_vals),
             temperature=req.temperature, eos_token_id=req.eos_token_id,
             request_id=req.request_id,
             orig_prompt_ids=(req.orig_prompt_ids if req.orig_prompt_ids
                              is not None else req.prompt_ids),
-            prior_output=req.prior_output + list(slot.out_ids))
+            prior_output=req.prior_output + list(req._out_vals))
         self._waiting.appendleft(requeued)
         self._release(slot)
         self.stats["evictions"] += 1
@@ -274,37 +363,60 @@ class Engine:
         slot.req = None
         slot.length = 0
         slot.blocks = []
-        slot.out_ids = []
+        slot.out_count = 0
         self._tbl[slot.idx] = 0                  # point at the trash block
 
     # -- compiled programs --------------------------------------------------
 
-    def _prefill(self, slot: _Slot, Pb: int):
-        """Dense-causal prefill of one request at bucket length ``Pb``; K/V
-        scattered into the paged pools; first generated token sampled."""
-        from ..framework import random as rnd
-
+    def _get_prefill_fn(self, Pb: int):
         fn = self._prefill_fns.get(Pb)
         if fn is None:
             fn = self._prefill_fns[Pb] = jax.jit(
-                self._build_prefill(Pb), donate_argnums=(2, 3))
+                self._build_prefill(Pb), donate_argnums=(2, 3, 4, 11))
+        return fn
+
+    def _get_decode_fn(self, k: int):
+        fn = self._decode_fns.get(k)
+        if fn is None:
+            fn = self._decode_fns[k] = jax.jit(
+                self._build_decode(k), donate_argnums=(2, 3, 6, 9))
+        return fn
+
+    def _prefill(self, slot: _Slot, Pb: int):
+        """Dense-causal prefill of one request at bucket length ``Pb``; K/V
+        scattered into the paged pools; first token sampled and SCATTERED
+        into the device-resident last-token vector inside the program (so
+        admission issues no shape-varying eager ops — those would each
+        trigger a compile in the serving window).  Dispatched asynchronously;
+        the ledger materializes the sampled token at the next sync."""
+        from ..framework import random as rnd
+
+        fn = self._get_prefill_fn(Pb)
         req = slot.req
         P = slot.length
         ids = np.zeros((1, Pb), np.int32)
         ids[0, :P] = req.prompt_ids
         blocks = np.zeros((Pb // self.block_size,), np.int32)
         blocks[:len(slot.blocks)] = slot.blocks
+        if self._first_idx >= self._first_seg:
+            self._full_first_bufs.append(self._first_buf)
+            self._first_buf = jnp.zeros((self._first_seg,), jnp.int32)
+            self._first_idx = 0
+        fidx = self._first_idx
+        self._first_idx += 1
         t0 = time.perf_counter()
-        first, self.k_pools, self.v_pools = fn(
+        self._first_buf, self._last_dev, self.k_pools, self.v_pools = fn(
             self._params, self._buffers, self.k_pools, self.v_pools,
+            self._last_dev, jnp.asarray(slot.idx, jnp.int32),
             jnp.asarray(ids), jnp.asarray(blocks),
             jnp.asarray(P, jnp.int32), rnd.next_key(),
-            jnp.asarray(req.temperature, jnp.float32))
-        slot.last_token = int(first)            # host read = sync point
-        slot.prefill_dt = time.perf_counter() - t0
-        slot.out_ids.append(slot.last_token)
+            jnp.asarray(req.temperature, jnp.float32),
+            self._first_buf, jnp.asarray(fidx, jnp.int32))
+        req._prefill_dt = time.perf_counter() - t0   # dispatch cost only
+        self._pending.append(("prefill", req, len(self._full_first_bufs), fidx))
         self.stats["prefills"] += 1
-        self.stats["prefill_time"] += slot.prefill_dt
+        self.stats["prefill_time"] += req._prefill_dt
+        self.stats["prefill_tokens"] += Pb
         self.stats["generated_tokens"] += 1
 
     def _build_prefill(self, Pb: int):
@@ -314,7 +426,8 @@ class Engine:
         cfg = self.cfg
         bs = self.block_size
 
-        def prefill(params, buffers, k_pools, v_pools, ids, blocks, P, key, temp):
+        def prefill(params, buffers, k_pools, v_pools, last, slot_idx, ids,
+                    blocks, P, key, temp, firstbuf, fidx):
             from ..kernels.decode_attention import write_paged_prefill
 
             cache = model.init_cache(1, Pb)
@@ -326,83 +439,202 @@ class Engine:
             for li, (k_c, v_c) in enumerate(new_cache["kv"]):
                 k_pools[li], v_pools[li] = write_paged_prefill(
                     k_pools[li], v_pools[li], blocks, k_c[0, :Pb], v_c[0, :Pb])
-            last = jax.lax.dynamic_index_in_dim(logits, P - 1, axis=1,
-                                                keepdims=False)[0]  # [V]
-            nxt = _sample(last, jax.random.fold_in(key, 1), temp)
-            return nxt, tuple(k_pools), tuple(v_pools)
+            lg = jax.lax.dynamic_index_in_dim(logits, P - 1, axis=1,
+                                              keepdims=False)[0]  # [V]
+            nxt = _sample(lg, jax.random.fold_in(key, 1), temp)
+            last = last.at[slot_idx].set(nxt)
+            firstbuf = firstbuf.at[fidx].set(nxt)
+            return firstbuf, last, tuple(k_pools), tuple(v_pools)
 
         return prefill
 
-    def _decode(self):
+    def _dispatch_chunk(self, k: int):
+        """Dispatch one k-sub-step decode chunk asynchronously and account
+        for it: ownership ledger, host length mirrors, dispatch-decided
+        finishes (a finish frees its blocks NOW — the chunk's garbage tail
+        writes land before any later prefill reuses them, because device
+        execution preserves dispatch order)."""
         from ..framework import random as rnd
 
-        if self._decode_fn is None:
-            self._decode_fn = jax.jit(self._build_decode(), donate_argnums=(2, 3))
+        fn = self._get_decode_fn(k)
         lengths = np.array([s.length if s.req is not None else 0
                             for s in self._slots], np.int32)
-        last = np.array([s.last_token for s in self._slots], np.int32)
         temps = np.array([s.req.temperature if s.req is not None else 0.0
                           for s in self._slots], np.float32)
+        if self._tok_row + k > self._tok_seg_rows:
+            self._full_tok_bufs.append(self._tok_buf)
+            self._tok_buf = jnp.zeros(
+                (self._tok_seg_rows, self.max_batch), jnp.int32)
+            self._tok_row = 0
+        row0 = self._tok_row
+        self._tok_row += k
         t0 = time.perf_counter()
-        nxt, self.k_pools, self.v_pools = self._decode_fn(
+        # _tbl MUST be snapshotted: jnp.asarray may alias long-lived host
+        # memory (zero-copy on CPU), and with async dispatch the scheduler
+        # mutates _tbl while this chunk is still in flight
+        self._tok_buf, lst, self.k_pools, self.v_pools = fn(
             self._params, self._buffers, self.k_pools, self.v_pools,
-            jnp.asarray(self._tbl), jnp.asarray(lengths), jnp.asarray(last),
-            rnd.next_key(), jnp.asarray(temps))
-        out = np.asarray(nxt)                   # host read = sync point
+            jnp.asarray(self._tbl.copy()), jnp.asarray(lengths),
+            self._last_dev, rnd.next_key(), jnp.asarray(temps),
+            self._tok_buf, jnp.asarray(row0, jnp.int32))
+        self._last_dev = lst
         self.stats["decode_time"] += time.perf_counter() - t0
-        self.stats["decode_steps"] += 1
-        return out
+        self.stats["decode_steps"] += k
+        self.stats["decode_calls"] += 1
+        recs = []
+        for s in self._slots:
+            if s.req is None:
+                continue
+            take = min(k, s.req.max_new_tokens - s.out_count)
+            recs.append((s.req, s.idx, take))
+            s.out_count += take
+            s.length += k
+            self.stats["generated_tokens"] += take
+            if s.out_count >= s.req.max_new_tokens:
+                self._finish_order.append(s.req)
+                self._release(s)
+        self._pending.append(
+            ("chunk", len(self._full_tok_bufs), row0, k, recs))
 
-    def _build_decode(self):
+    def _build_decode(self, k: int):
         from ..jit import functional_call
 
         model = self.model
 
-        def decode(params, buffers, k_pools, v_pools, tbl, lengths, last, key, temps):
-            cache = {"k": k_pools, "v": v_pools, "block_table": tbl,
-                     "lengths": lengths}
-            out = functional_call(model, params, buffers, last[:, None],
-                                  cache=cache, rng_key=key)
-            logits, new_cache = out[0], out[-1]
-            lg = logits[:, 0]                                    # [B, V]
-            keys = jax.random.split(jax.random.fold_in(key, 1), lg.shape[0])
-            nxt = jax.vmap(_sample)(lg, keys, temps)
-            return nxt, new_cache["k"], new_cache["v"]
+        def decode(params, buffers, k_pools, v_pools, tbl, lengths, last,
+                   key, temps, tokbuf, row0):
+            B = temps.shape[0]
+
+            def substep(carry, i):
+                kp, vp, lens, lst = carry
+                cache = {"k": kp, "v": vp, "block_table": tbl,
+                         "lengths": lens}
+                out = functional_call(model, params, buffers, lst[:, None],
+                                      cache=cache,
+                                      rng_key=jax.random.fold_in(key, 2 * i))
+                logits, new_cache = out[0], out[-1]
+                keys = jax.random.split(
+                    jax.random.fold_in(key, 2 * i + 1), B)
+                nxt = jax.vmap(_sample)(logits[:, 0], keys, temps)
+                # inactive slots (lengths 0) hold their state: the model's
+                # cached forward leaves their length at 0 and their writes
+                # land in the trash block
+                lst = jnp.where(lens > 0, nxt, lst)
+                return (new_cache["k"], new_cache["v"],
+                        new_cache["lengths"], lst), lst
+
+            (kp, vp, _, lst), toks = jax.lax.scan(
+                substep, (k_pools, v_pools, lengths, last), jnp.arange(k))
+            tokbuf = jax.lax.dynamic_update_slice(
+                tokbuf, toks, (row0, jnp.zeros((), row0.dtype)))
+            return tokbuf, lst, kp, vp
 
         return decode
 
-    # -- bookkeeping --------------------------------------------------------
+    def warmup(self):
+        """Execute every program the engine can hit — prefill at each bucket
+        and the decode-chunk ladder — on throwaway inputs (lengths 0, the
+        trash block absorbing all writes), so no XLA compile lands inside a
+        serving window.  Dummy EXECUTION rather than AOT ``.lower().compile()``
+        because only a real call warms jit's dispatch cache."""
+        from ..framework import random as rnd
 
-    def _collect(self, next_tokens: np.ndarray) -> List[RequestOutput]:
-        finished = []
-        for i, slot in enumerate(self._slots):
-            if slot.req is None:
-                continue
-            slot.length += 1       # host mirror of the in-trace lengths+1
-            tok = int(next_tokens[i])
-            req = slot.req
+        zeros = np.zeros((self.max_batch,), np.int32)
+        k = 1
+        while k <= self.decode_chunk:
+            fn = self._get_decode_fn(k)
+            buf, _lst, self.k_pools, self.v_pools = fn(
+                self._params, self._buffers, self.k_pools, self.v_pools,
+                jnp.asarray(self._tbl), jnp.asarray(zeros),
+                jnp.asarray(zeros), rnd.next_key(),
+                jnp.asarray(zeros, jnp.float32),
+                jnp.zeros((self._tok_seg_rows, self.max_batch), jnp.int32),
+                jnp.asarray(0, jnp.int32))
+            jax.block_until_ready(buf)
+            k *= 2
+        for Pb in self.prefill_buckets:
+            fn = self._get_prefill_fn(Pb)
+            _buf, self._last_dev, self.k_pools, self.v_pools = fn(
+                self._params, self._buffers, self.k_pools, self.v_pools,
+                self._last_dev, jnp.asarray(0, jnp.int32),
+                jnp.zeros((1, Pb), jnp.int32),
+                jnp.zeros((Pb // self.block_size,), jnp.int32),
+                jnp.asarray(1, jnp.int32), rnd.next_key(),
+                jnp.asarray(0.0, jnp.float32),
+                jnp.zeros((self._first_seg,), jnp.int32),
+                jnp.asarray(0, jnp.int32))
+        jax.block_until_ready(self.k_pools)
 
-            def _finish(reason):
-                finished.append(RequestOutput(
-                    request_id=req.request_id,
-                    prompt_ids=np.asarray(
-                        req.orig_prompt_ids if req.orig_prompt_ids is not None
-                        else req.prompt_ids),
-                    output_ids=req.prior_output + list(slot.out_ids),
-                    finish_reason=reason,
-                    prefill_time=slot.prefill_dt,
-                    finish_time=time.time()))
-                self._release(slot)
+    # -- deferred-sync materialization --------------------------------------
 
+    def _sync_pending(self):
+        """Materialize every pending token in ONE fused readback per kind,
+        walk the ledger in dispatch order filling request values (honoring
+        eos cuts), and emit finished outputs into the ready queue."""
+        if self._pending:
+            self.stats["syncs"] += 1
+            t0 = time.perf_counter()
+            # the programs accumulated every sampled token into device-side
+            # segment buffers, so the backlog materializes in a handful of
+            # reads no matter how many calls were dispatched (each read is a
+            # full tunnel round trip; per-call reads were the serving wall)
+            tok_segs = [np.asarray(b)
+                        for b in (*self._full_tok_bufs, self._tok_buf)]
+            first_segs = [np.asarray(b)
+                          for b in (*self._full_first_bufs, self._first_buf)]
+            for e in self._pending:
+                if e[0] == "prefill":
+                    _, req, seg, fidx = e
+                    self._absorb(req, [int(first_segs[seg][fidx])])
+                else:
+                    _, seg, row0, kk, recs = e
+                    rows = tok_segs[seg][row0:row0 + kk]
+                    for req, idx, take in recs:
+                        self._absorb(req, rows[:take, idx].tolist())
+            self._pending.clear()
+            self._full_tok_bufs.clear()
+            self._full_first_bufs.clear()
+            self._tok_row = 0
+            self._first_idx = 0
+            self.stats["sync_time"] = (self.stats.get("sync_time", 0.0)
+                                       + time.perf_counter() - t0)
+        for req in self._finish_order:
+            if not req._emitted:
+                self._ready.append(self._emit(req, "length"))
+        self._finish_order.clear()
+
+    def _absorb(self, req: GenRequest, vals: List[int]):
+        """Append materialized tokens to a request, cutting at eos (the
+        cut releases the slot if the request still owns one and emits the
+        stop output; later ledger cells for the request are ignored)."""
+        for tok in vals:
+            if req._stopped or req._emitted:
+                return
             if req.eos_token_id is not None and tok == req.eos_token_id:
-                _finish("stop")                  # eos itself is not emitted
-                continue
-            slot.last_token = tok
-            slot.out_ids.append(tok)
-            self.stats["generated_tokens"] += 1
-            if len(slot.out_ids) >= req.max_new_tokens:
-                _finish("length")
-        return finished
+                req._stopped = True
+                for s in self._slots:
+                    if s.req is req:
+                        self._release(s)
+                        break
+                self._ready.append(self._emit(req, "stop"))
+                return
+            req._out_vals.append(tok)
+
+    def _emit(self, req: GenRequest, reason: str) -> RequestOutput:
+        req._emitted = True
+        return RequestOutput(
+            request_id=req.request_id,
+            prompt_ids=np.asarray(
+                req.orig_prompt_ids if req.orig_prompt_ids is not None
+                else req.prompt_ids),
+            output_ids=req.prior_output + list(req._out_vals),
+            finish_reason=reason,
+            prefill_time=req._prefill_dt,
+            finish_time=time.time())
+
+    def _drain_ready(self) -> List[RequestOutput]:
+        out, self._ready = self._ready, []
+        return out
 
 
 def _sample(logits, key, temp):
@@ -412,4 +644,4 @@ def _sample(logits, key, temp):
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temp, 1e-6)
     sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
-    return jnp.where(temp > 0, sampled, greedy)
+    return jnp.where(temp <= 0.0, greedy, sampled)

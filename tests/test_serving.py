@@ -183,3 +183,83 @@ def test_paged_decode_fused_matches_reference():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-3, atol=2e-3)
     np.testing.assert_array_equal(np.asarray(out[3]), 0.0)
+
+
+def test_engine_chunked_decode_matches_stepwise(model):
+    """Chunked on-device decode (k > 1) is a pure overhead optimization:
+    greedy outputs, block accounting, and step counts must match the
+    step-at-a-time engine exactly."""
+    cfg = model.config
+    prompts = _prompts(cfg, (17, 33, 64), seed=3)
+
+    def run(chunk):
+        eng = Engine(model, max_batch=3, num_blocks=32, block_size=128,
+                     prefill_buckets=(128,), decode_chunk=chunk)
+        for p in prompts:
+            eng.add_request(GenRequest(prompt_ids=p, max_new_tokens=13))
+        outs = {o.request_id: o.output_ids for o in eng.run_to_completion()}
+        return outs, eng.stats["generated_tokens"], len(eng._free)
+
+    outs1, gen1, free1 = run(1)
+    outs8, gen8, free8 = run(8)
+    assert outs8 == outs1
+    assert gen8 == gen1
+    assert free8 == free1 == 31
+
+
+def test_engine_warmup_compiles_ladder(model):
+    eng = Engine(model, max_batch=2, num_blocks=16, block_size=128,
+                 prefill_buckets=(128,), decode_chunk=8)
+    eng.warmup()
+    assert sorted(eng._decode_fns) == [1, 2, 4, 8]
+    assert sorted(eng._prefill_fns) == [128]
+    # warmup is invisible to serving: a real request still round-trips
+    p = _prompts(eng.cfg, (20,), seed=5)[0]
+    eng.add_request(GenRequest(prompt_ids=p, max_new_tokens=5))
+    (out,) = eng.run_to_completion()
+    ref = _reference(model, [p], 5)[0]
+    assert out.output_ids == ref
+
+
+def test_engine_eos_mid_chunk_discards_tail(model):
+    """With chunking, a sequence that hits eos mid-chunk must emit exactly
+    the pre-eos tokens (the chunk's tail sub-steps are discarded)."""
+    cfg = model.config
+    p = _prompts(cfg, (24,), seed=7)[0]
+    ref = _reference(model, [p], 32)[0]
+    eos = ref[2]                     # force a stop 3 tokens in
+    eng = Engine(model, max_batch=2, num_blocks=16, block_size=128,
+                 prefill_buckets=(128,), decode_chunk=16)
+    eng.add_request(GenRequest(prompt_ids=p, max_new_tokens=32,
+                               eos_token_id=eos))
+    (out,) = eng.run_to_completion()
+    assert out.finish_reason == "stop"
+    assert out.output_ids == ref[:2]
+    # the slot and all its blocks were reclaimed despite the mid-chunk stop
+    assert len(eng._free) == eng.num_blocks - 1
+
+
+def test_engine_drain_mode_single_sync(model):
+    """Without eos, run_to_completion defers every readback: the whole trace
+    materializes in exactly one sync, and outputs match streaming step()."""
+    cfg = model.config
+    prompts = _prompts(cfg, (17, 33, 64, 100), seed=9)
+
+    def run(streaming):
+        eng = Engine(model, max_batch=3, num_blocks=32, block_size=128,
+                     prefill_buckets=(128,), decode_chunk=8)
+        for p in prompts:
+            eng.add_request(GenRequest(prompt_ids=p, max_new_tokens=11))
+        if streaming:
+            outs = []
+            while eng.has_work():
+                outs.extend(eng.step())
+        else:
+            outs = eng.run_to_completion()
+        return {o.request_id: o.output_ids for o in outs}, eng.stats
+
+    drained, dstats = run(streaming=False)
+    stepped, _ = run(streaming=True)
+    assert drained == stepped
+    assert dstats["evictions"] == 0
+    assert dstats["syncs"] == 1, dstats["syncs"]

@@ -9,7 +9,8 @@ TPU-native design:
 
 - **Two compiled programs, not a graph pass pipeline.** A bucketed *prefill*
   program (dense causal attention over the padded prompt, K/V scattered into
-  the paged pools afterwards) and a batched *decode-chunk* program (paged
+  the paged pools afterwards; same-bucket admissions batch through one call
+  on a 4/2/1 size ladder) and a batched *decode-chunk* program (paged
   attention via the block-table Pallas kernel, sampling fused in). Static
   shapes everywhere: the decode batch is always ``max_batch`` wide with
   inactive slots masked by ``lengths == 0``.
@@ -159,7 +160,7 @@ class Engine:
         # cannot write an update larger than its operand)
         self.decode_chunk = max(1, min(int(decode_chunk), self._tok_seg_rows))
         self._decode_fns: Dict[int, object] = {}
-        self._prefill_fns: Dict[int, object] = {}
+        self._prefill_fns: Dict[Tuple[int, int], object] = {}
         # device-resident last-token vector: threaded chunk -> chunk, so no
         # decode round trip is ever needed to BUILD the next decode's inputs
         self._last_dev = jnp.zeros((max_batch,), jnp.int32)
@@ -258,6 +259,14 @@ class Engine:
         return -(-n // self.block_size) * self.block_size
 
     def _admit(self):
+        """Admit waiting requests into free slots, then prefill them in
+        same-bucket BATCHES (size ladder 4/2/1): the remote tunnel charges
+        per call, so 16 admissions as 16 single prefills would pay 16x the
+        dispatch/arg-handle cost of ~5 batched ones.  Each admission's
+        program inputs are snapshotted at admit time (the padding blocks are
+        released immediately after — unallocated table entries write to the
+        trash block, which the length mask never attends)."""
+        admitted = []      # (slot, req, Pb, ids_row, blocks_row, P)
         for slot in self._slots:
             if not self._waiting:
                 break
@@ -280,16 +289,34 @@ class Engine:
             slot.req = req
             slot.length = len(req.prompt_ids)
             slot.blocks = blocks
-            slot.out_count = 0
-            slot.admit_seq = self._admit_counter
-            self._prefill(slot, Pb)
             slot.out_count = 1
+            slot.admit_seq = self._admit_counter
             # release bucket-padding blocks beyond the prompt's true need
+            # BEFORE snapshotting the program's block row: batched dispatch
+            # reorders prefills across buckets, so a freed padding block id
+            # left in the row could overwrite a later admission's real K/V
+            # (the padded tail's garbage goes to trash block 0 instead,
+            # which the length mask never attends)
             needed = -(-slot.length // self.block_size)
             while len(slot.blocks) > max(needed, 1):
                 self._free.append(slot.blocks.pop())
             self._write_tbl_row(slot)
-            if slot.out_count >= req.max_new_tokens:
+            P = slot.length
+            ids_row = np.zeros((Pb,), np.int32)
+            ids_row[:P] = req.prompt_ids
+            blocks_row = np.zeros((n_blocks,), np.int32)
+            blocks_row[:len(slot.blocks)] = slot.blocks
+            admitted.append((slot, req, Pb, ids_row, blocks_row, P))
+        by_bucket: Dict[int, list] = {}
+        for entry in admitted:
+            by_bucket.setdefault(entry[2], []).append(entry)
+        for Pb, group in by_bucket.items():
+            while group:
+                n = 4 if len(group) >= 4 else (2 if len(group) >= 2 else 1)
+                self._prefill_batch(group[:n], Pb)
+                group = group[n:]
+        for slot, req, *_ in admitted:
+            if slot.req is req and slot.out_count >= req.max_new_tokens:
                 self._finish_order.append(req)
                 self._release(slot)
 
@@ -368,11 +395,11 @@ class Engine:
 
     # -- compiled programs --------------------------------------------------
 
-    def _get_prefill_fn(self, Pb: int):
-        fn = self._prefill_fns.get(Pb)
+    def _get_prefill_fn(self, Pb: int, n: int):
+        fn = self._prefill_fns.get((Pb, n))
         if fn is None:
-            fn = self._prefill_fns[Pb] = jax.jit(
-                self._build_prefill(Pb), donate_argnums=(2, 3, 4, 11))
+            fn = self._prefill_fns[(Pb, n)] = jax.jit(
+                self._build_prefill(Pb, n), donate_argnums=(2, 3, 4, 11))
         return fn
 
     def _get_decode_fn(self, k: int):
@@ -382,68 +409,72 @@ class Engine:
                 self._build_decode(k), donate_argnums=(2, 3, 6, 9))
         return fn
 
-    def _prefill(self, slot: _Slot, Pb: int):
-        """Dense-causal prefill of one request at bucket length ``Pb``; K/V
-        scattered into the paged pools; first token sampled and SCATTERED
-        into the device-resident last-token vector inside the program (so
-        admission issues no shape-varying eager ops — those would each
-        trigger a compile in the serving window).  Dispatched asynchronously;
-        the ledger materializes the sampled token at the next sync."""
+    def _prefill_batch(self, group, Pb: int):
+        """Dense-causal prefill of ``n`` same-bucket requests in ONE call;
+        K/V scattered into the paged pools, first tokens sampled and
+        scattered into the device-resident last-token vector in-program.
+        Dispatched asynchronously; the ledger materializes the sampled
+        tokens at the next sync."""
         from ..framework import random as rnd
 
-        fn = self._get_prefill_fn(Pb)
-        req = slot.req
-        P = slot.length
-        ids = np.zeros((1, Pb), np.int32)
-        ids[0, :P] = req.prompt_ids
-        blocks = np.zeros((Pb // self.block_size,), np.int32)
-        blocks[:len(slot.blocks)] = slot.blocks
-        if self._first_idx >= self._first_seg:
+        n = len(group)
+        fn = self._get_prefill_fn(Pb, n)
+        ids = np.stack([e[3] for e in group])            # [n, Pb]
+        blocks = np.stack([e[4] for e in group])         # [n, nb]
+        P = np.array([e[5] for e in group], np.int32)
+        sidx = np.array([e[0].idx for e in group], np.int32)
+        temps = np.array([e[1].temperature for e in group], np.float32)
+        if self._first_idx + n > self._first_seg:
             self._full_first_bufs.append(self._first_buf)
             self._first_buf = jnp.zeros((self._first_seg,), jnp.int32)
             self._first_idx = 0
-        fidx = self._first_idx
-        self._first_idx += 1
+        fidx0 = self._first_idx
+        self._first_idx += n
         t0 = time.perf_counter()
         self._first_buf, self._last_dev, self.k_pools, self.v_pools = fn(
             self._params, self._buffers, self.k_pools, self.v_pools,
-            self._last_dev, jnp.asarray(slot.idx, jnp.int32),
-            jnp.asarray(ids), jnp.asarray(blocks),
-            jnp.asarray(P, jnp.int32), rnd.next_key(),
-            jnp.asarray(req.temperature, jnp.float32),
-            self._first_buf, jnp.asarray(fidx, jnp.int32))
-        req._prefill_dt = time.perf_counter() - t0   # dispatch cost only
-        self._pending.append(("prefill", req, len(self._full_first_bufs), fidx))
-        self.stats["prefills"] += 1
-        self.stats["prefill_time"] += req._prefill_dt
-        self.stats["prefill_tokens"] += Pb
-        self.stats["generated_tokens"] += 1
+            self._last_dev, jnp.asarray(sidx), jnp.asarray(ids),
+            jnp.asarray(blocks), jnp.asarray(P), rnd.next_key(),
+            jnp.asarray(temps), self._first_buf,
+            jnp.asarray(fidx0, jnp.int32))
+        dt = time.perf_counter() - t0                    # dispatch cost only
+        for j, (slot, req, *_rest) in enumerate(group):
+            req._prefill_dt = dt
+            self._pending.append(
+                ("prefill", req, len(self._full_first_bufs), fidx0 + j))
+        self.stats["prefills"] += n
+        self.stats["prefill_time"] += dt
+        self.stats["prefill_tokens"] += n * Pb
+        self.stats["generated_tokens"] += n
 
-    def _build_prefill(self, Pb: int):
+    def _build_prefill(self, Pb: int, n: int):
         from ..jit import functional_call
 
         model = self.model
-        cfg = self.cfg
-        bs = self.block_size
 
-        def prefill(params, buffers, k_pools, v_pools, last, slot_idx, ids,
-                    blocks, P, key, temp, firstbuf, fidx):
+        def prefill(params, buffers, k_pools, v_pools, last, sidx, ids,
+                    blocks, P, key, temps, firstbuf, fidx0):
             from ..kernels.decode_attention import write_paged_prefill
 
-            cache = model.init_cache(1, Pb)
+            cache = model.init_cache(n, Pb)
             out = functional_call(model, params, buffers, ids, cache=cache,
                                   rng_key=key)
             logits, new_cache = out[0], out[-1]
             k_pools = list(k_pools)
             v_pools = list(v_pools)
             for li, (k_c, v_c) in enumerate(new_cache["kv"]):
-                k_pools[li], v_pools[li] = write_paged_prefill(
-                    k_pools[li], v_pools[li], blocks, k_c[0, :Pb], v_c[0, :Pb])
-            lg = jax.lax.dynamic_index_in_dim(logits, P - 1, axis=1,
-                                              keepdims=False)[0]  # [V]
-            nxt = _sample(lg, jax.random.fold_in(key, 1), temp)
-            last = last.at[slot_idx].set(nxt)
-            firstbuf = firstbuf.at[fidx].set(nxt)
+                for j in range(n):
+                    k_pools[li], v_pools[li] = write_paged_prefill(
+                        k_pools[li], v_pools[li], blocks[j],
+                        k_c[j, :Pb], v_c[j, :Pb])
+            # causality makes row j's logits at P[j]-1 independent of the
+            # padded tail, so the batched result matches the n=1 program
+            lg = jnp.take_along_axis(
+                logits, (P - 1)[:, None, None], axis=1)[:, 0]     # [n, V]
+            keys = jax.random.split(jax.random.fold_in(key, 1), n)
+            nxt = jax.vmap(_sample)(lg, keys, temps)              # [n]
+            last = last.at[sidx].set(nxt)
+            firstbuf = jax.lax.dynamic_update_slice(firstbuf, nxt, (fidx0,))
             return firstbuf, last, tuple(k_pools), tuple(v_pools)
 
         return prefill
@@ -553,16 +584,19 @@ class Engine:
             jax.block_until_ready(buf)
             k *= 2
         for Pb in self.prefill_buckets:
-            fn = self._get_prefill_fn(Pb)
-            _buf, self._last_dev, self.k_pools, self.v_pools = fn(
-                self._params, self._buffers, self.k_pools, self.v_pools,
-                self._last_dev, jnp.asarray(0, jnp.int32),
-                jnp.zeros((1, Pb), jnp.int32),
-                jnp.zeros((Pb // self.block_size,), jnp.int32),
-                jnp.asarray(1, jnp.int32), rnd.next_key(),
-                jnp.asarray(0.0, jnp.float32),
-                jnp.zeros((self._first_seg,), jnp.int32),
-                jnp.asarray(0, jnp.int32))
+            for n in (1, 2, 4):
+                if n > self.max_batch:
+                    break
+                fn = self._get_prefill_fn(Pb, n)
+                _buf, self._last_dev, self.k_pools, self.v_pools = fn(
+                    self._params, self._buffers, self.k_pools, self.v_pools,
+                    self._last_dev, jnp.zeros((n,), jnp.int32),
+                    jnp.zeros((n, Pb), jnp.int32),
+                    jnp.zeros((n, Pb // self.block_size), jnp.int32),
+                    jnp.ones((n,), jnp.int32), rnd.next_key(),
+                    jnp.zeros((n,), jnp.float32),
+                    jnp.zeros((self._first_seg,), jnp.int32),
+                    jnp.asarray(0, jnp.int32))
         jax.block_until_ready(self.k_pools)
 
     # -- deferred-sync materialization --------------------------------------

@@ -212,7 +212,7 @@ def test_engine_warmup_compiles_ladder(model):
                  prefill_buckets=(128,), decode_chunk=8)
     eng.warmup()
     assert sorted(eng._decode_fns) == [1, 2, 4, 8]
-    assert sorted(eng._prefill_fns) == [128]
+    assert sorted(eng._prefill_fns) == [(128, 1), (128, 2)]
     # warmup is invisible to serving: a real request still round-trips
     p = _prompts(eng.cfg, (20,), seed=5)[0]
     eng.add_request(GenRequest(prompt_ids=p, max_new_tokens=5))

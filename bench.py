@@ -231,7 +231,7 @@ def _step_flops_of(lowered) -> float:
 
 
 def build_pretrain_step(preset: str, on_tpu: bool, batch=None, seq=None,
-                        steps=None):
+                        steps=None, accum: int = 1):
     """Construct the pretrain TrainStep for a tiny/small/base/longctx preset.
 
     Shared by ``main`` and ``scripts/capture_evidence.py`` so the committed
@@ -262,10 +262,12 @@ def build_pretrain_step(preset: str, on_tpu: bool, batch=None, seq=None,
     def loss_fn(m, ids):
         return m.compute_loss(m(ids), ids)
 
-    step_fn = paddle.jit.TrainStep(model, loss_fn, opt)
+    step_fn = paddle.jit.TrainStep(model, loss_fn, opt,
+                                   accumulate_steps=accum)
     rng = np.random.default_rng(0)
+    shape = (accum, batch, seq) if accum > 1 else (batch, seq)
     ids = paddle.to_tensor(
-        rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32))
+        rng.integers(0, cfg.vocab_size, size=shape).astype(np.int32))
     return step_fn, ids, model, cfg, (batch, seq, steps)
 
 
@@ -596,6 +598,11 @@ def main():
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=None)
+    ap.add_argument("--accum", type=int, default=1,
+                    help="gradient-accumulation micro-batches per optimizer "
+                         "update (pretrain presets; one AdamW pass per "
+                         "accum micro-steps — the bandwidth-bound optimizer "
+                         "cost amortizes)")
     args = ap.parse_args()
 
     fallback = False
@@ -643,8 +650,10 @@ def main():
         print(json.dumps(_stamp(result)))
         return
 
+    accum = max(1, args.accum)
     step_fn, ids, model, cfg, (batch, seq, steps) = build_pretrain_step(
-        preset, on_tpu, batch=args.batch, seq=args.seq, steps=args.steps)
+        preset, on_tpu, batch=args.batch, seq=args.seq, steps=args.steps,
+        accum=accum)
     n_params = sum(p.size for p in model.parameters())
 
     # warmup/compile
@@ -660,7 +669,7 @@ def main():
     last_loss = float(np.asarray(loss._data))
     dt = time.perf_counter() - t0
 
-    tokens_per_sec = batch * seq * steps / dt
+    tokens_per_sec = accum * batch * seq * steps / dt
     flops_per_token = model_flops_per_token(cfg, seq)
     achieved = tokens_per_sec * flops_per_token
 
@@ -678,6 +687,7 @@ def main():
         "preset": preset,
         "params": n_params,
         "batch": batch,
+        "accum": accum,
         "seq_len": seq,
         "steps": steps,
         "step_time_ms": round(1000 * dt / steps, 2),

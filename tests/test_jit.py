@@ -108,3 +108,56 @@ def test_trainstep_grad_dtype_bf16():
 
     assert l2[-1] < l2[0] / 2            # converges
     assert abs(l2[-1] - l1[-1]) < 0.05   # close to the fp32-grad run
+
+
+class TestGradAccumulation:
+    def test_accum_matches_full_batch(self):
+        """accumulate_steps=2 over [2, b] micro-batches must equal one step
+        over the concatenated [2b] batch: equal-size micro means average to
+        the full-batch mean, so gradients — and the single AdamW update —
+        are identical (fp32, no dropout)."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+
+        def build():
+            paddle.seed(7)
+            return nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 4))
+
+        def loss_fn(m, x, y):
+            return F.cross_entropy(m(x), y)
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 8)).astype(np.float32)
+        y = rng.integers(0, 4, size=(8,)).astype(np.int64)
+
+        m1 = build()
+        opt1 = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=m1.parameters())
+        step1 = paddle.jit.TrainStep(m1, loss_fn, opt1)
+        l1 = step1(paddle.to_tensor(x), paddle.to_tensor(y))
+
+        m2 = build()
+        opt2 = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=m2.parameters())
+        step2 = paddle.jit.TrainStep(m2, loss_fn, opt2, accumulate_steps=2)
+        l2 = step2(paddle.to_tensor(x.reshape(2, 4, 8)),
+                   paddle.to_tensor(y.reshape(2, 4)))
+
+        np.testing.assert_allclose(float(np.asarray(l1._data)),
+                                   float(np.asarray(l2._data)), rtol=1e-5)
+        for (n1, p1), (n2, p2) in zip(m1.named_parameters(), m2.named_parameters()):
+            np.testing.assert_allclose(np.asarray(p1._data), np.asarray(p2._data),
+                                       rtol=2e-5, atol=2e-6, err_msg=n1)
+
+    def test_accum_rejects_grads_fn(self):
+        import pytest as _pytest
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        m = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        with _pytest.raises(ValueError, match="accumulate_steps"):
+            paddle.jit.TrainStep(m, lambda mm, x: mm(x).mean(), opt,
+                                 grads_fn=lambda *a: None, accumulate_steps=2)

@@ -57,6 +57,8 @@ import numpy as np
 
 __all__ = ["Engine", "GenRequest", "RequestOutput"]
 
+NEG_INF = -1e30
+
 
 @dataclass
 class GenRequest:
@@ -64,6 +66,8 @@ class GenRequest:
     prompt_ids: np.ndarray                 # int32 [P]
     max_new_tokens: int = 64
     temperature: float = 0.0               # <= 0 -> greedy
+    top_k: int = 0                         # 0 -> no top-k filter
+    top_p: float = 1.0                     # 1.0 -> no nucleus filter
     eos_token_id: Optional[int] = None
     request_id: Optional[str] = None
     # eviction bookkeeping (internal): the user-visible prompt, and tokens
@@ -375,7 +379,8 @@ class Engine:
         requeued = GenRequest(
             prompt_ids=merged,
             max_new_tokens=req.max_new_tokens - len(req._out_vals),
-            temperature=req.temperature, eos_token_id=req.eos_token_id,
+            temperature=req.temperature, top_k=req.top_k, top_p=req.top_p,
+            eos_token_id=req.eos_token_id,
             request_id=req.request_id,
             orig_prompt_ids=(req.orig_prompt_ids if req.orig_prompt_ids
                              is not None else req.prompt_ids),
@@ -399,14 +404,14 @@ class Engine:
         fn = self._prefill_fns.get((Pb, n))
         if fn is None:
             fn = self._prefill_fns[(Pb, n)] = jax.jit(
-                self._build_prefill(Pb, n), donate_argnums=(2, 3, 4, 11))
+                self._build_prefill(Pb, n), donate_argnums=(2, 3, 4, 13))
         return fn
 
     def _get_decode_fn(self, k: int):
         fn = self._decode_fns.get(k)
         if fn is None:
             fn = self._decode_fns[k] = jax.jit(
-                self._build_decode(k), donate_argnums=(2, 3, 6, 9))
+                self._build_decode(k), donate_argnums=(2, 3, 6, 11))
         return fn
 
     def _prefill_batch(self, group, Pb: int):
@@ -424,6 +429,8 @@ class Engine:
         P = np.array([e[5] for e in group], np.int32)
         sidx = np.array([e[0].idx for e in group], np.int32)
         temps = np.array([e[1].temperature for e in group], np.float32)
+        top_ks = np.array([e[1].top_k for e in group], np.int32)
+        top_ps = np.array([e[1].top_p for e in group], np.float32)
         if self._first_idx + n > self._first_seg:
             self._full_first_bufs.append(self._first_buf)
             self._first_buf = jnp.zeros((self._first_seg,), jnp.int32)
@@ -435,8 +442,8 @@ class Engine:
             self._params, self._buffers, self.k_pools, self.v_pools,
             self._last_dev, jnp.asarray(sidx), jnp.asarray(ids),
             jnp.asarray(blocks), jnp.asarray(P), rnd.next_key(),
-            jnp.asarray(temps), self._first_buf,
-            jnp.asarray(fidx0, jnp.int32))
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            self._first_buf, jnp.asarray(fidx0, jnp.int32))
         dt = time.perf_counter() - t0                    # dispatch cost only
         for j, (slot, req, *_rest) in enumerate(group):
             req._prefill_dt = dt
@@ -453,7 +460,7 @@ class Engine:
         model = self.model
 
         def prefill(params, buffers, k_pools, v_pools, last, sidx, ids,
-                    blocks, P, key, temps, firstbuf, fidx0):
+                    blocks, P, key, temps, top_ks, top_ps, firstbuf, fidx0):
             from ..kernels.decode_attention import write_paged_prefill
 
             cache = model.init_cache(n, Pb)
@@ -471,8 +478,8 @@ class Engine:
             # padded tail, so the batched result matches the n=1 program
             lg = jnp.take_along_axis(
                 logits, (P - 1)[:, None, None], axis=1)[:, 0]     # [n, V]
-            keys = jax.random.split(jax.random.fold_in(key, 1), n)
-            nxt = jax.vmap(_sample)(lg, keys, temps)              # [n]
+            nxt = _sample_batch(lg, jax.random.fold_in(key, 1),
+                                temps, top_ks, top_ps)            # [n]
             last = last.at[sidx].set(nxt)
             firstbuf = jax.lax.dynamic_update_slice(firstbuf, nxt, (fidx0,))
             return firstbuf, last, tuple(k_pools), tuple(v_pools)
@@ -492,6 +499,10 @@ class Engine:
                             for s in self._slots], np.int32)
         temps = np.array([s.req.temperature if s.req is not None else 0.0
                           for s in self._slots], np.float32)
+        top_ks = np.array([s.req.top_k if s.req is not None else 0
+                           for s in self._slots], np.int32)
+        top_ps = np.array([s.req.top_p if s.req is not None else 1.0
+                           for s in self._slots], np.float32)
         if self._tok_row + k > self._tok_seg_rows:
             self._full_tok_bufs.append(self._tok_buf)
             self._tok_buf = jnp.zeros(
@@ -507,6 +518,7 @@ class Engine:
             self._params, self._buffers, self.k_pools, self.v_pools,
             jnp.asarray(self._tbl.copy()), jnp.asarray(lengths),
             self._last_dev, rnd.next_key(), jnp.asarray(temps),
+            jnp.asarray(top_ks), jnp.asarray(top_ps),
             self._tok_buf, jnp.asarray(row0, jnp.int32))
         self._last_dev = lst
         self.stats["decode_time"] += time.perf_counter() - t0
@@ -533,7 +545,7 @@ class Engine:
         model = self.model
 
         def decode(params, buffers, k_pools, v_pools, tbl, lengths, last,
-                   key, temps, tokbuf, row0):
+                   key, temps, top_ks, top_ps, tokbuf, row0):
             B = temps.shape[0]
 
             def substep(carry, i):
@@ -544,9 +556,9 @@ class Engine:
                                       cache=cache,
                                       rng_key=jax.random.fold_in(key, 2 * i))
                 logits, new_cache = out[0], out[-1]
-                keys = jax.random.split(
-                    jax.random.fold_in(key, 2 * i + 1), B)
-                nxt = jax.vmap(_sample)(logits[:, 0], keys, temps)
+                nxt = _sample_batch(logits[:, 0],
+                                    jax.random.fold_in(key, 2 * i + 1),
+                                    temps, top_ks, top_ps)
                 # inactive slots (lengths 0) hold their state: the model's
                 # cached forward leaves their length at 0 and their writes
                 # land in the trash block
@@ -578,7 +590,8 @@ class Engine:
                 self._params, self._buffers, self.k_pools, self.v_pools,
                 jnp.asarray(self._tbl), jnp.asarray(zeros),
                 jnp.asarray(zeros), rnd.next_key(),
-                jnp.asarray(zeros, jnp.float32),
+                jnp.asarray(zeros, jnp.float32), jnp.asarray(zeros),
+                jnp.ones((self.max_batch,), jnp.float32),
                 jnp.zeros((self._tok_seg_rows, self.max_batch), jnp.int32),
                 jnp.asarray(0, jnp.int32))
             jax.block_until_ready(buf)
@@ -595,6 +608,7 @@ class Engine:
                     jnp.zeros((n, Pb // self.block_size), jnp.int32),
                     jnp.ones((n,), jnp.int32), rnd.next_key(),
                     jnp.zeros((n,), jnp.float32),
+                    jnp.zeros((n,), jnp.int32), jnp.ones((n,), jnp.float32),
                     jnp.zeros((self._first_seg,), jnp.int32),
                     jnp.asarray(0, jnp.int32))
         jax.block_until_ready(self.k_pools)
@@ -671,11 +685,36 @@ class Engine:
         return out
 
 
-def _sample(logits, key, temp):
-    """Greedy for temp <= 0, else temperature sampling — fused into the
-    compiled prefill/decode programs (the reference samples in a separate
-    pass over the logits)."""
+def _sample_batch(logits, key, temps, top_ks, top_ps):
+    """Per-request sampling over a [B, V] logits batch: greedy rows
+    (temp <= 0) always take argmax; sampling rows apply temperature,
+    then top-k, then nucleus top-p filtering (mirroring
+    ``LlamaForCausalLM._build_generate_pure``'s sampler, but with the
+    knobs as TRACED per-row values so mixed batches share one program).
+    The two V-wide sorts only run when the batch contains a sampling
+    request — a batch-level ``lax.cond`` keeps pure-greedy serving on the
+    cheap path at runtime."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    scaled = logits / jnp.maximum(temp, 1e-6)
-    sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
-    return jnp.where(temp <= 0.0, greedy, sampled)
+
+    def sampled(lg0):
+        lg = lg0 / jnp.maximum(temps, 1e-6)[:, None]
+        V = lg.shape[-1]
+        srt = jnp.sort(lg, axis=-1)[:, ::-1]
+        kth = jnp.take_along_axis(
+            srt, jnp.clip(top_ks - 1, 0, V - 1)[:, None], axis=-1)
+        lg = jnp.where((top_ks[:, None] > 0) & (lg < kth), NEG_INF, lg)
+        srt2 = jnp.sort(lg, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(srt2, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1)
+        # floor at a tiny positive value: the exclusive cumsum of the top
+        # token is exactly 0, so any positive p keeps it; p <= 0 would keep
+        # NOTHING and collapse to uniform-over-vocab
+        keep = (csum - probs) < jnp.maximum(top_ps, 1e-9)[:, None]
+        thresh = jnp.min(jnp.where(keep, srt2, jnp.inf), axis=-1,
+                         keepdims=True)
+        lg = jnp.where(lg < thresh, NEG_INF, lg)
+        return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+    toks = jax.lax.cond(jnp.any(temps > 0.0), sampled,
+                        lambda lg0: greedy, logits.astype(jnp.float32))
+    return jnp.where(temps > 0.0, toks, greedy)

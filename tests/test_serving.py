@@ -263,3 +263,44 @@ def test_engine_drain_mode_single_sync(model):
     assert drained == stepped
     assert dstats["evictions"] == 0
     assert dstats["syncs"] == 1, dstats["syncs"]
+
+
+def test_engine_sampling_top_k1_equals_greedy(model):
+    """top_k=1 with temperature > 0 leaves only the argmax token in the
+    nucleus, so sampled output must equal the greedy run exactly — a strong
+    end-to-end check of the per-request top-k/top-p filtering."""
+    cfg = model.config
+    p = _prompts(cfg, (30,), seed=11)[0]
+    ref = _reference(model, [p], 9)[0]
+    eng = Engine(model, max_batch=2, num_blocks=16, block_size=128,
+                 prefill_buckets=(128,), decode_chunk=4)
+    eng.add_request(GenRequest(prompt_ids=p, max_new_tokens=9,
+                               temperature=0.7, top_k=1))
+    (out,) = eng.run_to_completion()
+    assert out.output_ids == ref
+    # nucleus-only variant: top_p <= 0 must still keep the top token (the
+    # filter floors p at a tiny positive value), so this equals greedy too
+    eng2 = Engine(model, max_batch=2, num_blocks=16, block_size=128,
+                  prefill_buckets=(128,), decode_chunk=4)
+    eng2.add_request(GenRequest(prompt_ids=p, max_new_tokens=9,
+                                temperature=0.7, top_p=0.0))
+    (out2,) = eng2.run_to_completion()
+    assert out2.output_ids == ref
+
+
+def test_engine_mixed_greedy_and_sampled_batch(model):
+    """A greedy request and a sampling request share one decode program;
+    the greedy row must stay bit-identical to model.generate."""
+    cfg = model.config
+    pg, ps = _prompts(cfg, (25, 40), seed=13)
+    ref = _reference(model, [pg], 10)[0]
+    eng = Engine(model, max_batch=2, num_blocks=16, block_size=128,
+                 prefill_buckets=(128,), decode_chunk=4)
+    eng.add_request(GenRequest(prompt_ids=pg, max_new_tokens=10))
+    eng.add_request(GenRequest(prompt_ids=ps, max_new_tokens=10,
+                               temperature=0.9, top_k=40, top_p=0.9))
+    outs = {o.request_id: o for o in eng.run_to_completion()}
+    assert outs["req-1"].output_ids == ref
+    sampled = outs["req-2"].output_ids
+    assert len(sampled) == 10
+    assert all(0 <= t < cfg.vocab_size for t in sampled)

@@ -304,3 +304,54 @@ def test_engine_mixed_greedy_and_sampled_batch(model):
     sampled = outs["req-2"].output_ids
     assert len(sampled) == 10
     assert all(0 <= t < cfg.vocab_size for t in sampled)
+
+
+def test_engine_fuzz_mixed_workload(model):
+    """Deterministic stress: 12 requests with random prompt/budget sizes,
+    mixed greedy/sampling/eos, through a tight pool (evictions likely) and
+    chunked drain scheduling.  Every greedy no-eos row must match
+    model.generate; every request must be emitted exactly once; the block
+    pool must be fully reclaimed."""
+    cfg = model.config
+    rng = np.random.default_rng(123)
+    eng = Engine(model, max_batch=3, num_blocks=8, block_size=128,
+                 prefill_buckets=(128, 256), decode_chunk=8)
+    reqs = []
+    for i in range(12):
+        P = int(rng.integers(10, 200))
+        p = rng.integers(1, cfg.vocab_size, size=(P,)).astype(np.int32)
+        mn = int(rng.integers(1, 20))
+        kind = i % 3
+        if kind == 0:        # greedy, no eos -> exact-match oracle
+            reqs.append((p, GenRequest(prompt_ids=p, max_new_tokens=mn), "greedy"))
+        elif kind == 1:      # greedy with eos from its own reference
+            ref = _reference(model, [p], mn)[0]
+            eos = ref[len(ref) // 2] if len(ref) > 1 else None
+            reqs.append((p, GenRequest(prompt_ids=p, max_new_tokens=mn,
+                                       eos_token_id=eos), "eos"))
+        else:                # sampling
+            reqs.append((p, GenRequest(prompt_ids=p, max_new_tokens=mn,
+                                       temperature=0.8, top_k=50, top_p=0.9),
+                         "sample"))
+    for _, r, _ in reqs:
+        eng.add_request(r)
+    outs = {o.request_id: o for o in eng.run_to_completion()}
+    assert len(outs) == 12, sorted(outs)
+    for (p, r, kind) in reqs:
+        out = outs[r.request_id]
+        if kind == "greedy":
+            ref = _reference(model, [p], r.max_new_tokens)[0]
+            assert out.output_ids == ref, r.request_id
+            assert out.finish_reason == "length"
+        elif kind == "eos":
+            ref = _reference(model, [p], r.max_new_tokens)[0]
+            if r.eos_token_id is not None and r.eos_token_id in ref:
+                cut = ref.index(r.eos_token_id)
+                assert out.output_ids == ref[:cut], r.request_id
+            assert out.finish_reason in ("stop", "length")
+        else:
+            assert len(out.output_ids) <= r.max_new_tokens
+            assert all(0 <= t < cfg.vocab_size for t in out.output_ids)
+    # pool fully reclaimed, no leaked or double-freed blocks
+    assert sorted(eng._free) == list(range(1, eng.num_blocks))
+    np.testing.assert_array_equal(eng._tbl, 0)

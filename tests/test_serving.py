@@ -355,3 +355,17 @@ def test_engine_fuzz_mixed_workload(model):
     # pool fully reclaimed, no leaked or double-freed blocks
     assert sorted(eng._free) == list(range(1, eng.num_blocks))
     np.testing.assert_array_equal(eng._tbl, 0)
+
+
+def test_eviction_requeue_preserves_sampling_knobs(model):
+    eng = Engine(model, max_batch=2, num_blocks=16, block_size=128,
+                 prefill_buckets=(128,))
+    p = _prompts(model.config, (20,), seed=17)[0]
+    eng.add_request(GenRequest(prompt_ids=p, max_new_tokens=8,
+                               temperature=0.9, top_k=40, top_p=0.85))
+    eng._round()                     # admit + prefill + one chunk
+    slot = next(s for s in eng._slots if s.req is not None)
+    eng._evict(slot)
+    requeued = eng._waiting[0]
+    assert (requeued.temperature, requeued.top_k, requeued.top_p) == \
+        (0.9, 40, 0.85)

@@ -14,4 +14,14 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 # deterministic fp32 matmuls for numerics comparisons against numpy
 jax.config.update("jax_default_matmul_precision", "highest")
+# persistent compilation cache: the suite compiles hundreds of identical CPU
+# programs (every serving test builds its own Engine program set); caching
+# them across runs cuts repeat-suite wall time substantially. Keyed by HLO
+# hash, so staleness is impossible by construction.
+try:
+    jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass  # older jax without these knobs: run uncached
 assert jax.default_backend() == "cpu", jax.default_backend()

@@ -231,7 +231,7 @@ def _step_flops_of(lowered) -> float:
 
 
 def build_pretrain_step(preset: str, on_tpu: bool, batch=None, seq=None,
-                        steps=None, accum: int = 1):
+                        steps=None, accum: int = 1, grad_dtype=None):
     """Construct the pretrain TrainStep for a tiny/small/base/longctx preset.
 
     Shared by ``main`` and ``scripts/capture_evidence.py`` so the committed
@@ -263,7 +263,8 @@ def build_pretrain_step(preset: str, on_tpu: bool, batch=None, seq=None,
         return m.compute_loss(m(ids), ids)
 
     step_fn = paddle.jit.TrainStep(model, loss_fn, opt,
-                                   accumulate_steps=accum)
+                                   accumulate_steps=accum,
+                                   grad_dtype=grad_dtype)
     rng = np.random.default_rng(0)
     shape = (accum, batch, seq) if accum > 1 else (batch, seq)
     ids = paddle.to_tensor(
@@ -603,6 +604,11 @@ def main():
                          "update (pretrain presets; one AdamW pass per "
                          "accum micro-steps — the bandwidth-bound optimizer "
                          "cost amortizes)")
+    ap.add_argument("--grad-dtype", default=None,
+                    choices=["bfloat16", "float32"],
+                    help="gradient (and accumulator) dtype; bfloat16 halves "
+                         "grad HBM traffic and the accumulator footprint "
+                         "(the loss-scaling-free TPU recipe)")
     args = ap.parse_args()
 
     fallback = False
@@ -653,7 +659,7 @@ def main():
     accum = max(1, args.accum)
     step_fn, ids, model, cfg, (batch, seq, steps) = build_pretrain_step(
         preset, on_tpu, batch=args.batch, seq=args.seq, steps=args.steps,
-        accum=accum)
+        accum=accum, grad_dtype=args.grad_dtype)
     n_params = sum(p.size for p in model.parameters())
 
     # warmup/compile

@@ -21,7 +21,7 @@ jax.config.update("jax_default_matmul_precision", "highest")
 try:
     jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
 except Exception:
     pass  # older jax without these knobs: run uncached
 assert jax.default_backend() == "cpu", jax.default_backend()

@@ -21,8 +21,9 @@ Presets:
            serving-path throughput; vs_baseline = fraction of the
            weight-streaming bandwidth bound
 
-Usage: python bench.py [--preset tiny|small|base|longctx|ocr|moe] [--device cpu|tpu]
-       [--steps N] [--batch B] [--seq S]
+Usage: python bench.py [--preset tiny|small|base|longctx|ocr|moe|decode|serve]
+       [--device cpu|tpu] [--steps N] [--batch B] [--seq S]
+       [--accum K] [--grad-dtype bfloat16|float32]
 """
 
 from __future__ import annotations

@@ -1,0 +1,303 @@
+"""Symbolic shape analysis over traced programs.
+
+Reference counterpart: ``pir/include/dialect/shape/utils/shape_analysis.h``
+(``ShapeConstraintIRAnalysis``: per-value symbolic shapes, equality
+constraints, broadcast simplification) and ``constraints_manager.h`` — the
+machinery PIR threads through hundreds of per-op
+``InferSymbolicShapeInterface`` implementations (declared in ops.yaml).
+
+TPU-native design — no per-op rulebook:
+
+- :class:`ShapeAnalysis` is the constraint manager: a union-find over
+  normalized :class:`~paddle_tpu.framework.dim_expr.DimExpr` classes with
+  ``add_equal``/``is_equal`` (equalities propagate through expressions via
+  representative substitution) and ``broadcast`` (resolves a broadcast dim
+  immediately when one side is 1 or both sides are provably equal, else
+  records the pair and answers later when an equality makes it decidable) —
+  the ``AddEqualCstr``/``IsEqual``/``SimplifyBroadcast`` surface.
+- :func:`infer_symbolic_shapes` infers every output dim of a jittable
+  function as a DimExpr of the input symbols by PROBING ``jax.eval_shape``
+  at a few symbol assignments and fitting a rational-affine form
+  ``(p0 + sum_i p_i * s_i) / q`` per dim, then VERIFYING the fit at a
+  held-out assignment. The reference needs an InferSymbolicShape rule per
+  op because it propagates through an IR; here the compiler's own abstract
+  evaluation IS the rule table, so three probes recover what hundreds of
+  hand-written rules encode — and a failed verification (a genuinely
+  non-affine dim) raises instead of guessing.
+"""
+
+from __future__ import annotations
+
+import math
+from fractions import Fraction
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from .dim_expr import DimExpr, Symbol, _wrap
+
+__all__ = ["ShapeAnalysis", "infer_symbolic_shapes", "SymbolicShapeError"]
+
+
+class SymbolicShapeError(ValueError):
+    """An output dim does not fit a verified rational-affine form."""
+
+
+class ShapeAnalysis:
+    """Equality/broadcast constraint manager over DimExprs.
+
+    ::
+
+        sa = ShapeAnalysis()
+        T, S = Symbol("T"), Symbol("S")
+        sa.add_equal(T, S)
+        sa.is_equal(T * 2, S + S)      # True: via representatives
+        sa.broadcast(T, 1)             # -> T
+    """
+
+    def __init__(self):
+        self._parent: Dict[DimExpr, DimExpr] = {}
+        self._pending_bcast: List[Tuple[DimExpr, DimExpr]] = []
+
+    # -- union-find ---------------------------------------------------------
+
+    def _find(self, e: DimExpr) -> DimExpr:
+        root = e
+        while root in self._parent:
+            root = self._parent[root]
+        while e in self._parent and self._parent[e] is not root:
+            e, self._parent[e] = self._parent[e], root
+        return root
+
+    def add_equal(self, a, b) -> None:
+        """Record ``a == b``.  The representative prefers constants, then
+        structurally smaller expressions (so substitution simplifies)."""
+        a, b = self._find(_wrap(a)), self._find(_wrap(b))
+        if a == b:
+            return
+        # constants win; otherwise the shorter repr becomes representative
+        if a.kind == "const" or (b.kind != "const" and len(repr(a)) <= len(repr(b))):
+            a, b = b, a
+        self._parent[a] = b
+
+    def canonicalize(self, e) -> DimExpr:
+        """Rebuild ``e`` with every known-equal subexpression replaced by its
+        class representative (leaf-up, then one top-level lookup)."""
+        e = _wrap(e)
+        if e.kind in ("const",):
+            return self._find(e)
+        if e.kind == "sym":
+            return self._find(e)
+        rebuilt = DimExpr._nary(e.kind, tuple(
+            self.canonicalize(a) for a in e.args)) \
+            if e.kind in ("add", "mul") else \
+            DimExpr(e.kind, tuple(self.canonicalize(a) for a in e.args))
+        return self._find(rebuilt)
+
+    def is_equal(self, a, b) -> bool:
+        a, b = self.canonicalize(a), self.canonicalize(b)
+        return a == b or a.prove_eq(b)
+
+    # -- broadcast ----------------------------------------------------------
+
+    def broadcast(self, a, b) -> DimExpr:
+        """The broadcasted dim of ``a`` and ``b`` (numpy semantics).  Decided
+        immediately when possible; otherwise the pair is recorded (a later
+        ``add_equal`` can make it decidable) and ``max(a, b)`` is returned —
+        sound for dims because the only legal undecided case is a == b."""
+        a, b = self.canonicalize(a), self.canonicalize(b)
+        if a == _wrap(1):
+            return b
+        if b == _wrap(1):
+            return a
+        if self.is_equal(a, b):
+            return a
+        # provable incompatibility (disjoint bounds, neither side able to be
+        # 1 or equal) is an illegal numpy broadcast — fail loudly
+        (alo, ahi), (blo, bhi) = a.bounds(), b.bounds()
+        overlap = not ((ahi is not None and ahi < blo)
+                       or (bhi is not None and bhi < alo))
+        can_be_one = alo <= 1 or blo <= 1
+        if not overlap and not can_be_one:
+            raise ValueError(f"dims {a!r} and {b!r} can never broadcast")
+        self._pending_bcast.append((a, b))
+        return a.max(b)
+
+    def pending_broadcasts(self) -> List[Tuple[DimExpr, DimExpr]]:
+        """Recorded broadcast pairs still undecided under current
+        constraints (the reference's unresolved ``symbol::Broadcast``s)."""
+        return [(a, b) for a, b in self._pending_bcast
+                if not self.is_equal(a, b)
+                and self.canonicalize(a) != _wrap(1)
+                and self.canonicalize(b) != _wrap(1)]
+
+
+# ---------------------------------------------------------------------------
+# probe-based symbolic shape inference
+# ---------------------------------------------------------------------------
+
+_Dim = Union[int, DimExpr]
+
+
+def _collect_syms(arg_shapes) -> List[Tuple[str, int, Optional[int]]]:
+    seen: Dict[str, Tuple[str, int, Optional[int]]] = {}
+
+    def walk(e: DimExpr):
+        if e.kind == "sym":
+            seen.setdefault(e.args[0], e.args)
+        elif e.kind != "const":
+            for a in e.args:
+                walk(a)
+
+    for shape in arg_shapes:
+        for d in shape:
+            if isinstance(d, DimExpr):
+                walk(d)
+    return list(seen.values())
+
+
+def infer_symbolic_shapes(fn, arg_shapes: Sequence[Sequence[_Dim]],
+                          dtypes=None, *, align: int = 8):
+    """Infer symbolic output shapes of ``fn`` over DimExpr-annotated inputs.
+
+    ``arg_shapes``: one shape per positional argument; dims are ints or
+    DimExprs over :func:`~paddle_tpu.framework.dim_expr.Symbol`s.
+    ``dtypes``: per-argument dtypes (default float32).  Returns a pytree of
+    shape tuples mirroring ``fn``'s outputs, with dynamic dims as DimExprs.
+
+    Probe assignments step in multiples of ``align`` within each symbol's
+    declared [lo, hi] range (the step shrinks when the range is narrow; a
+    range too small for three distinct probes raises).  Fits are verified
+    TWICE: at a held-out aligned assignment, and — when the program admits
+    it — at an off-align assignment evaluated through the constructed
+    floor expression, which catches align-periodic dims (ceil-padding)
+    that alias every aligned probe.  Divisibility-constrained programs
+    (e.g. ``reshape(-1, k)``) may legitimately reject the off-align probe;
+    the guarantee then covers align-multiple assignments — exactly the
+    bucketed/serving use-case.  A dim failing verification raises
+    :class:`SymbolicShapeError` — no silent wrong shapes.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    syms = _collect_syms(arg_shapes)
+    if dtypes is None:
+        dtypes = [jnp.float32] * len(arg_shapes)
+    if not syms:
+        structs = [jax.ShapeDtypeStruct(tuple(int(d) for d in s), dt)
+                   for s, dt in zip(arg_shapes, dtypes)]
+        out = jax.eval_shape(fn, *structs)
+        return jax.tree.map(lambda o: tuple(o.shape), out,
+                            is_leaf=lambda x: hasattr(x, "shape"))
+
+    names = [s[0] for s in syms]
+    # probe assignments: a base point plus one-symbol-at-a-time bumps, plus
+    # a held-out joint bump for verification — all within [lo, hi] (a probe
+    # past a symbol's declared range may be OUTSIDE the fn's validity, e.g.
+    # indexing a fixed positional table)
+    base, step = {}, {}
+    for name, lo, hi in syms:
+        v = -(-max(lo, 1) // align) * align if align > 1 else max(lo, 1)
+        st = align
+        if hi is not None:
+            while v + 2 * st > hi and st > 1:
+                st //= 2
+            v = min(v, max(hi - 2 * st, lo))
+            if v + 2 * st > hi:
+                raise SymbolicShapeError(
+                    f"symbol {name} range [{lo}, {hi}] is too narrow to "
+                    f"place three distinct probes")
+        base[name], step[name] = int(v), int(st)
+    probes = [dict(base)]
+    for name, lo, hi in syms:
+        p = dict(base)
+        p[name] = base[name] + step[name]
+        probes.append(p)
+    verify = {n: base[n] + 2 * step[n] for n in names}
+    probes.append(verify)
+
+    def eval_at(env):
+        structs = []
+        for shape, dt in zip(arg_shapes, dtypes):
+            dims = tuple(int(d.subs(env)) if isinstance(d, DimExpr) else int(d)
+                         for d in shape)
+            structs.append(jax.ShapeDtypeStruct(dims, dt))
+        out = jax.eval_shape(fn, *structs)
+        leaves, treedef = jax.tree.flatten(
+            out, is_leaf=lambda x: hasattr(x, "shape"))
+        return [tuple(l.shape) for l in leaves], treedef
+
+    results = [eval_at(env) for env in probes]
+    shapes_per_probe = [r[0] for r in results]
+    treedef = results[0][1]
+    n_leaves = len(shapes_per_probe[0])
+    for shp in shapes_per_probe[1:]:
+        if len(shp) != n_leaves or any(len(a) != len(b) for a, b in
+                                       zip(shp, shapes_per_probe[0])):
+            raise SymbolicShapeError(
+                "output RANK changes across probe shapes — not expressible "
+                "as symbolic dims")
+
+    sym_exprs = {n: Symbol(*next(s for s in syms if s[0] == n)) for n in names}
+
+    def fit_dim(values: List[int]) -> _Dim:
+        # values align with probes: base, per-symbol bump, verification
+        v0 = values[0]
+        coeffs: Dict[str, Fraction] = {}
+        for i, name in enumerate(names):
+            dv = values[1 + i] - v0
+            coeffs[name] = Fraction(dv, step[name])   # exact by construction
+        c0 = Fraction(v0) - sum(coeffs[n] * base[n] for n in names)
+        # verification at the held-out point
+        pred = c0 + sum(coeffs[n] * verify[n] for n in names)
+        if pred != values[-1]:
+            raise SymbolicShapeError(
+                f"dim values {values} do not fit a rational-affine form of "
+                f"{names} (predicted {pred} at the verification probe)")
+        if all(c == 0 for c in coeffs.values()):
+            return int(c0)
+        # common denominator q: expr = (p0 + sum p_i * s_i) // q
+        q = 1
+        for f in [c0, *coeffs.values()]:
+            q = math.lcm(q, f.denominator)
+        num: DimExpr = _wrap(int(c0 * q))
+        for n, c in coeffs.items():
+            pi = int(c * q)
+            if pi:
+                num = num + sym_exprs[n] * pi
+        return num if q == 1 else num // q
+
+    out_shapes = []
+    for li in range(n_leaves):
+        dims = []
+        for di in range(len(shapes_per_probe[0][li])):
+            vals = [shapes_per_probe[pi][li][di]
+                    for pi in range(len(probes))]
+            dims.append(vals[0] if len(set(vals)) == 1 else fit_dim(vals))
+        out_shapes.append(tuple(dims))
+
+    # off-align verification: every aligned probe is blind to align-periodic
+    # dims (e.g. ceil-to-multiple padding fits as plain T on aligned points).
+    # Evaluate the CONSTRUCTED exprs at an off-align assignment when the fn
+    # admits one (divisibility-constrained programs may legitimately reject
+    # it — then the guarantee narrows to align-multiple assignments, which
+    # is exactly the bucketed/serving use-case).
+    off = {n: min(base[n] + step[n] + max(1, step[n] // 2),
+                  next(s for s in syms if s[0] == n)[2] or 10**9)
+           for n in names}
+    if all(off[n] != base[n] + step[n] for n in names):
+        try:
+            actual, _ = eval_at(off)
+        except Exception:
+            actual = None
+        if actual is not None:
+            for li in range(n_leaves):
+                for di, d in enumerate(out_shapes[li]):
+                    want = d.subs(off) if isinstance(d, DimExpr) else d
+                    if want != actual[li][di]:
+                        raise SymbolicShapeError(
+                            f"inferred dim {d!r} evaluates to {want} at the "
+                            f"off-align probe {off} but the program yields "
+                            f"{actual[li][di]} — the dim is not expressible "
+                            f"in this algebra (align-periodic?)")
+
+    return jax.tree.unflatten(treedef, out_shapes)

@@ -149,3 +149,96 @@ def test_serving_engine_auto_buckets():
                                max_new_tokens=4))
     outs = eng.run_to_completion()
     assert len(outs) == 1 and len(outs[0].output_ids) == 4
+
+
+class TestShapeAnalysis:
+    """Constraint manager + probe-based symbolic shape inference
+    (reference ``shape_analysis.h`` / ``constraints_manager.h`` surface)."""
+
+    def test_equalities_propagate_through_expressions(self):
+        from paddle_tpu.framework.dim_expr import Symbol
+        from paddle_tpu.framework.shape_analysis import ShapeAnalysis
+
+        sa = ShapeAnalysis()
+        T, S, U = Symbol("T"), Symbol("S"), Symbol("U")
+        sa.add_equal(T, S)
+        sa.add_equal(S, U)
+        assert sa.is_equal(T, U)
+        assert sa.is_equal(T * 2 + 1, U + U + 1)
+        assert not sa.is_equal(T, U + 1)
+        sa.add_equal(U, 128)                    # pin the class to a constant
+        assert sa.is_equal(T * 2, 256)
+
+    def test_broadcast_resolution(self):
+        from paddle_tpu.framework.dim_expr import Symbol
+        from paddle_tpu.framework.shape_analysis import ShapeAnalysis
+
+        sa = ShapeAnalysis()
+        T, S = Symbol("T"), Symbol("S")
+        assert sa.broadcast(T, 1) == T
+        assert sa.broadcast(1, S) == S
+        assert sa.broadcast(T, T + 0) == T
+        b = sa.broadcast(T, S)                  # undecided: recorded
+        assert sa.pending_broadcasts() == [(T, S)]
+        sa.add_equal(S, T)
+        assert sa.pending_broadcasts() == []    # later equality resolves it
+
+    def test_infer_llama_forward_shapes(self):
+        """The flagship model's logits dims inferred symbolically over the
+        sequence symbol — no per-op shape rules anywhere."""
+        import jax.numpy as jnp
+
+        import paddle_tpu as paddle
+        from paddle_tpu.framework.dim_expr import Symbol
+        from paddle_tpu.framework.shape_analysis import infer_symbolic_shapes
+        from paddle_tpu.jit import functional_call
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+
+        paddle.seed(0)
+        cfg = llama_tiny_config()
+        model = LlamaForCausalLM(cfg)
+        params = {n: p._data for n, p in model.named_parameters()}
+        buffers = {n: b._data for n, b in model.named_buffers()}
+
+        def fwd(ids):
+            return functional_call(model, params, buffers, ids)
+
+        T = Symbol("T", lo=8, hi=cfg.max_position_embeddings)
+        out = infer_symbolic_shapes(fwd, [(2, T)], dtypes=[jnp.int32])
+        assert out == (2, T, cfg.vocab_size), out
+
+    def test_infer_rational_and_multi_symbol_dims(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.framework.dim_expr import Symbol
+        from paddle_tpu.framework.shape_analysis import infer_symbolic_shapes
+
+        T, S = Symbol("T"), Symbol("S")
+
+        def f(a, b):
+            # concat along the symbolic axis + a halving reshape
+            cat = jnp.concatenate([a, b], axis=0)         # [T+S, 4]
+            halved = a.reshape(-1, 8)                     # [T//2, 8]
+            return cat, halved
+
+        cat_s, halved_s = infer_symbolic_shapes(f, [(T, 4), (S, 4)])
+        env = {"T": 24, "S": 40}
+        assert cat_s[0].subs(env) == 64 and cat_s[1] == 4
+        assert halved_s[0].subs(env) == 12 and halved_s[1] == 8
+
+    def test_infer_rejects_non_affine(self):
+        import pytest as _pytest
+
+        import jax.numpy as jnp
+
+        from paddle_tpu.framework.dim_expr import Symbol
+        from paddle_tpu.framework.shape_analysis import (
+            SymbolicShapeError, infer_symbolic_shapes)
+
+        T = Symbol("T")
+
+        def outer(a):
+            return jnp.einsum("i,j->ij", a, a).reshape(-1)   # [T*T]
+
+        with _pytest.raises(SymbolicShapeError):
+            infer_symbolic_shapes(outer, [(T,)])

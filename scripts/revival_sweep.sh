@@ -1,0 +1,35 @@
+#!/bin/bash
+# Manual post-revival measurement sweep (run AFTER the watcher's RECAPTURE
+# sweep finishes so the two don't contend for the chip):
+#   1. gradient-accumulation sweep on the base preset (the next MFU lever:
+#      one AdamW pass per k micro-batches; bf16 accumulator fits HBM)
+#   2. serving-engine run at the post-rework SHA (batched prefill + sampling)
+#   3. an on-chip smoke of the sampling program (has only ever run on CPU)
+# Results append to BENCH_ACCUM_SWEEP.jsonl (NOT the driver cache: the accum
+# rows change the preset's global-batch semantics; promote the winner into
+# BENCH_TPU_CACHE.jsonl only deliberately, with its "accum" field visible).
+cd "$(dirname "$0")/.." || exit 1
+OUT=BENCH_ACCUM_SWEEP.jsonl
+for args in "--accum 2 --grad-dtype bfloat16" "--accum 4 --grad-dtype bfloat16" "--accum 4"; do
+    echo "[revival] base $args" >&2
+    line=$(timeout 2400 python bench.py --preset base --device tpu $args 2>/dev/null | tail -1)
+    [ -n "$line" ] && echo "$line" >> "$OUT" && echo "$line" | head -c 200 >&2 && echo >&2
+done
+echo "[revival] serve (post-rework)" >&2
+line=$(timeout 2400 python bench.py --preset serve --device tpu 2>/dev/null | tail -1)
+[ -n "$line" ] && echo "$line" >> "$OUT" && echo "$line" | head -c 200 >&2 && echo >&2
+echo "[revival] sampling smoke" >&2
+timeout 1200 env -u JAX_PLATFORMS python - <<'PY' >&2
+import numpy as np, sys
+sys.path.insert(0, '.')
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.serving import Engine, GenRequest
+paddle.seed(0)
+m = LlamaForCausalLM(llama_tiny_config(dtype="bfloat16"))
+eng = Engine(m, max_batch=2, num_blocks=16, block_size=128, prefill_buckets=(128,), decode_chunk=8)
+p = np.random.default_rng(0).integers(1, 512, size=(20,)).astype(np.int32)
+eng.add_request(GenRequest(prompt_ids=p, max_new_tokens=8, temperature=0.8, top_k=50, top_p=0.9))
+(out,) = eng.run_to_completion()
+print("sampling-on-chip OK:", out.output_ids)
+PY

@@ -369,3 +369,32 @@ def test_eviction_requeue_preserves_sampling_knobs(model):
     requeued = eng._waiting[0]
     assert (requeued.temperature, requeued.top_k, requeued.top_p) == \
         (0.9, 40, 0.85)
+
+
+def test_engine_streaming_step_with_slot_churn(model):
+    """Streaming step() (sync-per-round) through more requests than slots:
+    outputs must match drain mode exactly, and each step only returns
+    requests that finished in THAT round (streaming contract)."""
+    cfg = model.config
+    prompts = _prompts(cfg, (17, 33, 64, 100, 40), seed=21)
+    eng_d = Engine(model, max_batch=2, num_blocks=32, block_size=128,
+                   prefill_buckets=(128,), decode_chunk=8)
+    for p in prompts:
+        eng_d.add_request(GenRequest(prompt_ids=p, max_new_tokens=9))
+    drained = {o.request_id: o.output_ids for o in eng_d.run_to_completion()}
+
+    eng_s = Engine(model, max_batch=2, num_blocks=32, block_size=128,
+                   prefill_buckets=(128,), decode_chunk=8)
+    for p in prompts:
+        eng_s.add_request(GenRequest(prompt_ids=p, max_new_tokens=9))
+    stepped = {}
+    rounds = 0
+    while eng_s.has_work():
+        outs = eng_s.step()
+        rounds += 1
+        for o in outs:
+            assert o.request_id not in stepped, "double emission"
+            stepped[o.request_id] = o.output_ids
+        assert rounds < 100, "no progress"
+    assert stepped == drained
+    assert eng_s.stats["syncs"] >= 3      # streaming really synced per round

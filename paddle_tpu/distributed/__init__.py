@@ -35,6 +35,7 @@ from .parallel import (  # noqa: F401
 from .parallel.pipeline import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import launch  # noqa: F401
+from . import fault_tolerance  # noqa: F401
 from . import io  # noqa: F401
 from .fleet import ParallelMode  # noqa: F401
 from .semi_auto import (  # noqa: F401

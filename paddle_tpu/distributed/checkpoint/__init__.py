@@ -27,7 +27,10 @@ from __future__ import annotations
 
 import os
 import pickle
+import shutil
 import threading
+import zipfile
+import zlib
 from concurrent.futures import Future
 from typing import Dict, Optional
 
@@ -40,23 +43,32 @@ from ..collective import barrier, get_rank, get_world_size
 from ..mesh import ProcessMesh
 from ..placement import named_sharding
 
-__all__ = ["save_state_dict", "load_state_dict", "Metadata", "LocalTensorMetadata"]
+__all__ = ["save_state_dict", "load_state_dict", "Metadata",
+           "LocalTensorMetadata", "CheckpointCorruptionError"]
 
 _METADATA_FILE = "metadata.pkl"
+_STAGING_SUFFIX = ".saving"
 
 # path -> last async-save future; a new save into the same path waits for it
 _INFLIGHT: Dict[str, Future] = {}
 
 
+class CheckpointCorruptionError(ValueError):
+    """A shard chunk's bytes do not match the CRC32 recorded in the manifest
+    (silent storage corruption, a torn write, or a tampered file)."""
+
+
 class LocalTensorMetadata:
     """One saved chunk (reference metadata.py:20): its global offset, shape,
-    and where the bytes live."""
+    where the bytes live, and the CRC32 of those bytes (``None`` in
+    manifests written before integrity checking existed)."""
 
-    def __init__(self, global_offset, local_shape, file_name, key):
+    def __init__(self, global_offset, local_shape, file_name, key, crc32=None):
         self.global_offset = tuple(int(o) for o in global_offset)
         self.local_shape = tuple(int(s) for s in local_shape)
         self.file_name = file_name
         self.key = key
+        self.crc32 = crc32
 
     def __repr__(self):
         return f"LocalTensorMetadata(offset={self.global_offset}, shape={self.local_shape}, file={self.file_name})"
@@ -127,8 +139,16 @@ def save_state_dict(state_dict, path: str, process_group=None, coordinator_rank:
     Every process writes its unique local shards; rank ``coordinator_rank``
     writes the global metadata.  With ``async_save`` the device->host copies
     happen now and file IO returns a future.
+
+    Commit is ATOMIC: all files land in a ``<path>.saving`` staging
+    directory, the manifest is written last (tmp + rename), and only then
+    is the staging directory renamed to ``path`` — a save killed at ANY
+    point leaves either the old complete checkpoint or no ``path`` at all,
+    never a half-written one.  Each chunk's CRC32 goes into the manifest
+    for verify-on-load.
     """
-    os.makedirs(path, exist_ok=True)
+    staging = path + _STAGING_SUFFIX
+    os.makedirs(staging, exist_ok=True)
     rank = get_rank()
     flat = _unwrap_state(state_dict)
 
@@ -147,8 +167,11 @@ def save_state_dict(state_dict, path: str, process_group=None, coordinator_rank:
                 continue  # multiple local devices can hold the same slice
             seen_offsets.add(offset)
             key = f"{name}|{','.join(map(str, offset))}"
-            payload[key] = _to_storage(np.asarray(shard.data))  # device->host NOW (staging)
-            chunks.append(LocalTensorMetadata(offset, shape, file_name, key))
+            stored = _to_storage(np.asarray(shard.data))  # device->host NOW (staging)
+            payload[key] = stored
+            chunks.append(LocalTensorMetadata(
+                offset, shape, file_name, key,
+                crc32=zlib.crc32(np.ascontiguousarray(stored).tobytes())))
         if chunks:
             meta.add(name, global_shape, arr.dtype, chunks)
 
@@ -156,40 +179,44 @@ def save_state_dict(state_dict, path: str, process_group=None, coordinator_rank:
 
     def _merge_and_commit():
         merged = Metadata()
-        for fn in sorted(os.listdir(path)):
+        for fn in sorted(os.listdir(staging)):
             # require the .pkl suffix: a crash between tmp-write and os.replace
             # leaves a truncated .pkl.tmp behind that must never be merged
             if not (fn.startswith("metadata_part_") and fn.endswith(".pkl")):
                 continue
-            with open(os.path.join(path, fn), "rb") as f:
+            with open(os.path.join(staging, fn), "rb") as f:
                 part_meta = pickle.load(f)
             for tname, info in part_meta.state_dict_metadata.items():
                 if tname in merged.state_dict_metadata:
                     merged.state_dict_metadata[tname]["chunks"].extend(info["chunks"])
                 else:
                     merged.state_dict_metadata[tname] = dict(info)
-        # atomic commit: readers must never see a partially-written manifest
-        tmp = os.path.join(path, _METADATA_FILE + ".tmp")
+        # manifest written LAST within staging, atomically (tmp + rename)
+        tmp = os.path.join(staging, _METADATA_FILE + ".tmp")
         with open(tmp, "wb") as f:
             pickle.dump(merged, f)
-        os.replace(tmp, os.path.join(path, _METADATA_FILE))
+        os.replace(tmp, os.path.join(staging, _METADATA_FILE))
+        # ... and the whole checkpoint becomes visible in ONE rename: a crash
+        # before this line leaves `path` untouched (old version or absent)
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        os.rename(staging, path)
 
     def _write_local():
-        np.savez(os.path.join(path, file_name), **payload)
-        part = os.path.join(path, f"metadata_part_{rank}.pkl")
+        np.savez(os.path.join(staging, file_name), **payload)
+        part = os.path.join(staging, f"metadata_part_{rank}.pkl")
         tmp = part + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(meta, f)
         os.replace(tmp, part)
 
     def _clear_stale_rendezvous():
-        """Coordinator removes EVERY part/manifest from any previous save into
-        this directory — the current world may be smaller than the one that
-        wrote them (elastic restart), and stale parts would otherwise satisfy
-        the part count and be merged into the manifest."""
-        for fn in os.listdir(path):
+        """Coordinator removes EVERY part/manifest left in staging by a
+        previous (crashed or smaller-world) save — stale parts would
+        otherwise satisfy the part count and be merged into the manifest."""
+        for fn in os.listdir(staging):
             if fn.startswith("metadata_part_") or fn.startswith(_METADATA_FILE):
-                os.remove(os.path.join(path, fn))
+                os.remove(os.path.join(staging, fn))
 
     # a still-in-flight async save into the same path would race with this
     # save's cleanup; serialize per-path: each rank waits on its own prior
@@ -244,12 +271,15 @@ def save_state_dict(state_dict, path: str, process_group=None, coordinator_rank:
             _write_local()
             if rank == coordinator_rank:
                 def all_parts():
-                    have = [fn for fn in os.listdir(path)
+                    have = [fn for fn in os.listdir(staging)
                             if fn.startswith("metadata_part_") and fn.endswith(".pkl")]
                     return len(have) >= world
                 _poll(all_parts, f"{world} metadata parts")
                 _merge_and_commit()
             else:
+                # the manifest appears at the FINAL path only after the
+                # coordinator's atomic staging rename — polling it means
+                # "the whole checkpoint is committed", not just the manifest
                 _poll(lambda: os.path.exists(os.path.join(path, _METADATA_FILE)),
                       "coordinator metadata commit")
             fut.set_result(path)
@@ -300,9 +330,31 @@ def load_state_dict(state_dict, path: str, process_group=None, coordinator_rank:
     def chunk_arrays_for(chunks, dtype_name):
         out = {}
         for c in chunks:
-            if c.file_name not in files:
-                files[c.file_name] = np.load(os.path.join(path, c.file_name))
-            out[c.key] = _from_storage(files[c.file_name][c.key], dtype_name)
+            try:
+                if c.file_name not in files:
+                    files[c.file_name] = np.load(os.path.join(path, c.file_name))
+                raw = files[c.file_name][c.key]
+            except CheckpointCorruptionError:
+                raise
+            except (OSError, KeyError, ValueError, zlib.error,
+                    zipfile.BadZipFile) as e:
+                # a shard the container itself cannot decode (npz zip CRC,
+                # truncated archive, missing member) is the same condition
+                # our manifest CRC guards against: classify it as corruption
+                # so CheckpointManager.resume quarantines the step instead of
+                # retrying it forever
+                raise CheckpointCorruptionError(
+                    f"shard {c.file_name} of checkpoint {path} is unreadable "
+                    f"({e}) — treating as corrupt") from e
+            want = getattr(c, "crc32", None)  # pre-integrity manifests: None
+            if want is not None:
+                got = zlib.crc32(np.ascontiguousarray(raw).tobytes())
+                if got != want:
+                    raise CheckpointCorruptionError(
+                        f"chunk {c.key!r} in {c.file_name} failed CRC "
+                        f"verification (manifest {want:#010x}, file "
+                        f"{got:#010x}) — checkpoint {path} is corrupt")
+            out[c.key] = _from_storage(raw, dtype_name)
         return out
 
     # (container, key) lets non-Tensor leaves be written back into the

@@ -1,0 +1,133 @@
+"""Deterministic fault injection — the harness the chaos tests drive.
+
+Every simulated failure is derived from seeded RNG streams keyed by
+``FLAGS_ft_inject_seed``, so a chaos run replays bit-for-bit: the same ops
+get their connections dropped, the same shard gets the same bits flipped,
+the same step crashes.  Faults are configured through ``framework.flags``
+(env ``FLAGS_ft_inject_*``), so a training SUBPROCESS can be made faulty
+without touching its code.
+
+Supported faults (all off by default):
+
+- **worker crash** at train step N (``ft_inject_crash_step`` /
+  ``ft_inject_crash_rank``) — fail-stop via ``os._exit``, exactly what a
+  preempted TPU host looks like to its peers.  Fires only in the first
+  incarnation (``PADDLE_RESTART_COUNT`` is exported by the launcher on
+  relaunch) so the recovered process does not crash again at the same step.
+- **dropped store connections** (``ft_inject_store_drop_rate``) — the
+  client socket dies mid-op, exercising the reconnect/backoff path.
+- **slow / partitioned store peer** (``ft_inject_store_delay_ms``) — fixed
+  added latency per op, exercising timeout bounds.
+- **bit-flipped checkpoint shard** (``ft_inject_corrupt_step`` +
+  :meth:`FaultInjector.corrupt_file`) — silent storage corruption, caught
+  by the CRC manifest on load.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+from typing import List, Optional, Tuple
+
+from ...framework import flags
+
+__all__ = ["FaultInjector", "get_injector", "set_injector"]
+
+
+class FaultInjector:
+    def __init__(self, seed: int = 0, crash_step: int = -1,
+                 crash_rank: int = -1, store_drop_rate: float = 0.0,
+                 store_delay_ms: int = 0, corrupt_step: int = -1):
+        self.seed = int(seed)
+        self.crash_step = int(crash_step)
+        self.crash_rank = int(crash_rank)
+        self.store_drop_rate = float(store_drop_rate)
+        self.store_delay_ms = int(store_delay_ms)
+        self.corrupt_step = int(corrupt_step)
+        # independent streams so enabling one fault cannot shift another's
+        # decisions (replayability across configurations)
+        self._drop_rng = random.Random(f"{self.seed}/store-drop")
+        self._flip_rng = random.Random(f"{self.seed}/bit-flip")
+
+    @classmethod
+    def from_flags(cls) -> "FaultInjector":
+        return cls(seed=flags.get_flag("ft_inject_seed"),
+                   crash_step=flags.get_flag("ft_inject_crash_step"),
+                   crash_rank=flags.get_flag("ft_inject_crash_rank"),
+                   store_drop_rate=flags.get_flag("ft_inject_store_drop_rate"),
+                   store_delay_ms=flags.get_flag("ft_inject_store_delay_ms"),
+                   corrupt_step=flags.get_flag("ft_inject_corrupt_step"))
+
+    def active(self) -> bool:
+        return (self.crash_step >= 0 or self.store_drop_rate > 0.0
+                or self.store_delay_ms > 0 or self.corrupt_step >= 0)
+
+    # -- fail-stop worker crash ---------------------------------------------
+
+    def crash_point(self, step: int, rank: Optional[int] = None) -> None:
+        """Call once per train step; fail-stops the process when the injected
+        crash matches.  A relaunched incarnation (``PADDLE_RESTART_COUNT`` >
+        0) never re-fires — the crash models a one-time preemption."""
+        if self.crash_step < 0 or step != self.crash_step:
+            return
+        if self.crash_rank >= 0 and rank is not None and rank != self.crash_rank:
+            return
+        if int(os.environ.get("PADDLE_RESTART_COUNT", "0")) > 0:
+            return
+        print(f"[inject] fail-stop crash at step {step}", file=sys.stderr,
+              flush=True)
+        os._exit(1)
+
+    # -- store faults --------------------------------------------------------
+
+    def should_drop(self) -> bool:
+        """One deterministic draw per store op."""
+        if self.store_drop_rate <= 0.0:
+            return False
+        return self._drop_rng.random() < self.store_drop_rate
+
+    def delay_seconds(self) -> float:
+        return self.store_delay_ms / 1000.0
+
+    # -- checkpoint corruption ----------------------------------------------
+
+    def corrupt_file(self, path: str, nbits: int = 8) -> List[Tuple[int, int]]:
+        """Flip ``nbits`` seeded-random bits in ``path`` in place.  Returns
+        the ``(offset, bit)`` list — identical across runs with one seed."""
+        size = os.path.getsize(path)
+        if size == 0:
+            return []
+        flips = [(self._flip_rng.randrange(size), self._flip_rng.randrange(8))
+                 for _ in range(nbits)]
+        with open(path, "r+b") as f:
+            for off, bit in flips:
+                f.seek(off)
+                b = f.read(1)[0]
+                f.seek(off)
+                f.write(bytes([b ^ (1 << bit)]))
+        return flips
+
+
+# process-wide injector consulted by the store client; ``None`` until
+# installed, so the zero-fault fast path costs one attribute check
+_INJECTOR: Optional[FaultInjector] = None
+_LOADED_FROM_FLAGS = False
+
+
+def set_injector(inj: Optional[FaultInjector]) -> None:
+    global _INJECTOR, _LOADED_FROM_FLAGS
+    _INJECTOR = inj
+    _LOADED_FROM_FLAGS = True
+
+
+def get_injector() -> Optional[FaultInjector]:
+    """The process-wide injector; lazily built from flags on first use so
+    subprocesses configured via ``FLAGS_ft_inject_*`` env need no code."""
+    global _INJECTOR, _LOADED_FROM_FLAGS
+    if not _LOADED_FROM_FLAGS:
+        _LOADED_FROM_FLAGS = True
+        inj = FaultInjector.from_flags()
+        if inj.active():
+            _INJECTOR = inj
+    return _INJECTOR

@@ -1,0 +1,110 @@
+"""Retry / timeout / backoff policies for control-plane calls.
+
+Every host-side control operation (store round-trips, rendezvous joins,
+barriers) goes through a bounded policy so no call can hang unboundedly:
+an exponential backoff with deterministic jitter caps the retry cadence,
+and a :class:`Deadline` caps the total wall time.
+
+Jitter is DETERMINISTIC (seeded ``random.Random``) so chaos runs replay
+identically under a fixed ``FLAGS_ft_inject_seed`` — the same property the
+injection framework relies on (see ``injection.py``).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Iterator, Optional, Tuple, Type
+
+__all__ = ["RetryPolicy", "Deadline", "retry_call"]
+
+
+class Deadline:
+    """Absolute wall-clock budget for one logical operation."""
+
+    def __init__(self, seconds: Optional[float]):
+        self.seconds = seconds
+        self._end = None if seconds is None else time.monotonic() + seconds
+
+    @classmethod
+    def after(cls, seconds: Optional[float]) -> "Deadline":
+        return cls(seconds)
+
+    def remaining(self) -> float:
+        if self._end is None:
+            return float("inf")
+        return self._end - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+    def check(self, what: str) -> None:
+        if self.expired():
+            raise TimeoutError(
+                f"deadline of {self.seconds:.1f}s exceeded while {what}")
+
+    def clamp(self, delay: float) -> float:
+        """Never sleep past the deadline."""
+        return max(0.0, min(delay, self.remaining()))
+
+
+class RetryPolicy:
+    """Exponential backoff with deterministic jitter and bounded attempts.
+
+    >>> p = RetryPolicy(max_attempts=3, base_delay=0.1, seed=7)
+    >>> list(p.delays()) == list(p.delays())   # replayable
+    True
+    """
+
+    def __init__(self, max_attempts: int = 5, base_delay: float = 0.05,
+                 max_delay: float = 2.0, multiplier: float = 2.0,
+                 jitter: float = 0.25, seed: int = 0):
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.seed = int(seed)
+
+    def delays(self) -> Iterator[float]:
+        """The backoff schedule: one delay per retry (attempts - 1 entries).
+        A fresh seeded RNG per call keeps the sequence replayable."""
+        rng = random.Random(self.seed)
+        d = self.base_delay
+        for _ in range(self.max_attempts - 1):
+            capped = min(d, self.max_delay)
+            # symmetric jitter in [1-j, 1+j]; deterministic given the seed
+            yield capped * (1.0 + self.jitter * (2.0 * rng.random() - 1.0))
+            d *= self.multiplier
+
+
+def retry_call(fn: Callable, *, policy: RetryPolicy,
+               retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+               deadline: Optional[Deadline] = None,
+               describe: str = "operation",
+               on_retry: Optional[Callable[[int, BaseException], None]] = None):
+    """Call ``fn()`` under ``policy``: retry on ``retry_on`` exceptions with
+    backoff, never exceeding ``deadline``.  The last failure is re-raised
+    (wrapped in ``TimeoutError`` when the deadline, not the attempt budget,
+    is what ran out)."""
+    deadline = deadline or Deadline(None)
+    last: Optional[BaseException] = None
+    schedule = policy.delays()
+    for attempt in range(policy.max_attempts):
+        deadline.check(describe)
+        try:
+            return fn()
+        except retry_on as e:
+            last = e
+            if on_retry is not None:
+                on_retry(attempt, e)
+            delay = next(schedule, None)
+            if delay is None:
+                break
+            if deadline.expired():
+                raise TimeoutError(
+                    f"deadline of {deadline.seconds:.1f}s exceeded while "
+                    f"{describe} (last error: {e})") from e
+            time.sleep(deadline.clamp(delay))
+    assert last is not None
+    raise last

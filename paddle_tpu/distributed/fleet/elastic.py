@@ -25,7 +25,8 @@ import shutil
 import sys
 from typing import Optional
 
-from ..checkpoint import load_state_dict, save_state_dict
+from ..checkpoint import (CheckpointCorruptionError, load_state_dict,
+                          save_state_dict)
 from ..collective import barrier, get_rank
 
 __all__ = ["CheckpointManager", "ElasticManager", "ELASTIC_EXIT_CODE"]
@@ -51,6 +52,7 @@ class CheckpointManager:
         self.root = root
         self.keep = max(1, int(keep))
         self._last_async = None
+        self._async_step = None
         os.makedirs(root, exist_ok=True)
 
     def _dir(self, step: int) -> str:
@@ -83,23 +85,53 @@ class CheckpointManager:
         # bounds retention for async users too (at most keep+1 on disk); the
         # sync path prunes after its own save instead, so no extra barrier
         if self._last_async is not None:
-            self._last_async.result()
+            prev_fut = self._last_async
             self._last_async = None
-            self._prune()
+            prev_fut.result()
+            self._prune(self._async_step)
         sd = self._state_of(target)
         fut = save_state_dict(sd, self._dir(step), async_save=async_save)
         if async_save:
             self._last_async = fut
+            self._async_step = step
         else:
-            self._prune()
+            self._prune(step)
         return fut
 
-    def _prune(self):
+    def _prune(self, new_step: int):
+        """GC old checkpoints — but ONLY once the new step's manifest is
+        fully committed: a save that crashed before commit must never
+        trigger deletion of the checkpoints resume would fall back to.
+        Rank-0-only, with a barrier so no rank races ahead into a save that
+        re-uses a directory mid-delete."""
         steps = self.complete_steps()
+        if new_step not in steps:
+            return  # commit didn't land: keep everything loadable
         if get_rank() == 0:
             for s in steps[:-self.keep]:
                 shutil.rmtree(self._dir(s), ignore_errors=True)
+            for fn in os.listdir(self.root):
+                # orphaned staging dirs from saves that died pre-commit;
+                # anything at or below the newest complete step is garbage
+                if fn.endswith(".saving"):
+                    m = _STEP_DIR.match(fn[:-len(".saving")])
+                    if m and int(m.group(1)) <= steps[-1]:
+                        shutil.rmtree(os.path.join(self.root, fn),
+                                      ignore_errors=True)
         barrier()
+
+    def _quarantine(self, step: int) -> None:
+        """Move a CRC-corrupt step OUT of the resume scan (rank 0 renames;
+        everyone else just stops seeing it).  Kept on disk as
+        ``step_N.corrupt`` for post-mortem, never re-considered."""
+        src = self._dir(step)
+        if get_rank() == 0:
+            try:
+                os.rename(src, src + ".corrupt")
+                print(f"[elastic] quarantined corrupt checkpoint "
+                      f"{os.path.basename(src)} -> .corrupt", file=sys.stderr)
+            except OSError:
+                pass  # another rank/process already moved it
 
     @staticmethod
     def _copy_containers(d):
@@ -151,6 +183,8 @@ class CheckpointManager:
                     t._data = old
                 print(f"[elastic] checkpoint step {step} unreadable ({e}); "
                       "falling back", file=sys.stderr)
+                if isinstance(e, CheckpointCorruptionError):
+                    self._quarantine(step)
                 continue
             if is_plain:
                 self._write_back(target, work)
@@ -166,10 +200,12 @@ class ElasticManager:
     etcd node registry + heartbeats + membership watch; here the native
     ``TCPStore`` plays etcd's role).
 
-    Heartbeats are MONOTONIC COUNTERS, not timestamps: each node's beat
-    thread increments ``hb/<job>/<rank>``; the watcher samples all counters
-    twice across ``interval`` — a counter that did not advance is a dead (or
-    wedged) peer.  No cross-host clock comparison anywhere.
+    Detection is delegated to the fault-tolerance
+    :class:`~paddle_tpu.distributed.fault_tolerance.HeartbeatFailureDetector`:
+    lease counters are MONOTONIC, not timestamps — a counter that did not
+    advance is a dead (or wedged) peer; no cross-host clock comparison
+    anywhere.  On rank 0 the detector's monitor also publishes membership
+    epochs that the rendezvous layer consumes for graceful mesh shrink.
 
     Usage on every node::
 
@@ -182,78 +218,42 @@ class ElasticManager:
 
     def __init__(self, store, rank: int, nnodes: int, job_id: str = "default",
                  interval: float = 5.0):
+        from ..fault_tolerance.detector import HeartbeatFailureDetector
+
         self.store = store
         self.rank = int(rank)
         self.nnodes = int(nnodes)
         self.job_id = job_id
         self.interval = float(interval)
+        self.detector = HeartbeatFailureDetector(
+            store, self.rank, self.nnodes, job_id=job_id, interval=interval)
         self._stop = None
-        self._thread = None
-
-    def _key(self, rank: int) -> str:
-        return f"hb/{self.job_id}/{rank}"
-
-    def start(self):
-        """Begin heartbeating this node (daemon thread)."""
-        import threading
-
-        self._stop = threading.Event()
-
-        def beat():
-            failures = 0
-            while not self._stop.is_set():
-                try:
-                    self.store.add(self._key(self.rank), 1)
-                    failures = 0
-                except Exception as e:
-                    # a transient store error must NOT stop the heartbeat —
-                    # peers would flag this healthy node dead and restart the
-                    # whole job; only give up after sustained failure
-                    failures += 1
-                    if failures >= 5:
-                        import sys
-
-                        print(f"[elastic] heartbeat giving up after "
-                              f"{failures} store failures: {e}", file=sys.stderr)
-                        return
-                self._stop.wait(self.interval)
-
-        self._thread = threading.Thread(target=beat, name="elastic-heartbeat",
-                                        daemon=True)
-        self._thread.start()
-        return self
 
     #: pseudo-rank reported when the STORE itself (the coordinator node) is
     #: unreachable — also a membership loss, needing re-rendezvous
     STORE_LOST = -1
 
+    def start(self):
+        """Begin renewing this node's lease (daemon thread; rank 0 also runs
+        the membership monitor)."""
+        self._stop = self.detector.start()._stop
+        return self
+
     def counters(self):
         """Current heartbeat counter per rank (0 = never beat)."""
-        out = {}
-        for r in range(self.nnodes):
-            out[r] = self.store.add(self._key(r), 0)  # add 0 = atomic read
-        return out
+        return self.detector.counters()
+
+    def membership(self):
+        """Latest published ``(epoch, alive_ranks)`` from the rank-0
+        monitor (epoch 0 = nothing declared yet)."""
+        return self.detector.membership()
 
     def dead_peers(self, wait_factor: float = 2.5, _retries: int = 3):
         """Ranks whose counter did not advance across ``wait_factor *
         interval`` seconds (a beat interval plus slack).  Blocking.
         ``[STORE_LOST]`` when the store itself is persistently unreachable
         (the coordinator node died — the membership is lost wholesale)."""
-        import time as _time
-
-        for attempt in range(_retries):
-            try:
-                before = self.counters()
-                _time.sleep(self.interval * wait_factor)
-                after = self.counters()
-            except Exception:
-                if attempt == _retries - 1:
-                    return [self.STORE_LOST]
-                _time.sleep(self.interval)
-                continue
-            return [r for r in range(self.nnodes)
-                    if r != self.rank and after[r] == before[r]]
-        return [self.STORE_LOST]
+        return self.detector.sample_dead(wait_factor, retries=_retries)
 
     def watch(self, on_dead, poll_factor: float = 2.5):
         """Loop until dead peers appear (or the store is lost —
@@ -271,8 +271,5 @@ class ElasticManager:
         return []
 
     def stop(self):
-        if self._stop is not None:
-            self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        self.detector.stop()
+        self._stop = self.detector._stop

@@ -54,6 +54,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="processes per host (1 on TPU; >1 only for CPU simulation)")
     p.add_argument("--max_restarts", type=int, default=3,
                    help="elastic: relaunch a failed training process this many times")
+    p.add_argument("--on_peer_failure", choices=("exit", "shrink"),
+                   default="exit",
+                   help="when a peer node stops heartbeating: 'exit' stops "
+                        "local trainers and exits ELASTIC_EXIT_CODE for an "
+                        "outer supervisor (reference behavior); 'shrink' "
+                        "re-rendezvouses the SURVIVORS at the reduced node "
+                        "count and relaunches trainers (graceful mesh "
+                        "shrink; requires the store host to survive)")
+    p.add_argument("--heartbeat_interval", type=float, default=5.0,
+                   help="seconds between membership heartbeats (lower = "
+                        "faster failure detection, more store traffic)")
     p.add_argument("--log_dir", default=None, help="write per-process logs here")
     p.add_argument("--job_id", default="default", help="job name for logs")
     p.add_argument("training_script", help="the training program")
@@ -79,12 +90,17 @@ def _child_env(args, local_rank: int, coordinator: Optional[str] = None) -> dict
 
 
 class _Proc:
-    def __init__(self, cmd: List[str], env: dict, log_path: Optional[str], tag: str):
+    def __init__(self, cmd: List[str], env: dict, log_path: Optional[str],
+                 tag: str, restart_base: int = 0):
         self.cmd = cmd
         self.env = env
         self.log_path = log_path
         self.tag = tag
         self.restarts = 0
+        # restarts inherited from earlier incarnations (mesh shrinks): keeps
+        # PADDLE_RESTART_COUNT monotonic across generations, so crash-once
+        # fault injection (fault_tolerance.injection) never re-fires
+        self.restart_base = restart_base
         self.popen: Optional[subprocess.Popen] = None
         self._log_f = None
 
@@ -94,6 +110,7 @@ class _Proc:
             out = self._log_f
         else:
             out = None  # inherit
+        self.env["PADDLE_RESTART_COUNT"] = str(self.restarts + self.restart_base)
         self.popen = subprocess.Popen(self.cmd, env=self.env, stdout=out, stderr=out)
 
     def stop(self, sig=signal.SIGTERM):
@@ -106,38 +123,30 @@ class _Proc:
             self._log_f = None
 
 
-def launch(args) -> int:
-    """Run the job on this host; returns the exit code."""
-    rdzv = None
-    coordinator = None
-    if args.rank < 0:
-        # dynamic rank assignment over the native TCPStore (the reference's
-        # launch-master role); requires --master and --nnodes
-        if not args.master:
-            raise SystemExit("--rank -1 (auto) needs --master host:port")
-        from .rendezvous import rendezvous
+def _run_generation(args, rdzv, coordinator, incarnation: int):
+    """Run the trainers for ONE rendezvous (sub-)generation.
 
-        rdzv = rendezvous(args.master.replace("tcp://", ""), args.nnodes,
-                          job_id=args.job_id)
-        args.rank = rdzv.rank
-        # the rendezvous store OWNS the --master port for the job's lifetime;
-        # the PJRT coordination service must bind a DIFFERENT one, on the
-        # machine of PJRT process 0 (= the rank-0 node by arrival order)
-        host, port_s = args.master.replace("tcp://", "").rsplit(":", 1)
-        coord_port = (int(port_s) or rdzv.store.port) + 1
-        coordinator = f"{rdzv.peers[0]['host']}:{coord_port}"
-        print(f"[launch] rendezvous assigned node rank {args.rank}/{args.nnodes}"
-              f" (jax coordinator {coordinator})", file=sys.stderr)
+    Returns ``(exit_code, dead_ranks)``.  ``dead_ranks`` is non-empty when
+    peer nodes stopped heartbeating — in ``--on_peer_failure shrink`` mode
+    the caller then re-rendezvouses the survivors and runs the next
+    sub-generation; in ``exit`` mode it exits ``ELASTIC_EXIT_CODE`` for an
+    outer supervisor (reference elastic semantics).
+    """
     procs: List[_Proc] = []
     elastic_mgr = None
     node_died = []
     if rdzv is not None and args.nnodes > 1:
         # heartbeat this node + watch peers over the rendezvous store
-        # (reference ElasticManager: etcd registry + watch -> relaunch)
+        # (reference ElasticManager: etcd registry + watch -> relaunch);
+        # lease keys are scoped per (sub-)generation so stale counters from
+        # a pre-shrink mesh never alias a renumbered survivor
         from ..fleet.elastic import ElasticManager
 
+        lease_job = (args.job_id if incarnation == 0
+                     else f"{args.job_id}/g{rdzv.gen}.{rdzv.subgen}")
         elastic_mgr = ElasticManager(rdzv.store, args.rank, args.nnodes,
-                                     job_id=args.job_id).start()
+                                     job_id=lease_job,
+                                     interval=args.heartbeat_interval).start()
         import threading
 
         def _watch():
@@ -158,7 +167,7 @@ def launch(args) -> int:
         log_path = (os.path.join(args.log_dir, f"{args.job_id}.rank{args.rank}.local{lr}.log")
                     if args.log_dir else None)
         p = _Proc(cmd, _child_env(args, lr, coordinator), log_path,
-                  tag=f"rank{args.rank}.{lr}")
+                  tag=f"rank{args.rank}.{lr}", restart_base=incarnation)
         p.start()
         procs.append(p)
 
@@ -168,8 +177,7 @@ def launch(args) -> int:
         while alive:
             time.sleep(0.2)
             # a dead PEER NODE needs whole-job re-rendezvous, not a local
-            # restart: exit with the elastic code so an outer supervisor
-            # relaunches this launcher into the next rendezvous generation.
+            # restart: stop trainers and report the dead ranks upward.
             # Checked BEFORE child exit codes — a trainer that traps SIGTERM
             # and exits 0 must not read as success while the job is short
             if node_died:
@@ -217,9 +225,61 @@ def launch(args) -> int:
             p.close()
         if elastic_mgr is not None:
             elastic_mgr.stop()
+    return exit_code, list(node_died)
+
+
+def launch(args) -> int:
+    """Run the job on this host; returns the exit code."""
+    rdzv = None
+    coordinator = None
+    coord_base = None
+    if args.rank < 0:
+        # dynamic rank assignment over the native TCPStore (the reference's
+        # launch-master role); requires --master and --nnodes
+        if not args.master:
+            raise SystemExit("--rank -1 (auto) needs --master host:port")
+        from .rendezvous import rendezvous
+
+        rdzv = rendezvous(args.master.replace("tcp://", ""), args.nnodes,
+                          job_id=args.job_id)
+        args.rank = rdzv.rank
+        # the rendezvous store OWNS the --master port for the job's lifetime;
+        # the PJRT coordination service must bind a DIFFERENT one, on the
+        # machine of PJRT process 0 (= the rank-0 node by arrival order)
+        host, port_s = args.master.replace("tcp://", "").rsplit(":", 1)
+        coord_base = int(port_s) or rdzv.store.port
+        coordinator = f"{rdzv.peers[0]['host']}:{coord_base + 1}"
+        print(f"[launch] rendezvous assigned node rank {args.rank}/{args.nnodes}"
+              f" (jax coordinator {coordinator})", file=sys.stderr)
+    incarnation = 0
+    try:
+        while True:
+            exit_code, dead = _run_generation(args, rdzv, coordinator,
+                                              incarnation)
+            can_shrink = (args.on_peer_failure == "shrink" and dead
+                          and rdzv is not None
+                          and all(r >= 0 for r in dead)  # STORE_LOST => no store to shrink on
+                          and args.nnodes - len(set(dead)) >= 1)
+            if not can_shrink:
+                return exit_code
+            # graceful mesh shrink: survivors re-form the job at the reduced
+            # node count on the same store and resume from checkpoints
+            from .rendezvous import invalidate_generation, shrink_rendezvous
+
+            invalidate_generation(rdzv.store, rdzv.job_id, rdzv.gen, dead)
+            rdzv = shrink_rendezvous(rdzv, dead)
+            args.rank, args.nnodes = rdzv.rank, rdzv.nnodes
+            incarnation += 1
+            # fresh PJRT coordination port per incarnation: the previous
+            # service (on a possibly-dead host) must not be re-joined
+            coordinator = (f"{rdzv.peers[0]['host']}:"
+                           f"{coord_base + 1 + incarnation}")
+            print(f"[launch] mesh shrunk to {args.nnodes} node(s); this host "
+                  f"is now rank {args.rank} (gen {rdzv.gen}.{rdzv.subgen}, "
+                  f"jax coordinator {coordinator})", file=sys.stderr)
+    finally:
         if rdzv is not None:
             rdzv.store.close()
-    return exit_code
 
 
 def main(argv=None) -> int:

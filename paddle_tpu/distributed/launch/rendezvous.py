@@ -10,6 +10,15 @@ The node that successfully BINDS the master port hosts the store (the
 reference's HTTPMaster works the same way: the process whose IP matches the
 master address serves); everyone else connects as a client.  Generation
 counting makes the same store reusable across elastic restarts.
+
+Fault tolerance (v2): joins are BOUNDED — a generation that never fills
+raises ``TimeoutError`` naming the missing ranks instead of hanging; and
+when the failure detector declares a peer dead mid-training, survivors
+:func:`invalidate_generation` and :func:`shrink_rendezvous` to re-form the
+job at the reduced node count on the same store (graceful mesh shrink)
+rather than waiting out the full join timeout.  Both paths presume the
+store host survived; losing the store host is a whole-job restart (see
+``fault_tolerance`` failure model).
 """
 
 from __future__ import annotations
@@ -21,19 +30,31 @@ from typing import Dict, List, Optional, Tuple
 
 from ..store import TCPStore
 
-__all__ = ["rendezvous", "RendezvousResult"]
+__all__ = ["rendezvous", "RendezvousResult", "invalidate_generation",
+           "shrink_rendezvous", "GenerationInvalidated"]
+
+
+class GenerationInvalidated(RuntimeError):
+    """The generation being joined (or trained on) was declared dead-peered
+    and invalidated; survivors should re-rendezvous."""
 
 
 class RendezvousResult:
     def __init__(self, rank: int, nnodes: int, peers: List[dict],
-                 store: TCPStore):
+                 store: TCPStore, job_id: str = "default", gen: int = 0,
+                 subgen: int = -1):
         self.rank = rank
         self.nnodes = nnodes
         self.peers = peers          # [{rank, host}, ...] in rank order
         self.store = store          # kept open: heartbeat/elastic use it
+        self.job_id = job_id
+        self.gen = gen              # join generation on this store
+        self.subgen = subgen        # >= 0 after a mesh shrink
 
     def __repr__(self):
-        return f"RendezvousResult(rank={self.rank}, nnodes={self.nnodes})"
+        tag = f", subgen={self.subgen}" if self.subgen >= 0 else ""
+        return (f"RendezvousResult(rank={self.rank}, nnodes={self.nnodes}, "
+                f"gen={self.gen}{tag})")
 
 
 def _is_local(host: str) -> bool:
@@ -70,6 +91,43 @@ def _try_host(host: str, port: int, nnodes: int, timeout: float):
                     timeout=timeout)
 
 
+def _collect_peers(store: TCPStore, prefix: str, nnodes: int, timeout: float,
+                   what: str, invalid_key: Optional[str] = None) -> List[dict]:
+    """Gather all ``nnodes`` peer records under ``prefix`` within
+    ``timeout`` seconds.  Bounded: on expiry raises ``TimeoutError`` naming
+    exactly which ranks never registered; if ``invalid_key`` appears the
+    generation was declared dead and ``GenerationInvalidated`` is raised."""
+    deadline = time.monotonic() + timeout
+    peers: Dict[int, dict] = {}
+    while len(peers) < nnodes:
+        for r in range(nnodes):
+            if r in peers:
+                continue
+            raw = store.get(f"{prefix}/node/{r}", wait=False)
+            if raw is not None:
+                peers[r] = json.loads(raw)
+        if len(peers) >= nnodes:
+            break
+        if invalid_key is not None and store.get(invalid_key, wait=False) is not None:
+            raise GenerationInvalidated(
+                f"{what}: generation invalidated while joining "
+                f"(dead peers: {store.get(invalid_key, wait=False)})")
+        if time.monotonic() > deadline:
+            missing = sorted(set(range(nnodes)) - set(peers))
+            raise TimeoutError(
+                f"{what} incomplete after {timeout:.1f}s: missing ranks "
+                f"{missing} of {nnodes} (joined: {sorted(peers)})")
+        # wait on the FIRST missing rank's key so the poll blocks server-side
+        # instead of spinning; short slices keep the deadline responsive
+        first = min(set(range(nnodes)) - set(peers))
+        slice_s = min(1.0, max(0.05, deadline - time.monotonic()))
+        try:
+            store.wait(f"{prefix}/node/{first}", timeout=slice_s)
+        except TimeoutError:
+            pass  # re-check all ranks + the deadline
+    return [peers[r] for r in range(nnodes)]
+
+
 def rendezvous(master: str, nnodes: int, job_id: str = "default",
                timeout: float = 300.0) -> RendezvousResult:
     """Join the job; blocks until all ``nnodes`` nodes registered.
@@ -80,9 +138,11 @@ def rendezvous(master: str, nnodes: int, job_id: str = "default",
 
     Failure semantics: a node that crashes AFTER joining but before its
     generation completes leaves that generation short — the remaining
-    joiners raise ``TimeoutError`` after ``timeout`` (they never hang
-    forever).  Recover by restarting the whole set of nodes (the next
-    ``nnodes`` joins form a fresh generation) or restarting the master.
+    joiners raise ``TimeoutError`` after ``timeout`` naming the missing
+    ranks (they never hang forever).  Recover by restarting the whole set
+    of nodes (the next ``nnodes`` joins form a fresh generation), or — when
+    the failure strikes mid-training — via :func:`invalidate_generation` +
+    :func:`shrink_rendezvous` on the surviving nodes.
     """
     host, port_s = master.rsplit(":", 1)
     store = _try_host(host, int(port_s), nnodes, timeout)
@@ -90,14 +150,58 @@ def rendezvous(master: str, nnodes: int, job_id: str = "default",
     # ranks from the atomic join counter; a full round of nnodes joins forms
     # one GENERATION, so elastic restarts re-entering rendezvous on the same
     # store simply start the next generation (no state to reset)
-    joined = store.add(f"rdzv/{job_id}/joined", 1) - 1
-    gen, rank = divmod(joined, nnodes)
-    info = {"rank": rank, "host": socket.gethostname()}
-    store.set(f"rdzv/{job_id}/{gen}/node/{rank}", json.dumps(info))
+    try:
+        joined = store.add(f"rdzv/{job_id}/joined", 1) - 1
+        gen, rank = divmod(joined, nnodes)
+        info = {"rank": rank, "host": socket.gethostname()}
+        store.set(f"rdzv/{job_id}/{gen}/node/{rank}", json.dumps(info))
+        peers = _collect_peers(
+            store, f"rdzv/{job_id}/{gen}", nnodes, timeout,
+            what=f"rendezvous {job_id!r} generation {gen}",
+            invalid_key=f"rdzv/{job_id}/{gen}/invalid")
+        store.barrier(f"rdzv/{job_id}/{gen}/ready", timeout=timeout)
+    except BaseException:
+        store.close()  # a failed join must not leak the store (or its port)
+        raise
+    return RendezvousResult(rank, nnodes, peers, store, job_id=job_id, gen=gen)
 
-    peers: List[dict] = []
-    for r in range(nnodes):
-        raw = store.get(f"rdzv/{job_id}/{gen}/node/{r}")  # blocking
-        peers.append(json.loads(raw))
-    store.barrier(f"rdzv/{job_id}/{gen}/ready", timeout=timeout)
-    return RendezvousResult(rank, nnodes, peers, store)
+
+def invalidate_generation(store: TCPStore, job_id: str, gen: int,
+                          dead_ranks: List[int]) -> None:
+    """Mark generation ``gen`` dead on the store (idempotent — every
+    survivor may call it).  Late joiners and in-flight ``rendezvous`` polls
+    observe the key and abort instead of waiting out their timeout."""
+    store.set(f"rdzv/{job_id}/{gen}/invalid", json.dumps(sorted(dead_ranks)))
+
+
+def shrink_rendezvous(prev: RendezvousResult, dead_ranks: List[int],
+                      timeout: float = 60.0) -> RendezvousResult:
+    """Re-form the job WITHOUT the dead peers: every survivor of
+    ``prev.gen`` calls this once and receives a fresh contiguous rank in a
+    mesh of ``prev.nnodes - len(dead_ranks)`` nodes, over the SAME store
+    (the store host must be a survivor — a dead store host is the
+    whole-job-restart path).
+
+    Ranks are re-assigned by arrival order on a shrink counter scoped to
+    the invalidated generation, so repeated shrinks (two failures in
+    sequence) keep working: each invalidation starts the next sub-
+    generation."""
+    store, job_id, gen = prev.store, prev.job_id, prev.gen
+    new_n = prev.nnodes - len(set(dead_ranks))
+    if new_n < 1:
+        raise ValueError(f"no survivors to shrink to (dead={dead_ranks})")
+    joined = store.add(f"rdzv/{job_id}/{gen}/shrink/joined", 1) - 1
+    subgen, rank = divmod(joined, new_n)
+    prefix = f"rdzv/{job_id}/{gen}/shrink/{subgen}"
+    info = {"rank": rank, "host": socket.gethostname(),
+            "prev_rank": prev.rank}
+    store.set(f"{prefix}/node/{rank}", json.dumps(info))
+    peers = _collect_peers(
+        store, prefix, new_n, timeout,
+        what=f"shrink rendezvous {job_id!r} gen {gen}.{subgen}")
+    # subsequent barriers (including this ready barrier) are at the SHRUNK
+    # world size; each survivor's client adjusts its own view
+    store.world_size = new_n
+    store.barrier(f"{prefix}/ready", timeout=timeout)
+    return RendezvousResult(rank, new_n, peers, store, job_id=job_id,
+                            gen=gen, subgen=subgen)

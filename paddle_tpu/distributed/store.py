@@ -144,59 +144,144 @@ class _PyServer:
             pass
 
 
+_OP_NAMES = {_SET: "set", _GET: "get", _ADD: "add", _WAIT: "wait",
+             _DELETE: "delete"}
+
+
 class _PyClient:
+    """Pure-Python client with bounded ops: the connected socket honors the
+    store timeout (a dead master raises ``TimeoutError`` naming the key —
+    it can never hang ``get()`` forever), and idempotent ops reconnect on a
+    dropped connection under an exponential-backoff policy."""
+
     def __init__(self, host: str, port: int, timeout: float):
-        deadline = time.monotonic() + timeout
+        self._host, self._port = host, port
+        self._timeout = float(timeout)
+        self._mu = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._connect(time.monotonic() + timeout)
+
+    def _connect(self, deadline: float):
         last = None
         while time.monotonic() < deadline:
             try:
-                self._sock = socket.create_connection((host, port), timeout=5.0)
+                self._sock = socket.create_connection(
+                    (self._host, self._port),
+                    timeout=min(5.0, self._timeout))
                 self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._sock.settimeout(None)
-                self._mu = threading.Lock()
+                self._sock.settimeout(self._timeout)
                 return
             except OSError as e:
                 last = e
+                self._sock = None
                 time.sleep(0.05)
-        raise TimeoutError(f"TCPStore: cannot reach {host}:{port}: {last}")
+        raise TimeoutError(
+            f"TCPStore: cannot reach {self._host}:{self._port}: {last}")
 
-    def _roundtrip(self, cmd: int, key: bytes, payload: Optional[bytes]):
+    def _drop_sock(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _retry_policy(self):
+        from paddle_tpu.framework import flags
+        from .fault_tolerance.policy import RetryPolicy
+        return RetryPolicy(max_attempts=flags.get_flag("ft_store_max_retries"),
+                           base_delay=flags.get_flag("ft_store_backoff_base"),
+                           seed=flags.get_flag("ft_inject_seed"))
+
+    def _roundtrip(self, cmd: int, key: bytes, payload: Optional[bytes],
+                   op_timeout: Optional[float] = None,
+                   idempotent: bool = True):
+        from .fault_tolerance.injection import get_injector
+
+        op = _OP_NAMES.get(cmd, str(cmd))
+        limit = op_timeout if op_timeout is not None else self._timeout
+        inj = get_injector()
         with self._mu:
-            msg = bytes([cmd]) + struct.pack("!I", len(key)) + key
-            if payload is not None:
-                msg += struct.pack("!I", len(payload)) + payload
-            self._sock.sendall(msg)
-            status = _recv_exact(self._sock, 1)[0]
-            val = _recv_bytes(self._sock)
-            return status, val
+            if inj is not None and inj.delay_seconds():
+                time.sleep(inj.delay_seconds())  # slow/partitioned peer
+            drop_next = inj is not None and inj.should_drop()
+            policy = self._retry_policy()
+            schedule = policy.delays()
+            deadline = time.monotonic() + limit
+            last: Optional[BaseException] = None
+            for _ in range(policy.max_attempts):
+                try:
+                    if self._sock is None:
+                        self._connect(deadline)
+                    if drop_next:
+                        drop_next = False
+                        self._drop_sock()
+                        raise ConnectionError("[inject] store connection dropped")
+                    self._sock.settimeout(max(0.05, min(limit,
+                                                        deadline - time.monotonic())))
+                    msg = bytes([cmd]) + struct.pack("!I", len(key)) + key
+                    if payload is not None:
+                        msg += struct.pack("!I", len(payload)) + payload
+                    self._sock.sendall(msg)
+                    status = _recv_exact(self._sock, 1)[0]
+                    val = _recv_bytes(self._sock)
+                    return status, val
+                except TimeoutError as e:
+                    # socket.timeout (master unresponsive) or the reconnect
+                    # deadline inside _connect — either way: bounded, loud
+                    self._drop_sock()
+                    raise TimeoutError(
+                        f"TCPStore {op}({key!r}) timed out after {limit:.1f}s "
+                        f"(master {self._host}:{self._port} dead or "
+                        f"unresponsive)") from e
+                except (ConnectionError, OSError) as e:
+                    last = e
+                    self._drop_sock()
+                    if not idempotent:
+                        # the op may or may not have executed server-side;
+                        # a blind retry could e.g. double-increment a rank
+                        # counter — surface the drop to the caller instead
+                        raise ConnectionError(
+                            f"TCPStore {op}({key!r}) connection lost mid-op: "
+                            f"{e}") from e
+                    delay = next(schedule, None)
+                    if delay is None or time.monotonic() + delay > deadline:
+                        break
+                    time.sleep(delay)
+            raise TimeoutError(
+                f"TCPStore {op}({key!r}): master {self._host}:{self._port} "
+                f"unreachable within {limit:.1f}s ({last})")
 
-    def set(self, key: bytes, val: bytes):
-        status, _ = self._roundtrip(_SET, key, val)
+    def set(self, key: bytes, val: bytes, op_timeout: Optional[float] = None):
+        status, _ = self._roundtrip(_SET, key, val, op_timeout=op_timeout)
         if status != 0:
             raise RuntimeError("store set failed")
 
-    def get(self, key: bytes) -> Optional[bytes]:
-        status, val = self._roundtrip(_GET, key, None)
+    def get(self, key: bytes,
+            op_timeout: Optional[float] = None) -> Optional[bytes]:
+        status, val = self._roundtrip(_GET, key, None, op_timeout=op_timeout)
         return val if status == 0 else None
 
-    def add(self, key: bytes, delta: int) -> int:
-        status, val = self._roundtrip(_ADD, key, struct.pack("<q", delta))
+    def add(self, key: bytes, delta: int,
+            op_timeout: Optional[float] = None) -> int:
+        status, val = self._roundtrip(_ADD, key, struct.pack("<q", delta),
+                                      op_timeout=op_timeout, idempotent=False)
         if status != 0:
             raise RuntimeError("store add failed")
         return struct.unpack("<q", val)[0]
 
     def wait_key(self, key: bytes, timeout_ms: int) -> bool:
-        status, _ = self._roundtrip(_WAIT, key, struct.pack("<I", timeout_ms))
+        # the server parks the request up to timeout_ms before answering —
+        # the socket deadline must outlive the server-side wait
+        status, _ = self._roundtrip(_WAIT, key, struct.pack("<I", timeout_ms),
+                                    op_timeout=timeout_ms / 1000.0 + 5.0)
         return status == 0
 
     def delete(self, key: bytes):
         self._roundtrip(_DELETE, key, None)
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._drop_sock()
 
 
 # ---------------------------------------------------------------------------
@@ -223,45 +308,78 @@ class _NativeServer:
 class _NativeClient:
     def __init__(self, lib, host: str, port: int, timeout: float):
         self._lib = lib
+        self._host, self._port = host, port
+        # one request/response in flight per connection: without this lock,
+        # concurrent ops from the heartbeat/monitor/watch threads interleave
+        # send+recv on the shared socket and deadlock reading each other's
+        # responses (same discipline as _PyClient._mu)
+        self._mu = threading.Lock()
         self._h = lib.pts_client_connect(host.encode(), port,
                                          int(timeout * 1000))
         if not self._h:
             raise TimeoutError(f"TCPStore: cannot reach {host}:{port}")
 
-    def set(self, key: bytes, val: bytes):
-        if self._lib.pts_set(self._h, key, len(key), val, len(val)) != 0:
-            raise RuntimeError("store set failed")
+    def _fail(self, op: str, key: bytes):
+        # same typed contract as _PyClient: a broken/unresponsive master is
+        # a ConnectionError naming the op + key, never a bare RuntimeError
+        raise ConnectionError(
+            f"TCPStore {op}({key!r}) failed (master {self._host}:"
+            f"{self._port} dead or unresponsive)")
 
-    def get(self, key: bytes) -> Optional[bytes]:
+    # op_timeout is accepted for client-interface parity and ignored: native
+    # ops never reconnect, so a dead master fails them immediately (the recv
+    # errors out) — there is no retry loop to bound
+
+    def set(self, key: bytes, val: bytes, op_timeout: Optional[float] = None):
+        with self._mu:
+            if self._lib.pts_set(self._h, key, len(key), val, len(val)) != 0:
+                self._fail("set", key)
+
+    def get(self, key: bytes,
+            op_timeout: Optional[float] = None) -> Optional[bytes]:
         import ctypes
         out = ctypes.POINTER(ctypes.c_uint8)()
         n = ctypes.c_int()
-        rc = self._lib.pts_get(self._h, key, len(key),
-                               ctypes.byref(out), ctypes.byref(n))
-        if rc == 1:
-            return None
-        if rc != 0:
-            raise RuntimeError("store get failed")
-        val = bytes(bytearray(out[: n.value])) if n.value else b""
-        self._lib.pts_buf_free(out)
+        with self._mu:
+            rc = self._lib.pts_get(self._h, key, len(key),
+                                   ctypes.byref(out), ctypes.byref(n))
+            if rc == 1:
+                return None
+            if rc != 0:
+                self._fail("get", key)
+            val = bytes(bytearray(out[: n.value])) if n.value else b""
+            self._lib.pts_buf_free(out)
         return val
 
-    def add(self, key: bytes, delta: int) -> int:
+    def add(self, key: bytes, delta: int,
+            op_timeout: Optional[float] = None) -> int:
         import ctypes
         res = ctypes.c_int64()
-        if self._lib.pts_add(self._h, key, len(key), delta,
-                             ctypes.byref(res)) != 0:
-            raise RuntimeError("store add failed")
+        with self._mu:
+            if self._lib.pts_add(self._h, key, len(key), delta,
+                                 ctypes.byref(res)) != 0:
+                self._fail("add", key)
         return res.value
 
     def wait_key(self, key: bytes, timeout_ms: int) -> bool:
-        rc = self._lib.pts_wait(self._h, key, len(key), timeout_ms)
-        if rc < 0:
-            raise RuntimeError("store wait failed")
-        return rc == 0
+        # slice long waits so heartbeat/monitor threads sharing this
+        # connection aren't starved for the whole rendezvous timeout
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while True:
+            remaining_ms = int((deadline - time.monotonic()) * 1000)
+            slice_ms = max(1, min(200, remaining_ms))
+            with self._mu:
+                rc = self._lib.pts_wait(self._h, key, len(key), slice_ms)
+            if rc < 0:
+                self._fail("wait", key)
+            if rc == 0:
+                return True
+            if remaining_ms <= slice_ms:
+                return False
 
     def delete(self, key: bytes):
-        self._lib.pts_delete(self._h, key, len(key))
+        with self._mu:
+            self._lib.pts_delete(self._h, key, len(key))
 
     def close(self):
         if self._h:
@@ -286,6 +404,14 @@ class TCPStore:
     def __init__(self, host: str, port: int, world_size: int = 1,
                  is_master: bool = False, timeout: float = 300.0,
                  use_native: Optional[bool] = None):
+        if use_native is None:
+            from .fault_tolerance.injection import get_injector
+            inj = get_injector()
+            if inj is not None and (inj.store_drop_rate > 0
+                                    or inj.store_delay_ms > 0):
+                # store-fault injection instruments the Python client (drops,
+                # delays, reconnect) — chaos runs must not silently bypass it
+                use_native = False
         lib = native.load() if use_native in (None, True) else None
         if use_native is True and lib is None:
             raise RuntimeError("native store requested but library unavailable")
@@ -306,21 +432,29 @@ class TCPStore:
     def _k(key: Union[str, bytes]) -> bytes:
         return key.encode() if isinstance(key, str) else bytes(key)
 
-    def set(self, key, value: Union[str, bytes]) -> None:
+    def set(self, key, value: Union[str, bytes],
+            timeout: Optional[float] = None) -> None:
+        """``timeout`` bounds THIS op (default: the store timeout).  Liveness
+        probes pass a short one — a failure detector must not wait out the
+        rendezvous-scale default to learn the master is dead."""
         if isinstance(value, str):
             value = value.encode()
-        self._client.set(self._k(key), value)
+        self._client.set(self._k(key), value, op_timeout=timeout)
 
-    def get(self, key, wait: bool = True) -> Optional[bytes]:
-        """Blocking get (reference ``Store::get`` waits for the key)."""
+    def get(self, key, wait: bool = True,
+            timeout: Optional[float] = None) -> Optional[bytes]:
+        """Blocking get (reference ``Store::get`` waits for the key).
+        ``timeout`` bounds the whole op (default: the store timeout)."""
         k = self._k(key)
+        t = self.timeout if timeout is None else timeout
         if wait:
-            if not self._client.wait_key(k, int(self.timeout * 1000)):
+            if not self._client.wait_key(k, int(t * 1000)):
                 raise TimeoutError(f"TCPStore.get({key!r}) timed out")
-        return self._client.get(k)
+        return self._client.get(k, op_timeout=timeout)
 
-    def add(self, key, delta: int = 1) -> int:
-        return self._client.add(self._k(key), int(delta))
+    def add(self, key, delta: int = 1,
+            timeout: Optional[float] = None) -> int:
+        return self._client.add(self._k(key), int(delta), op_timeout=timeout)
 
     def wait(self, keys: Union[str, List[str]], timeout: Optional[float] = None) -> None:
         if isinstance(keys, (str, bytes)):
@@ -340,12 +474,24 @@ class TCPStore:
 
     def barrier(self, name: str = "barrier", timeout: Optional[float] = None) -> None:
         """All ``world_size`` processes rendezvous; generation-counted so the
-        same name can be reused across phases."""
+        same name can be reused across phases.  Bounded: raises
+        ``TimeoutError`` reporting how many peers arrived — a dead peer
+        fails the barrier loudly instead of hanging it."""
         arrived = self.add(f"__{name}/arrive", 1)
         gen = (arrived - 1) // self.world_size  # which barrier round am I in
         if arrived == (gen + 1) * self.world_size:  # last one in: release
             self.set(f"__{name}/release/{gen}", b"1")
-        self.wait(f"__{name}/release/{gen}", timeout)
+        try:
+            self.wait(f"__{name}/release/{gen}", timeout)
+        except TimeoutError:
+            try:
+                now = self.add(f"__{name}/arrive", 0) - gen * self.world_size
+            except Exception:
+                now = -1  # store unreachable: arrival count unknown
+            raise TimeoutError(
+                f"store barrier {name!r} (gen {gen}) timed out after "
+                f"{self.timeout if timeout is None else timeout:.1f}s: "
+                f"{now}/{self.world_size} arrived") from None
 
     def close(self) -> None:
         self._client.close()

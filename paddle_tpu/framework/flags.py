@@ -78,3 +78,28 @@ define_flag("pallas_interpret", False, "Run Pallas kernels in interpreter mode (
 define_flag("deterministic", False, "Prefer deterministic kernels")
 define_flag("eager_jit_ops", True, "Cache per-op jitted callables for eager dispatch")
 define_flag("log_level", 0, "Framework verbose log level (VLOG equivalent)")
+
+# ---------------------------------------------------------------------------
+# Fault-tolerance flags (consumed by distributed.fault_tolerance)
+# ---------------------------------------------------------------------------
+define_flag("ft_heartbeat_interval", 5.0,
+            "Seconds between heartbeat lease renewals on the control store")
+define_flag("ft_lease_ttl", 0.0,
+            "Seconds a silent peer keeps its membership lease; 0 = 3x interval")
+define_flag("ft_store_max_retries", 5,
+            "Reconnect attempts for a dropped control-store connection")
+define_flag("ft_store_backoff_base", 0.05,
+            "Base delay (s) of the store reconnect exponential backoff")
+# deterministic fault injection (chaos testing) — all off by default
+define_flag("ft_inject_seed", 0,
+            "Seed for every fault-injection random stream (determinism)")
+define_flag("ft_inject_crash_step", -1,
+            "Simulate a fail-stop worker crash before this train step (-1 off)")
+define_flag("ft_inject_crash_rank", -1,
+            "Restrict the injected crash to this rank (-1 = every rank)")
+define_flag("ft_inject_store_drop_rate", 0.0,
+            "Probability an outgoing store op gets its connection dropped")
+define_flag("ft_inject_store_delay_ms", 0,
+            "Added latency per store op (simulates a slow/partitioned peer)")
+define_flag("ft_inject_corrupt_step", -1,
+            "Bit-flip one checkpoint shard of this step after save (-1 off)")

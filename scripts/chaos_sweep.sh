@@ -1,0 +1,113 @@
+#!/bin/bash
+# Fault-injection matrix for the fault_tolerance stack (tentpole PR 5).
+# Runs every chaos scenario — the fast subset that tier-1 already runs
+# (tests/test_chaos.py) PLUS the injection sweeps that are too slow or too
+# parameter-heavy for the suite.  Every scenario is deterministic under
+# FLAGS_ft_inject_seed, and every invocation is timeout-guarded so a
+# regression that re-introduces a hang fails the sweep instead of wedging
+# it.  Exit code: number of failed scenarios.
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+FAIL=0
+
+run() {  # run <tag> <timeout-s> <cmd...>
+    local tag="$1" budget="$2"; shift 2
+    echo "[chaos] $tag" >&2
+    if timeout -k 10 "$budget" "$@" >/dev/null 2>&1; then
+        echo "[chaos] $tag: OK" >&2
+    else
+        echo "[chaos] $tag: FAILED (rc=$?)" >&2
+        FAIL=$((FAIL + 1))
+    fi
+}
+
+# 1. the pytest chaos scenarios (crash+resume, shard rot, replay determinism)
+run "pytest -m chaos" 600 \
+    python -m pytest tests/test_chaos.py -q -m chaos -p no:cacheprovider
+
+# 2. crash-step sweep: fail-stop at several points relative to the save
+#    cadence (before first save, on a save boundary, mid-interval)
+for step in 0 3 4 7; do
+    run "crash at step $step" 240 python - "$step" <<'PY'
+import subprocess, sys, tempfile, textwrap, os
+step = sys.argv[1]
+d = tempfile.mkdtemp(prefix="chaos_crash_")
+script = os.path.join(d, "train.py")
+import pathlib
+src = pathlib.Path("tests/test_chaos.py").read_text()
+body = src.split('TRAIN_SCRIPT = """')[1].split('"""')[0]
+pathlib.Path(script).write_text(textwrap.dedent(body))
+env = dict(os.environ, FLAGS_ft_inject_seed="7", FLAGS_ft_inject_crash_step=step)
+r = subprocess.run([sys.executable, "-m", "paddle_tpu.distributed.launch",
+                    "--max_restarts", "2", script, os.path.join(d, "ck"), "10"],
+                   capture_output=True, text=True, timeout=200, env=env)
+assert r.returncode == 0, r.stderr
+assert "train-done" in r.stdout, r.stdout
+PY
+done
+
+# 3. store under injected connection drops at increasing rates — idempotent
+#    ops must survive via reconnect+backoff; bounded even at high drop rates
+for rate in 0.2 0.5 0.7; do
+    run "store drop rate $rate" 120 python - "$rate" <<'PY'
+import sys
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.fault_tolerance import FaultInjector, set_injector
+rate = float(sys.argv[1])
+set_injector(FaultInjector(seed=123, store_drop_rate=rate))
+m = TCPStore("127.0.0.1", 0, world_size=1, is_master=True, timeout=10.0)
+assert not m.native  # injection instruments the Python client
+try:
+    for i in range(40):
+        m.set(f"k{i}", str(i).encode())
+        assert m.get(f"k{i}") == str(i).encode()
+finally:
+    set_injector(None)
+    m.close()
+PY
+done
+
+# 4. slow store peer: injected per-op latency must stay within timeouts
+run "store delay 200ms" 120 python - <<'PY'
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.fault_tolerance import FaultInjector, set_injector
+set_injector(FaultInjector(seed=1, store_delay_ms=200))
+m = TCPStore("127.0.0.1", 0, world_size=1, is_master=True, timeout=10.0)
+assert not m.native
+try:
+    for i in range(10):
+        m.set(f"k{i}", b"v")
+        assert m.get(f"k{i}") == b"v"
+finally:
+    set_injector(None)
+    m.close()
+PY
+
+# 5. shard-rot sweep: flip 1..32 bits in the newest shard; resume must fall
+#    back to the previous step every time (zip-layer OR crc-layer detection)
+run "shard rot 1..32 bits" 600 python - <<'PY'
+import os, pathlib, subprocess, sys, tempfile, textwrap
+src = pathlib.Path("tests/test_chaos.py").read_text()
+body = src.split('TRAIN_SCRIPT = """')[1].split('"""')[0]
+from paddle_tpu.distributed.fault_tolerance import FaultInjector
+for nbits in (1, 8, 32):
+    d = tempfile.mkdtemp(prefix=f"chaos_rot{nbits}_")
+    script = os.path.join(d, "train.py")
+    pathlib.Path(script).write_text(textwrap.dedent(body))
+    ck = os.path.join(d, "ck")
+    def run():
+        return subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             script, ck, "12"], capture_output=True, text=True,
+            timeout=200, env=dict(os.environ))
+    r = run(); assert r.returncode == 0, r.stderr
+    newest = os.path.join(ck, "step_00000012")
+    shard = [f for f in os.listdir(newest) if f.endswith(".npz")][0]
+    FaultInjector(seed=5).corrupt_file(os.path.join(newest, shard), nbits=nbits)
+    r2 = run(); assert r2.returncode == 0, r2.stderr
+    assert "resume-from 10" in r2.stdout, (nbits, r2.stdout)
+PY
+
+echo "[chaos] sweep done: $FAIL failure(s)" >&2
+exit "$FAIL"

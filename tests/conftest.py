@@ -14,14 +14,65 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 # deterministic fp32 matmuls for numerics comparisons against numpy
 jax.config.update("jax_default_matmul_precision", "highest")
-# persistent compilation cache: the suite compiles hundreds of identical CPU
-# programs (every serving test builds its own Engine program set); caching
-# them across runs cuts repeat-suite wall time substantially. Keyed by HLO
-# hash, so staleness is impossible by construction.
-try:
-    jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_jax_cache")
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-except Exception:
-    pass  # older jax without these knobs: run uncached
+# Persistent compilation cache: OFF by default.  jaxlib CPU crashes
+# (SIGSEGV/SIGABRT) deserializing cache entries written by an earlier
+# process — observed at several different tests depending on which keys
+# hit (the seed's "deterministic mid-suite SIGSEGV" at test_elastic_resume
+# was one instance; a warm-cache rerun aborted at test_group_sharded
+# instead).  Truncated entries from killed runs are one trigger, but even
+# intact cross-run entries abort, so reuse is disabled rather than
+# hardened.  Opt in with PADDLE_TPU_TEST_PCACHE=<dir> (e.g. on a TPU
+# backend, where deserialization is exercised in production); opted-in
+# writes are still committed atomically (tmp + os.replace, the same
+# manifest-last discipline as distributed.checkpoint) so a killed run
+# cannot poison the dir, and sub-second compiles are not cached at all.
+_pcache = os.environ.get("PADDLE_TPU_TEST_PCACHE", "0")
+if _pcache != "0":
+    try:
+        import time as _time
+
+        from jax._src import lru_cache as _lru
+
+        def _atomic_put(self, key, val):
+            if not key:
+                raise ValueError("key cannot be empty")
+            if self.eviction_enabled and len(val) > self.max_size:
+                return
+            cache_path = self.path / f"{key}{_lru._CACHE_SUFFIX}"
+            atime_path = self.path / f"{key}{_lru._ATIME_SUFFIX}"
+            if self.eviction_enabled:
+                self.lock.acquire(timeout=self.lock_timeout_secs)
+            try:
+                if cache_path.exists():
+                    return
+                self._evict_if_needed(additional_size=len(val))
+                tmp = cache_path.with_name(f"{cache_path.name}.tmp{os.getpid()}")
+                tmp.write_bytes(val)
+                os.replace(tmp, cache_path)  # all-or-nothing visibility
+                atime_path.write_bytes(_time.time_ns().to_bytes(8, "little"))
+            finally:
+                if self.eviction_enabled:
+                    self.lock.release()
+
+        _lru.LRUCache.put = _atomic_put
+        jax.config.update("jax_compilation_cache_dir", _pcache)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        # only cache compiles worth caching: deserializing the tiny TrainStep
+        # executables that many tests compile with identical HLO (but
+        # different donation/device context) segfaults jaxlib CPU mid-suite —
+        # the seed's 30%-mark SIGSEGV; sub-second compiles are also not where
+        # the suite's time goes (vision/transformer compiles are)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # older/newer jax without these internals: run uncached
 assert jax.default_backend() == "cpu", jax.default_backend()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection tests (subprocess kills, "
+        "corrupted shards, partitioned stores); deterministic under "
+        "FLAGS_ft_inject_seed — run the full matrix with scripts/chaos_sweep.sh")
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 "
+        "selection (-m 'not slow')")

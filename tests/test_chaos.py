@@ -1,0 +1,141 @@
+"""Chaos tests: end-to-end recovery under injected faults (satellite of the
+fault-tolerance tentpole).
+
+Every fault comes from the deterministic injection framework
+(``distributed.fault_tolerance.injection``) configured through
+``FLAGS_ft_inject_*`` env, so each scenario replays bit-for-bit under a
+fixed seed.  These are the FAST subset run in tier-1; the full matrix is
+``scripts/chaos_sweep.sh``.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fault_tolerance import FaultInjector
+
+pytestmark = pytest.mark.chaos
+
+TRAIN_SCRIPT = """
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet import CheckpointManager
+    from paddle_tpu.distributed.fault_tolerance import get_injector
+
+    ckpt_dir, total = sys.argv[1], int(sys.argv[2])
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        return ((m(x) - y) ** 2).mean()
+
+    step_fn = paddle.jit.TrainStep(model, loss_fn, opt)
+    mgr = CheckpointManager(ckpt_dir, keep=2)
+    start = mgr.resume(step_fn)
+    print("resume-from", start, flush=True)
+    inj = get_injector()
+    for i in range(start, total):
+        rs = np.random.default_rng(100 + i)  # restart-invariant data
+        x = paddle.to_tensor(rs.normal(size=(16, 8)).astype(np.float32))
+        y = paddle.to_tensor(rs.normal(size=(16, 1)).astype(np.float32))
+        loss = step_fn(x, y)
+        if inj is not None:
+            inj.crash_point(i)  # fail-stop when FLAGS_ft_inject_crash_step == i
+        if (i + 1) % 2 == 0:
+            mgr.save(i + 1, step_fn)
+    print("train-done", start)
+"""
+
+SAVE_EVERY = 2
+
+
+def _write_script(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent(TRAIN_SCRIPT))
+    return str(script)
+
+
+def _env(**flags):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    for k, v in flags.items():
+        env[f"FLAGS_{k}"] = str(v)
+    return env
+
+
+def _launch(script, ckpt, total, env, max_restarts=2):
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--max_restarts", str(max_restarts), script, ckpt, str(total)]
+    return subprocess.run(cmd, capture_output=True, text=True, timeout=300,
+                          env=env)
+
+
+def test_injected_crash_resumes_within_save_every(tmp_path):
+    """Worker fail-stops at step 5 (injected); the launcher relaunches it
+    with PADDLE_RESTART_COUNT=1 (so the crash never re-fires) and training
+    resumes from the last save — within SAVE_EVERY steps of the crash."""
+    script = _write_script(tmp_path)
+    ckpt = str(tmp_path / "ckpt")
+    crash_at = 5
+    r = _launch(script, ckpt, 12,
+                _env(ft_inject_seed=7, ft_inject_crash_step=crash_at))
+    assert r.returncode == 0, r.stderr
+    assert "[inject] fail-stop crash at step 5" in r.stderr
+    assert "restart 1/2" in r.stderr  # the launcher relaunched, once
+    resumes = [int(l.split()[1]) for l in r.stdout.splitlines()
+               if l.startswith("resume-from")]
+    assert resumes[0] == 0
+    assert len(resumes) == 2, r.stdout  # exactly one relaunch
+    assert crash_at - resumes[1] <= SAVE_EVERY  # bounded lost work
+    assert f"train-done {resumes[1]}" in r.stdout
+
+
+def test_corrupted_shard_falls_back_to_previous_step(tmp_path):
+    """Bit-flip one shard of the NEWEST checkpoint (deterministic flips from
+    the injection seed): resume skips it and falls back to the previous
+    intact step instead of crashing or loading garbage."""
+    script = _write_script(tmp_path)
+    ckpt = str(tmp_path / "ckpt")
+    r = _launch(script, ckpt, 12, _env())
+    assert r.returncode == 0, r.stderr
+    assert "train-done 0" in r.stdout
+
+    # keep=2 retains steps 10 and 12; rot the newest shard on disk
+    newest = os.path.join(ckpt, "step_00000012")
+    shard = [f for f in os.listdir(newest) if f.endswith(".npz")][0]
+    flips = FaultInjector(seed=5).corrupt_file(os.path.join(newest, shard))
+    assert flips  # seeded flips; stream determinism is unit-tested
+
+    r2 = _launch(script, ckpt, 12, _env())
+    assert r2.returncode == 0, r2.stderr
+    assert "falling back" in (r2.stderr + r2.stdout)
+    assert "resume-from 10" in r2.stdout  # previous intact step
+    assert "train-done 10" in r2.stdout
+
+
+def test_chaos_replay_is_deterministic(tmp_path):
+    """The same seed produces the same crash point and the same recovery
+    trace — two runs of the kill scenario are step-for-step identical."""
+    outs = []
+    for tag in ("a", "b"):
+        d = tmp_path / tag
+        d.mkdir()
+        script = _write_script(d)
+        r = _launch(script, str(d / "ckpt"), 8,
+                    _env(ft_inject_seed=11, ft_inject_crash_step=3))
+        assert r.returncode == 0, r.stderr
+        outs.append([l for l in r.stdout.splitlines()
+                     if l.startswith(("resume-from", "train-done"))])
+    assert outs[0] == outs[1]
+    assert outs[0][0] == "resume-from 0"
+    assert outs[0][-1].startswith("train-done")

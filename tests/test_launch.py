@@ -152,8 +152,8 @@ class TestElasticNodeDeath:
         env = {**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"}
         cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
                "--master", f"127.0.0.1:{port}", "--nnodes", "2",
-               "--rank", "-1", "--max_restarts", "0", str(script)]
-        # small heartbeat interval via the manager default is 5s; tolerate it
+               "--rank", "-1", "--max_restarts", "0",
+               "--heartbeat_interval", "1", str(script)]
         procs = [subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                   stderr=subprocess.STDOUT, text=True, env=env)
                  for _ in range(2)]
@@ -172,3 +172,62 @@ class TestElasticNodeDeath:
             for p in procs:
                 if p.poll() is None:
                     p.kill()
+
+
+@pytest.mark.chaos
+class TestMeshShrink:
+    def test_peer_death_shrinks_mesh(self, tmp_path):
+        """Three auto-rank launchers with ``--on_peer_failure shrink``; one
+        node is killed mid-run — the SURVIVORS re-rendezvous at 2 nodes on
+        the same store (hosted here, so the kill never takes the store) and
+        relaunch their trainers into the shrunken mesh."""
+        import subprocess
+        import sys
+        import textwrap
+        import time
+
+        from paddle_tpu.distributed.store import TCPStore
+
+        master = TCPStore("127.0.0.1", 0, world_size=3, is_master=True,
+                          timeout=60.0)
+        script = tmp_path / "train_shrink.py"
+        script.write_text(textwrap.dedent("""
+            import os, time
+            n = int(os.environ["PADDLE_TRAINERS_NUM"])
+            print("UP", os.environ["PADDLE_TRAINER_ID"], "of", n, flush=True)
+            if n == 3:
+                time.sleep(300)   # gen 0: run until the launcher stops us
+            print("SHRUNK-OK", n, flush=True)
+        """))
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"}
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--master", f"127.0.0.1:{master.port}", "--nnodes", "3",
+               "--rank", "-1", "--max_restarts", "0",
+               "--on_peer_failure", "shrink", "--heartbeat_interval", "0.3",
+               str(script)]
+        procs = [subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True, env=env)
+                 for _ in range(3)]
+        try:
+            time.sleep(20)  # rendezvous + spawn warmup (loaded machine)
+            assert all(p.poll() is None for p in procs)
+            procs[2].kill()  # one node fail-stops; the store survives here
+            outs = []
+            for p in procs[:2]:
+                out, _ = p.communicate(timeout=240)
+                outs.append(out)
+            for p, out in zip(procs[:2], outs):
+                assert p.returncode == 0, (p.returncode, out[-2000:])
+                assert "stopped heartbeating" in out
+                assert "mesh shrunk to 2 node(s)" in out
+                assert "SHRUNK-OK 2" in out
+            # the two survivors took ranks 0 and 1 of the shrunken mesh
+            got = sorted(out.split("mesh shrunk")[1][:80].split("rank ")[1][0]
+                         for out in outs)
+            assert got == ["0", "1"]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            master.close()

@@ -288,6 +288,35 @@ def lower_pretrain_step(step_fn, *example_args, lr: float = 3e-4):
         rnd.next_key(), tuple(a._data for a in example_args))
 
 
+def _bytes_fields(lowered, audit=False, label=""):
+    """``bytes_per_step`` fields for a BENCH line, from the compiled step's
+    cost analysis (fallback: HLO-text fusion audit).  With ``audit=True``
+    the ranked per-fusion report goes to stderr (stdout stays one JSON
+    line)."""
+    import sys
+
+    from paddle_tpu.profiler.fusion_audit import audit_lowered, bytes_per_step
+
+    fields = {}
+    try:
+        b = bytes_per_step(lowered=lowered)
+    except Exception:
+        b = None
+    if b:
+        fields["bytes_per_step"] = float(b)
+        fields["bytes_source"] = "xla_cost"
+    if audit:
+        a = audit_lowered(lowered)
+        if a is not None:
+            if "bytes_per_step" not in fields and a.total_bytes:
+                fields["bytes_per_step"] = float(a.total_bytes)
+                fields["bytes_source"] = "hlo_audit"
+            print(f"== fusion audit{' (' + label + ')' if label else ''} ==",
+                  file=sys.stderr)
+            print(a.report(), file=sys.stderr)
+    return fields
+
+
 def _bench_decode(jax, paddle, backend, on_tpu, args):
     """Serving path: KV-cache greedy decode throughput (new tokens/s).
 
@@ -336,7 +365,25 @@ def _bench_decode(jax, paddle, backend, on_tpu, args):
     hbm = 819e9 if on_tpu else None   # v5e HBM bandwidth
     steps_per_sec = new / dt
     frac_bound = (steps_per_sec * param_bytes / hbm) if hbm else 0.0
+    # bytes/step: whole generate program / new tokens (cached jitted fn)
+    bytes_fields = {}
+    try:
+        from paddle_tpu.framework import random as rnd
+
+        sig, fn = next(iter(model._generate_fns.items()))
+        params = {n: p._data for n, p in model.named_parameters()}
+        buffers = {n: b._data for n, b in model.named_buffers()}
+        lowered = fn.lower(params, buffers, out._data[:, :prompt], rnd.next_key())
+        bf = _bytes_fields(lowered, audit=getattr(args, "audit", False),
+                           label="decode")
+        if bf.get("bytes_per_step"):
+            bf["bytes_per_step"] = bf["bytes_per_step"] / new  # per new token
+        bytes_fields = bf
+    except Exception:
+        bytes_fields = {"bytes_per_step": float(param_bytes),
+                        "bytes_source": "analytic_weight_stream"}
     return {
+        **bytes_fields,
         "metric": "llama_decode_new_tokens_per_sec",
         "value": round(new_tokens_per_sec, 2),
         "unit": "tokens/s",
@@ -428,6 +475,11 @@ def _bench_serve(jax, paddle, backend, on_tpu, args):
     else:
         frac_bound = 0.0
     return {
+        # the engine runs many distinct programs (prefill buckets + decode
+        # chunk ladder); per-decode-step traffic is the analytic weight
+        # stream — labeled as such so the gate knows it's a model, not XLA
+        "bytes_per_step": float(param_bytes),
+        "bytes_source": "analytic_weight_stream",
         "metric": "llama_serve_new_tokens_per_sec",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s",
@@ -500,7 +552,10 @@ def _bench_ocr(jax, paddle, backend, on_tpu, args):
     hbm = 819e9 if on_tpu else None   # v5e HBM bandwidth
     bound_img_s = (batch * hbm / step_bytes) if (hbm and step_bytes) else 0.0
     vs_bound = images_per_sec / bound_img_s if bound_img_s else 0.0
+    bytes_fields = _bytes_fields(lowered, audit=getattr(args, "audit", False),
+                                 label="ocr")
     return {
+        **bytes_fields,
         "metric": "ocr_det_train_images_per_sec",
         "value": round(images_per_sec, 2),
         "unit": "images/s",
@@ -567,11 +622,14 @@ def _bench_moe(jax, paddle, backend, on_tpu, args):
 
     lowered = lower_pretrain_step(step_fn, ids)
     step_flops = _step_flops_of(lowered)
+    bytes_fields = _bytes_fields(lowered, audit=getattr(args, "audit", False),
+                                 label="moe")
 
     tokens_per_sec = batch * seq * steps / dt
     dev_kind, peak = _peak_flops(jax, on_tpu)
     mfu = (step_flops * steps / dt / peak) if peak and step_flops else 0.0
     return {
+        **bytes_fields,
         "metric": "llama_moe_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s",
@@ -610,7 +668,18 @@ def main():
                     help="gradient (and accumulator) dtype; bfloat16 halves "
                          "grad HBM traffic and the accumulator footprint "
                          "(the loss-scaling-free TPU recipe)")
+    ap.add_argument("--audit", action="store_true",
+                    help="print the per-fusion bytes-accessed-vs-minimum "
+                         "report (profiler.fusion_audit) to stderr; stdout "
+                         "stays one JSON line")
+    ap.add_argument("--audit-only", action="store_true",
+                    help="pretrain presets: lower + compile + cost-analyse "
+                         "the step but skip the timed run (bytes_per_step "
+                         "without executing — lets the bytes gate cover "
+                         "presets too slow to run on the CPU proxy)")
     args = ap.parse_args()
+    if args.audit_only:
+        args.audit = True
 
     fallback = False
     probe = "cpu" if args.device == "cpu" else ("tpu" if args.device == "tpu"
@@ -663,6 +732,21 @@ def main():
         accum=accum, grad_dtype=args.grad_dtype)
     n_params = sum(p.size for p in model.parameters())
 
+    lowered = lower_pretrain_step(step_fn, ids)
+    bytes_fields = _bytes_fields(lowered, audit=args.audit, label=preset)
+
+    if args.audit_only:
+        print(json.dumps(_stamp({
+            **bytes_fields,
+            "metric": f"llama_{preset}_pretrain_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0, "mfu": 0.0,
+            "audit_only": True,
+            "device": _peak_flops(jax, on_tpu)[0], "backend": backend,
+            "preset": preset, "params": n_params, "batch": batch,
+            "accum": accum, "seq_len": seq, "steps": 0,
+        })))
+        return
+
     # warmup/compile
     loss = step_fn(ids)
     jax.block_until_ready(loss._data)
@@ -684,6 +768,7 @@ def main():
     mfu = achieved / peak if peak else 0.0
 
     result = {
+        **bytes_fields,
         "metric": f"llama_{preset}_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s",

@@ -69,10 +69,21 @@ class ShapeAnalysis:
 
     def add_equal(self, a, b) -> None:
         """Record ``a == b``.  The representative prefers constants, then
-        structurally smaller expressions (so substitution simplifies)."""
+        structurally smaller expressions (so substitution simplifies).
+
+        A constraint that would merge two DISTINCT constants (directly, or
+        through the classes' representatives — e.g. ``T == 4`` after
+        ``T == 8``) is a contradiction: raising here is what keeps every
+        later ``is_equal``/``broadcast`` answer trustworthy, instead of the
+        whole analysis silently collapsing onto whichever constant won the
+        union (the reference's ConstraintsManager rejects these too)."""
         a, b = self._find(_wrap(a)), self._find(_wrap(b))
         if a == b:
             return
+        if a.kind == "const" and b.kind == "const":
+            raise ValueError(
+                f"contradictory equality constraint: classes resolve to "
+                f"distinct constants {a!r} and {b!r}")
         # constants win; otherwise the shorter repr becomes representative
         if a.kind == "const" or (b.kind != "const" and len(repr(a)) <= len(repr(b))):
             a, b = b, a
@@ -277,27 +288,33 @@ def infer_symbolic_shapes(fn, arg_shapes: Sequence[Sequence[_Dim]],
 
     # off-align verification: every aligned probe is blind to align-periodic
     # dims (e.g. ceil-to-multiple padding fits as plain T on aligned points).
-    # Evaluate the CONSTRUCTED exprs at an off-align assignment when the fn
-    # admits one (divisibility-constrained programs may legitimately reject
-    # it — then the guarantee narrows to align-multiple assignments, which
-    # is exactly the bucketed/serving use-case).
-    off = {n: min(base[n] + step[n] + max(1, step[n] // 2),
-                  next(s for s in syms if s[0] == n)[2] or 10**9)
-           for n in names}
-    if all(off[n] != base[n] + step[n] for n in names):
+    # Evaluate the CONSTRUCTED exprs at off-align assignments when the fn
+    # admits them (divisibility-constrained programs may legitimately reject
+    # the probe — then the guarantee narrows to align-multiple assignments,
+    # which is exactly the bucketed/serving use-case).  Verified PER SYMBOL:
+    # one symbol whose range is too narrow to move off-align (hi clamps the
+    # probe back onto the aligned bump) must not disable the check for the
+    # others — each movable symbol gets its own one-symbol-off probe.
+    for n in names:
+        hi_n = next(s for s in syms if s[0] == n)[2]
+        off_n = min(base[n] + step[n] + max(1, step[n] // 2),
+                    hi_n if hi_n is not None else 10**9)
+        if off_n == base[n] + step[n] or off_n % align == 0:
+            continue  # range too narrow to place an off-align probe for n
+        off = dict(base)
+        off[n] = off_n
         try:
             actual, _ = eval_at(off)
         except Exception:
-            actual = None
-        if actual is not None:
-            for li in range(n_leaves):
-                for di, d in enumerate(out_shapes[li]):
-                    want = d.subs(off) if isinstance(d, DimExpr) else d
-                    if want != actual[li][di]:
-                        raise SymbolicShapeError(
-                            f"inferred dim {d!r} evaluates to {want} at the "
-                            f"off-align probe {off} but the program yields "
-                            f"{actual[li][di]} — the dim is not expressible "
-                            f"in this algebra (align-periodic?)")
+            continue  # fn rejects off-align sizes for this symbol
+        for li in range(n_leaves):
+            for di, d in enumerate(out_shapes[li]):
+                want = d.subs(off) if isinstance(d, DimExpr) else d
+                if want != actual[li][di]:
+                    raise SymbolicShapeError(
+                        f"inferred dim {d!r} evaluates to {want} at the "
+                        f"off-align probe {off} but the program yields "
+                        f"{actual[li][di]} — the dim is not expressible "
+                        f"in this algebra (align-periodic?)")
 
     return jax.tree.unflatten(treedef, out_shapes)

@@ -343,3 +343,11 @@ def load_profiler_result(filename: str) -> ProfilerResult:
 
 __all__ += ["SortedKeys", "SummaryView", "export_protobuf",
             "load_profiler_result"]
+
+from .fusion_audit import (  # noqa: E402
+    FusionAudit, FusionRecord, audit_compiled, audit_hlo_text, audit_lowered,
+    bytes_per_step,
+)
+
+__all__ += ["FusionAudit", "FusionRecord", "audit_compiled", "audit_hlo_text",
+            "audit_lowered", "bytes_per_step"]

@@ -1,0 +1,303 @@
+"""HLO fusion auditor — bytes-accessed vs. analytic minimum, per fusion.
+
+In the spirit of "Operator Fusion in XLA: Analysis and Evaluation"
+(arXiv:2301.13062): XLA's fusion decisions are the single biggest lever on
+bandwidth-bound steps, and they are invisible in aggregate timings.  This
+pass walks a compiled module's optimized HLO, attributes HBM traffic to each
+top-level instruction (fusions, dots, custom calls, copies, collectives),
+and compares the traffic each fusion *actually* causes against the analytic
+minimum for its operand/output set:
+
+    minimum  = unique operand bytes + output bytes
+    actual   = per-use operand bytes + output bytes
+
+so duplicate operand reads show up as waste.  Two further classes of
+avoidable traffic are flagged:
+
+- ``copy``/``transpose``/``convert`` instructions surviving at top level
+  (layout churn: pure data movement XLA failed to fuse into a consumer);
+- **missed producer→consumer fusions**: a loop fusion whose output feeds
+  exactly one other loop fusion — the intermediate round-trips HBM where a
+  single fusion would have kept it in registers (this is exactly the
+  unfused-AdamW pattern ``kernels/adamw.py`` eliminates).
+
+The report ranks by waste so the top entries are the next kernels to write.
+
+Works on the text HLO (``compiled.as_text()``) because jaxlib exposes
+cost_analysis only as a module-level aggregate — per-fusion numbers must
+come from the instruction stream.  Aggregate ``bytes accessed`` for BENCH
+lines still comes from ``utils.xla_cost`` (one authoritative number), with
+the audit total as fallback.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "FusionRecord", "FusionAudit", "audit_hlo_text", "audit_compiled",
+    "audit_lowered", "bytes_per_step", "shape_bytes",
+]
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s4": 1, "u4": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1, "f8e5m2fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# ops that move no HBM bytes of their own at top level
+_FREE_OPS = {
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "after-all", "token", "partition-id", "replica-id", "iota",
+    "reshape",  # layout-preserving reshape is a bitcast post-layout
+}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([^\]]*)\]")
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*(?P<rest>.+)$")
+_KIND_RE = re.compile(r"kind=k(\w+)")
+
+
+def shape_bytes(type_str: str) -> int:
+    """Bytes of an HLO type string: ``f32[128,256]{1,0}``, tuples, scalars.
+
+    Dynamic dims (``<=N``) count at their bound; unknown dtypes count 0
+    (token/opaque)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(type_str):
+        width = _DTYPE_BYTES.get(dtype)
+        if width is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            d = d.strip().lstrip("<=").strip()
+            if d:
+                n *= int(d)
+        total += n * width
+    if total == 0 and "[" not in type_str:
+        # bare scalar like "f32" (rare in text dumps)
+        total = _DTYPE_BYTES.get(type_str.strip(), 0)
+    return total
+
+
+def _split_type_op(rest: str) -> Tuple[str, str, str]:
+    """Split ``f32[2]{0} fusion(%a, %b), kind=...`` into
+    (type_str, opcode, tail-after-opcode)."""
+    rest = rest.strip()
+    if rest.startswith("("):  # tuple type — find balanced paren
+        depth = 0
+        for i, c in enumerate(rest):
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+        type_str, rest2 = rest[: i + 1], rest[i + 1:].strip()
+    else:
+        sp = rest.find(" ")
+        if sp < 0:
+            return rest, "", ""
+        type_str, rest2 = rest[:sp], rest[sp + 1:].strip()
+    m = re.match(r"([\w\-]+)", rest2)
+    opcode = m.group(1) if m else ""
+    return type_str, opcode, rest2[len(opcode):]
+
+
+def _paren_args(tail: str) -> str:
+    """The balanced ``(...)`` operand list right after the opcode."""
+    start = tail.find("(")
+    if start < 0:
+        return ""
+    depth = 0
+    for i in range(start, len(tail)):
+        if tail[i] == "(":
+            depth += 1
+        elif tail[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return tail[start + 1: i]
+    return tail[start + 1:]
+
+
+@dataclass
+class FusionRecord:
+    name: str
+    opcode: str
+    kind: str = ""            # Loop / Input / Output / Custom for fusions
+    bytes_out: int = 0
+    bytes_in: int = 0         # per-use operand traffic
+    bytes_in_unique: int = 0  # unique operand buffers
+    operands: List[str] = field(default_factory=list)
+    notes: List[str] = field(default_factory=list)
+
+    @property
+    def bytes_accessed(self) -> int:
+        return self.bytes_in + self.bytes_out
+
+    @property
+    def bytes_min(self) -> int:
+        return self.bytes_in_unique + self.bytes_out
+
+    @property
+    def waste(self) -> int:
+        return self.bytes_accessed - self.bytes_min
+
+
+@dataclass
+class FusionAudit:
+    records: List[FusionRecord]
+    missed_fusions: List[Tuple[str, str, int]] = field(default_factory=list)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(r.bytes_accessed for r in self.records)
+
+    @property
+    def total_min(self) -> int:
+        return sum(r.bytes_min for r in self.records)
+
+    @property
+    def total_waste(self) -> int:
+        # duplicate-read waste + intermediates that a merged fusion would kill
+        return (self.total_bytes - self.total_min
+                + sum(b for _, _, b in self.missed_fusions))
+
+    def ranked(self) -> List[FusionRecord]:
+        return sorted(self.records, key=lambda r: (r.waste, r.bytes_accessed),
+                      reverse=True)
+
+    def report(self, top: int = 12) -> str:
+        lines = [
+            f"fusion audit: {len(self.records)} traffic-moving instructions, "
+            f"{self.total_bytes / 1e6:.3f} MB accessed, "
+            f"{self.total_min / 1e6:.3f} MB analytic minimum, "
+            f"{self.total_waste / 1e6:.3f} MB avoidable",
+            f"{'instruction':<34}{'op':<14}{'kind':<8}"
+            f"{'MB acc':>10}{'MB min':>10}{'waste':>10}  notes",
+        ]
+        for r in self.ranked()[:top]:
+            lines.append(
+                f"{r.name[:33]:<34}{r.opcode[:13]:<14}{r.kind[:7]:<8}"
+                f"{r.bytes_accessed / 1e6:>10.3f}{r.bytes_min / 1e6:>10.3f}"
+                f"{r.waste / 1e6:>10.3f}  {'; '.join(r.notes)}")
+        for prod, cons, b in sorted(self.missed_fusions, key=lambda t: -t[2])[:top]:
+            lines.append(
+                f"missed fusion: {prod} -> {cons} round-trips "
+                f"{b / 1e6:.3f} MB intermediate through HBM")
+        return "\n".join(lines)
+
+
+def audit_hlo_text(text: str) -> FusionAudit:
+    """Audit the ENTRY computation of an optimized HLO text dump."""
+    # isolate ENTRY body (between "ENTRY ... {" and its closing "}")
+    entry = None
+    m = re.search(r"^ENTRY [^\n]*\{\s*$", text, re.M)
+    if m:
+        rest = text[m.end():]
+        close = rest.find("\n}")
+        entry = rest[: close if close >= 0 else len(rest)]
+    else:  # bare instruction list (toy tests)
+        entry = text
+
+    sizes: Dict[str, int] = {}
+    records: List[FusionRecord] = []
+    consumers: Dict[str, List[str]] = {}
+    by_name: Dict[str, FusionRecord] = {}
+
+    for raw in entry.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("//") or line.endswith("{") or line == "}":
+            continue
+        mi = _INSTR_RE.match(line)
+        if not mi or "=" not in line:
+            continue
+        name = mi.group("name")
+        type_str, opcode, tail = _split_type_op(mi.group("rest"))
+        if not opcode:
+            continue
+        out_bytes = shape_bytes(type_str)
+        sizes[name] = out_bytes
+        operands = [t for t in re.findall(r"%([\w.\-]+)", _paren_args(tail))
+                    if t in sizes]
+        for op_name in operands:
+            consumers.setdefault(op_name, []).append(name)
+        if opcode in _FREE_OPS:
+            continue
+        rec = FusionRecord(name=name, opcode=opcode, bytes_out=out_bytes,
+                           operands=operands)
+        mk = _KIND_RE.search(tail)
+        if mk:
+            rec.kind = mk.group(1)
+        rec.bytes_in = sum(sizes[o] for o in operands)
+        rec.bytes_in_unique = sum(sizes[o] for o in dict.fromkeys(operands))
+        dups = [o for o in dict.fromkeys(operands) if operands.count(o) > 1]
+        if dups:
+            rec.notes.append(f"re-reads {len(dups)} operand(s)")
+        if opcode in ("copy", "transpose", "convert"):
+            rec.notes.append("pure data movement at top level")
+        records.append(rec)
+        by_name[name] = rec
+
+    audit = FusionAudit(records=records)
+    # missed producer->consumer fusion: a loop fusion feeding exactly one
+    # other loop fusion — the intermediate buffer is avoidable traffic
+    for rec in records:
+        if rec.opcode != "fusion" or rec.kind not in ("Loop", "Output", ""):
+            continue
+        cons = consumers.get(rec.name, [])
+        if len(cons) == 1 and cons[0] in by_name:
+            c = by_name[cons[0]]
+            if c.opcode == "fusion" and c.kind in ("Loop", "Input", ""):
+                audit.missed_fusions.append((rec.name, c.name, rec.bytes_out))
+    return audit
+
+
+def audit_compiled(compiled) -> Optional[FusionAudit]:
+    """Audit a jax ``Compiled`` object (returns None if the backend does not
+    expose optimized HLO text, e.g. some TPU plugin builds)."""
+    try:
+        text = compiled.as_text()
+    except Exception:
+        return None
+    if not text:
+        return None
+    return audit_hlo_text(text)
+
+
+def audit_lowered(lowered) -> Optional[FusionAudit]:
+    try:
+        return audit_compiled(lowered.compile())
+    except Exception:
+        return None
+
+
+def bytes_per_step(lowered=None, compiled=None) -> Optional[float]:
+    """Authoritative bytes-accessed for one execution: XLA's own
+    cost_analysis when available, else the audit total from the HLO text."""
+    from ..utils.xla_cost import cost_of_lowered
+
+    if lowered is not None:
+        cost = cost_of_lowered(lowered)
+        if cost and cost.get("bytes accessed"):
+            return float(cost["bytes accessed"])
+    if compiled is not None:
+        try:
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            if cost and cost.get("bytes accessed"):
+                return float(cost["bytes accessed"])
+        except Exception:
+            pass
+    audit = None
+    if compiled is not None:
+        audit = audit_compiled(compiled)
+    if audit is None and lowered is not None:
+        audit = audit_lowered(lowered)
+    return float(audit.total_bytes) if audit is not None else None

@@ -1,0 +1,86 @@
+#!/bin/bash
+# HBM-traffic regression gate (tentpole PR 6).  Re-measures bytes_per_step
+# for the CPU-proxy presets and fails when any preset regresses more than
+# TOLERANCE vs the committed baseline (scripts/BYTES_BASELINE.json).
+#
+# bytes_per_step comes from XLA's own cost analysis of the compiled step
+# (see profiler/fusion_audit.bytes_per_step), so it is deterministic for a
+# given preset+backend — the 5% tolerance absorbs compiler-version drift,
+# not noise.  Presets too slow to *run* on the CPU proxy are covered via
+# `bench.py --audit-only` (compile + cost-analyse, skip the timed loop).
+#
+# Refresh the baseline after an intentional traffic change:
+#     scripts/bytes_gate.sh --update
+# Exit code: number of failed presets (0 = gate passes).
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+BASELINE="scripts/BYTES_BASELINE.json"
+TOLERANCE="${BYTES_GATE_TOLERANCE:-0.05}"
+UPDATE=0
+[ "$1" = "--update" ] && UPDATE=1
+FAIL=0
+NEW="$(mktemp)"
+trap 'rm -f "$NEW"' EXIT
+echo "{}" > "$NEW"
+
+check() {  # check <preset> <timeout-s> <extra bench args...>
+    local preset="$1" budget="$2"; shift 2
+    echo "[bytes_gate] $preset" >&2
+    local line
+    if ! line=$(timeout -k 10 "$budget" python bench.py --preset "$preset" \
+                --device cpu "$@" 2>/dev/null); then
+        echo "[bytes_gate] $preset: FAILED (bench rc=$?)" >&2
+        FAIL=$((FAIL + 1))
+        return
+    fi
+    python - "$preset" "$BASELINE" "$NEW" "$TOLERANCE" "$UPDATE" <<PY || FAIL=$((FAIL + 1))
+import json, sys
+preset, baseline_path, new_path, tol, update = sys.argv[1:6]
+line = """$line"""
+result = json.loads(line.strip().splitlines()[-1])
+b = result.get("bytes_per_step")
+if not b:
+    print(f"[bytes_gate] {preset}: FAILED (no bytes_per_step in BENCH line)",
+          file=sys.stderr)
+    sys.exit(1)
+new = json.load(open(new_path))
+new[preset] = {"bytes_per_step": b, "source": result.get("bytes_source", "")}
+json.dump(new, open(new_path, "w"), indent=2, sort_keys=True)
+if int(update):
+    print(f"[bytes_gate] {preset}: {b:.0f} B/step (recorded)", file=sys.stderr)
+    sys.exit(0)
+try:
+    base = json.load(open(baseline_path))[preset]["bytes_per_step"]
+except (OSError, KeyError, ValueError):
+    print(f"[bytes_gate] {preset}: FAILED (no baseline entry — run "
+          f"scripts/bytes_gate.sh --update and commit {baseline_path})",
+          file=sys.stderr)
+    sys.exit(1)
+ratio = b / base
+if ratio > 1 + float(tol):
+    print(f"[bytes_gate] {preset}: FAILED "
+          f"{b:.0f} vs baseline {base:.0f} B/step (+{(ratio - 1) * 100:.1f}%"
+          f" > {float(tol) * 100:.0f}%)", file=sys.stderr)
+    sys.exit(1)
+print(f"[bytes_gate] {preset}: OK {b:.0f} B/step "
+      f"({(ratio - 1) * 100:+.1f}% vs baseline)", file=sys.stderr)
+PY
+}
+
+# presets cheap enough to execute on the CPU proxy
+check tiny   600 --steps 2
+check ocr    600
+check moe    600
+check decode 600
+check serve  600
+# small/base are compile-only on CPU: cost-analyse, skip the timed run
+check small  600 --audit-only
+check base   900 --audit-only
+
+if [ "$UPDATE" = 1 ]; then
+    cp "$NEW" "$BASELINE"
+    echo "[bytes_gate] baseline updated: $BASELINE" >&2
+fi
+echo "[bytes_gate] failures: $FAIL" >&2
+exit "$FAIL"

@@ -242,3 +242,58 @@ class TestShapeAnalysis:
 
         with _pytest.raises(SymbolicShapeError):
             infer_symbolic_shapes(outer, [(T,)])
+
+    def test_add_equal_rejects_contradictory_constants(self):
+        """PR 6 satellite: add_equal(T,2); add_equal(T,3) used to silently
+        union the two constants, after which is_equal(2, 3) was True."""
+        import pytest as _pytest
+
+        from paddle_tpu.framework.dim_expr import Symbol
+        from paddle_tpu.framework.shape_analysis import ShapeAnalysis
+
+        sa = ShapeAnalysis()
+        T = Symbol("T")
+        sa.add_equal(T, 2)
+        with _pytest.raises(ValueError, match="contradictory"):
+            sa.add_equal(T, 3)
+        assert not sa.is_equal(2, 3)
+        assert sa.is_equal(T, 2)                # the valid constraint survives
+        # direct constant contradiction, and via two pinned classes
+        with _pytest.raises(ValueError, match="contradictory"):
+            sa.add_equal(4, 5)
+        S = Symbol("S")
+        sa.add_equal(S, 3)
+        with _pytest.raises(ValueError, match="contradictory"):
+            sa.add_equal(T, S)                  # T==2, S==3
+        sa.add_equal(T, 2)                      # re-asserting a fact is fine
+
+    def test_off_align_verification_is_per_symbol(self):
+        """PR 6 satellite: one symbol whose off-align probe the program
+        rejects (divisibility constraint) must not disable the off-align
+        check for the OTHER symbols — the ceil-padded dim in T is only
+        catchable off-align, and the old joint probe (all symbols moved at
+        once) died on S's reshape and skipped the check entirely."""
+        import pytest as _pytest
+
+        import jax.numpy as jnp
+
+        from paddle_tpu.framework.dim_expr import Symbol
+        from paddle_tpu.framework.shape_analysis import (
+            SymbolicShapeError, infer_symbolic_shapes)
+
+        T, S = Symbol("T"), Symbol("S")
+
+        def padded_and_constrained(a, b):
+            n = a.shape[0]
+            pad = (-n) % 8
+            return jnp.pad(a, (0, pad)), b.reshape(-1, 8)   # [ceil8(T)], [S//8, 8]
+
+        with _pytest.raises(SymbolicShapeError, match="off-align"):
+            infer_symbolic_shapes(padded_and_constrained, [(T,), (S,)])
+
+        def well_behaved(a, b):
+            return a * 2.0, b.reshape(-1, 8)                # [T], [S//8, 8]
+
+        a_s, b_s = infer_symbolic_shapes(well_behaved, [(T,), (S,)])
+        assert a_s == (T,)
+        assert b_s[0].subs({"S": 32}) == 4 and b_s[1] == 8

@@ -6,6 +6,7 @@ Reference: ``fleet/meta_parallel/pipeline_parallel.py:255,575``,
 ``pp_layers.py:257``.
 """
 
+import jax
 import numpy as np
 import pytest
 
@@ -13,6 +14,13 @@ import paddle_tpu as paddle
 import paddle_tpu.distributed.fleet as fleet
 from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
 from paddle_tpu.models.llama_pp import LlamaForCausalLMPipe
+
+# the pipeline schedules run under jax.shard_map, promoted to the public jax
+# namespace only in jax >= 0.6; this jax ships jax.experimental.shard_map only
+needs_jax_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="needs jax.shard_map (absent in this jax; only "
+           "jax.experimental.shard_map exists)")
 
 
 @pytest.fixture
@@ -30,6 +38,7 @@ def _ids(cfg, bsz=4, seq=64, seed=0):
     return paddle.to_tensor(rng.integers(0, cfg.vocab_size, size=(bsz, seq)).astype(np.int32))
 
 
+@needs_jax_shard_map
 def test_pipe_forward_backward_parity(pp_fleet):
     cfg = llama_tiny_config()
     paddle.seed(0)
@@ -59,6 +68,7 @@ def test_pipe_stacked_param_shardings(pp_fleet):
     assert "mp" in str(spec), spec  # TP composes on the matmul dim
 
 
+@needs_jax_shard_map
 def test_pipe_train_batch_loss_decreases(pp_fleet):
     cfg = llama_tiny_config()
     paddle.seed(0)
@@ -109,6 +119,7 @@ def _seq_loss_and_grads(cfg, model, ids_np):
     return jax.value_and_grad(loss_of)(params)
 
 
+@needs_jax_shard_map
 def test_1f1b_loss_and_grad_parity(pp_fleet):
     """Manual-vjp 1F1B schedule reproduces the sequential model's loss AND
     grads (embedding + a stacked decoder grad) exactly.  Reference:
@@ -139,6 +150,7 @@ def test_1f1b_loss_and_grad_parity(pp_fleet):
                                np.asarray(ref_grads[emb_key]), rtol=1e-3, atol=1e-5)
 
 
+@needs_jax_shard_map
 def test_1f1b_activation_liveness_flat_in_n_micro(pp_fleet):
     """THE 1F1B property: per-device activation stash is bounded by 2*pp
     microbatches, so compiled temp memory stays flat as n_micro grows 4x,
@@ -165,6 +177,7 @@ def test_1f1b_activation_liveness_flat_in_n_micro(pp_fleet):
     assert b16 < b4 * 1.5, (b4, b16)
 
 
+@needs_jax_shard_map
 def test_train_batch_1f1b_schedule_and_accumulate_steps(pp_fleet):
     """strategy.pipeline_configs drives train_batch: accumulate_steps
     overrides n_micro and schedule='1F1B' routes through the manual vjp."""
@@ -182,6 +195,7 @@ def test_train_batch_1f1b_schedule_and_accumulate_steps(pp_fleet):
     strategy.pipeline_configs = {"micro_batch_size": 1}
 
 
+@needs_jax_shard_map
 def test_zb_loss_and_grad_parity(pp_fleet):
     """Zero-bubble schedule (B/W split, deferred full-batch weight-grad pass)
     reproduces the sequential model's loss and grads exactly.  Reference:
@@ -215,6 +229,7 @@ def test_zb_loss_and_grad_parity(pp_fleet):
                                np.asarray(ref_grads[down_key]), rtol=1e-3, atol=1e-5)
 
 
+@needs_jax_shard_map
 def test_zb_matches_1f1b_grads(pp_fleet):
     """Both manual-vjp schedules compute the same gradients (same math,
     different critical-path placement of the dW matmuls)."""
@@ -238,6 +253,7 @@ def test_zb_matches_1f1b_grads(pp_fleet):
                                    rtol=1e-4, atol=1e-6, err_msg=k)
 
 
+@needs_jax_shard_map
 def test_train_batch_zb_schedule(pp_fleet):
     """schedule='ZB' routes train_batch through the zero-bubble manual vjp."""
     cfg = llama_tiny_config()
@@ -254,6 +270,7 @@ def test_train_batch_zb_schedule(pp_fleet):
     strategy.pipeline_configs = {"micro_batch_size": 1}
 
 
+@needs_jax_shard_map
 def test_vpp_forward_parity(pp_fleet):
     """Circular virtual-stage (interleaved VPP) forward matches the
     sequential model.  Reference: PipelineParallelWithInterleave
@@ -271,6 +288,7 @@ def test_vpp_forward_parity(pp_fleet):
                                rtol=1e-3, atol=1e-3)
 
 
+@needs_jax_shard_map
 def test_vpp_train_batch_loss_decreases(pp_fleet):
     cfg = llama_tiny_config(num_hidden_layers=4)
     paddle.seed(0)

@@ -317,6 +317,27 @@ def _bytes_fields(lowered, audit=False, label=""):
     return fields
 
 
+def _lint_fields(lowered, lint=False, label="", expected=()):
+    """``lint_findings``/``lint_codes`` fields for a BENCH line from the
+    sharding & communication static analyzer (``paddle_tpu.analysis``):
+    donation misses + every compiled collective vs the expected set.  The
+    ranked findings report goes to stderr; stdout stays one JSON line."""
+    import sys
+
+    if not lint:
+        return {}
+    from paddle_tpu.analysis import lint_lowered
+
+    try:
+        rep = lint_lowered(lowered, expected=expected)
+    except Exception as e:  # lint must never break the BENCH contract
+        return {"lint_error": repr(e)}
+    print(f"== sharding lint{' (' + label + ')' if label else ''} ==",
+          file=sys.stderr)
+    print(rep.report(), file=sys.stderr)
+    return {"lint_findings": len(rep), "lint_codes": rep.counts()}
+
+
 def _bench_decode(jax, paddle, backend, on_tpu, args):
     """Serving path: KV-cache greedy decode throughput (new tokens/s).
 
@@ -378,6 +399,8 @@ def _bench_decode(jax, paddle, backend, on_tpu, args):
                            label="decode")
         if bf.get("bytes_per_step"):
             bf["bytes_per_step"] = bf["bytes_per_step"] / new  # per new token
+        bf.update(_lint_fields(lowered, getattr(args, "lint", False),
+                               label="decode"))
         bytes_fields = bf
     except Exception:
         bytes_fields = {"bytes_per_step": float(param_bytes),
@@ -474,12 +497,35 @@ def _bench_serve(jax, paddle, backend, on_tpu, args):
         frac_bound = ideal / dt
     else:
         frac_bound = 0.0
+    lint_fields = {}
+    if getattr(args, "lint", False):
+        # the engine runs many programs; lint the k=1 decode chunk — the
+        # steady-state serving program (same arg recipe as Engine.warmup)
+        try:
+            import jax.numpy as jnp
+
+            from paddle_tpu.framework import random as rnd
+
+            zeros = np.zeros((max_batch,), np.int32)
+            fn = eng._get_decode_fn(1)
+            lowered = fn.lower(
+                eng._params, eng._buffers, eng.k_pools, eng.v_pools,
+                jnp.asarray(eng._tbl.copy()), jnp.asarray(zeros),
+                jnp.asarray(zeros), rnd.next_key(),
+                jnp.asarray(zeros, jnp.float32), jnp.asarray(zeros),
+                jnp.ones((max_batch,), jnp.float32),
+                jnp.zeros((eng._tok_seg_rows, max_batch), jnp.int32),
+                jnp.asarray(0, jnp.int32))
+            lint_fields = _lint_fields(lowered, True, label="serve-decode")
+        except Exception as e:
+            lint_fields = {"lint_error": repr(e)}
     return {
         # the engine runs many distinct programs (prefill buckets + decode
         # chunk ladder); per-decode-step traffic is the analytic weight
         # stream — labeled as such so the gate knows it's a model, not XLA
         "bytes_per_step": float(param_bytes),
         "bytes_source": "analytic_weight_stream",
+        **lint_fields,
         "metric": "llama_serve_new_tokens_per_sec",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s",
@@ -554,6 +600,8 @@ def _bench_ocr(jax, paddle, backend, on_tpu, args):
     vs_bound = images_per_sec / bound_img_s if bound_img_s else 0.0
     bytes_fields = _bytes_fields(lowered, audit=getattr(args, "audit", False),
                                  label="ocr")
+    bytes_fields.update(_lint_fields(lowered, getattr(args, "lint", False),
+                                     label="ocr"))
     return {
         **bytes_fields,
         "metric": "ocr_det_train_images_per_sec",
@@ -624,6 +672,8 @@ def _bench_moe(jax, paddle, backend, on_tpu, args):
     step_flops = _step_flops_of(lowered)
     bytes_fields = _bytes_fields(lowered, audit=getattr(args, "audit", False),
                                  label="moe")
+    bytes_fields.update(_lint_fields(lowered, getattr(args, "lint", False),
+                                     label="moe"))
 
     tokens_per_sec = batch * seq * steps / dt
     dev_kind, peak = _peak_flops(jax, on_tpu)
@@ -672,6 +722,12 @@ def main():
                     help="print the per-fusion bytes-accessed-vs-minimum "
                          "report (profiler.fusion_audit) to stderr; stdout "
                          "stays one JSON line")
+    ap.add_argument("--lint", action="store_true",
+                    help="run the sharding & communication static analyzer "
+                         "(paddle_tpu.analysis) on the compiled step: "
+                         "donation misses + unintended collectives; adds "
+                         "lint_findings/lint_codes to the BENCH line, ranked "
+                         "report to stderr")
     ap.add_argument("--audit-only", action="store_true",
                     help="pretrain presets: lower + compile + cost-analyse "
                          "the step but skip the timed run (bytes_per_step "
@@ -734,6 +790,7 @@ def main():
 
     lowered = lower_pretrain_step(step_fn, ids)
     bytes_fields = _bytes_fields(lowered, audit=args.audit, label=preset)
+    bytes_fields.update(_lint_fields(lowered, args.lint, label=preset))
 
     if args.audit_only:
         print(json.dumps(_stamp({

@@ -1,0 +1,172 @@
+"""Sharding & communication static analyzer.
+
+Two levels, both running **without executing the model**:
+
+- Level 1 (:mod:`.jaxpr_lint`) traces the step function abstractly and
+  lints the jaxpr + lowering metadata: donation misses on large buffers,
+  f32→f64 / weak-type promotions, Python-scalar retrace hazards, and
+  host↔device transfer ops baked into the step.
+- Level 2 (:mod:`.hlo_lint`) parses the compiled module text and checks
+  the partitioner's output: every collective with byte counts, compared
+  against the expected set derived from declared shardings via the
+  :mod:`.spec_algebra` src→dst transition rules; unpartitioned custom
+  calls (the Mosaic / shard_map gap); replicated buffers that the caller
+  declared sharded.
+
+Entry point::
+
+    from paddle_tpu import analysis
+    report = analysis.check(step_fn, (params, batch), mesh=mesh,
+                            donate_argnums=(0,),
+                            expected=["all-reduce",          # grad sync
+                                      (P("x"), P(None))])    # declared gather
+    print(report.report())
+    assert not report.by_code("donation-miss")
+
+``expected`` entries are either bare collective kinds or
+``(src_spec, dst_spec)`` pairs expanded through the spec algebra.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import jax
+
+from .findings import Finding, Report, SEVERITY_RANK
+from .hlo_lint import lint_hlo_text, parse_hlo_module
+from .jaxpr_lint import (
+    DEFAULT_BIG_BUFFER, lint_donation, lint_jaxpr, lint_python_scalars)
+from .spec_algebra import Transfer, expected_collectives, normalize_spec, transition
+
+__all__ = [
+    "Finding", "Report", "SEVERITY_RANK", "Transfer",
+    "check", "lint_lowered", "lint_hlo_text", "lint_jaxpr",
+    "lint_donation", "lint_python_scalars", "parse_hlo_module",
+    "expected_collectives", "normalize_spec", "transition",
+    "DEFAULT_BIG_BUFFER",
+]
+
+
+def _is_spec_leaf(x) -> bool:
+    from jax.sharding import PartitionSpec
+    return x is None or isinstance(x, PartitionSpec)
+
+
+def _shardings_tree(specs, mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s if s is not None else PartitionSpec()),
+        specs, is_leaf=_is_spec_leaf)
+
+
+def _spec_is_sharded(spec) -> bool:
+    if spec is None:
+        return False
+    return any(e is not None and e != () for e in tuple(spec))
+
+
+def _declared_params(lowered, declared_specs) -> Dict[int, Tuple[str, int, bool]]:
+    """Map entry-parameter index -> (label, global bytes, sharded?) by
+    zipping the flattened args with the flattened declared specs.
+
+    Index alignment is positional over the flattened argument list; XLA may
+    prune unused parameters, in which case later indices shift and the
+    replicated-buffer check degrades to a no-op rather than a false
+    positive (a pruned param simply isn't found at full size)."""
+    import jax.numpy as jnp
+
+    from .jaxpr_lint import arg_aval
+
+    args_info = jax.tree_util.tree_flatten_with_path(lowered.args_info)[0]
+    specs = jax.tree_util.tree_leaves(declared_specs, is_leaf=_is_spec_leaf)
+    out: Dict[int, Tuple[str, int, bool]] = {}
+    for i, (path, info) in enumerate(args_info):
+        spec = specs[i] if i < len(specs) else None
+        aval = arg_aval(info)
+        try:
+            nbytes = int(aval.size) * jnp.dtype(aval.dtype).itemsize
+        except Exception:
+            nbytes = 0
+        out[i] = (f"arg{jax.tree_util.keystr(path)}", nbytes,
+                  _spec_is_sharded(spec))
+    return out
+
+
+def lint_lowered(lowered, *, mesh=None, expected: Iterable[Any] = (),
+                 declared_specs=None,
+                 big_buffer_bytes: int = DEFAULT_BIG_BUFFER) -> Report:
+    """Lint an already-``lower()``-ed computation (donation + HLO levels).
+
+    Use :func:`check` when you still hold the Python callable — it adds the
+    jaxpr-walk lints (upcasts, host transfers, Python scalars) on top.
+    """
+    rep = Report()
+    rep.extend(lint_donation(lowered, big_buffer_bytes))
+    try:
+        text = lowered.compile().as_text()
+    except Exception as e:  # backend without HLO text access
+        rep.meta["hlo_error"] = repr(e)
+        return rep
+    if text:
+        kinds = expected_collectives(expected, mesh)
+        declared = (_declared_params(lowered, declared_specs)
+                    if declared_specs is not None else None)
+        rep.extend(lint_hlo_text(text, expected_kinds=kinds,
+                                 declared_params=declared))
+    return rep
+
+
+def check(fn, args: Tuple[Any, ...] = (), kwargs: Optional[dict] = None, *,
+          mesh=None, in_specs=None, out_specs=None,
+          donate_argnums=None, static_argnums=None,
+          expected: Iterable[Any] = (), declared_specs=None,
+          big_buffer_bytes: int = DEFAULT_BIG_BUFFER) -> Report:
+    """Statically analyze ``fn(*args, **kwargs)`` — traces and compiles,
+    never executes.
+
+    ``fn`` may be a plain callable (it is jitted here, with
+    ``in_specs``/``out_specs`` turned into ``NamedSharding`` on ``mesh``
+    and ``donate_argnums`` applied) or an already-jitted function (used
+    as-is).  ``args`` may be real arrays or ``jax.ShapeDtypeStruct``.
+
+    ``expected`` declares intended communication: bare kind strings
+    (``"all-reduce"``) and/or ``(src_spec, dst_spec)`` pairs expanded via
+    :func:`spec_algebra.expected_collectives`.  ``declared_specs`` (a tree
+    of PartitionSpecs over the args) enables the replicated-buffer check
+    without forcing the shardings into the jit.
+    """
+    kwargs = kwargs or {}
+    rep = Report()
+    rep.extend(lint_python_scalars(args, kwargs))
+
+    if hasattr(fn, "lower"):
+        jfn = fn
+    else:
+        jit_kw: Dict[str, Any] = {}
+        if donate_argnums is not None:
+            jit_kw["donate_argnums"] = donate_argnums
+        if static_argnums is not None:
+            jit_kw["static_argnums"] = static_argnums
+        if mesh is not None and in_specs is not None:
+            jit_kw["in_shardings"] = _shardings_tree(in_specs, mesh)
+        if mesh is not None and out_specs is not None:
+            jit_kw["out_shardings"] = _shardings_tree(out_specs, mesh)
+        jfn = jax.jit(fn, **jit_kw)
+
+    lowered = jfn.lower(*args, **kwargs)
+    try:
+        closed = jax.make_jaxpr(
+            jfn, static_argnums=static_argnums or ())(*args, **kwargs)
+    except Exception as e:
+        rep.meta["jaxpr_error"] = repr(e)
+    else:
+        rep.extend(lint_jaxpr(closed))
+
+    if declared_specs is None and in_specs is not None:
+        declared_specs = in_specs
+    rep.extend(lint_lowered(lowered, mesh=mesh, expected=expected,
+                            declared_specs=declared_specs,
+                            big_buffer_bytes=big_buffer_bytes))
+    rep.meta["fn"] = getattr(fn, "__name__", type(fn).__name__)
+    return rep

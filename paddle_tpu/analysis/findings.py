@@ -1,0 +1,125 @@
+"""Findings taxonomy for the sharding & communication static analyzer.
+
+Every lint (jaxpr level or HLO level) reports through a common ``Finding``
+record so downstream consumers — ``bench.py --lint``, ``scripts/lint_gate.sh``,
+tests — can rank, count, and diff results without caring which level produced
+them.
+
+Finding codes (the stable taxonomy; gates key on these strings):
+
+========================  =====  ========================================
+code                      level  meaning
+========================  =====  ========================================
+``donation-miss``         jaxpr  large input buffer with a same-shape/dtype
+                                 output was not donated — the update
+                                 double-buffers in HBM
+``dtype-upcast``          jaxpr  ``convert_element_type`` widens a non-scalar
+                                 operand (f32->f64, weak-type promotion, ...)
+``python-scalar-arg``     jaxpr  a bare Python ``bool``/``int``/``float``
+                                 argument — weakly typed, retraces on type
+                                 change, silently promotes
+``host-transfer``         jaxpr  ``pure_callback`` / ``io_callback`` /
+                                 ``debug_callback`` / ``device_put`` inside
+                                 the traced step — host round-trip per step
+``unintended-collective`` hlo    a compiled collective (all-gather,
+                                 all-reduce, reduce-scatter, all-to-all,
+                                 collective-permute) not in the expected set
+``unpartitioned-custom-call`` hlo  a custom call fed by a GSPMD-inserted
+                                 all-gather: the op could not be partitioned
+                                 and runs replicated on full data (the
+                                 Mosaic / shard_map gap)
+``replicated-buffer``     hlo    an entry parameter materialized at full
+                                 (global) size although its declared spec
+                                 shards it
+========================  =====  ========================================
+
+Severity is ``high`` / ``medium`` / ``low``; ranking is by severity first,
+then by the number of bytes at stake, so the top of the report is always the
+biggest HBM burn.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List
+
+__all__ = ["Finding", "Report", "SEVERITY_RANK"]
+
+SEVERITY_RANK = {"high": 0, "medium": 1, "low": 2}
+
+
+@dataclass
+class Finding:
+    code: str                 # taxonomy code, see module docstring
+    severity: str             # "high" | "medium" | "low"
+    message: str              # one-line human description
+    where: str = ""           # arg path / HLO instruction name
+    bytes: int = 0            # HBM bytes at stake (0 when unknown)
+    suggestion: str = ""      # concrete next action
+
+    def line(self) -> str:
+        b = f" [{self.bytes / 1e6:.3f} MB]" if self.bytes else ""
+        loc = f" @ {self.where}" if self.where else ""
+        s = f"  -> {self.suggestion}" if self.suggestion else ""
+        return f"{self.severity.upper():<7}{self.code:<28}{self.message}{loc}{b}{s}"
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)
+    meta: Dict[str, object] = field(default_factory=dict)
+
+    def __len__(self) -> int:
+        return len(self.findings)
+
+    def __iter__(self) -> Iterator[Finding]:
+        return iter(self.findings)
+
+    def __bool__(self) -> bool:  # truthy iff something was found
+        return bool(self.findings)
+
+    def add(self, *args, **kwargs) -> Finding:
+        f = Finding(*args, **kwargs)
+        self.findings.append(f)
+        return f
+
+    def extend(self, other: "Report") -> None:
+        self.findings.extend(other.findings)
+        for k, v in other.meta.items():
+            self.meta.setdefault(k, v)
+
+    def ranked(self) -> List[Finding]:
+        return sorted(
+            self.findings,
+            key=lambda f: (SEVERITY_RANK.get(f.severity, 3), -f.bytes, f.code))
+
+    def counts(self) -> Dict[str, int]:
+        """Findings per taxonomy code (what the lint gate diffs)."""
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.code] = out.get(f.code, 0) + 1
+        return dict(sorted(out.items()))
+
+    def by_code(self, code: str) -> List[Finding]:
+        return [f for f in self.findings if f.code == code]
+
+    def report(self, top: int = 20) -> str:
+        head = (f"lint: {len(self.findings)} finding(s)"
+                + (f" — {self._counts_str()}" if self.findings else ""))
+        lines = [head]
+        lines.extend(f.line() for f in self.ranked()[:top])
+        if len(self.findings) > top:
+            lines.append(f"... {len(self.findings) - top} more")
+        return "\n".join(lines)
+
+    def _counts_str(self) -> str:
+        return ", ".join(f"{c}:{n}" for c, n in self.counts().items())
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "counts": self.counts(),
+            "meta": {k: v for k, v in self.meta.items()
+                     if isinstance(v, (str, int, float, bool))},
+            "findings": [vars(f) for f in self.ranked()],
+        }, indent=2, sort_keys=True)

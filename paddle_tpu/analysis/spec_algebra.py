@@ -1,0 +1,135 @@
+"""A small ``PartitionSpec`` transition algebra: which collective does a
+``src`` → ``dst`` resharding cost?
+
+GSPMD answers this question inside the compiler, invisibly; this module
+answers it *predictably*, per mesh axis, so the HLO lint can say not just
+"there is an all-gather here" but "no declared resharding explains it".
+The rules, per mesh axis ``a`` (sizes from the mesh):
+
+==============================  =======================================
+transition of axis ``a``        collective implied
+==============================  =======================================
+in src, absent from dst         ``all-gather`` over ``a`` (shards are
+                                concatenated onto every device)
+absent from src, in dst         ``slice`` — a local dynamic-slice, no
+                                communication
+in src dim *i*, in dst dim *j*  ``all-to-all`` over ``a`` (resharding
+(*i* ≠ *j*)                     moves the split dimension)
+same dim, different position    ``collective-permute`` (tile order
+within the dim's axis tuple     changes; data moves between neighbors)
+pending partial sum over ``a``  ``all-reduce`` if ``a`` is absent from
+(``src_partial``)               dst, ``reduce-scatter`` if dst shards
+                                over ``a``
+==============================  =======================================
+
+Byte estimates use the *global* array size as the magnitude of the
+transfer — coarse (an all-gather moves ``(n-1)/n`` of that per device)
+but monotone and good enough for ranking findings.
+
+This is deliberately the seed of ROADMAP item 3's communication planner:
+the same table, driven forward (choose dst to minimize transfer) instead
+of backward (explain an observed collective).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Mapping, Sequence, Set, Tuple
+
+__all__ = ["Transfer", "normalize_spec", "transition", "expected_collectives"]
+
+
+@dataclass(frozen=True)
+class Transfer:
+    kind: str    # "all-gather" | "all-to-all" | "collective-permute" |
+                 # "all-reduce" | "reduce-scatter" | "slice"
+    axis: str    # mesh axis driving the transfer
+    bytes: int   # estimated magnitude (global bytes involved; 0 for slice)
+
+    @property
+    def is_communication(self) -> bool:
+        return self.kind != "slice"
+
+
+def normalize_spec(spec, ndim: int) -> Tuple[Tuple[str, ...], ...]:
+    """Canonicalize a ``PartitionSpec`` (or tuple/None) to ``ndim`` per-dim
+    axis-name tuples: ``P('x', ('y','z'))`` with ndim 3 ->
+    ``(('x',), ('y','z'), ())``."""
+    entries = tuple(spec) if spec is not None else ()
+    out: List[Tuple[str, ...]] = []
+    for i in range(ndim):
+        e = entries[i] if i < len(entries) else None
+        if e is None:
+            out.append(())
+        elif isinstance(e, str):
+            out.append((e,))
+        else:
+            out.append(tuple(e))
+    return tuple(out)
+
+
+def _axis_dims(norm: Sequence[Tuple[str, ...]]) -> Dict[str, Tuple[int, int]]:
+    """axis name -> (dim index, position within the dim's axis tuple)."""
+    out: Dict[str, Tuple[int, int]] = {}
+    for dim, axes in enumerate(norm):
+        for pos, a in enumerate(axes):
+            out[a] = (dim, pos)
+    return out
+
+
+def transition(src, dst, *, ndim: int, axis_sizes: Mapping[str, int],
+               nbytes: int, src_partial: Iterable[str] = ()) -> List[Transfer]:
+    """Collectives implied by resharding an ``ndim``-dim array of global
+    size ``nbytes`` from spec ``src`` to spec ``dst``.
+
+    ``src_partial`` lists mesh axes carrying an unreduced partial sum in
+    ``src`` (the state after a contraction over a sharded dimension).
+    """
+    s = _axis_dims(normalize_spec(src, ndim))
+    d = _axis_dims(normalize_spec(dst, ndim))
+    partial = set(src_partial)
+    out: List[Transfer] = []
+
+    for a in partial:  # pending reductions resolve first
+        kind = "reduce-scatter" if a in d else "all-reduce"
+        out.append(Transfer(kind, a, nbytes))
+
+    for a, (sdim, spos) in s.items():
+        if a in partial:
+            continue
+        if a not in d:
+            out.append(Transfer("all-gather", a, nbytes))
+        elif d[a][0] != sdim:
+            out.append(Transfer("all-to-all", a, nbytes))
+        elif d[a][1] != spos:
+            out.append(Transfer("collective-permute", a, nbytes))
+    for a in d:
+        if a not in s and a not in partial:
+            out.append(Transfer("slice", a, 0))
+    return out
+
+
+def expected_collectives(pairs, mesh=None, *,
+                         axis_sizes: Mapping[str, int] = None) -> Set[str]:
+    """Expand declared reshardings into the collective kinds they justify.
+
+    ``pairs`` is an iterable whose items are either bare kind strings
+    (passed through) or ``(src_spec, dst_spec)`` /
+    ``(src_spec, dst_spec, ndim)`` tuples run through :func:`transition`.
+    """
+    sizes = dict(axis_sizes or {})
+    if mesh is not None and not sizes:
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    kinds: Set[str] = set()
+    for item in pairs:
+        if isinstance(item, str):
+            kinds.add(item)
+            continue
+        src, dst = item[0], item[1]
+        ndim = item[2] if len(item) > 2 else max(
+            len(tuple(src) if src is not None else ()),
+            len(tuple(dst) if dst is not None else ()), 1)
+        for t in transition(src, dst, ndim=ndim, axis_sizes=sizes, nbytes=0):
+            if t.is_communication:
+                kinds.add(t.kind)
+    return kinds

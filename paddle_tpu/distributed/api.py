@@ -109,7 +109,7 @@ def _reduce_partial(data, mesh: ProcessMesh, src_placements, mesh_dim: int, redu
     process with k devices holding the same addend, the reduction yields k*x —
     exactly what k reference ranks contributing x each would produce.
     """
-    from jax import shard_map
+    from ..framework.shard_map_compat import shard_map
 
     axis = mesh.dim_names[mesh_dim]
     # partition spec of the data as currently placed: Shard dims map to axes,

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec
 
+from ...framework.shard_map_compat import pvary, shard_map
 from ...framework.dispatch import apply_op
 from ...framework.tensor import Tensor
 from ..mesh import ProcessMesh, get_mesh
@@ -85,7 +86,7 @@ def _build_ring_fn(mesh: ProcessMesh, axis_name: str, cp: int, causal: bool,
         perm = [(i, (i + 1) % cp) for i in range(cp)]
 
         def vary(x):
-            return jax.lax.pcast(x, (axis_name,), to="varying")
+            return pvary(x, (axis_name,))
 
         def step(carry, s_idx):
             acc, m_run, l_run, kc, vc = carry
@@ -116,7 +117,7 @@ def _build_ring_fn(mesh: ProcessMesh, axis_name: str, cp: int, causal: bool,
         return jnp.swapaxes(out, 1, 2).astype(q_loc.dtype)  # [B, Sq, H, D]
 
     seq_spec = PartitionSpec(None, axis_name)
-    sm_fn = jax.shard_map(ring_body, mesh=mesh.jax_mesh,
+    sm_fn = shard_map(ring_body, mesh=mesh.jax_mesh,
                           in_specs=(seq_spec, seq_spec, seq_spec),
                           out_specs=seq_spec,
                           axis_names={axis_name})
@@ -204,7 +205,7 @@ def _build_ulysses_fn(mesh: ProcessMesh, axis_name: str, cp: int, causal: bool,
         return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
                                   tiled=True)
 
-    sm = jax.shard_map(body, mesh=mesh.jax_mesh,
+    sm = shard_map(body, mesh=mesh.jax_mesh,
                        in_specs=(seq_spec, seq_spec, seq_spec),
                        out_specs=seq_spec, axis_names={axis_name})
     return jax.jit(sm)

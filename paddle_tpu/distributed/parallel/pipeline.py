@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...framework.dispatch import unwrap, wrap
+from ...framework.shard_map_compat import pvary
 from ...framework.tensor import Tensor
 from ...nn.layers import Layer, LayerList
 
@@ -136,10 +137,9 @@ def pipeline_spmd_step(block_fn: Callable, n_stages: int, n_micro: int, axis_nam
         mb_shape = micro_inputs.shape[1:]
         # the carry becomes stage-dependent after tick 1; mark it varying over
         # the pp axis up front so scan's carry type is stable (JAX vma typing)
-        state0 = jax.lax.pcast(jnp.zeros(mb_shape, micro_inputs.dtype),
-                               (axis_name,), to="varying")
-        out0 = jax.lax.pcast(jnp.zeros((n_micro,) + mb_shape, micro_inputs.dtype),
-                             (axis_name,), to="varying")
+        state0 = pvary(jnp.zeros(mb_shape, micro_inputs.dtype), (axis_name,))
+        out0 = pvary(jnp.zeros((n_micro,) + mb_shape, micro_inputs.dtype),
+                     (axis_name,))
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def tick(carry, t):
@@ -174,7 +174,7 @@ def _varying(x, axis_name):
     from P('pp') shard_map inputs) pass through."""
     def mark(a):
         try:
-            return jax.lax.pcast(a, (axis_name,), to="varying")
+            return pvary(a, (axis_name,))
         except ValueError:
             return a
 

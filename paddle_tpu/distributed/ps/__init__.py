@@ -134,9 +134,11 @@ class SparseTable:
         server loop (mask ids to the local vocab range, gather/scatter with
         LOCAL indices). GSPMD's generic partitioned scatter was measured
         26-1000x slower than this at 20M-100M rows on the CPU mesh."""
-        return jax.shard_map(fn, mesh=self.mesh.jax_mesh,
-                             in_specs=in_specs, out_specs=out_specs,
-                             check_vma=False)
+        from ...framework.shard_map_compat import shard_map
+
+        return shard_map(fn, mesh=self.mesh.jax_mesh,
+                         in_specs=in_specs, out_specs=out_specs,
+                         check_vma=False)
 
     # -- pull ---------------------------------------------------------------
 

@@ -1,0 +1,68 @@
+"""The ONE place the jax ``shard_map`` version gap is bridged.
+
+``shard_map`` was promoted from ``jax.experimental.shard_map`` to the public
+``jax`` namespace in jax 0.6, renaming ``check_rep`` to ``check_vma`` and
+replacing the ``auto`` frozenset with its complement ``axis_names``.  Callers
+throughout this repo are written against the NEW surface (kwargs ``mesh`` /
+``in_specs`` / ``out_specs`` / ``check_vma`` / ``axis_names``); this module
+routes them to whichever implementation the installed jax provides,
+translating the renamed knobs for the experimental one:
+
+- ``check_vma=X``   -> ``check_rep=X``
+- ``axis_names=S``  -> ``auto = set(mesh.axis_names) - S``
+
+Import ``shard_map`` from here instead of touching ``jax.shard_map`` or
+``jax.experimental.shard_map`` directly — the ROADMAP's "shard_map gap"
+(tests skipped wholesale on pre-0.6 jax) closes in this file alone.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "pvary", "HAS_PUBLIC_SHARD_MAP"]
+
+HAS_PUBLIC_SHARD_MAP = hasattr(jax, "shard_map")
+
+
+def pvary(x, axis_names):
+    """Mark ``x`` as varying over ``axis_names`` inside a shard_map body.
+
+    The varying-manual-axes (VMA) type system arrived with the public
+    ``shard_map``; pre-VMA jax has no replicated/varying distinction inside
+    manual regions, so the cast is the identity there."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, tuple(axis_names), to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, tuple(axis_names))
+    return x
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,
+              axis_names=None, **kwargs):
+    """Version-portable ``shard_map`` (new-style keyword surface)."""
+    if HAS_PUBLIC_SHARD_MAP:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    if axis_names is not None and frozenset(axis_names) != frozenset(
+            mesh.axis_names):
+        # Partial-manual (``auto``) regions crash this jaxlib's SPMD
+        # partitioner with an uncatchable CHECK failure
+        # (spmd_partitioner.cc "IsManualSubgroup"), so go FULLY manual
+        # instead: axes outside ``axis_names`` are unmentioned by the specs,
+        # which makes the body per-device identical along them — same result,
+        # at worst an extra all-gather if an input arrives sharded on an
+        # auto axis.  Replication over those axes is real but invisible to
+        # the old rep-checker, so it must be off.
+        kwargs["check_rep"] = False
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)

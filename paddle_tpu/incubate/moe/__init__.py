@@ -126,10 +126,12 @@ def dispatch_all_to_all(expert_inputs, mesh: ProcessMesh, axis_name: str = "ep")
         # experts' slots from everyone -> local [E/ep, C, d]
         return jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1, tiled=True)
 
-    fn = jax.shard_map(body, mesh=mesh.jax_mesh,
-                       in_specs=PartitionSpec(None, axis_name),
-                       out_specs=PartitionSpec(axis_name),
-                       axis_names={axis_name})
+    from ...framework.shard_map_compat import shard_map
+
+    fn = shard_map(body, mesh=mesh.jax_mesh,
+                   in_specs=PartitionSpec(None, axis_name),
+                   out_specs=PartitionSpec(axis_name),
+                   axis_names={axis_name})
     return fn(expert_inputs)
 
 

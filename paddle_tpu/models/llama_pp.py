@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec
 
+from ..framework.shard_map_compat import shard_map
 from ..framework.dispatch import apply_op
 from ..framework.tensor import Tensor
 from ..kernels import rms_norm as rms_mod
@@ -240,7 +241,7 @@ class LlamaForCausalLMPipe(Layer):
                        "gate_up": gate_up, "down": down}
             if reshape_stage is not None:
                 stacked = jax.tree.map(reshape_stage, stacked)
-            sm = jax.shard_map(
+            sm = shard_map(
                 schedule,
                 mesh=mesh.jax_mesh,
                 in_specs=(jax.tree.map(lambda _: PartitionSpec("pp"), stacked),
@@ -330,7 +331,7 @@ class LlamaForCausalLMPipe(Layer):
             micro = (ids.reshape(n_micro, mb, S), labels.reshape(n_micro, mb, S), inv_b)
             cos, sin = buffers["rope_cos"], buffers["rope_sin"]
             P = PartitionSpec
-            sm = jax.shard_map(
+            sm = shard_map(
                 schedule,
                 mesh=mesh.jax_mesh,
                 in_specs=(jax.tree.map(lambda _: P("pp"), stacked),

@@ -366,11 +366,18 @@ class Engine:
         generated tokens prepended to the prompt) and free its blocks.  The
         merge needs token VALUES, so a deferred-sync backlog materializes
         here first."""
+        free_before = len(self._free)
         self._sync_pending()
         req = slot.req
         if req is None:
             # the sync itself released this slot (the victim's pending first
             # token was its eos): nothing left to requeue
+            return
+        if len(self._free) > free_before:
+            # the sync released eos-finished slots and refilled the pool:
+            # the pressure that chose this victim is gone — abort the
+            # preemption (the caller's allocation loop re-checks _free and
+            # takes these blocks instead of recomputing the victim)
             return
         merged = np.concatenate(
             [np.asarray(req.prompt_ids, np.int32),
@@ -654,12 +661,19 @@ class Engine:
     def _absorb(self, req: GenRequest, vals: List[int]):
         """Append materialized tokens to a request, cutting at eos (the
         cut releases the slot if the request still owns one and emits the
-        stop output; later ledger cells for the request are ignored)."""
-        for tok in vals:
+        stop output; later ledger cells for the request are ignored).
+
+        ``generated_tokens``/``decode_steps`` were counted at DISPATCH time
+        (one per ledger cell), assuming every cell becomes an output token —
+        cells discarded here (the eos itself and everything after the cut)
+        are un-counted so throughput stats equal emitted ``output_ids``."""
+        for i, tok in enumerate(vals):
             if req._stopped or req._emitted:
+                self.stats["generated_tokens"] -= len(vals) - i
                 return
             if req.eos_token_id is not None and tok == req.eos_token_id:
                 req._stopped = True
+                self.stats["generated_tokens"] -= len(vals) - i
                 for s in self._slots:
                     if s.req is req:
                         self._release(s)

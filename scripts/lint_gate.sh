@@ -1,0 +1,93 @@
+#!/bin/bash
+# Sharding & communication lint regression gate.  Re-runs the static
+# analyzer (`bench.py --lint` -> paddle_tpu.analysis) over the CPU-proxy
+# presets and fails when any preset GAINS a finding in a gated class vs the
+# committed baseline (scripts/LINT_BASELINE.json):
+#
+#   unintended-collective  — a new compiled collective no declared resharding
+#                            explains (GSPMD started moving bytes silently)
+#   donation-miss          — a large buffer stopped being donated (the update
+#                            double-buffers in HBM again)
+#
+# Other finding codes are reported but do not fail the gate.  The analyzer
+# runs on the lowered/compiled step only — nothing is executed beyond what
+# the preset itself runs, so counts are deterministic per preset+backend.
+#
+# Refresh the baseline after an intentional change:
+#     scripts/lint_gate.sh --update
+# Exit code: number of failed presets (0 = gate passes).
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+BASELINE="scripts/LINT_BASELINE.json"
+UPDATE=0
+[ "$1" = "--update" ] && UPDATE=1
+FAIL=0
+NEW="$(mktemp)"
+trap 'rm -f "$NEW"' EXIT
+echo "{}" > "$NEW"
+
+check() {  # check <preset> <timeout-s> <extra bench args...>
+    local preset="$1" budget="$2"; shift 2
+    echo "[lint_gate] $preset" >&2
+    local line
+    if ! line=$(timeout -k 10 "$budget" python bench.py --preset "$preset" \
+                --device cpu --lint "$@" 2>/dev/null); then
+        echo "[lint_gate] $preset: FAILED (bench rc=$?)" >&2
+        FAIL=$((FAIL + 1))
+        return
+    fi
+    python - "$preset" "$BASELINE" "$NEW" "$UPDATE" <<PY || FAIL=$((FAIL + 1))
+import json, sys
+preset, baseline_path, new_path, update = sys.argv[1:5]
+line = """$line"""
+result = json.loads(line.strip().splitlines()[-1])
+codes = result.get("lint_codes")
+if codes is None:
+    err = result.get("lint_error", "no lint_codes in BENCH line")
+    print(f"[lint_gate] {preset}: FAILED ({err})", file=sys.stderr)
+    sys.exit(1)
+new = json.load(open(new_path))
+new[preset] = {"lint_codes": codes,
+               "lint_findings": result.get("lint_findings", 0)}
+json.dump(new, open(new_path, "w"), indent=2, sort_keys=True)
+if int(update):
+    print(f"[lint_gate] {preset}: {codes or 'clean'} (recorded)",
+          file=sys.stderr)
+    sys.exit(0)
+try:
+    base = json.load(open(baseline_path))[preset]["lint_codes"]
+except (OSError, KeyError, ValueError):
+    print(f"[lint_gate] {preset}: FAILED (no baseline entry — run "
+          f"scripts/lint_gate.sh --update and commit {baseline_path})",
+          file=sys.stderr)
+    sys.exit(1)
+GATED = ("unintended-collective", "donation-miss")
+bad = [c for c in GATED if codes.get(c, 0) > base.get(c, 0)]
+info = {c: n for c, n in codes.items() if n != base.get(c, 0)}
+if bad:
+    deltas = ", ".join(f"{c}: {base.get(c, 0)} -> {codes.get(c, 0)}"
+                       for c in bad)
+    print(f"[lint_gate] {preset}: FAILED ({deltas})", file=sys.stderr)
+    sys.exit(1)
+note = f" (non-gated drift: {info})" if info else ""
+print(f"[lint_gate] {preset}: OK {codes or 'clean'}{note}", file=sys.stderr)
+PY
+}
+
+# presets cheap enough to execute on the CPU proxy
+check tiny   600 --steps 2
+check ocr    600
+check moe    600
+check decode 600
+check serve  600
+# small/base are compile-only on CPU: lint the lowered step, skip the run
+check small  600 --audit-only
+check base   900 --audit-only
+
+if [ "$UPDATE" = 1 ]; then
+    cp "$NEW" "$BASELINE"
+    echo "[lint_gate] baseline updated: $BASELINE" >&2
+fi
+echo "[lint_gate] failures: $FAIL" >&2
+exit "$FAIL"

@@ -1,0 +1,262 @@
+"""Sharding & communication static analyzer: each seeded defect class must
+be caught, and a clean program must report ZERO findings (no false
+positives).  Everything here traces/compiles toy programs — nothing is
+executed — so the suite stays in the non-slow tier."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu import analysis
+from paddle_tpu.analysis.hlo_lint import lint_hlo_text, parse_hlo_module
+from paddle_tpu.analysis.spec_algebra import (
+    expected_collectives, normalize_spec, transition)
+
+
+@pytest.fixture
+def mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("x", "y"))
+
+
+def _sds(shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: a clean program reports nothing
+
+
+def test_clean_program_zero_findings(mesh):
+    """Donated, consistently sharded, mask-using elementwise update: the
+    analyzer must stay silent (false positives kill lint adoption)."""
+    def step(params, batch):
+        mask = batch > 0          # bool mask widening must NOT be flagged
+        scale = jnp.where(mask, 1.0, 0.99).mean()
+        return {k: v * scale for k, v in params.items()}
+
+    params = {"w": _sds((512, 512)), "b": _sds((512,))}
+    batch = _sds((64, 512))
+    rep = analysis.check(
+        step, (params, batch), donate_argnums=(0,), mesh=mesh,
+        in_specs=({"w": P("x"), "b": P()}, P()),
+        out_specs={"w": P("x"), "b": P()})
+    assert len(rep) == 0, rep.report()
+
+
+# ---------------------------------------------------------------------------
+# level 1: jaxpr / lowering metadata
+
+
+def test_donation_miss_detected_and_fixed():
+    def step(params, batch):
+        return {k: v - 0.1 * jnp.sum(batch) * v for k, v in params.items()}
+
+    params = {"w": _sds((512, 512)), "b": _sds((512,))}
+    batch = _sds((64, 512))
+    rep = analysis.check(step, (params, batch))
+    misses = rep.by_code("donation-miss")
+    assert len(misses) == 1              # w only; b is below the size floor
+    assert misses[0].severity == "high"
+    assert misses[0].bytes == 512 * 512 * 4
+    assert "w" in misses[0].where
+
+    fixed = analysis.check(step, (params, batch), donate_argnums=(0,))
+    assert not fixed.by_code("donation-miss")
+
+
+def test_dtype_upcast_detected():
+    def widen(a):
+        return a.astype(jnp.float32) * 2.0
+
+    rep = analysis.check(widen, (_sds((1024, 64), jnp.bfloat16),))
+    ups = rep.by_code("dtype-upcast")
+    assert len(ups) == 1
+    assert ups[0].bytes == 1024 * 64 * 4
+    assert "bfloat16" in ups[0].message and "float32" in ups[0].message
+
+
+def test_bool_mask_widening_not_flagged():
+    def masked(a):
+        return a * (a > 0).astype(jnp.float32)
+
+    rep = analysis.check(masked, (_sds((1024, 64)),))
+    assert not rep.by_code("dtype-upcast")
+
+
+def test_host_transfer_detected():
+    def step(a):
+        b = jax.pure_callback(
+            lambda x: x, jax.ShapeDtypeStruct(a.shape, a.dtype), a)
+        return b + 1.0
+
+    rep = analysis.check(step, (_sds((256, 128)),))
+    hits = rep.by_code("host-transfer")
+    assert len(hits) == 1
+    assert hits[0].severity == "high"
+    assert "pure_callback" in hits[0].message
+
+
+def test_python_scalar_arg_detected():
+    rep = analysis.check(lambda a, s: a * s, (_sds((8, 8)), 3.0))
+    scalars = rep.by_code("python-scalar-arg")
+    assert len(scalars) == 1
+    assert "float" in scalars[0].message
+
+
+# ---------------------------------------------------------------------------
+# level 2: compiled HLO
+
+
+def test_unintended_all_gather_detected(mesh):
+    """in P('x') -> out replicated forces a GSPMD all-gather; undeclared,
+    it is a finding — declared via the spec algebra, it is not."""
+    def f(a):
+        return a * 2.0
+
+    a = _sds((256, 128))
+    rep = analysis.check(f, (a,), mesh=mesh,
+                         in_specs=(P("x"),), out_specs=P(None))
+    hits = rep.by_code("unintended-collective")
+    assert len(hits) == 1
+    assert "all-gather" in hits[0].message
+    assert hits[0].bytes == 256 * 128 * 4
+
+    declared = analysis.check(f, (a,), mesh=mesh,
+                              in_specs=(P("x"),), out_specs=P(None),
+                              expected=[(P("x"), P(None))])
+    assert not declared.by_code("unintended-collective")
+
+
+def test_unpartitioned_custom_call_detected(mesh):
+    """Sharded input into a lapack custom call (cholesky): GSPMD cannot
+    partition it, inserts an all-gather, and runs it replicated — the
+    exact failure mode the shard_map gap used to hide."""
+    def chol(a):
+        s = a @ a.T + 1000.0 * jnp.eye(a.shape[0])
+        return jnp.linalg.cholesky(s)
+
+    rep = analysis.check(chol, (_sds((256, 256)),), mesh=mesh,
+                         in_specs=(P("x"),))
+    hits = rep.by_code("unpartitioned-custom-call")
+    assert hits, rep.report()
+    assert hits[0].severity == "high"
+    assert "all-gather" in hits[0].message
+
+
+def test_replicated_buffer_detected(mesh):
+    def f(a, table):
+        return a * 2.0, table
+
+    rep = analysis.check(
+        f, (_sds((256, 128)), _sds((1024, 128))), mesh=mesh,
+        in_specs=(P("x"), P(None)),            # table compiled replicated...
+        declared_specs=(P("x"), P("x")))       # ...but declared sharded
+    hits = rep.by_code("replicated-buffer")
+    assert len(hits) == 1
+    assert "parameter 1" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# spec algebra
+
+
+def test_normalize_spec():
+    assert normalize_spec(P("x", ("y", "z")), 3) == (("x",), ("y", "z"), ())
+    assert normalize_spec(None, 2) == ((), ())
+
+
+def test_transition_rules():
+    sizes = {"x": 2, "y": 4}
+    kinds = lambda ts: sorted(t.kind for t in ts if t.is_communication)
+
+    # axis dropped -> all-gather; axis added -> local slice only
+    assert kinds(transition(P("x"), P(None), ndim=2, axis_sizes=sizes,
+                            nbytes=64)) == ["all-gather"]
+    assert kinds(transition(P(None), P("x"), ndim=2, axis_sizes=sizes,
+                            nbytes=64)) == []
+    # axis moved to another dim -> all-to-all
+    assert kinds(transition(P("x", None), P(None, "x"), ndim=2,
+                            axis_sizes=sizes, nbytes=64)) == ["all-to-all"]
+    # tile order within a dim changed -> collective-permute
+    assert kinds(transition(P(("x", "y")), P(("y", "x")), ndim=1,
+                            axis_sizes=sizes, nbytes=64)
+                 ) == ["collective-permute", "collective-permute"]
+    # pending partial sum -> all-reduce, or reduce-scatter if dst shards it
+    assert kinds(transition(P(None), P(None), ndim=1, axis_sizes=sizes,
+                            nbytes=64, src_partial=("x",))) == ["all-reduce"]
+    assert kinds(transition(P(None), P("x"), ndim=1, axis_sizes=sizes,
+                            nbytes=64, src_partial=("x",))
+                 ) == ["reduce-scatter"]
+
+
+def test_expected_collectives_mixes_kinds_and_pairs():
+    got = expected_collectives(["all-reduce", (P("x"), P(None))],
+                               axis_sizes={"x": 8})
+    assert got == {"all-reduce", "all-gather"}
+
+
+# ---------------------------------------------------------------------------
+# HLO text parsing (synthetic modules — no compile needed)
+
+
+_TOY_HLO = """\
+HloModule toy, input_output_alias={ {}: (0, {}, may-alias) }, num_partitions=4
+
+ENTRY main {
+  p0 = f32[64,64]{1,0} parameter(0)
+  p1 = f32[16,64]{1,0} parameter(1)
+  ag = f32[64,64]{1,0} all-gather(p1), dimensions={0}
+  cc = f32[64,64]{1,0} custom-call(ag), custom_call_target="lapack_spotrf_ffi"
+  ar = f32[64,64]{1,0} all-reduce(cc), to_apply=add
+  ROOT done = f32[64,64]{1,0} add(p0, ar)
+}
+"""
+
+
+def test_parse_hlo_module_header_and_collectives():
+    info = parse_hlo_module(_TOY_HLO)
+    assert info.num_partitions == 4
+    assert info.donated_params == {0}
+    assert sorted(k for k, _ in info.collectives()) == [
+        "all-gather", "all-reduce"]
+    assert info.params[1].type_str.startswith("f32[16,64]")
+
+
+def test_lint_hlo_text_expected_filtering():
+    rep = lint_hlo_text(_TOY_HLO)
+    assert rep.counts()["unintended-collective"] == 2
+    rep2 = lint_hlo_text(_TOY_HLO, expected_kinds=("all-reduce",))
+    assert rep2.counts()["unintended-collective"] == 1
+    assert rep2.by_code("unpartitioned-custom-call")  # ag feeds the lapack call
+
+
+def test_report_ranking_and_json():
+    rep = lint_hlo_text(_TOY_HLO)
+    ranked = rep.ranked()
+    # high-severity all-gather outranks the medium all-reduce
+    assert ranked[0].severity == "high"
+    assert rep.counts() == {"unintended-collective": 2,
+                            "unpartitioned-custom-call": 1}
+    import json
+    parsed = json.loads(rep.to_json())
+    assert parsed["counts"] == rep.counts()
+    assert len(parsed["findings"]) == len(rep)
+
+
+def test_lint_gate_diff_semantics():
+    """The regression the gate must catch: a program change that adds an
+    unintended collective strictly increases the gated count."""
+    def f(a):
+        return a * 2.0
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("x",))
+    a = _sds((256, 128))
+    clean = analysis.check(f, (a,), mesh=mesh,
+                           in_specs=(P("x"),), out_specs=P("x"))
+    regressed = analysis.check(f, (a,), mesh=mesh,
+                               in_specs=(P("x"),), out_specs=P(None))
+    code = "unintended-collective"
+    assert clean.counts().get(code, 0) == 0
+    assert regressed.counts().get(code, 0) > clean.counts().get(code, 0)

@@ -1,6 +1,8 @@
 """Ring attention (context parallel) tests — the SURVEY §5 capability upgrade.
 Parity vs full attention on the simulated mesh, causal + GQA + gradients."""
 
+import importlib.util
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -11,12 +13,12 @@ import paddle_tpu.distributed as dist
 from paddle_tpu.distributed.parallel.context_parallel import ring_attention
 from paddle_tpu.kernels.flash_attention import _attention_reference
 
-# these exercise jax.shard_map (public-namespace promotion, jax >= 0.6);
-# this jax ships only jax.experimental.shard_map
+# shard_map reaches the repo through framework.shard_map_compat, which
+# falls back to jax.experimental.shard_map on pre-0.6 jax
 needs_jax_shard_map = pytest.mark.skipif(
-    not hasattr(jax, "shard_map"),
-    reason="needs jax.shard_map (absent in this jax; only "
-           "jax.experimental.shard_map exists)")
+    not (hasattr(jax, "shard_map")
+         or importlib.util.find_spec("jax.experimental.shard_map")),
+    reason="no shard_map implementation in this jax")
 
 
 @pytest.fixture

@@ -5,6 +5,8 @@ Reference: ``incubate/distributed/models/moe/moe_layer.py:119-190``,
 ``moe/gate/``.
 """
 
+import importlib.util
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -13,12 +15,12 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.incubate.moe import MoELayer, top_k_gating
 
-# these exercise jax.shard_map (public-namespace promotion, jax >= 0.6);
-# this jax ships only jax.experimental.shard_map
+# shard_map reaches the repo through framework.shard_map_compat, which
+# falls back to jax.experimental.shard_map on pre-0.6 jax
 needs_jax_shard_map = pytest.mark.skipif(
-    not hasattr(jax, "shard_map"),
-    reason="needs jax.shard_map (absent in this jax; only "
-           "jax.experimental.shard_map exists)")
+    not (hasattr(jax, "shard_map")
+         or importlib.util.find_spec("jax.experimental.shard_map")),
+    reason="no shard_map implementation in this jax")
 
 
 def _dense_oracle(tokens, wg, w_gate_up, w_down, top_k):

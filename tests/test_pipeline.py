@@ -6,6 +6,8 @@ Reference: ``fleet/meta_parallel/pipeline_parallel.py:255,575``,
 ``pp_layers.py:257``.
 """
 
+import importlib.util
+
 import jax
 import numpy as np
 import pytest
@@ -15,12 +17,12 @@ import paddle_tpu.distributed.fleet as fleet
 from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
 from paddle_tpu.models.llama_pp import LlamaForCausalLMPipe
 
-# the pipeline schedules run under jax.shard_map, promoted to the public jax
-# namespace only in jax >= 0.6; this jax ships jax.experimental.shard_map only
+# the pipeline schedules run under shard_map, reached through
+# framework.shard_map_compat (jax.experimental.shard_map on pre-0.6 jax)
 needs_jax_shard_map = pytest.mark.skipif(
-    not hasattr(jax, "shard_map"),
-    reason="needs jax.shard_map (absent in this jax; only "
-           "jax.experimental.shard_map exists)")
+    not (hasattr(jax, "shard_map")
+         or importlib.util.find_spec("jax.experimental.shard_map")),
+    reason="no shard_map implementation in this jax")
 
 
 @pytest.fixture

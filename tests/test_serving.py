@@ -398,3 +398,68 @@ def test_engine_streaming_step_with_slot_churn(model):
         assert rounds < 100, "no progress"
     assert stepped == drained
     assert eng_s.stats["syncs"] >= 3      # streaming really synced per round
+
+
+def test_eos_stats_match_emitted_tokens(model):
+    """ADVICE.md serving/__init__.py:531 — generated_tokens is counted at
+    dispatch time (per ledger cell); when an eos cut discards a chunk tail
+    the stat must be reconciled so it equals the emitted output_ids."""
+    cfg = model.config
+    prompts = _prompts(cfg, (24, 40), seed=11)
+    refs = _reference(model, prompts, 32)
+    eos = refs[0][3]                 # stop request 1 four tokens in
+    eng = Engine(model, max_batch=2, num_blocks=16, block_size=128,
+                 prefill_buckets=(128,), decode_chunk=16)
+    eng.add_request(GenRequest(prompt_ids=prompts[0], max_new_tokens=32,
+                               eos_token_id=eos))
+    eng.add_request(GenRequest(prompt_ids=prompts[1], max_new_tokens=32))
+    outs = eng.run_to_completion()
+    emitted = sum(len(o.output_ids) for o in outs)
+    assert eng.stats["generated_tokens"] == emitted
+
+
+def test_eos_stats_eos_as_first_token(model):
+    """The degenerate cut: the prefill's first sampled token IS the eos —
+    zero tokens emitted, zero counted."""
+    cfg = model.config
+    p = _prompts(cfg, (24,), seed=5)[0]
+    eos = _reference(model, [p], 1)[0][0]
+    eng = Engine(model, max_batch=2, num_blocks=16, block_size=128,
+                 prefill_buckets=(128,))
+    eng.add_request(GenRequest(prompt_ids=p, max_new_tokens=8,
+                               eos_token_id=eos))
+    (out,) = eng.run_to_completion()
+    assert out.finish_reason == "stop" and out.output_ids == []
+    assert eng.stats["generated_tokens"] == 0
+
+
+def test_evict_aborts_when_sync_frees_blocks(model, monkeypatch):
+    """ADVICE.md serving/__init__.py:359 — the eviction victim is chosen
+    before _evict's _sync_pending() runs; if that sync releases blocks (a
+    backlog eos finishing another slot), the preemption must be aborted
+    instead of recompute-requeueing a healthy sequence."""
+    cfg = model.config
+    eng = Engine(model, max_batch=2, num_blocks=6, block_size=128,
+                 prefill_buckets=(128,))
+    for p in _prompts(cfg, (100, 110), seed=9):
+        eng.add_request(GenRequest(prompt_ids=p, max_new_tokens=8))
+    eng._admit()
+    slot_a, slot_b = [s for s in eng._slots if s.req is not None]
+    eng._free.clear()                # growth pressure: nothing free
+
+    def sync_releases_a():
+        if slot_a.req is not None:
+            eng._release(slot_a)     # the pending eos materializes
+
+    monkeypatch.setattr(eng, "_sync_pending", sync_releases_a)
+    eng._evict(slot_b)
+    assert slot_b.req is not None, "preemption not aborted"
+    assert eng.stats["evictions"] == 0
+    assert eng._free, "released blocks must be available to the caller"
+
+    # with nothing reclaimable the eviction must still proceed as before
+    monkeypatch.setattr(eng, "_sync_pending", lambda: None)
+    eng._free.clear()
+    eng._evict(slot_b)
+    assert slot_b.req is None
+    assert eng.stats["evictions"] == 1

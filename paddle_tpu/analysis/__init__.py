@@ -13,6 +13,24 @@ Two levels, both running **without executing the model**:
   calls (the Mosaic / shard_map gap); replicated buffers that the caller
   declared sharded.
 
+Three cross-rank / schedule-level analyzers ride on the same Report API:
+
+- :mod:`.schedule_lint` — pipeline-schedule verifier: builds the
+  tick-level dependency DAG of the GPipe/1F1B/VPP/zero-bubble step
+  functions, proves deadlock-freedom and F-before-B ordering, checks
+  warmup/cooldown tick counts and per-stage activation watermarks, and
+  predicts the bubble fraction analytically (``check_schedule``,
+  ``bubble_fraction``).
+- :mod:`.collective_match` — cross-rank collective consistency: per-rank
+  collective sequences diffed for kind/participants/bytes
+  (``match_collectives``) and rank-divergent control flow — a collective
+  under an ``axis_index``-predicated ``cond`` — flagged as a static
+  deadlock at jaxpr (``lint_rank_divergence``) and compiled-HLO level
+  (``lint_hlo_rank_divergence``, wired into :func:`lint_lowered`).
+- :mod:`.host_lint` — AST concurrency self-lint of the host-side
+  distributed code (unbounded store ops, barriers in rank branches,
+  blocking store calls under locks).
+
 Entry point::
 
     from paddle_tpu import analysis
@@ -33,10 +51,18 @@ from typing import Any, Dict, Iterable, Optional, Tuple
 
 import jax
 
+from .collective_match import (
+    CollectiveSig, collective_sequence, lint_hlo_rank_divergence,
+    lint_rank_divergence, match_collectives)
 from .findings import Finding, Report, SEVERITY_RANK
 from .hlo_lint import lint_hlo_text, parse_hlo_module
+from .host_lint import lint_paths as host_lint_paths
+from .host_lint import lint_source as host_lint_source
+from .host_lint import lint_tree as host_lint_tree
 from .jaxpr_lint import (
     DEFAULT_BIG_BUFFER, lint_donation, lint_jaxpr, lint_python_scalars)
+from .schedule_lint import (
+    build_schedule, bubble_fraction, check_schedule, lint_schedule)
 from .spec_algebra import Transfer, expected_collectives, normalize_spec, transition
 
 __all__ = [
@@ -45,6 +71,10 @@ __all__ = [
     "lint_donation", "lint_python_scalars", "parse_hlo_module",
     "expected_collectives", "normalize_spec", "transition",
     "DEFAULT_BIG_BUFFER",
+    "build_schedule", "bubble_fraction", "check_schedule", "lint_schedule",
+    "CollectiveSig", "collective_sequence", "match_collectives",
+    "lint_rank_divergence", "lint_hlo_rank_divergence",
+    "host_lint_source", "host_lint_paths", "host_lint_tree",
 ]
 
 
@@ -114,6 +144,10 @@ def lint_lowered(lowered, *, mesh=None, expected: Iterable[Any] = (),
                     if declared_specs is not None else None)
         rep.extend(lint_hlo_text(text, expected_kinds=kinds,
                                  declared_params=declared))
+        # post-compile rank-divergent control flow (best-effort: XLA may
+        # hoist the collective out of the conditional; the jaxpr-level
+        # walk in check() is the authoritative detector)
+        rep.extend(lint_hlo_rank_divergence(text))
     return rep
 
 
@@ -162,6 +196,7 @@ def check(fn, args: Tuple[Any, ...] = (), kwargs: Optional[dict] = None, *,
         rep.meta["jaxpr_error"] = repr(e)
     else:
         rep.extend(lint_jaxpr(closed))
+        rep.extend(lint_rank_divergence(closed))
 
     if declared_specs is None and in_specs is not None:
         declared_specs = in_specs

@@ -31,6 +31,36 @@ code                      level  meaning
 ``replicated-buffer``     hlo    an entry parameter materialized at full
                                  (global) size although its declared spec
                                  shards it
+``schedule-deadlock``     sched  cycle or lag-violating edge in the pipeline
+                                 schedule's tick DAG — a ppermute waits on a
+                                 message produced at/after its own tick
+``schedule-missing-edge`` sched  a dependency the schedule semantics require
+                                 (comm hop, stash reuse) has no edge — the
+                                 consumer can fire before its producer
+``schedule-order``        sched  a microbatch's backward is ticked at or
+                                 before its forward on some stage
+``schedule-tick-count``   sched  warmup/cooldown tick count wrong (op
+                                 scheduled outside [0, total_ticks), idle
+                                 tail, late warmup) — the off-by-one class
+``schedule-memory``       sched  peak in-flight activations on a stage
+                                 exceed the stash watermark the step
+                                 function allocates
+``collective-mismatch``   coll   two ranks' collective sequences diverge in
+                                 count, op kind, participant set, or payload
+                                 bytes — the rendezvous never completes
+``rank-divergent-collective`` coll  a collective under a ``cond`` whose
+                                 predicate derives from axis_index /
+                                 partition-id: only some ranks enter it
+                                 (static deadlock)
+``host-unbounded-store-op``   host  blocking store ``get``/``wait``/
+                                 ``barrier`` with no explicit timeout —
+                                 inherits the rendezvous-scale default
+``host-barrier-in-rank-branch`` host  store barrier inside a rank-dependent
+                                 ``if`` — skipping ranks leave the arrival
+                                 count short forever
+``host-blocking-under-lock``  host  blocking store op while holding a lock —
+                                 a network stall serializes every other
+                                 thread behind it
 ========================  =====  ========================================
 
 Severity is ``high`` / ``medium`` / ``low``; ranking is by severity first,

@@ -1,0 +1,270 @@
+"""AST concurrency lint for the HOST side of the distributed stack.
+
+The jaxpr/HLO lints cover device programs; the hangs we actually shipped
+(and fixed) in PR 5 lived in host Python — a liveness probe inheriting
+the 300-second rendezvous store timeout, a barrier only some ranks
+reach.  This module is the regression fence: a static self-lint over
+``distributed/store.py``, ``distributed/launch/``,
+``distributed/fault_tolerance/`` and ``distributed/ps/`` run in CI
+against a committed baseline (``scripts/LINT_BASELINE.json``,
+``host_lint`` section), so a new unbounded blocking call fails the gate
+the day it lands.
+
+Three checks:
+
+- ``host-unbounded-store-op`` (medium): a call to a blocking store
+  method (``get``/``wait``/``barrier``/``wait_key``) on a store-ish
+  receiver with no explicit ``timeout=``/``op_timeout=`` bound (and not
+  ``wait=False``).  The implicit bound is the store-construction
+  timeout — rendezvous-scale (300 s), which is the wrong policy for
+  heartbeat-scale probes and turns a dead master into a five-minute
+  stall per op.
+
+- ``host-barrier-in-rank-branch`` (high): a ``barrier(...)`` call
+  lexically inside an ``if`` whose test reads rank identity (``rank``,
+  ``local_rank``, ``node_rank``, ``trainer_id``, ``is_master``,
+  ``get_rank()``).  A barrier only some ranks execute is the host-side
+  twin of the rank-divergent collective: the ranks that skip it leave
+  the arrival count short forever.
+
+- ``host-blocking-under-lock`` (high): a blocking store op issued while
+  holding a lock (lexically inside ``with <lock-ish>``).  The store op
+  can stall for its full timeout with the lock held, so every other
+  thread (heartbeat, watchdog) piles up behind a network wait.
+
+Only store-ish receivers are considered (names ending in ``store`` /
+``_store``/``client``), so ``subprocess.Popen.wait`` and dict ``.get``
+stay out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import sys
+from typing import Iterable, List, Optional, Sequence
+
+from .findings import Report
+
+__all__ = ["lint_source", "lint_paths", "lint_tree", "DEFAULT_SUBDIRS"]
+
+# blocking store methods whose wait must be explicitly bounded
+_BLOCKING_METHODS = {"get", "wait", "barrier", "wait_key"}
+# kwargs that count as an explicit bound
+_BOUND_KWARGS = {"timeout", "op_timeout", "timeout_ms"}
+_BARRIER_METHODS = {"barrier"}
+
+_RANK_TOKENS = {"rank", "local_rank", "node_rank", "trainer_id",
+                "is_master", "get_rank"}
+_LOCK_TOKENS = {"lock", "rlock", "mutex", "mu", "cond", "condition",
+                "semaphore"}
+
+# paths (relative to the paddle_tpu package root) the self-lint covers
+DEFAULT_SUBDIRS = (
+    "distributed/store.py",
+    "distributed/launch",
+    "distributed/fault_tolerance",
+    "distributed/ps",
+)
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted-name text of an expression ('' when not a
+    plain name/attribute chain)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        return _dotted(node.func)
+    elif parts:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def _tokens(dotted: str) -> List[str]:
+    out: List[str] = []
+    for piece in dotted.split("."):
+        out.extend(t for t in piece.split("_") if t)
+    return out
+
+
+def _store_ish(receiver: str) -> bool:
+    if not receiver:
+        return False
+    leaf = receiver.split(".")[-1].lower()
+    return leaf.endswith("store") or leaf.endswith("client") or leaf == "rdzv"
+
+
+def _lock_ish(expr: ast.AST) -> bool:
+    return bool(_LOCK_TOKENS & {t.lower() for t in _tokens(_dotted(expr))})
+
+
+def _rank_ish_test(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        name = ""
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and (name in _RANK_TOKENS
+                     or name.split("_")[-1] == "rank"):
+            return True
+    return False
+
+
+class _HostVisitor(ast.NodeVisitor):
+    def __init__(self, path: str, rep: Report):
+        self.path = path
+        self.rep = rep
+        self.lock_depth = 0
+        self.rank_if_depth = 0
+
+    def _where(self, node: ast.AST) -> str:
+        return f"{self.path}:{getattr(node, 'lineno', 0)}"
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(_lock_ish(item.context_expr) for item in node.items)
+        self.lock_depth += int(locked)
+        self.generic_visit(node)
+        self.lock_depth -= int(locked)
+
+    visit_AsyncWith = visit_With  # same containment semantics
+
+    def visit_If(self, node: ast.If) -> None:
+        ranky = _rank_ish_test(node.test)
+        for part, stmts in (("body", node.body), ("orelse", node.orelse)):
+            self.rank_if_depth += int(ranky)
+            for stmt in stmts:
+                self.visit(stmt)
+            self.rank_if_depth -= int(ranky)
+        self.visit(node.test)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            method = func.attr
+            receiver = _dotted(func.value)
+            if _store_ish(receiver):
+                kwargs = {kw.arg for kw in node.keywords if kw.arg}
+                nonblocking = any(
+                    kw.arg == "wait"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False
+                    for kw in node.keywords)
+                blocking = (method in _BLOCKING_METHODS and not nonblocking)
+                # wait_key takes its bound as the positional timeout_ms arg
+                positional_bound = (method == "wait_key"
+                                    and len(node.args) >= 2)
+                if (blocking and not positional_bound
+                        and not (kwargs & _BOUND_KWARGS)):
+                    self.rep.add(
+                        "host-unbounded-store-op", "medium",
+                        f"blocking `{receiver}.{method}(...)` with no "
+                        "explicit timeout — it inherits the store-wide "
+                        "default (rendezvous-scale), so a dead master "
+                        "stalls this call path for minutes",
+                        where=self._where(node),
+                        suggestion="pass timeout= sized to THIS op's "
+                                   "latency budget (heartbeat-scale for "
+                                   "probes), or wait=False for a poll")
+                if blocking and self.lock_depth > 0:
+                    self.rep.add(
+                        "host-blocking-under-lock", "high",
+                        f"blocking `{receiver}.{method}(...)` while holding "
+                        "a lock — the network wait (up to the op timeout) "
+                        "happens with the lock held, serializing every "
+                        "other thread behind a possibly-dead master",
+                        where=self._where(node),
+                        suggestion="do the store op outside the critical "
+                                   "section; hold the lock only to publish "
+                                   "the result")
+                if (method in _BARRIER_METHODS
+                        and self.rank_if_depth > 0):
+                    self.rep.add(
+                        "host-barrier-in-rank-branch", "high",
+                        f"`{receiver}.{method}(...)` inside a rank-"
+                        "dependent branch — ranks taking the other branch "
+                        "never arrive, so the barrier's arrival count "
+                        "stays short and every participant times out",
+                        where=self._where(node),
+                        suggestion="hoist the barrier out of the rank "
+                                   "conditional (all ranks must reach it), "
+                                   "or replace it with a key the leader "
+                                   "sets and followers wait on")
+        self.generic_visit(node)
+
+
+def lint_source(src: str, path: str = "<string>") -> Report:
+    """Lint one module's source text."""
+    rep = Report()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        rep.add("host-lint-error", "low",
+                f"could not parse: {e}", where=path)
+        return rep
+    _HostVisitor(path, rep).visit(tree)
+    return rep
+
+
+def lint_paths(paths: Iterable[str]) -> Report:
+    rep = Report()
+    n_files = 0
+    for p in paths:
+        try:
+            with open(p, "r", encoding="utf-8") as f:
+                src = f.read()
+        except OSError as e:
+            rep.add("host-lint-error", "low", f"unreadable: {e}", where=p)
+            continue
+        n_files += 1
+        rep.extend(lint_source(src, path=p))
+    rep.meta["files_scanned"] = n_files
+    return rep
+
+
+def _expand(root: str, rel: str) -> List[str]:
+    full = os.path.join(root, rel)
+    if os.path.isfile(full):
+        return [full]
+    out: List[str] = []
+    for dirpath, _, names in os.walk(full):
+        for name in sorted(names):
+            if name.endswith(".py"):
+                out.append(os.path.join(dirpath, name))
+    return out
+
+
+def lint_tree(root: Optional[str] = None,
+              subdirs: Sequence[str] = DEFAULT_SUBDIRS) -> Report:
+    """Self-lint the host-side distributed code under the package root
+    (default: this installed ``paddle_tpu``)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    files: List[str] = []
+    for rel in subdirs:
+        files.extend(_expand(root, rel))
+    rep = lint_paths(files)
+    rep.meta["root"] = root
+    return rep
+
+
+def _main(argv: Sequence[str]) -> int:
+    """CLI: one JSON line (gate-friendly).  ``--report`` adds the ranked
+    human listing on stderr."""
+    verbose = "--report" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    rep = lint_paths(paths) if paths else lint_tree()
+    out = {"host_findings": len(rep.findings), "host_codes": rep.counts()}
+    print(json.dumps(out, sort_keys=True))
+    if verbose:
+        print(rep.report(), file=sys.stderr)
+    return 1 if rep.findings else 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via scripts
+    raise SystemExit(_main(sys.argv[1:]))

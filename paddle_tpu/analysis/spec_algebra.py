@@ -12,7 +12,11 @@ transition of axis ``a``        collective implied
 in src, absent from dst         ``all-gather`` over ``a`` (shards are
                                 concatenated onto every device)
 absent from src, in dst         ``slice`` — a local dynamic-slice, no
-                                communication
+                                communication — UNLESS another axis was
+                                simultaneously removed from the same
+                                dim (replacement): then GSPMD reshards
+                                with a direct ``collective-permute``
+                                exchange instead of gather+slice
 in src dim *i*, in dst dim *j*  ``all-to-all`` over ``a`` (resharding
 (*i* ≠ *j*)                     moves the split dimension)
 same dim, different position    ``collective-permute`` (tile order
@@ -21,6 +25,17 @@ pending partial sum over ``a``  ``all-reduce`` if ``a`` is absent from
 (``src_partial``)               dst, ``reduce-scatter`` if dst shards
                                 over ``a``
 ==============================  =======================================
+
+Multi-axis tuple entries (``P(('dp','mp'), None)``) are expanded per
+axis, NOT treated as one opaque axis, so the rules above compose:
+swapping tuple order is a permute per displaced axis, merging two dims'
+axes into one tuple is an all-to-all for the moved axis, and dropping
+the tuple's outer axis keeps a permute for the inner one (its tile
+position changes).  The table was validated empirically against the
+collectives GSPMD inserts for identity reshards on the 8-device CPU
+mesh (see ``tests/test_spec_fuzz.py``): per transition,
+``expected_collectives`` must be a SUPERSET of what GSPMD emits, so the
+HLO lint never flags a declared resharding as unintended.
 
 Byte estimates use the *global* array size as the magnitude of the
 transfer — coarse (an all-gather moves ``(n-1)/n`` of that per device)
@@ -94,18 +109,27 @@ def transition(src, dst, *, ndim: int, axis_sizes: Mapping[str, int],
         kind = "reduce-scatter" if a in d else "all-reduce"
         out.append(Transfer(kind, a, nbytes))
 
+    removed_dims: Set[int] = set()
     for a, (sdim, spos) in s.items():
         if a in partial:
             continue
         if a not in d:
             out.append(Transfer("all-gather", a, nbytes))
+            removed_dims.add(sdim)
         elif d[a][0] != sdim:
             out.append(Transfer("all-to-all", a, nbytes))
         elif d[a][1] != spos:
             out.append(Transfer("collective-permute", a, nbytes))
-    for a in d:
+    for a, (ddim, _) in d.items():
         if a not in s and a not in partial:
-            out.append(Transfer("slice", a, 0))
+            if ddim in removed_dims:
+                # replacement: an axis left this dim while `a` arrived —
+                # GSPMD reshards tile-to-tile with a collective-permute
+                # (observed empirically, e.g. P('x') -> P('y')); the
+                # all-gather above stays as the fallback upper bound
+                out.append(Transfer("collective-permute", a, nbytes))
+            else:
+                out.append(Transfer("slice", a, 0))
     return out
 
 
@@ -132,4 +156,10 @@ def expected_collectives(pairs, mesh=None, *,
         for t in transition(src, dst, ndim=ndim, axis_sizes=sizes, nbytes=0):
             if t.is_communication:
                 kinds.add(t.kind)
+    if "all-to-all" in kinds:
+        # a dim-move is realized by GSPMD as a transposing all-to-all plus
+        # a device-order collective-permute — or degenerates to a pure
+        # permute when tile counts line up (both observed on the 8-dev
+        # sweep in tests/test_spec_fuzz.py); cover both realizations
+        kinds.add("collective-permute")
     return kinds

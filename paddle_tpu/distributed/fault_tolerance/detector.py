@@ -157,14 +157,16 @@ class HeartbeatFailureDetector:
         epoch = self.store.add(self._epoch_key(), 0, timeout=self.op_timeout)
         if epoch == 0:
             return 0, list(range(self.nnodes))
-        raw = self.store.get(f"ft/{self.job_id}/members/{epoch}")
+        raw = self.store.get(f"ft/{self.job_id}/members/{epoch}",
+                             timeout=self.op_timeout)
         return epoch, (json.loads(raw) if raw else None)
 
     def dead_from_epoch(self) -> List[int]:
         epoch = self.store.add(self._epoch_key(), 0, timeout=self.op_timeout)
         if epoch == 0:
             return []
-        raw = self.store.get(f"ft/{self.job_id}/dead/{epoch}")
+        raw = self.store.get(f"ft/{self.job_id}/dead/{epoch}",
+                             timeout=self.op_timeout)
         return json.loads(raw) if raw else []
 
     def wait_epoch(self, above: int = 0, timeout: float = 30.0) -> int:

@@ -31,7 +31,8 @@ from typing import Dict, List, Optional, Tuple
 from ..store import TCPStore
 
 __all__ = ["rendezvous", "RendezvousResult", "invalidate_generation",
-           "shrink_rendezvous", "GenerationInvalidated"]
+           "shrink_rendezvous", "GenerationInvalidated",
+           "request_join", "grow_rendezvous", "pending_joins"]
 
 
 class GenerationInvalidated(RuntimeError):
@@ -205,3 +206,121 @@ def shrink_rendezvous(prev: RendezvousResult, dead_ranks: List[int],
     store.barrier(f"{prefix}/ready", timeout=timeout)
     return RendezvousResult(rank, new_n, peers, store, job_id=job_id,
                             gen=gen, subgen=subgen)
+
+
+# ---------------------------------------------------------------------------
+# scale UP: admit a (re)joining worker at the next generation bump
+
+
+def _wait_json(store: TCPStore, key: str, timeout: float, what: str) -> dict:
+    """Bounded sliced wait for ``key``, then decode it.  Short wait slices
+    keep the deadline responsive (same pattern as :func:`_collect_peers`)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        raw = store.get(key, wait=False)
+        if raw is not None:
+            return json.loads(raw)
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"{what}: {key!r} not published "
+                               f"within {timeout:.1f}s")
+        slice_s = min(1.0, max(0.05, deadline - time.monotonic()))
+        try:
+            store.wait(key, timeout=slice_s)
+        except TimeoutError:
+            pass
+
+
+def pending_joins(store: TCPStore, job_id: str = "default") -> int:
+    """How many workers are parked in :func:`request_join` waiting to be
+    admitted (requested minus already admitted).  Survivors poll this to
+    decide when a :func:`grow_rendezvous` round is worth taking."""
+    requested = store.add(f"rdzv/{job_id}/grow/pending", 0)
+    admitted = store.add(f"rdzv/{job_id}/grow/admitted", 0)
+    return max(0, requested - admitted)
+
+
+def request_join(master: str, job_id: str = "default",
+                 timeout: float = 300.0) -> RendezvousResult:
+    """A NEW (or restarted) worker asks to join a running job.
+
+    Unlike :func:`rendezvous`, this does not require a fresh generation:
+    the request parks on the store until the survivors take a
+    :func:`grow_rendezvous` round at the next generation bump, which
+    admits every pending request at once and assigns the newcomer a rank
+    past the current world.  Bounded: raises ``TimeoutError`` if no grow
+    round admits us within ``timeout``."""
+    host, port_s = master.rsplit(":", 1)
+    store = TCPStore(host, int(port_s), world_size=1, is_master=False,
+                     timeout=timeout)
+    try:
+        k = store.add(f"rdzv/{job_id}/grow/pending", 1)  # my request id
+        info = {"host": socket.gethostname()}
+        store.set(f"rdzv/{job_id}/grow/req/{k}", json.dumps(info))
+        admit = _wait_json(store, f"rdzv/{job_id}/grow/admit/{k}", timeout,
+                           what=f"join request {k} for job {job_id!r}")
+        prefix, rank, new_n = admit["prefix"], admit["rank"], admit["nnodes"]
+        info["rank"] = rank
+        store.set(f"{prefix}/node/{rank}", json.dumps(info))
+        peers = _collect_peers(
+            store, prefix, new_n, timeout,
+            what=f"grow rendezvous {job_id!r} (admitted as rank {rank})")
+        store.world_size = new_n
+        store.barrier(f"{prefix}/ready", timeout=timeout)
+    except BaseException:
+        store.close()  # a failed join must not leak the client
+        raise
+    return RendezvousResult(rank, new_n, peers, store, job_id=job_id,
+                            gen=admit.get("gen", -1))
+
+
+def grow_rendezvous(prev: RendezvousResult,
+                    timeout: float = 60.0) -> RendezvousResult:
+    """Survivor side of scale-up: every member of the current world calls
+    this once; pending :func:`request_join` workers are admitted at this
+    generation bump and the job re-forms at the grown size.
+
+    Survivors KEEP their ranks (no resharding of their state); newcomers
+    are appended after them in request order.  The member with rank 0
+    acts as admitter — it freezes the pending set, publishes the round
+    meta, and writes each newcomer's admission ticket.  Repeated grows
+    work: each round is scoped to an arrival-counter ``bump``."""
+    store, job_id, gen = prev.store, prev.job_id, prev.gen
+    # the arrival counter is scoped by the round's world size: nnodes is
+    # non-decreasing across grows, so each size change starts a fresh
+    # counter and repeated same-size rounds advance `bump` by divmod —
+    # a single cumulative counter would tear once nnodes changes
+    base = f"rdzv/{job_id}/grow/{gen}/n{prev.nnodes}"
+    joined = store.add(f"{base}/joined", 1) - 1
+    bump, _ = divmod(joined, prev.nnodes)
+    prefix = f"{base}/{bump}"
+
+    if prev.rank == 0:
+        requested = store.add(f"rdzv/{job_id}/grow/pending", 0)
+        admitted = store.add(f"rdzv/{job_id}/grow/admitted", 0)
+        newcomers = max(0, requested - admitted)
+        new_n = prev.nnodes + newcomers
+        store.set(f"{prefix}/meta", json.dumps(
+            {"nnodes": new_n, "admitted": newcomers, "base": prev.nnodes}))
+        for i in range(newcomers):
+            store.set(f"rdzv/{job_id}/grow/admit/{admitted + 1 + i}",
+                      json.dumps({"prefix": prefix,
+                                  "rank": prev.nnodes + i,
+                                  "nnodes": new_n, "gen": gen}))
+        store.add(f"rdzv/{job_id}/grow/admitted", newcomers)
+    else:
+        meta = _wait_json(store, f"{prefix}/meta", timeout,
+                          what=f"grow rendezvous {job_id!r} bump {bump}")
+        new_n = meta["nnodes"]
+
+    info = {"rank": prev.rank, "host": socket.gethostname(),
+            "prev_rank": prev.rank}
+    store.set(f"{prefix}/node/{prev.rank}", json.dumps(info))
+    peers = _collect_peers(
+        store, prefix, new_n, timeout,
+        what=f"grow rendezvous {job_id!r} gen {gen} bump {bump}")
+    # barriers from here on (including this ready barrier) are at the
+    # GROWN world size; each client adjusts its own view
+    store.world_size = new_n
+    store.barrier(f"{prefix}/ready", timeout=timeout)
+    return RendezvousResult(prev.rank, new_n, peers, store, job_id=job_id,
+                            gen=gen)

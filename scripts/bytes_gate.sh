@@ -13,50 +13,33 @@
 #     scripts/bytes_gate.sh --update
 # Exit code: number of failed presets (0 = gate passes).
 cd "$(dirname "$0")/.." || exit 1
-export JAX_PLATFORMS=cpu
-export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
-BASELINE="scripts/BYTES_BASELINE.json"
+GATE_NAME=bytes_gate
+GATE_BASELINE="scripts/BYTES_BASELINE.json"
 TOLERANCE="${BYTES_GATE_TOLERANCE:-0.05}"
-UPDATE=0
-[ "$1" = "--update" ] && UPDATE=1
-FAIL=0
-NEW="$(mktemp)"
-trap 'rm -f "$NEW"' EXIT
-echo "{}" > "$NEW"
+. scripts/gate_lib.sh
+gate_init "$@"
 
 check() {  # check <preset> <timeout-s> <extra bench args...>
     local preset="$1" budget="$2"; shift 2
-    echo "[bytes_gate] $preset" >&2
-    local line
-    if ! line=$(timeout -k 10 "$budget" python bench.py --preset "$preset" \
-                --device cpu "$@" 2>/dev/null); then
-        echo "[bytes_gate] $preset: FAILED (bench rc=$?)" >&2
-        FAIL=$((FAIL + 1))
-        return
-    fi
-    python - "$preset" "$BASELINE" "$NEW" "$TOLERANCE" "$UPDATE" <<PY || FAIL=$((FAIL + 1))
-import json, sys
-preset, baseline_path, new_path, tol, update = sys.argv[1:6]
-line = """$line"""
-result = json.loads(line.strip().splitlines()[-1])
+    gate_bench "$preset" "$budget" "$@" || return
+    gate_diff "$preset" "$TOLERANCE" <<PY
+import json, os, sys
+exec(os.environ["GATE_PY_COMMON"])
+preset, baseline_path, new_path, update, tol = sys.argv[1:6]
+line = """$GATE_LINE"""
+result = gate_result(line)
 b = result.get("bytes_per_step")
 if not b:
     print(f"[bytes_gate] {preset}: FAILED (no bytes_per_step in BENCH line)",
           file=sys.stderr)
     sys.exit(1)
-new = json.load(open(new_path))
-new[preset] = {"bytes_per_step": b, "source": result.get("bytes_source", "")}
-json.dump(new, open(new_path, "w"), indent=2, sort_keys=True)
+gate_record(new_path, preset,
+            {"bytes_per_step": b, "source": result.get("bytes_source", "")})
 if int(update):
     print(f"[bytes_gate] {preset}: {b:.0f} B/step (recorded)", file=sys.stderr)
     sys.exit(0)
-try:
-    base = json.load(open(baseline_path))[preset]["bytes_per_step"]
-except (OSError, KeyError, ValueError):
-    print(f"[bytes_gate] {preset}: FAILED (no baseline entry — run "
-          f"scripts/bytes_gate.sh --update and commit {baseline_path})",
-          file=sys.stderr)
-    sys.exit(1)
+base = gate_base(baseline_path, preset, "bytes_gate",
+                 "scripts/bytes_gate.sh")["bytes_per_step"]
 ratio = b / base
 if ratio > 1 + float(tol):
     print(f"[bytes_gate] {preset}: FAILED "
@@ -78,9 +61,4 @@ check serve  600
 check small  600 --audit-only
 check base   900 --audit-only
 
-if [ "$UPDATE" = 1 ]; then
-    cp "$NEW" "$BASELINE"
-    echo "[bytes_gate] baseline updated: $BASELINE" >&2
-fi
-echo "[bytes_gate] failures: $FAIL" >&2
-exit "$FAIL"
+gate_finish

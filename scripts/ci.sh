@@ -1,0 +1,39 @@
+#!/bin/bash
+# Full local CI: tier-1 tests, then every regression gate, each reported
+# with its own exit code so one failing stage doesn't mask the others.
+#
+#   tier-1        pytest tests/ -m 'not slow'  (the seed contract)
+#   bytes_gate    HBM bytes/step vs scripts/BYTES_BASELINE.json
+#   lint_gate     sharding/communication lint vs scripts/LINT_BASELINE.json
+#   schedule_gate pipeline-schedule matrix + host self-lint
+#   host_lint     standalone self-lint summary line (rc 1 on any finding)
+#
+# Exit code: number of failed stages (0 = green).
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+FAILED=0
+declare -a SUMMARY
+
+stage() {  # stage <name> <cmd...>
+    local name="$1"; shift
+    echo "=== [ci] $name ===" >&2
+    "$@"
+    local rc=$?
+    SUMMARY+=("$name rc=$rc")
+    [ "$rc" -ne 0 ] && FAILED=$((FAILED + 1))
+    return 0
+}
+
+stage tier-1 timeout -k 10 1200 python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+stage bytes_gate    ./scripts/bytes_gate.sh
+stage lint_gate     ./scripts/lint_gate.sh
+stage schedule_gate ./scripts/schedule_gate.sh
+stage host_lint     python -m paddle_tpu.analysis.host_lint
+
+echo "=== [ci] summary ===" >&2
+for s in "${SUMMARY[@]}"; do echo "[ci] $s" >&2; done
+echo "[ci] failed stages: $FAILED" >&2
+exit "$FAILED"

@@ -1,0 +1,103 @@
+# Shared plumbing for the regression gates (bytes_gate, lint_gate,
+# schedule_gate).  Source from a gate script AFTER cd-ing to the repo
+# root and setting:
+#
+#   GATE_NAME      - tag used in log lines ("lint_gate")
+#   GATE_BASELINE  - committed baseline JSON path
+#
+# Provides:
+#   gate_init "$@"     - env (JAX_PLATFORMS/PYTHONPATH), --update flag,
+#                        FAIL counter, $NEW tempfile (auto-removed)
+#   gate_bench p t ... - run `python bench.py --preset p` under timeout t,
+#                        capture the BENCH line into $GATE_LINE; counts a
+#                        failure and returns 1 when bench itself dies
+#   gate_diff p ... <<PY - run a python diff snippet with the standard
+#                        argv prefix (preset, baseline, new, update, extra
+#                        args); snippet exit 1 counts a failure.  Snippets
+#                        start with  exec(os.environ["GATE_PY_COMMON"])
+#                        to get gate_result/gate_record/gate_base helpers.
+#   gate_finish        - on --update replace the baseline wholesale, then
+#                        exit with the failure count
+#   gate_finish_merge  - same, but MERGE $NEW's top-level keys into the
+#                        existing baseline (for gates that own only a
+#                        section of a shared baseline file)
+
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+# python helpers shared by the per-gate diff snippets; exec'd from env so
+# the snippets stay heredocs with access to the captured $GATE_LINE
+export GATE_PY_COMMON='
+import json, os, sys
+
+def gate_result(line):
+    """Last line of bench stdout is the one-JSON-line contract."""
+    return json.loads(line.strip().splitlines()[-1])
+
+def gate_record(new_path, preset, entry):
+    new = json.load(open(new_path))
+    new[preset] = entry
+    json.dump(new, open(new_path, "w"), indent=2, sort_keys=True)
+
+def gate_base(baseline_path, preset, gate, refresh_cmd):
+    try:
+        return json.load(open(baseline_path))[preset]
+    except (OSError, KeyError, ValueError):
+        print(f"[{gate}] {preset}: FAILED (no baseline entry — run "
+              f"{refresh_cmd} --update and commit {baseline_path})",
+              file=sys.stderr)
+        sys.exit(1)
+'
+
+gate_init() {
+    UPDATE=0
+    [ "$1" = "--update" ] && UPDATE=1
+    FAIL=0
+    NEW="$(mktemp)"
+    trap 'rm -f "$NEW"' EXIT
+    echo "{}" > "$NEW"
+}
+
+gate_bench() {  # gate_bench <preset> <timeout-s> <extra bench args...>
+    local preset="$1" budget="$2"; shift 2
+    echo "[$GATE_NAME] $preset" >&2
+    if ! GATE_LINE=$(timeout -k 10 "$budget" python bench.py \
+                     --preset "$preset" --device cpu "$@" 2>/dev/null); then
+        echo "[$GATE_NAME] $preset: FAILED (bench rc=$?)" >&2
+        FAIL=$((FAIL + 1))
+        return 1
+    fi
+}
+
+gate_diff() {  # gate_diff <preset> [extra argv...] <<PY ... PY
+    local preset="$1"; shift
+    python - "$preset" "$GATE_BASELINE" "$NEW" "$UPDATE" "$@" \
+        || FAIL=$((FAIL + 1))
+}
+
+gate_finish() {
+    if [ "$UPDATE" = 1 ]; then
+        cp "$NEW" "$GATE_BASELINE"
+        echo "[$GATE_NAME] baseline updated: $GATE_BASELINE" >&2
+    fi
+    echo "[$GATE_NAME] failures: $FAIL" >&2
+    exit "$FAIL"
+}
+
+gate_finish_merge() {
+    if [ "$UPDATE" = 1 ]; then
+        python - "$GATE_BASELINE" "$NEW" <<'PY'
+import json, sys
+baseline_path, new_path = sys.argv[1:3]
+try:
+    base = json.load(open(baseline_path))
+except (OSError, ValueError):
+    base = {}
+base.update(json.load(open(new_path)))
+json.dump(base, open(baseline_path, "w"), indent=2, sort_keys=True)
+PY
+        echo "[$GATE_NAME] baseline section updated in: $GATE_BASELINE" >&2
+    fi
+    echo "[$GATE_NAME] failures: $FAIL" >&2
+    exit "$FAIL"
+}

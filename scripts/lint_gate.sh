@@ -17,51 +17,33 @@
 #     scripts/lint_gate.sh --update
 # Exit code: number of failed presets (0 = gate passes).
 cd "$(dirname "$0")/.." || exit 1
-export JAX_PLATFORMS=cpu
-export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
-BASELINE="scripts/LINT_BASELINE.json"
-UPDATE=0
-[ "$1" = "--update" ] && UPDATE=1
-FAIL=0
-NEW="$(mktemp)"
-trap 'rm -f "$NEW"' EXIT
-echo "{}" > "$NEW"
+GATE_NAME=lint_gate
+GATE_BASELINE="scripts/LINT_BASELINE.json"
+. scripts/gate_lib.sh
+gate_init "$@"
 
 check() {  # check <preset> <timeout-s> <extra bench args...>
     local preset="$1" budget="$2"; shift 2
-    echo "[lint_gate] $preset" >&2
-    local line
-    if ! line=$(timeout -k 10 "$budget" python bench.py --preset "$preset" \
-                --device cpu --lint "$@" 2>/dev/null); then
-        echo "[lint_gate] $preset: FAILED (bench rc=$?)" >&2
-        FAIL=$((FAIL + 1))
-        return
-    fi
-    python - "$preset" "$BASELINE" "$NEW" "$UPDATE" <<PY || FAIL=$((FAIL + 1))
-import json, sys
+    gate_bench "$preset" "$budget" --lint "$@" || return
+    gate_diff "$preset" <<PY
+import json, os, sys
+exec(os.environ["GATE_PY_COMMON"])
 preset, baseline_path, new_path, update = sys.argv[1:5]
-line = """$line"""
-result = json.loads(line.strip().splitlines()[-1])
+line = """$GATE_LINE"""
+result = gate_result(line)
 codes = result.get("lint_codes")
 if codes is None:
     err = result.get("lint_error", "no lint_codes in BENCH line")
     print(f"[lint_gate] {preset}: FAILED ({err})", file=sys.stderr)
     sys.exit(1)
-new = json.load(open(new_path))
-new[preset] = {"lint_codes": codes,
-               "lint_findings": result.get("lint_findings", 0)}
-json.dump(new, open(new_path, "w"), indent=2, sort_keys=True)
+gate_record(new_path, preset, {
+    "lint_codes": codes, "lint_findings": result.get("lint_findings", 0)})
 if int(update):
     print(f"[lint_gate] {preset}: {codes or 'clean'} (recorded)",
           file=sys.stderr)
     sys.exit(0)
-try:
-    base = json.load(open(baseline_path))[preset]["lint_codes"]
-except (OSError, KeyError, ValueError):
-    print(f"[lint_gate] {preset}: FAILED (no baseline entry — run "
-          f"scripts/lint_gate.sh --update and commit {baseline_path})",
-          file=sys.stderr)
-    sys.exit(1)
+base = gate_base(baseline_path, preset, "lint_gate",
+                 "scripts/lint_gate.sh")["lint_codes"]
 GATED = ("unintended-collective", "donation-miss")
 bad = [c for c in GATED if codes.get(c, 0) > base.get(c, 0)]
 info = {c: n for c, n in codes.items() if n != base.get(c, 0)}
@@ -85,9 +67,6 @@ check serve  600
 check small  600 --audit-only
 check base   900 --audit-only
 
-if [ "$UPDATE" = 1 ]; then
-    cp "$NEW" "$BASELINE"
-    echo "[lint_gate] baseline updated: $BASELINE" >&2
-fi
-echo "[lint_gate] failures: $FAIL" >&2
-exit "$FAIL"
+# the baseline file is shared with schedule_gate's host_lint section:
+# merge our preset keys instead of replacing the file
+gate_finish_merge

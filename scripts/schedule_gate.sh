@@ -1,0 +1,117 @@
+#!/bin/bash
+# Pipeline-schedule & host-concurrency gate.  Two checks, no bench runs:
+#
+#   1. schedule matrix — build_schedule over the supported kinds
+#      (GPipe/1F1B/ZB/VPP at several S,M) and lint_schedule each one.
+#      The generator must produce verifier-clean schedules: any finding
+#      (deadlock, missing comm edge, F/B order, tick count, stash
+#      watermark) fails the gate outright — there is no "acceptable"
+#      count to baseline.
+#   2. host self-lint — paddle_tpu.analysis.host_lint over the shipped
+#      host-side distributed tree, diffed against the "host_lint" section
+#      of scripts/LINT_BASELINE.json.  Any finding code that GAINS vs the
+#      committed baseline fails the gate.
+#
+# Defect injection (verifies the gate actually trips; never set in CI):
+#     SCHEDULE_GATE_INJECT=cooldown    truncate every schedule by one tick
+#     SCHEDULE_GATE_INJECT=drop-edge   drop a stage's ppermute edges
+#     SCHEDULE_GATE_INJECT=host        lint an extra seeded-defect source
+#
+# Other modes:
+#     scripts/schedule_gate.sh --update    refresh the host_lint baseline
+#     scripts/schedule_gate.sh --measure   run the compiled 1F1B pipeline
+#                                          and print predicted-vs-measured
+#                                          bubble rows (pp=2 and pp=4)
+# Exit code: number of failed checks (0 = gate passes).
+cd "$(dirname "$0")/.." || exit 1
+GATE_NAME=schedule_gate
+GATE_BASELINE="scripts/LINT_BASELINE.json"
+. scripts/gate_lib.sh
+
+if [ "$1" = "--measure" ]; then
+    export XLA_FLAGS="--xla_force_host_platform_device_count=8${XLA_FLAGS:+ $XLA_FLAGS}"
+    exec python - <<'PY'
+import sys
+from paddle_tpu.analysis.schedule_lint import measure_bubble_fraction
+
+for S, M in ((2, 4), (4, 8)):
+    r = measure_bubble_fraction(n_stages=S, n_micro=M)
+    print(f"[schedule_gate] 1F1B pp={S} M={M}: predicted "
+          f"{r['predicted']:.4f} measured {r['measured']:.4f} "
+          f"rel_err {r['rel_err']:.3f}", file=sys.stderr)
+PY
+fi
+
+gate_init "$@"
+
+echo "[schedule_gate] schedule matrix" >&2
+gate_diff schedule_matrix <<'PY'
+import dataclasses, json, os, sys
+exec(os.environ["GATE_PY_COMMON"])
+preset, baseline_path, new_path, update = sys.argv[1:5]
+from paddle_tpu.analysis.schedule_lint import build_schedule, lint_schedule
+
+MATRIX = [("GPipe", 2, 4, 1), ("GPipe", 4, 8, 1),
+          ("1F1B", 2, 4, 1), ("1F1B", 4, 8, 1), ("1F1B", 8, 16, 1),
+          ("ZB", 2, 4, 1), ("ZB", 4, 8, 1),
+          ("VPP", 2, 4, 2), ("VPP", 4, 8, 2)]
+inject = os.environ.get("SCHEDULE_GATE_INJECT", "")
+dirty = 0
+for kind, S, M, V in MATRIX:
+    sched = build_schedule(kind, S, M, virtual_pp_degree=V)
+    if inject == "cooldown":
+        sched = dataclasses.replace(sched, total_ticks=sched.total_ticks - 1)
+    elif inject == "drop-edge":
+        sched = dataclasses.replace(
+            sched,
+            edges=[e for e in sched.edges if not (e.comm and e.src[2] == 1)])
+    counts = lint_schedule(sched).counts()
+    if counts:
+        dirty += 1
+        print(f"[schedule_gate] {kind} S={S} M={M} V={V}: {dict(counts)}",
+              file=sys.stderr)
+if dirty:
+    print(f"[schedule_gate] schedule matrix: FAILED "
+          f"({dirty}/{len(MATRIX)} schedules carry findings)",
+          file=sys.stderr)
+    sys.exit(1)
+print(f"[schedule_gate] schedule matrix: OK ({len(MATRIX)} schedules clean)",
+      file=sys.stderr)
+PY
+
+echo "[schedule_gate] host self-lint" >&2
+gate_diff host_lint <<'PY'
+import json, os, sys
+exec(os.environ["GATE_PY_COMMON"])
+preset, baseline_path, new_path, update = sys.argv[1:5]
+from paddle_tpu.analysis.host_lint import lint_source, lint_tree
+
+rep = lint_tree()
+if os.environ.get("SCHEDULE_GATE_INJECT", "") == "host":
+    rep.extend(lint_source(
+        "def peers(store):\n    return store.get('peers')\n", "injected.py"))
+codes = dict(rep.counts())
+gate_record(new_path, preset,
+            {"host_codes": codes, "host_findings": sum(codes.values())})
+if int(update):
+    print(f"[schedule_gate] host self-lint: {codes or 'clean'} (recorded)",
+          file=sys.stderr)
+    sys.exit(0)
+base = gate_base(baseline_path, preset, "schedule_gate",
+                 "scripts/schedule_gate.sh")["host_codes"]
+bad = {c: (base.get(c, 0), n) for c, n in codes.items()
+       if n > base.get(c, 0)}
+if bad:
+    deltas = ", ".join(f"{c}: {o} -> {n}" for c, (o, n) in bad.items())
+    for f in rep.ranked():
+        print(f"[schedule_gate] {f.line()}", file=sys.stderr)
+    print(f"[schedule_gate] host self-lint: FAILED ({deltas})",
+          file=sys.stderr)
+    sys.exit(1)
+print(f"[schedule_gate] host self-lint: OK {codes or 'clean'}",
+      file=sys.stderr)
+PY
+
+# host_lint shares LINT_BASELINE.json with lint_gate's presets: merge our
+# section instead of replacing the file
+gate_finish_merge

@@ -197,6 +197,44 @@ def test_expected_collectives_mixes_kinds_and_pairs():
     assert got == {"all-reduce", "all-gather"}
 
 
+def test_transition_tuple_entries():
+    """Multi-axis tuple entries expand per axis; pins the empirically
+    observed GSPMD behavior for identity reshards on the 2x4 CPU mesh
+    (expected kinds must be a superset of what GSPMD emits)."""
+    sizes = {"x": 2, "y": 4}
+    kinds = lambda ts: sorted(t.kind for t in ts if t.is_communication)
+
+    # drop the tuple's inner axis: pure all-gather (GSPMD: all-gather)
+    assert kinds(transition(P(("x", "y")), P("x"), ndim=1,
+                            axis_sizes=sizes, nbytes=64)) == ["all-gather"]
+    # drop the OUTER axis: the survivor's tile position changes
+    # (GSPMD: all-gather + collective-permute)
+    assert kinds(transition(P(("x", "y")), P("y"), ndim=1,
+                            axis_sizes=sizes, nbytes=64)
+                 ) == ["all-gather", "collective-permute"]
+    # merge two dims' axes into one tuple: the moved axis is an
+    # all-to-all (GSPMD: all-to-all)
+    assert kinds(transition(P("x", "y"), P(("x", "y"), None), ndim=2,
+                            axis_sizes=sizes, nbytes=64)) == ["all-to-all"]
+    # move the whole tuple to another dim: all-to-all per axis
+    assert kinds(transition(P(("x", "y"), None), P(None, ("x", "y")),
+                            ndim=2, axis_sizes=sizes, nbytes=64)
+                 ) == ["all-to-all", "all-to-all"]
+    # add an OUTER axis next to a retained one: the retained axis's
+    # tiles move (GSPMD: collective-permute); adding INNER is local
+    assert kinds(transition(P("y"), P(("x", "y")), ndim=1,
+                            axis_sizes=sizes, nbytes=64)
+                 ) == ["collective-permute"]
+    assert kinds(transition(P("x"), P(("x", "y")), ndim=1,
+                            axis_sizes=sizes, nbytes=64)) == []
+    # same-dim axis REPLACEMENT: GSPMD exchanges tiles directly with a
+    # collective-permute; the all-gather stays as the upper bound so
+    # expected_collectives covers both strategies
+    assert kinds(transition(P("x"), P("y"), ndim=1,
+                            axis_sizes=sizes, nbytes=64)
+                 ) == ["all-gather", "collective-permute"]
+
+
 # ---------------------------------------------------------------------------
 # HLO text parsing (synthetic modules — no compile needed)
 

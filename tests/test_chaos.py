@@ -139,3 +139,93 @@ def test_chaos_replay_is_deterministic(tmp_path):
     assert outs[0] == outs[1]
     assert outs[0][0] == "resume-from 0"
     assert outs[0][-1].startswith("train-done")
+
+
+def test_scale_up_rejoin_at_generation_bump():
+    """Scale-UP rendezvous: a rejoining worker parks in request_join and is
+    admitted at the survivors' next grow_rendezvous bump — no fresh
+    generation (no full restart) required.  Two consecutive grow rounds
+    prove the bump counter keeps working."""
+    import threading
+    import time
+
+    from paddle_tpu.distributed.launch.rendezvous import (
+        grow_rendezvous, pending_joins, rendezvous, request_join)
+    from paddle_tpu.distributed.store import TCPStore
+
+    master = TCPStore("127.0.0.1", 0, world_size=2, is_master=True,
+                      timeout=30.0)
+    addr = f"127.0.0.1:{master.port}"
+    results, errs = {}, []
+
+    def join(i):
+        try:
+            results[i] = rendezvous(addr, nnodes=2, job_id="grow",
+                                    timeout=30.0)
+        except BaseException as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=join, args=(i,), daemon=True)
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not errs and len(results) == 2
+    by_rank = {r.rank: r for r in results.values()}
+    assert sorted(by_rank) == [0, 1]
+
+    def one_grow_round(base_by_rank, expect_n):
+        newcomer, errs2 = {}, []
+
+        def rejoin():
+            try:
+                newcomer["r"] = request_join(addr, job_id="grow",
+                                             timeout=30.0)
+            except BaseException as e:
+                errs2.append(e)
+
+        tn = threading.Thread(target=rejoin, daemon=True)
+        tn.start()
+        # survivors see the parked request before taking the round
+        deadline = time.monotonic() + 10.0
+        while pending_joins(base_by_rank[0].store, "grow") < 1:
+            assert time.monotonic() < deadline, "join request never parked"
+            time.sleep(0.02)
+
+        grown = {}
+
+        def grow(prev):
+            try:
+                grown[prev.rank] = grow_rendezvous(prev, timeout=30.0)
+            except BaseException as e:
+                errs2.append(e)
+
+        survivors = [threading.Thread(target=grow, args=(base_by_rank[r],),
+                                      daemon=True)
+                     for r in sorted(base_by_rank)]
+        for t in survivors:
+            t.start()
+        for t in survivors:
+            t.join(timeout=30.0)
+        tn.join(timeout=30.0)
+        assert not errs2, errs2
+        assert not tn.is_alive()
+
+        new_world = dict(grown)
+        new_world[newcomer["r"].rank] = newcomer["r"]
+        # survivors KEEP their ranks; the newcomer is appended after them
+        assert sorted(grown) == sorted(base_by_rank)
+        assert newcomer["r"].rank == expect_n - 1
+        assert all(r.nnodes == expect_n for r in new_world.values())
+        assert all(len(r.peers) == expect_n for r in new_world.values())
+        assert all(r.store.world_size == expect_n
+                   for r in new_world.values())
+        return new_world
+
+    world3 = one_grow_round(by_rank, expect_n=3)       # 2 -> 3
+    world4 = one_grow_round(world3, expect_n=4)        # 3 -> 4 (next bump)
+
+    for r in world4.values():
+        r.store.close()
+    master.close()

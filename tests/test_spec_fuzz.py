@@ -1,0 +1,154 @@
+"""Property tests: spec_algebra vs the collectives GSPMD actually inserts,
+and collective_match under random sequence perturbations.
+
+The contract under test (the one the HLO lint depends on): for any
+declared resharding ``(src, dst)``, ``expected_collectives`` must be a
+SUPERSET of the collective kinds GSPMD emits for an identity jit with
+those in/out shardings — otherwise the lint would flag a declared
+resharding as ``unintended-collective``.
+
+A small seeded sample runs in tier-1; the exhaustive catalog sweep
+(121 ordered pairs on the 2x4 mesh) is marked ``slow``.
+"""
+
+import itertools
+import random
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.analysis.collective_match import (
+    CollectiveSig, collective_sequence, match_collectives)
+from paddle_tpu.analysis.spec_algebra import expected_collectives
+
+_COLL_RE = re.compile(
+    r"\s(all-gather|all-reduce|all-to-all|collective-permute|"
+    r"reduce-scatter)(?:-start)?\(")
+
+# every 2-dim spec over the 2x4 mesh using each axis at most once,
+# including multi-axis tuple entries in both orders
+_ENTRIES = [None, "x", "y", ("x", "y"), ("y", "x")]
+
+
+def _axes_of(e):
+    if e is None:
+        return set()
+    return {e} if isinstance(e, str) else set(e)
+
+
+_SPECS = [P(a, b) for a, b in itertools.product(_ENTRIES, _ENTRIES)
+          if not (_axes_of(a) & _axes_of(b))]
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("x", "y"))
+
+
+def _observed_kinds(mesh, src, dst):
+    x = jax.ShapeDtypeStruct((16, 16), jnp.float32)
+    f = jax.jit(lambda a: a,
+                in_shardings=NamedSharding(mesh, src),
+                out_shardings=NamedSharding(mesh, dst))
+    return set(_COLL_RE.findall(f.lower(x).compile().as_text()))
+
+
+def _assert_superset(mesh, pairs):
+    bad = []
+    for src, dst in pairs:
+        obs = _observed_kinds(mesh, src, dst)
+        exp = expected_collectives([(src, dst, 2)], mesh)
+        if not obs <= exp:
+            bad.append((src, dst, sorted(obs), sorted(exp)))
+    assert not bad, "\n".join(
+        f"{s} -> {d}: observed {o} not within expected {e}"
+        for s, d, o, e in bad)
+
+
+def test_expected_superset_sampled(mesh):
+    rng = random.Random(0)
+    pairs = [(rng.choice(_SPECS), rng.choice(_SPECS)) for _ in range(10)]
+    _assert_superset(mesh, pairs)
+
+
+@pytest.mark.slow
+def test_expected_superset_exhaustive(mesh):
+    _assert_superset(mesh, itertools.product(_SPECS, _SPECS))
+
+
+# ---------------------------------------------------------------------------
+# collective_match under perturbation (synthetic sequences — no compile)
+
+
+def _base_seq():
+    return [
+        CollectiveSig("all-gather", 4096, "{{0,1,2,3},{4,5,6,7}}"),
+        CollectiveSig("all-reduce", 1024, ""),
+        CollectiveSig("collective-permute", 2048, ""),
+        CollectiveSig("reduce-scatter", 512, "{{0,1,2,3},{4,5,6,7}}"),
+    ]
+
+
+def _perturb(rng, seq):
+    """One random rank-divergence: drop, kind flip, group flip, or byte
+    flip.  Every one must be caught."""
+    seq = list(seq)
+    i = rng.randrange(len(seq))
+    mode = rng.choice(["drop", "kind", "groups", "bytes"])
+    if mode == "drop":
+        del seq[i]
+    elif mode == "kind":
+        old = seq[i]
+        new_kind = "all-to-all" if old.kind != "all-to-all" else "all-gather"
+        seq[i] = CollectiveSig(new_kind, old.bytes, old.groups)
+    elif mode == "groups":
+        old = seq[i]
+        seq[i] = CollectiveSig(old.kind, old.bytes, "{{0,1},{2,3}}")
+    else:
+        old = seq[i]
+        seq[i] = CollectiveSig(old.kind, old.bytes * 2, old.groups)
+    return seq, mode
+
+
+def test_match_identical_ranks_clean():
+    base = _base_seq()
+    rep = match_collectives([base, list(base), list(base)])
+    assert not rep.counts()
+
+
+def test_match_catches_every_perturbation():
+    rng = random.Random(1)
+    for trial in range(32):
+        base = _base_seq()
+        mutated, mode = _perturb(rng, base)
+        rep = match_collectives({"r0": base, "r1": mutated})
+        assert rep.counts().get("collective-mismatch", 0) >= 1, (
+            f"trial {trial}: perturbation {mode!r} not caught")
+
+
+def test_collective_sequence_scans_all_computations():
+    # collectives inside non-ENTRY computations (scan/while bodies) must
+    # be part of the rank signature
+    hlo = """\
+HloModule m, num_partitions=8
+
+%body (p: f32[8]) -> f32[8] {
+  %p = f32[8]{0} parameter(0)
+  %ar = f32[8]{0} all-reduce(f32[8]{0} %p), replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add
+  ROOT %out = f32[8]{0} copy(f32[8]{0} %ar)
+}
+
+ENTRY %main (a: f32[8]) -> f32[8] {
+  %a = f32[8]{0} parameter(0)
+  ROOT %w = f32[8]{0} while(f32[8]{0} %a), condition=%cond, body=%body
+}
+"""
+    seq = collective_sequence(hlo)
+    assert [s.kind for s in seq] == ["all-reduce"]
+    assert seq[0].bytes == 32

@@ -61,6 +61,10 @@ code                      level  meaning
 ``host-blocking-under-lock``  host  blocking store op while holding a lock —
                                  a network stall serializes every other
                                  thread behind it
+``reshard-unbounded``     plan   a resharding plan fell back to the
+                                 all-gather last resort (or broke the
+                                 2x-shard peak bound) — the move
+                                 materializes the full array per device
 ========================  =====  ========================================
 
 Severity is ``high`` / ``medium`` / ``low``; ranking is by severity first,

@@ -49,9 +49,10 @@ of backward (explain an observed collective).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Iterable, List, Mapping, Sequence, Set, Tuple
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Set, Tuple
 
-__all__ = ["Transfer", "normalize_spec", "transition", "expected_collectives"]
+__all__ = ["Transfer", "AxisTransition", "normalize_spec", "axis_transitions",
+           "transition", "expected_collectives"]
 
 
 @dataclass(frozen=True)
@@ -92,6 +93,58 @@ def _axis_dims(norm: Sequence[Tuple[str, ...]]) -> Dict[str, Tuple[int, int]]:
     return out
 
 
+@dataclass(frozen=True)
+class AxisTransition:
+    """How one mesh axis participates in a ``src`` -> ``dst`` resharding.
+
+    ``kind`` is one of ``"kept"`` (same dim, same tuple position),
+    ``"reordered"`` (same dim, different position), ``"moved"`` (different
+    dim), ``"removed"`` (in src only), ``"added"`` (in dst only) or
+    ``"partial"`` (a pending reduction over the axis).  ``src_pos`` /
+    ``dst_pos`` are ``(dim, position-in-tuple)`` or ``None`` when the axis
+    is absent on that side.
+    """
+
+    axis: str
+    kind: str
+    src_pos: Optional[Tuple[int, int]]
+    dst_pos: Optional[Tuple[int, int]]
+
+
+def axis_transitions(src, dst, *, ndim: int,
+                     src_partial: Iterable[str] = ()) -> List[AxisTransition]:
+    """Classify every mesh axis touched by the resharding.
+
+    This is the structured form of the table in the module docstring: the
+    HLO lint runs it backward through :func:`transition` to explain
+    observed collectives, and the resharding planner
+    (``distributed/resharding/planner.py``) runs it forward to choose
+    them.  Order: partials, then src axes dim-major, then added dst axes
+    dim-major.
+    """
+    s = _axis_dims(normalize_spec(src, ndim))
+    d = _axis_dims(normalize_spec(dst, ndim))
+    partial = set(src_partial)
+    out: List[AxisTransition] = []
+    for a in src_partial:
+        out.append(AxisTransition(a, "partial", None, d.get(a)))
+    for a, spos in s.items():
+        if a in partial:
+            continue
+        if a not in d:
+            out.append(AxisTransition(a, "removed", spos, None))
+        elif d[a][0] != spos[0]:
+            out.append(AxisTransition(a, "moved", spos, d[a]))
+        elif d[a][1] != spos[1]:
+            out.append(AxisTransition(a, "reordered", spos, d[a]))
+        else:
+            out.append(AxisTransition(a, "kept", spos, d[a]))
+    for a, dpos in d.items():
+        if a not in s and a not in partial:
+            out.append(AxisTransition(a, "added", None, dpos))
+    return out
+
+
 def transition(src, dst, *, ndim: int, axis_sizes: Mapping[str, int],
                nbytes: int, src_partial: Iterable[str] = ()) -> List[Transfer]:
     """Collectives implied by resharding an ``ndim``-dim array of global
@@ -100,36 +153,31 @@ def transition(src, dst, *, ndim: int, axis_sizes: Mapping[str, int],
     ``src_partial`` lists mesh axes carrying an unreduced partial sum in
     ``src`` (the state after a contraction over a sharded dimension).
     """
-    s = _axis_dims(normalize_spec(src, ndim))
-    d = _axis_dims(normalize_spec(dst, ndim))
-    partial = set(src_partial)
     out: List[Transfer] = []
-
-    for a in partial:  # pending reductions resolve first
-        kind = "reduce-scatter" if a in d else "all-reduce"
-        out.append(Transfer(kind, a, nbytes))
-
     removed_dims: Set[int] = set()
-    for a, (sdim, spos) in s.items():
-        if a in partial:
-            continue
-        if a not in d:
-            out.append(Transfer("all-gather", a, nbytes))
-            removed_dims.add(sdim)
-        elif d[a][0] != sdim:
-            out.append(Transfer("all-to-all", a, nbytes))
-        elif d[a][1] != spos:
-            out.append(Transfer("collective-permute", a, nbytes))
-    for a, (ddim, _) in d.items():
-        if a not in s and a not in partial:
-            if ddim in removed_dims:
-                # replacement: an axis left this dim while `a` arrived —
-                # GSPMD reshards tile-to-tile with a collective-permute
-                # (observed empirically, e.g. P('x') -> P('y')); the
-                # all-gather above stays as the fallback upper bound
-                out.append(Transfer("collective-permute", a, nbytes))
-            else:
-                out.append(Transfer("slice", a, 0))
+    adds: List[AxisTransition] = []
+    for t in axis_transitions(src, dst, ndim=ndim, src_partial=src_partial):
+        if t.kind == "partial":  # pending reductions resolve first
+            kind = "reduce-scatter" if t.dst_pos is not None else "all-reduce"
+            out.append(Transfer(kind, t.axis, nbytes))
+        elif t.kind == "removed":
+            out.append(Transfer("all-gather", t.axis, nbytes))
+            removed_dims.add(t.src_pos[0])
+        elif t.kind == "moved":
+            out.append(Transfer("all-to-all", t.axis, nbytes))
+        elif t.kind == "reordered":
+            out.append(Transfer("collective-permute", t.axis, nbytes))
+        elif t.kind == "added":
+            adds.append(t)
+    for t in adds:  # classified after ALL removals are known
+        if t.dst_pos[0] in removed_dims:
+            # replacement: an axis left this dim while `t.axis` arrived —
+            # GSPMD reshards tile-to-tile with a collective-permute
+            # (observed empirically, e.g. P('x') -> P('y')); the
+            # all-gather above stays as the fallback upper bound
+            out.append(Transfer("collective-permute", t.axis, nbytes))
+        else:
+            out.append(Transfer("slice", t.axis, 0))
     return out
 
 

@@ -292,7 +292,11 @@ def save_state_dict(state_dict, path: str, process_group=None, coordinator_rank:
 
 
 def _read_region(chunk_arrays, chunks, offset, shape, dtype):
-    """Assemble the region [offset, offset+shape) from overlapping chunks."""
+    """Assemble the region [offset, offset+shape) from overlapping chunks.
+
+    Legacy eager path (all chunk arrays pre-loaded); ``load_state_dict``
+    now streams through ``resharding.filestream`` instead, which never
+    holds more than one chunk alongside the shard being built."""
     out = np.zeros(shape, dtype=dtype)
     covered = np.zeros(shape, dtype=bool)
     lo = np.array(offset)
@@ -314,48 +318,56 @@ def _read_region(chunk_arrays, chunks, offset, shape, dtype):
     return out
 
 
-def load_state_dict(state_dict, path: str, process_group=None, coordinator_rank: int = 0):
+def load_state_dict(state_dict, path: str, process_group=None,
+                    coordinator_rank: int = 0, prefer_files=(), stats=None):
     """Load into ``state_dict`` IN PLACE, resharding to each tensor's current
     placement (cross-topology: the save and load meshes may differ).
 
     Tensors in ``state_dict`` define the target shapes/shardings (reference
-    load_state_dict.py contract).
+    load_state_dict.py contract).  Region assembly streams through
+    ``resharding.filestream``: per target shard, only the overlapping
+    chunks are read (one at a time), never the full tensor.
+
+    ``prefer_files`` biases which replica satisfies overlapping chunks
+    (e.g. the resuming rank's ``prev_rank`` file after an elastic
+    shrink).  ``stats``, if a dict, is filled with the modeled peak
+    read memory: ``peak_bytes`` / ``bound_bytes`` / ``bounded`` /
+    ``tensors`` / ``reads``.
     """
+    from ..resharding.filestream import (ChunkRef, plan_file_reshard,
+                                         read_shard)
+
     with open(os.path.join(path, _METADATA_FILE), "rb") as f:
         meta: Metadata = pickle.load(f)
 
     # lazily open each rank file once
     files: Dict[str, np.lib.npyio.NpzFile] = {}
 
-    def chunk_arrays_for(chunks, dtype_name):
-        out = {}
-        for c in chunks:
-            try:
-                if c.file_name not in files:
-                    files[c.file_name] = np.load(os.path.join(path, c.file_name))
-                raw = files[c.file_name][c.key]
-            except CheckpointCorruptionError:
-                raise
-            except (OSError, KeyError, ValueError, zlib.error,
-                    zipfile.BadZipFile) as e:
-                # a shard the container itself cannot decode (npz zip CRC,
-                # truncated archive, missing member) is the same condition
-                # our manifest CRC guards against: classify it as corruption
-                # so CheckpointManager.resume quarantines the step instead of
-                # retrying it forever
+    def fetch_chunk(c, crc_want, dtype_name):
+        try:
+            if c.file_name not in files:
+                files[c.file_name] = np.load(os.path.join(path, c.file_name))
+            raw = files[c.file_name][c.key]
+        except CheckpointCorruptionError:
+            raise
+        except (OSError, KeyError, ValueError, zlib.error,
+                zipfile.BadZipFile) as e:
+            # a shard the container itself cannot decode (npz zip CRC,
+            # truncated archive, missing member) is the same condition
+            # our manifest CRC guards against: classify it as corruption
+            # so CheckpointManager.resume quarantines the step instead of
+            # retrying it forever
+            raise CheckpointCorruptionError(
+                f"shard {c.file_name} of checkpoint {path} is unreadable "
+                f"({e}) — treating as corrupt") from e
+        if crc_want is not None:  # pre-integrity manifests: None
+            got = zlib.crc32(np.ascontiguousarray(raw).tobytes())
+            if got != crc_want:
                 raise CheckpointCorruptionError(
-                    f"shard {c.file_name} of checkpoint {path} is unreadable "
-                    f"({e}) — treating as corrupt") from e
-            want = getattr(c, "crc32", None)  # pre-integrity manifests: None
-            if want is not None:
-                got = zlib.crc32(np.ascontiguousarray(raw).tobytes())
-                if got != want:
-                    raise CheckpointCorruptionError(
-                        f"chunk {c.key!r} in {c.file_name} failed CRC "
-                        f"verification (manifest {want:#010x}, file "
-                        f"{got:#010x}) — checkpoint {path} is corrupt")
-            out[c.key] = _from_storage(raw, dtype_name)
-        return out
+                    f"chunk {c.key!r} in {c.file_name} failed CRC "
+                    f"verification (manifest {crc_want:#010x}, file "
+                    f"{got:#010x}) — checkpoint {path} is corrupt")
+        return _from_storage(raw, dtype_name)
 
     # (container, key) lets non-Tensor leaves be written back into the
     # CALLER's dict — rebinding only a local would silently leave the caller
@@ -372,23 +384,46 @@ def load_state_dict(state_dict, path: str, process_group=None, coordinator_rank:
 
     _flatten_targets(state_dict)
 
+    agg = {"tensors": 0, "reads": 0, "peak_bytes": 0, "bound_bytes": 0,
+           "bounded": True}
     for name, (container, key_in_container, target) in flat_targets.items():
         if name not in meta.state_dict_metadata:
             raise KeyError(f"tensor {name!r} not present in checkpoint {path}")
         info = meta.state_dict_metadata[name]
         chunks = info["chunks"]
-        arrays = chunk_arrays_for(chunks, info["dtype"])
         tgt_arr = target._data if isinstance(target, Tensor) else target
         if tuple(tgt_arr.shape) != tuple(info["global_shape"]):
             raise ValueError(f"{name}: target shape {tgt_arr.shape} != saved {info['global_shape']}")
         sharding = tgt_arr.sharding
 
-        def cb(index, _chunks=chunks, _arrays=arrays, _info=info):
-            offset, shape = _slices_to_offset_shape(index, _info["global_shape"])
-            region = _read_region(_arrays, _chunks, offset, shape, np.dtype(_info["dtype"]))
-            return region
+        refs, crcs = [], {}
+        for c in chunks:
+            ref = ChunkRef(c.file_name, c.key, tuple(c.global_offset),
+                           tuple(c.local_shape))
+            refs.append(ref)
+            crcs[(c.file_name, c.key)] = getattr(c, "crc32", None)
+        gshape = tuple(info["global_shape"])
+        regions = sorted({_slices_to_offset_shape(idx, gshape)
+                          for idx in sharding.addressable_devices_indices_map(
+                              gshape).values()})
+        plan = plan_file_reshard(name, refs, gshape, info["dtype"], regions,
+                                 prefer_files=prefer_files)
+        agg["tensors"] += 1
+        agg["reads"] += sum(len(p.reads) for p in plan.programs.values())
+        agg["peak_bytes"] = max(agg["peak_bytes"], plan.peak_bytes)
+        agg["bound_bytes"] = max(agg["bound_bytes"], plan.bound_bytes)
+        agg["bounded"] = agg["bounded"] and plan.bounded
 
-        new_arr = jax.make_array_from_callback(tuple(info["global_shape"]), sharding, cb)
+        def cb(index, _plan=plan, _info=info, _crcs=crcs):
+            offset, shape = _slices_to_offset_shape(index, _info["global_shape"])
+            program = _plan.programs[(offset, shape)]
+            return read_shard(
+                program,
+                lambda r: fetch_chunk(r, _crcs[(r.file_name, r.key)],
+                                      _info["dtype"]),
+                np.dtype(_info["dtype"]))
+
+        new_arr = jax.make_array_from_callback(gshape, sharding, cb)
         new_arr = new_arr.astype(tgt_arr.dtype)
         if isinstance(target, Tensor):
             target._data = new_arr
@@ -396,4 +431,6 @@ def load_state_dict(state_dict, path: str, process_group=None, coordinator_rank:
             container[key_in_container] = new_arr
     for f in files.values():
         f.close()
+    if isinstance(stats, dict):
+        stats.update(agg)
     return state_dict

@@ -59,12 +59,13 @@ RNG streams so every chaos run replays identically.
 
 from .detector import STORE_LOST, HeartbeatFailureDetector  # noqa: F401
 from .injection import FaultInjector, get_injector, set_injector  # noqa: F401
-from .policy import Deadline, RetryPolicy, retry_call  # noqa: F401
+from .policy import (Deadline, HeartbeatConfig, RetryPolicy,  # noqa: F401
+                     heartbeat_config, retry_call)
 
 __all__ = [
-    "Deadline", "FaultInjector", "HeartbeatFailureDetector", "RetryPolicy",
-    "STORE_LOST", "get_injector", "guard_host_collectives", "retry_call",
-    "set_injector",
+    "Deadline", "FaultInjector", "HeartbeatConfig", "HeartbeatFailureDetector",
+    "RetryPolicy", "STORE_LOST", "get_injector", "guard_host_collectives",
+    "heartbeat_config", "retry_call", "set_injector",
 ]
 
 

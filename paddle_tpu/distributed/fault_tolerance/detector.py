@@ -33,20 +33,25 @@ STORE_LOST = -1
 
 class HeartbeatFailureDetector:
     def __init__(self, store, rank: int, nnodes: int, job_id: str = "default",
-                 interval: float = 5.0, ttl: Optional[float] = None,
+                 interval: Optional[float] = None, ttl: Optional[float] = None,
                  monitor: Optional[bool] = None):
+        from .policy import heartbeat_config
+
         self.store = store
         self.rank = int(rank)
         self.nnodes = int(nnodes)
         self.job_id = job_id
-        self.interval = float(interval)
-        self.ttl = float(ttl) if ttl else 3.0 * self.interval
+        # interval/ttl default to the validated FLAGS_ft_heartbeat_interval
+        # / FLAGS_ft_lease_ttl surface (policy.heartbeat_config)
+        cfg = heartbeat_config(interval, ttl)
+        self.interval = cfg.interval
+        self.ttl = cfg.ttl
         self.monitor = (self.rank == 0) if monitor is None else bool(monitor)
         # liveness probes are bounded at heartbeat scale, NOT the store's
         # rendezvous-scale default timeout: once the master dies, a probe
         # that waits out a 300s op deadline (holding the client lock) makes
         # detection orders of magnitude slower than the ttl it enforces
-        self.op_timeout = max(2.0, 2.0 * self.interval)
+        self.op_timeout = cfg.op_timeout
         self.STORE_LOST = STORE_LOST
         self._stop: Optional[threading.Event] = None
         self._threads: List[threading.Thread] = []

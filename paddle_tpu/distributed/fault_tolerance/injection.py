@@ -38,10 +38,12 @@ __all__ = ["FaultInjector", "get_injector", "set_injector"]
 class FaultInjector:
     def __init__(self, seed: int = 0, crash_step: int = -1,
                  crash_rank: int = -1, store_drop_rate: float = 0.0,
-                 store_delay_ms: int = 0, corrupt_step: int = -1):
+                 store_delay_ms: int = 0, corrupt_step: int = -1,
+                 crash_signal: int = 0):
         self.seed = int(seed)
         self.crash_step = int(crash_step)
         self.crash_rank = int(crash_rank)
+        self.crash_signal = int(crash_signal)
         self.store_drop_rate = float(store_drop_rate)
         self.store_delay_ms = int(store_delay_ms)
         self.corrupt_step = int(corrupt_step)
@@ -57,7 +59,8 @@ class FaultInjector:
                    crash_rank=flags.get_flag("ft_inject_crash_rank"),
                    store_drop_rate=flags.get_flag("ft_inject_store_drop_rate"),
                    store_delay_ms=flags.get_flag("ft_inject_store_delay_ms"),
-                   corrupt_step=flags.get_flag("ft_inject_corrupt_step"))
+                   corrupt_step=flags.get_flag("ft_inject_corrupt_step"),
+                   crash_signal=flags.get_flag("ft_inject_crash_signal"))
 
     def active(self) -> bool:
         return (self.crash_step >= 0 or self.store_drop_rate > 0.0
@@ -74,6 +77,14 @@ class FaultInjector:
         if self.crash_rank >= 0 and rank is not None and rank != self.crash_rank:
             return
         if int(os.environ.get("PADDLE_RESTART_COUNT", "0")) > 0:
+            return
+        if self.crash_signal > 0:
+            # a real preemption/OOM kill delivers a signal with NO cleanup
+            # (atexit, finally, buffered IO all skipped for SIGKILL) —
+            # strictly harsher than os._exit
+            print(f"[inject] signal {self.crash_signal} crash at step {step}",
+                  file=sys.stderr, flush=True)
+            os.kill(os.getpid(), self.crash_signal)
             return
         print(f"[inject] fail-stop crash at step {step}", file=sys.stderr,
               flush=True)

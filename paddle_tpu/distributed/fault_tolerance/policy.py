@@ -14,9 +14,68 @@ from __future__ import annotations
 
 import random
 import time
-from typing import Callable, Iterator, Optional, Tuple, Type
+from typing import Callable, Iterator, NamedTuple, Optional, Tuple, Type
 
-__all__ = ["RetryPolicy", "Deadline", "retry_call"]
+__all__ = ["RetryPolicy", "Deadline", "retry_call", "HeartbeatConfig",
+           "heartbeat_config"]
+
+
+class HeartbeatConfig(NamedTuple):
+    """Validated detection-latency knobs for the heartbeat failure
+    detector — the documented surface of ``FLAGS_ft_heartbeat_interval``
+    and ``FLAGS_ft_lease_ttl``.
+
+    - ``interval``: seconds between lease renewals (bounds: 0.05..300).
+    - ``ttl``: seconds a silent peer keeps its lease; must be at least
+      ``2 * interval`` so one delayed beat cannot evict a live peer
+      (flag value 0 means the 3x-interval default).
+    - ``op_timeout``: per-store-op budget derived from the interval, so
+      liveness probes stay bounded at heartbeat scale rather than the
+      rendezvous-scale default.
+
+    Worst-case detection latency is ``ttl + interval`` (a peer that died
+    right after renewing, observed by a sampler that just missed it).
+    """
+
+    interval: float
+    ttl: float
+    op_timeout: float
+
+
+#: validated bounds for FLAGS_ft_heartbeat_interval (seconds)
+HEARTBEAT_INTERVAL_BOUNDS = (0.05, 300.0)
+
+
+def heartbeat_config(interval: Optional[float] = None,
+                     ttl: Optional[float] = None) -> HeartbeatConfig:
+    """Resolve (and validate) the heartbeat knobs.
+
+    Explicit arguments win; ``None`` falls back to the flags.  Raises
+    ``ValueError`` on out-of-bounds values instead of letting a
+    mis-tuned job silently evict live peers.
+    """
+    from ...framework.flags import get_flag
+
+    if interval is None:
+        interval = float(get_flag("ft_heartbeat_interval"))
+    interval = float(interval)
+    lo, hi = HEARTBEAT_INTERVAL_BOUNDS
+    if not (lo <= interval <= hi):
+        raise ValueError(
+            f"FLAGS_ft_heartbeat_interval={interval} out of bounds "
+            f"[{lo}, {hi}]")
+    if ttl is None:
+        ttl = float(get_flag("ft_lease_ttl"))
+    ttl = float(ttl)
+    if ttl == 0.0:
+        ttl = 3.0 * interval
+    if ttl < 2.0 * interval:
+        raise ValueError(
+            f"FLAGS_ft_lease_ttl={ttl} must be >= 2x the heartbeat "
+            f"interval ({interval}) — one delayed beat would evict a "
+            f"live peer")
+    return HeartbeatConfig(interval=interval, ttl=ttl,
+                           op_timeout=max(2.0, 2.0 * interval))
 
 
 class Deadline:

@@ -26,7 +26,8 @@ import numpy as np
 from ..collective import get_rank, get_world_size, init_parallel_env
 from ..mesh import ProcessMesh, get_mesh, set_global_mesh
 from . import topology as tp_mod
-from .elastic import ELASTIC_EXIT_CODE, CheckpointManager, ElasticManager
+from .elastic import (ELASTIC_EXIT_CODE, CheckpointManager, ElasticManager,
+                      migrate_to_mesh)
 from .recompute import recompute
 from . import metrics  # noqa: F401  (fleet.metrics.sum/max/auc/... reductions)
 from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode
@@ -35,6 +36,7 @@ __all__ = ["init", "DistributedStrategy", "get_hybrid_communicate_group", "fleet
            "distributed_model", "distributed_optimizer", "HybridParallelOptimizer",
            "HybridCommunicateGroup", "CommunicateTopology", "ParallelMode", "recompute",
            "CheckpointManager", "ElasticManager", "ELASTIC_EXIT_CODE",
+           "migrate_to_mesh",
            "Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker", "UtilBase",
            "MultiSlotDataGenerator", "MultiSlotStringDataGenerator"]
 

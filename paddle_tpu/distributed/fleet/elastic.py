@@ -19,6 +19,7 @@ Usage (the loop a relaunched process can re-enter at any point)::
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import shutil
@@ -29,7 +30,8 @@ from ..checkpoint import (CheckpointCorruptionError, load_state_dict,
                           save_state_dict)
 from ..collective import barrier, get_rank
 
-__all__ = ["CheckpointManager", "ElasticManager", "ELASTIC_EXIT_CODE"]
+__all__ = ["CheckpointManager", "ElasticManager", "ELASTIC_EXIT_CODE",
+           "migrate_to_mesh"]
 
 # reference fleet/elastic/__init__.py:33
 ELASTIC_EXIT_CODE = 101
@@ -53,6 +55,9 @@ class CheckpointManager:
         self.keep = max(1, int(keep))
         self._last_async = None
         self._async_step = None
+        #: modeled read-peak stats of the last successful resume (dict
+        #: from load_state_dict: peak_bytes/bound_bytes/bounded/...)
+        self.last_reshard_stats = None
         os.makedirs(root, exist_ok=True)
 
     def _dir(self, step: int) -> str:
@@ -149,7 +154,29 @@ class CheckpointManager:
                 dst[k] = v
         return dst
 
-    def resume(self, target) -> int:
+    @staticmethod
+    def _shrink_prev_rank(peers):
+        """This rank's rank at the PREVIOUS topology, from the rendezvous
+        v2 shrink peer records (``peers`` arg, or the launcher-exported
+        ``PADDLE_SHRINK_PEERS`` / ``PADDLE_PREV_RANK`` env)."""
+        if peers is None:
+            raw = os.environ.get("PADDLE_SHRINK_PEERS")
+            if raw:
+                try:
+                    peers = json.loads(raw)
+                except ValueError:
+                    peers = None
+            if peers is None:
+                prev = os.environ.get("PADDLE_PREV_RANK")
+                return int(prev) if prev not in (None, "") else None
+        me = get_rank()
+        for p in peers or ():
+            if int(p.get("rank", -1)) == me:
+                prev = p.get("prev_rank")
+                return int(prev) if prev is not None else None
+        return None
+
+    def resume(self, target, peers=None) -> int:
         """Load the newest readable checkpoint into ``target`` IN PLACE.
 
         Returns the step to continue from (0 if no checkpoint).  A checkpoint
@@ -157,9 +184,20 @@ class CheckpointManager:
         to the previous one — the reference relaunch loop's behavior of
         retrying from the last intact save.  The target is only mutated after
         a load fully succeeds.
+
+        After an elastic shrink the checkpoint was written at the OLD
+        topology; the load streams each old shard onto this rank's new
+        placement through ``resharding.filestream``.  ``peers`` (or the
+        launcher's ``PADDLE_SHRINK_PEERS`` env) supplies rendezvous v2
+        shrink records so the rank's ``prev_rank`` file wins overlapping
+        replicas; the modeled read peak lands in
+        ``self.last_reshard_stats``.
         """
         from ...framework.tensor import Tensor
 
+        prev_rank = self._shrink_prev_rank(peers)
+        prefer = (f"{prev_rank}_0.distcp.npz",) if prev_rank is not None else ()
+        self.last_reshard_stats = None
         is_plain = isinstance(target, dict) or not hasattr(target, "state_dict")
         for step in reversed(self.complete_steps()):
             sd = self._state_of(target)
@@ -176,8 +214,10 @@ class CheckpointManager:
                         snap.append((v, v._data))
 
             _collect(work)
+            stats = {}
             try:
-                load_state_dict(work, self._dir(step))
+                load_state_dict(work, self._dir(step), prefer_files=prefer,
+                                stats=stats)
             except Exception as e:  # fall back to an older complete save
                 for t, old in snap:
                     t._data = old
@@ -190,8 +230,62 @@ class CheckpointManager:
                 self._write_back(target, work)
             elif hasattr(target, "set_state_dict"):
                 target.set_state_dict(work)
+            self.last_reshard_stats = stats
+            print(f"[reshard] resume step {step}: tensors={stats.get('tensors')}"
+                  f" reads={stats.get('reads')} peak={stats.get('peak_bytes')}B"
+                  f" bound={stats.get('bound_bytes')}B"
+                  f" bounded={stats.get('bounded')}"
+                  + (f" prefer={prefer[0]}" if prefer else ""),
+                  file=sys.stderr)
             return step
         return 0
+
+
+def migrate_to_mesh(target, dst_mesh):
+    """Live-state migration after a GRACEFUL shrink (no restart): move
+    every sharded jax Array leaf of ``target`` (a state dict, possibly
+    nested, with Tensor or jax.Array leaves) onto ``dst_mesh``, keeping
+    each leaf's PartitionSpec, through the resharding planner — the same
+    engine cold resume-from-checkpoint uses.  Leaves are replaced IN
+    PLACE; returns the modeled peak stats dict."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from ...framework.tensor import Tensor
+    from ..resharding import execute, plan_reshard
+    from ..resharding.planner import _mesh_eq
+
+    stats = {"arrays": 0, "peak_bytes": 0, "bound_bytes": 0, "bounded": True}
+
+    def visit(d):
+        for k, v in d.items():
+            if isinstance(v, dict):
+                visit(v)
+                continue
+            arr = v._data if isinstance(v, Tensor) else v
+            if not isinstance(arr, jax.Array):
+                continue
+            sh = arr.sharding
+            if not isinstance(sh, NamedSharding) or _mesh_eq(sh.mesh, dst_mesh):
+                continue
+            plan = plan_reshard(sh.mesh, sh.spec, dst_mesh, sh.spec,
+                                arr.shape, arr.dtype)
+            out = execute(plan, arr)
+            stats["arrays"] += 1
+            stats["peak_bytes"] = max(stats["peak_bytes"], plan.peak_bytes)
+            stats["bound_bytes"] = max(stats["bound_bytes"], plan.bound_bytes)
+            stats["bounded"] = stats["bounded"] and plan.bounded
+            if isinstance(v, Tensor):
+                v._data = out
+            else:
+                d[k] = out
+
+    sd = CheckpointManager._state_of(target)
+    if isinstance(sd, dict):
+        visit(sd)
+    if sd is not target and hasattr(target, "set_state_dict"):
+        target.set_state_dict(sd)
+    return stats
 
 
 class ElasticManager:
@@ -217,16 +311,18 @@ class ElasticManager:
     """
 
     def __init__(self, store, rank: int, nnodes: int, job_id: str = "default",
-                 interval: float = 5.0):
+                 interval: Optional[float] = None):
         from ..fault_tolerance.detector import HeartbeatFailureDetector
 
         self.store = store
         self.rank = int(rank)
         self.nnodes = int(nnodes)
         self.job_id = job_id
-        self.interval = float(interval)
+        # None defers to the validated FLAGS_ft_heartbeat_interval surface
+        # (fault_tolerance.policy.heartbeat_config)
         self.detector = HeartbeatFailureDetector(
             store, self.rank, self.nnodes, job_id=job_id, interval=interval)
+        self.interval = self.detector.interval
         self._stop = None
 
     #: pseudo-rank reported when the STORE itself (the coordinator node) is

@@ -62,9 +62,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "re-rendezvouses the SURVIVORS at the reduced node "
                         "count and relaunches trainers (graceful mesh "
                         "shrink; requires the store host to survive)")
-    p.add_argument("--heartbeat_interval", type=float, default=5.0,
+    p.add_argument("--heartbeat_interval", type=float, default=None,
                    help="seconds between membership heartbeats (lower = "
-                        "faster failure detection, more store traffic)")
+                        "faster failure detection, more store traffic); "
+                        "default: FLAGS_ft_heartbeat_interval (see "
+                        "fault_tolerance.policy.heartbeat_config for the "
+                        "validated bounds, FLAGS_ft_lease_ttl for the "
+                        "companion lease knob)")
     p.add_argument("--log_dir", default=None, help="write per-process logs here")
     p.add_argument("--job_id", default="default", help="job name for logs")
     p.add_argument("training_script", help="the training program")
@@ -86,6 +90,18 @@ def _child_env(args, local_rank: int, coordinator: Optional[str] = None) -> dict
     env["PADDLE_TRAINER_ID"] = str(proc_id)
     env["PADDLE_TRAINERS_NUM"] = str(world)
     env["PADDLE_LOCAL_RANK"] = str(local_rank)
+    # after a mesh shrink: rendezvous v2 peer records (rank/host/prev_rank)
+    # so CheckpointManager.resume can stream each rank's OLD shard file
+    # onto the new topology (distributed.resharding)
+    peers = getattr(args, "_shrink_peers", None)
+    if peers is not None:
+        import json
+
+        env["PADDLE_SHRINK_PEERS"] = json.dumps(peers)
+        mine = next((p for p in peers
+                     if int(p.get("rank", -1)) == args.rank), None)
+        if mine is not None and mine.get("prev_rank") is not None:
+            env["PADDLE_PREV_RANK"] = str(mine["prev_rank"])
     return env
 
 
@@ -269,6 +285,7 @@ def launch(args) -> int:
             invalidate_generation(rdzv.store, rdzv.job_id, rdzv.gen, dead)
             rdzv = shrink_rendezvous(rdzv, dead)
             args.rank, args.nnodes = rdzv.rank, rdzv.nnodes
+            args._shrink_peers = rdzv.peers  # exported via _child_env
             incarnation += 1
             # fresh PJRT coordination port per incarnation: the previous
             # service (on a possibly-dead host) must not be re-joined

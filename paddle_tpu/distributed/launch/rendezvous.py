@@ -195,7 +195,7 @@ def shrink_rendezvous(prev: RendezvousResult, dead_ranks: List[int],
     subgen, rank = divmod(joined, new_n)
     prefix = f"rdzv/{job_id}/{gen}/shrink/{subgen}"
     info = {"rank": rank, "host": socket.gethostname(),
-            "prev_rank": prev.rank}
+            "prev_rank": prev.rank, "prev_nnodes": prev.nnodes}
     store.set(f"{prefix}/node/{rank}", json.dumps(info))
     peers = _collect_peers(
         store, prefix, new_n, timeout,
@@ -313,7 +313,7 @@ def grow_rendezvous(prev: RendezvousResult,
         new_n = meta["nnodes"]
 
     info = {"rank": prev.rank, "host": socket.gethostname(),
-            "prev_rank": prev.rank}
+            "prev_rank": prev.rank, "prev_nnodes": prev.nnodes}
     store.set(f"{prefix}/node/{prev.rank}", json.dumps(info))
     peers = _collect_peers(
         store, prefix, new_n, timeout,

@@ -1,0 +1,25 @@
+"""Unified resharding engine: move a sharded array from layout A to
+layout B — live (collective program via shard_map) or file-backed
+(checkpoint shards streamed onto a new topology).
+
+- :mod:`planner` — ``plan_reshard``: spec_algebra's transition table run
+  forward into a bounded collective program (ROADMAP item 3).
+- :mod:`executor` — ``execute`` / ``reshard``: run the program on live
+  arrays, including the single cross-mesh ``remesh`` hop.
+- :mod:`filestream` — ``plan_file_reshard`` / ``read_shard``: resume a
+  checkpoint written at the old topology shard-by-shard, never
+  materializing a full replica on any host.
+- :mod:`audit` — ``python -m paddle_tpu.distributed.resharding.audit``:
+  the CI catalog sweep behind ``scripts/reshard_gate.sh``.
+"""
+
+from .planner import (PlanError, ReshardPlan, ReshardStep, plan_reshard,
+                      mesh_axis_sizes, shard_nbytes)
+from .executor import execute, reshard
+from .filestream import (ChunkReader, ChunkRef, FileReshardPlan, RegionRead,
+                         ShardProgram, plan_file_reshard, read_shard)
+
+__all__ = ["PlanError", "ReshardPlan", "ReshardStep", "plan_reshard",
+           "mesh_axis_sizes", "shard_nbytes", "execute", "reshard",
+           "ChunkReader", "ChunkRef", "FileReshardPlan", "RegionRead",
+           "ShardProgram", "plan_file_reshard", "read_shard"]

@@ -1,0 +1,101 @@
+"""Resharding-plan audit: sweep the spec catalog and report the worst
+modeled peak-memory ratio and whether every plan's collectives stay
+within spec_algebra's expected set.
+
+CLI (backs ``scripts/reshard_gate.sh``)::
+
+    python -m paddle_tpu.distributed.resharding.audit
+
+prints one JSON line::
+
+    {"n_plans": ..., "n_bounded": ..., "max_peak_ratio": ...,
+     "kinds_ok": ..., "planned_peak_bytes": ..., "gather_peak_bytes": ...}
+
+``max_peak_ratio`` is max over plans of ``peak / max(src_shard,
+dst_shard)`` — the gate fails above 2.0.  ``gather_peak_bytes`` is the
+peak of the gather-then-scatter baseline (full replica + shard) for the
+same worst-case pair, the number PERF.md compares against.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _catalog(mesh_cls, devices):
+    import numpy as np
+    devs = np.array(devices[:8]).reshape(2, 4)
+    full = mesh_cls(devs, ("x", "y"))
+    shrunk = [full,
+              mesh_cls(devs[:, :2].reshape(2, 2), ("x", "y")),
+              mesh_cls(devs[:, :1].reshape(2, 1), ("x", "y"))]
+    return full, shrunk
+
+
+def run_audit(shape=(256, 256), dtype="float32"):
+    import itertools
+
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ...analysis.spec_algebra import expected_collectives
+    from .planner import plan_reshard
+
+    if len(jax.devices()) < 8:
+        raise RuntimeError("audit needs 8 devices "
+                           "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    full, dst_meshes = _catalog(Mesh, jax.devices())
+
+    entries = [None, "x", "y", ("x", "y"), ("y", "x")]
+
+    def axes(e):
+        if e is None:
+            return set()
+        return {e} if isinstance(e, str) else set(e)
+
+    specs = [P(a, b) for a in entries for b in entries if not (axes(a) & axes(b))]
+
+    itemsize = np.dtype(dtype).itemsize
+    total = int(np.prod(shape)) * itemsize
+    n_plans = n_bounded = 0
+    max_ratio = 0.0
+    kinds_ok = True
+    worst_peak = 0
+    gather_peak = 0
+    for (src, dst), dmesh in itertools.product(
+            itertools.product(specs, specs), dst_meshes):
+        plan = plan_reshard(full, src, dmesh, dst, shape, dtype)
+        n_plans += 1
+        n_bounded += bool(plan.bounded)
+        denom = max(plan.src_shard_bytes, plan.dst_shard_bytes)
+        ratio = plan.peak_bytes / denom
+        if ratio > max_ratio:
+            max_ratio = ratio
+            worst_peak = plan.peak_bytes
+            # gather-then-scatter baseline: replicate, then slice
+            gather_peak = total + plan.dst_shard_bytes
+        if plan.collective_kinds() - expected_collectives([(src, dst, 2)],
+                                                          full):
+            kinds_ok = False
+    return {"n_plans": n_plans, "n_bounded": n_bounded,
+            "max_peak_ratio": round(max_ratio, 4), "kinds_ok": kinds_ok,
+            "planned_peak_bytes": worst_peak,
+            "gather_peak_bytes": gather_peak}
+
+
+def main(argv=None) -> int:
+    result = run_audit()
+    print(json.dumps(result, sort_keys=True))
+    ok = (result["max_peak_ratio"] <= 2.0 and result["kinds_ok"]
+          and result["n_bounded"] == result["n_plans"])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    sys.exit(main())

@@ -1,0 +1,187 @@
+"""Run a ``ReshardPlan`` on live jax arrays.
+
+Every collective step becomes one ``shard_map_compat.shard_map`` program
+(jit-compiled, cached per step signature), so the executor moves exactly
+the collectives the planner modeled — nothing is left for GSPMD to
+invent.  The single ``remesh`` step crosses meshes with
+``jax.make_array_from_callback``, assembling each destination shard from
+the overlapping *source* shards lazily (``shard.data[slices]`` before
+``np.asarray``), so no host ever materializes more than one destination
+shard plus the overlapping source region — the cross-mesh analogue of
+the 2x bound the collective steps keep on device.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+import jax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...analysis.spec_algebra import normalize_spec
+from ...framework.shard_map_compat import shard_map
+from .planner import ReshardPlan, ReshardStep, mesh_axis_sizes, plan_reshard
+
+__all__ = ["execute", "reshard"]
+
+
+def _pspec(norm) -> P:
+    return P(*(t if t else None for t in norm))
+
+
+def _permute_pairs(mesh, order_from: Tuple[str, ...],
+                   order_to: Tuple[str, ...]) -> List[Tuple[int, int]]:
+    """ppermute pairs realizing a tile-order change within one dim.
+
+    ppermute over axis tuple ``order_from`` indexes devices by major-first
+    linearization in that order; the device at combined coordinate ``c``
+    must end up holding the tile whose number is ``c`` linearized in the
+    *new* order — i.e. receive from the device whose old index equals
+    that number (validated on the 8-device CPU mesh)."""
+    sizes = mesh_axis_sizes(mesh)
+
+    def lin(order, coord):
+        i = 0
+        for a in order:
+            i = i * sizes[a] + coord[a]
+        return i
+
+    pairs = []
+    for c in itertools.product(*(range(sizes[a]) for a in order_from)):
+        coord = dict(zip(order_from, c))
+        pairs.append((lin(order_to, coord), lin(order_from, coord)))
+    return pairs
+
+
+def _step_body(step: ReshardStep):
+    sizes = mesh_axis_sizes(step.mesh)
+    kind = step.kind
+    if kind == "slice":
+        n, d, a = sizes[step.axis], step.dim, step.axis
+
+        def body(x):
+            blk = x.shape[d] // n
+            return lax.dynamic_slice_in_dim(x, lax.axis_index(a) * blk,
+                                            blk, d)
+    elif kind == "all-gather":
+        def body(x, a=step.axis, d=step.dim):
+            return lax.all_gather(x, a, axis=d, tiled=True)
+    elif kind == "all-to-all":
+        def body(x, a=step.axis, j=step.dim, i=step.src_dim):
+            return lax.all_to_all(x, a, split_axis=j, concat_axis=i,
+                                  tiled=True)
+    elif kind == "collective-permute":
+        pairs = _permute_pairs(step.mesh, step.order_from, step.order_to)
+
+        def body(x, a=tuple(step.order_from), p=pairs):
+            return lax.ppermute(x, a, p)
+    elif kind == "all-reduce":
+        def body(x, a=step.axis):
+            return lax.psum(x, a)
+    elif kind == "reduce-scatter":
+        def body(x, a=step.axis, d=step.dim):
+            return lax.psum_scatter(x, a, scatter_dimension=d, tiled=True)
+    else:
+        raise ValueError(f"no collective body for step kind {kind!r}")
+    return body
+
+
+_STEP_CACHE: Dict[tuple, object] = {}
+
+
+def _step_fn(step: ReshardStep):
+    key = (step.mesh, step.kind, step.axis, step.dim, step.src_dim,
+           step.order_from, step.order_to, step.spec_before, step.spec_after)
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(shard_map(_step_body(step), mesh=step.mesh,
+                               in_specs=_pspec(step.spec_before),
+                               out_specs=_pspec(step.spec_after),
+                               check_vma=False))
+        _STEP_CACHE[key] = fn
+    return fn
+
+
+def _dedup_shards(arr):
+    """One source shard per distinct global index (replicas carry copies)."""
+    seen, out = set(), []
+    for s in arr.addressable_shards:
+        key = tuple((sl.start, sl.stop) for sl in s.index)
+        if key not in seen:
+            seen.add(key)
+            out.append(s)
+    return out
+
+
+def _run_remesh(step: ReshardStep, arr):
+    dst = NamedSharding(step.mesh, _pspec(step.spec_after))
+    n_local = len(arr.addressable_shards)
+    n_global = arr.sharding.num_devices if hasattr(arr.sharding, "num_devices") \
+        else len(arr.sharding.device_set)
+    if n_local < n_global:
+        raise ValueError(
+            "remesh requires all source shards addressable from this "
+            "process (multi-host live migration must go through the "
+            "file-backed path: resharding.filestream)")
+    shards = _dedup_shards(arr)
+
+    def cb(index):
+        lo = [sl.start or 0 for sl in index]
+        hi = [sl.stop if sl.stop is not None else arr.shape[i]
+              for i, sl in enumerate(index)]
+        out = np.empty([h - l for l, h in zip(lo, hi)], dtype=arr.dtype)
+        for s in shards:
+            slo = [sl.start or 0 for sl in s.index]
+            shi = [sl.stop if sl.stop is not None else arr.shape[i]
+                   for i, sl in enumerate(s.index)]
+            olo = [max(a, b) for a, b in zip(lo, slo)]
+            ohi = [min(a, b) for a, b in zip(hi, shi)]
+            if any(a >= b for a, b in zip(olo, ohi)):
+                continue
+            src_sl = tuple(slice(a - b, c - b)
+                           for a, c, b in zip(olo, ohi, slo))
+            dst_sl = tuple(slice(a - b, c - b)
+                           for a, c, b in zip(olo, ohi, lo))
+            # slice BEFORE np.asarray so only the overlap leaves the device
+            out[dst_sl] = np.asarray(s.data[src_sl])
+        return out
+
+    return jax.make_array_from_callback(arr.shape, dst, cb)
+
+
+def execute(plan: ReshardPlan, arr):
+    """Run ``plan`` on ``arr`` and return the array in the destination
+    layout (on the destination mesh)."""
+    src = NamedSharding(plan.src_mesh,
+                        _pspec(normalize_spec(plan.src_spec,
+                                              len(plan.global_shape))))
+    if tuple(arr.shape) != tuple(plan.global_shape):
+        raise ValueError(f"array shape {arr.shape} != planned "
+                         f"{plan.global_shape}")
+    if not arr.sharding.is_equivalent_to(src, arr.ndim):
+        raise ValueError(f"array sharding {arr.sharding} != planned source "
+                         f"{src}")
+    x = arr
+    for step in plan.steps:
+        if step.kind == "remesh":
+            x = _run_remesh(step, x)
+        else:
+            x = _step_fn(step)(x)
+    return x
+
+
+def reshard(arr, dst_sharding, *, return_plan: bool = False):
+    """Plan + execute in one call: move ``arr`` to ``dst_sharding`` (a
+    ``NamedSharding``, possibly on a different/shrunken mesh)."""
+    src = arr.sharding
+    if not isinstance(src, NamedSharding):
+        raise TypeError(f"reshard needs a NamedSharding source, got "
+                        f"{type(src).__name__}")
+    plan = plan_reshard(src.mesh, src.spec, dst_sharding.mesh,
+                        dst_sharding.spec, arr.shape, arr.dtype)
+    out = execute(plan, arr)
+    return (out, plan) if return_plan else out

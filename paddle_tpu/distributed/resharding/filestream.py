@@ -1,0 +1,252 @@
+"""File-backed resharding: stream checkpoint shards written at one
+topology onto another, shard by shard.
+
+The distcp format (``distributed/checkpoint``) stores per-rank ``.npz``
+members plus chunk metadata ``(global_offset, local_shape, file_name)``.
+To resume on a *different* (e.g. shrunken) mesh, each surviving rank
+needs only the chunks overlapping its *new* shard — never the full
+tensor.  ``plan_file_reshard`` computes those overlaps up front (pure
+metadata, no I/O) as a ``FileReshardPlan`` with the same modeled
+peak-memory accounting as the live planner: per target shard, peak =
+shard bytes + the largest overlapping chunk held while copying, bounded
+by ``2 * max(chunk, shard)``.
+
+Coverage is verified at plan time by coordinate compression — the
+candidate boxes' own edges partition the region into cells that are each
+fully inside or outside every box — so no ``np.zeros(global_shape)``
+bitmap is ever allocated (for f32 that bitmap alone would break the 2x
+bound).
+"""
+
+from __future__ import annotations
+
+import zipfile
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ChunkRef", "RegionRead", "ShardProgram", "FileReshardPlan",
+           "plan_file_reshard", "read_shard", "ChunkReader"]
+
+Box = Tuple[Tuple[int, ...], Tuple[int, ...]]  # (lo, hi) corners
+
+
+def _corruption_error():
+    # lazy: checkpoint/__init__ imports this module, so the exception
+    # class stays defined there to avoid an import cycle
+    from ..checkpoint import CheckpointCorruptionError
+    return CheckpointCorruptionError
+
+
+@dataclass(frozen=True)
+class ChunkRef:
+    """One stored chunk of a tensor: where it lives in the global array
+    and which file/member holds its bytes."""
+
+    file_name: str
+    key: str                      # npz member name
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+
+    @property
+    def nbytes_of(self):
+        return int(np.prod(self.local_shape)) if self.local_shape else 1
+
+
+@dataclass(frozen=True)
+class RegionRead:
+    """Copy ``chunk[chunk_slices] -> shard[shard_slices]``."""
+
+    chunk: ChunkRef
+    chunk_slices: Tuple[Tuple[int, int], ...]
+    shard_slices: Tuple[Tuple[int, int], ...]
+
+
+@dataclass(frozen=True)
+class ShardProgram:
+    """Everything needed to materialize one destination shard."""
+
+    offset: Tuple[int, ...]
+    shape: Tuple[int, ...]
+    reads: Tuple[RegionRead, ...]
+    peak_bytes: int
+
+
+@dataclass
+class FileReshardPlan:
+    name: str
+    global_shape: Tuple[int, ...]
+    dtype_name: str
+    programs: Dict[Tuple[Tuple[int, ...], Tuple[int, ...]], ShardProgram] \
+        = field(default_factory=dict)
+    max_chunk_bytes: int = 0
+    max_shard_bytes: int = 0
+
+    @property
+    def peak_bytes(self) -> int:
+        return max((p.peak_bytes for p in self.programs.values()), default=0)
+
+    @property
+    def bound_bytes(self) -> int:
+        return 2 * max(self.max_chunk_bytes, self.max_shard_bytes, 1)
+
+    @property
+    def bounded(self) -> bool:
+        return self.peak_bytes <= self.bound_bytes
+
+
+def _covered(lo: Sequence[int], hi: Sequence[int],
+             boxes: Iterable[Box]) -> bool:
+    """Do ``boxes`` jointly cover the [lo, hi) region?  Coordinate
+    compression: clip, then test each cell of the grid induced by the
+    boxes' edges against every box — O(cells * boxes) with cells bounded
+    by the chunk count per dim, independent of element count."""
+    clipped = []
+    for blo, bhi in boxes:
+        clo = tuple(max(a, b) for a, b in zip(blo, lo))
+        chi = tuple(min(a, b) for a, b in zip(bhi, hi))
+        if all(a < b for a, b in zip(clo, chi)):
+            clipped.append((clo, chi))
+    if not clipped:
+        return all(a >= b for a, b in zip(lo, hi))  # empty region is covered
+    cuts = []
+    for d in range(len(lo)):
+        edges = {lo[d], hi[d]}
+        for clo, chi in clipped:
+            edges.add(clo[d])
+            edges.add(chi[d])
+        cuts.append(sorted(e for e in edges if lo[d] <= e <= hi[d]))
+    import itertools
+    for cell in itertools.product(*(range(len(c) - 1) for c in cuts)):
+        clo = tuple(cuts[d][i] for d, i in enumerate(cell))
+        chi = tuple(cuts[d][i + 1] for d, i in enumerate(cell))
+        if not any(all(b[0][d] <= clo[d] and chi[d] <= b[1][d]
+                       for d in range(len(lo))) for b in clipped):
+            return False
+    return True
+
+
+def plan_file_reshard(name: str, chunks: Sequence, global_shape: Sequence[int],
+                      dtype_name: str,
+                      target_regions: Iterable[Tuple[Sequence[int],
+                                                     Sequence[int]]],
+                      prefer_files: Sequence[str] = ()) -> FileReshardPlan:
+    """Plan reading tensor ``name`` (stored as ``chunks``) into each of
+    ``target_regions`` — ``(offset, shape)`` pairs for the *new*
+    topology's shards.
+
+    ``prefer_files`` biases overlap resolution: chunks from those files
+    are applied last, so where replicas overlap, the preferred file (the
+    resuming rank's ``prev_rank`` file, kept warm in page cache) wins.
+    """
+    itemsize = np.dtype(dtype_name).itemsize
+    refs: List[ChunkRef] = []
+    for c in chunks:
+        refs.append(c if isinstance(c, ChunkRef) else ChunkRef(
+            file_name=c["file_name"], key=c.get("key", ""),
+            global_offset=tuple(c["global_offset"]),
+            local_shape=tuple(c["local_shape"])))
+    prefer = set(prefer_files)
+    refs.sort(key=lambda r: r.file_name in prefer)  # preferred last -> wins
+
+    plan = FileReshardPlan(name, tuple(int(s) for s in global_shape),
+                           dtype_name)
+    plan.max_chunk_bytes = max((r.nbytes_of * itemsize for r in refs),
+                               default=0)
+    boxes: List[Box] = [
+        (r.global_offset,
+         tuple(o + s for o, s in zip(r.global_offset, r.local_shape)))
+        for r in refs]
+
+    for offset, shape in target_regions:
+        lo = tuple(int(o) for o in offset)
+        hi = tuple(o + int(s) for o, s in zip(lo, shape))
+        key = (lo, tuple(int(s) for s in shape))
+        if key in plan.programs:
+            continue
+        reads: List[RegionRead] = []
+        biggest = 0
+        for r, (blo, bhi) in zip(refs, boxes):
+            olo = tuple(max(a, b) for a, b in zip(lo, blo))
+            ohi = tuple(min(a, b) for a, b in zip(hi, bhi))
+            if any(a >= b for a, b in zip(olo, ohi)):
+                continue
+            reads.append(RegionRead(
+                r,
+                tuple((a - b, c - b) for a, c, b in zip(olo, ohi, blo)),
+                tuple((a - b, c - b) for a, c, b in zip(olo, ohi, lo))))
+            biggest = max(biggest, r.nbytes_of * itemsize)
+        if not _covered(lo, hi, boxes):
+            raise ValueError(
+                f"checkpoint chunks for {name!r} do not cover region "
+                f"offset={lo} shape={key[1]} (missing shards from the old "
+                f"topology?)")
+        shard_bytes = int(np.prod(key[1])) * itemsize if key[1] else itemsize
+        plan.max_shard_bytes = max(plan.max_shard_bytes, shard_bytes)
+        plan.programs[key] = ShardProgram(lo, key[1], tuple(reads),
+                                          shard_bytes + biggest)
+    return plan
+
+
+def read_shard(program: ShardProgram, fetch, dtype) -> np.ndarray:
+    """Materialize one destination shard.  ``fetch(chunk)`` returns the
+    chunk's array (called once per read, sequentially — at most one chunk
+    is live alongside the shard)."""
+    out = np.empty(program.shape, dtype=dtype)
+    for rr in program.reads:
+        data = fetch(rr.chunk)
+        src = tuple(slice(a, b) for a, b in rr.chunk_slices)
+        dst = tuple(slice(a, b) for a, b in rr.shard_slices)
+        out[dst] = data[src]
+    return out
+
+
+class ChunkReader:
+    """Lazy npz member fetcher with CRC verification.
+
+    Opens each file on demand, reads one member per ``fetch`` call, and
+    classifies zip/OS-level damage as ``CheckpointCorruptionError`` so
+    the resume fallback path (quarantine + older step) engages."""
+
+    def __init__(self, dirname: str, crcs: Optional[Dict[Tuple[str, str],
+                                                         int]] = None):
+        import os
+        self._dir = dirname
+        self._crcs = crcs or {}
+        self._files: Dict[str, np.lib.npyio.NpzFile] = {}
+        self._os = os
+
+    def fetch(self, chunk: ChunkRef) -> np.ndarray:
+        err = _corruption_error()
+        path = self._os.path.join(self._dir, chunk.file_name)
+        try:
+            f = self._files.get(chunk.file_name)
+            if f is None:
+                f = np.load(path)
+                self._files[chunk.file_name] = f
+            data = f[chunk.key]
+        except (zipfile.BadZipFile, OSError, ValueError, KeyError) as e:
+            raise err(f"{path}: {type(e).__name__}: {e}") from e
+        want = self._crcs.get((chunk.file_name, chunk.key))
+        if want is not None:
+            got = zlib.crc32(np.ascontiguousarray(data).tobytes())
+            if got != want:
+                raise err(f"{path}:{chunk.key}: crc32 {got:#x} != "
+                          f"recorded {want:#x}")
+        return data
+
+    def close(self) -> None:
+        for f in self._files.values():
+            try:
+                f.close()
+            except Exception:
+                pass
+        self._files.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

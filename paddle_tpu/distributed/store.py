@@ -16,17 +16,23 @@ interoperate within one job.
 
 from __future__ import annotations
 
+import pickle
 import socket
 import struct
+import sys
 import threading
 import time
-from typing import Dict, List, Optional, Union
+from typing import Dict, List, Optional, Tuple, Union
 
 from paddle_tpu.core import native
 
-__all__ = ["TCPStore"]
+__all__ = ["TCPStore", "WarmStandby"]
 
-_SET, _GET, _ADD, _WAIT, _DELETE = 1, 2, 3, 4, 5
+_SET, _GET, _ADD, _WAIT, _DELETE, _SNAPSHOT = 1, 2, 3, 4, 5, 6
+
+#: master-side key a WarmStandby advertises its endpoint under; clients
+#: that called TCPStore.enable_failover() re-point here on master death
+STANDBY_ENDPOINT_KEY = b"__standby/endpoint"
 
 
 # ---------------------------------------------------------------------------
@@ -70,7 +76,10 @@ class _PyServer:
             return len(self._kv)
 
     def _accept(self):
-        self._sock.settimeout(0.2)
+        try:
+            self._sock.settimeout(0.2)
+        except OSError:
+            return  # stop() closed the socket before this thread ran
         while not self._stop.is_set():
             try:
                 conn, _ = self._sock.accept()
@@ -129,6 +138,13 @@ class _PyServer:
                         with self._cond:
                             self._kv.pop(key, None)
                         conn.sendall(b"\x00" + struct.pack("!I", 0))
+                    elif cmd == _SNAPSHOT:
+                        # full key-space dump for the warm standby's mirror
+                        # (pickle: values are arbitrary bytes, keys too)
+                        with self._cond:
+                            blob = pickle.dumps(dict(self._kv), protocol=2)
+                        conn.sendall(b"\x00")
+                        _send_bytes(conn, blob)
                     else:
                         return
         except (ConnectionError, OSError):
@@ -159,7 +175,26 @@ class _PyClient:
         self._timeout = float(timeout)
         self._mu = threading.Lock()
         self._sock: Optional[socket.socket] = None
+        self._failover: Optional[Tuple[str, int]] = None
         self._connect(time.monotonic() + timeout)
+
+    def set_failover(self, host: str, port: int) -> None:
+        """Warm-standby endpoint to re-point at when the master becomes
+        unreachable (see :class:`WarmStandby`)."""
+        self._failover = (host, int(port))
+
+    def _switch_failover(self) -> bool:
+        """Re-point at the standby (at most once — it IS the master after
+        that).  Returns True when an op should retry there."""
+        if self._failover is None or (self._host, self._port) == self._failover:
+            return False
+        print(f"[store] master {self._host}:{self._port} unreachable; "
+              f"failing over to standby "
+              f"{self._failover[0]}:{self._failover[1]}",
+              file=sys.stderr, flush=True)
+        self._host, self._port = self._failover
+        self._drop_sock()
+        return True
 
     def _connect(self, deadline: float):
         last = None
@@ -206,48 +241,61 @@ class _PyClient:
                 time.sleep(inj.delay_seconds())  # slow/partitioned peer
             drop_next = inj is not None and inj.should_drop()
             policy = self._retry_policy()
-            schedule = policy.delays()
-            deadline = time.monotonic() + limit
             last: Optional[BaseException] = None
-            for _ in range(policy.max_attempts):
-                try:
-                    if self._sock is None:
-                        self._connect(deadline)
-                    if drop_next:
-                        drop_next = False
+            # outer loop: at most two endpoints — the master, then (if a
+            # WarmStandby was advertised via set_failover) the standby;
+            # each gets a fresh attempt budget and deadline
+            for _ep_round in range(2):
+                schedule = policy.delays()
+                deadline = time.monotonic() + limit
+                switched = False
+                for _ in range(policy.max_attempts):
+                    try:
+                        if self._sock is None:
+                            self._connect(deadline)
+                        if drop_next:
+                            drop_next = False
+                            self._drop_sock()
+                            raise ConnectionError("[inject] store connection dropped")
+                        self._sock.settimeout(max(0.05, min(limit,
+                                                            deadline - time.monotonic())))
+                        msg = bytes([cmd]) + struct.pack("!I", len(key)) + key
+                        if payload is not None:
+                            msg += struct.pack("!I", len(payload)) + payload
+                        self._sock.sendall(msg)
+                        status = _recv_exact(self._sock, 1)[0]
+                        val = _recv_bytes(self._sock)
+                        return status, val
+                    except TimeoutError as e:
+                        # socket.timeout (master unresponsive) or the reconnect
+                        # deadline inside _connect — either way: bounded, loud
                         self._drop_sock()
-                        raise ConnectionError("[inject] store connection dropped")
-                    self._sock.settimeout(max(0.05, min(limit,
-                                                        deadline - time.monotonic())))
-                    msg = bytes([cmd]) + struct.pack("!I", len(key)) + key
-                    if payload is not None:
-                        msg += struct.pack("!I", len(payload)) + payload
-                    self._sock.sendall(msg)
-                    status = _recv_exact(self._sock, 1)[0]
-                    val = _recv_bytes(self._sock)
-                    return status, val
-                except TimeoutError as e:
-                    # socket.timeout (master unresponsive) or the reconnect
-                    # deadline inside _connect — either way: bounded, loud
-                    self._drop_sock()
-                    raise TimeoutError(
-                        f"TCPStore {op}({key!r}) timed out after {limit:.1f}s "
-                        f"(master {self._host}:{self._port} dead or "
-                        f"unresponsive)") from e
-                except (ConnectionError, OSError) as e:
-                    last = e
-                    self._drop_sock()
-                    if not idempotent:
-                        # the op may or may not have executed server-side;
-                        # a blind retry could e.g. double-increment a rank
-                        # counter — surface the drop to the caller instead
-                        raise ConnectionError(
-                            f"TCPStore {op}({key!r}) connection lost mid-op: "
-                            f"{e}") from e
-                    delay = next(schedule, None)
-                    if delay is None or time.monotonic() + delay > deadline:
-                        break
-                    time.sleep(delay)
+                        if self._switch_failover():
+                            last = e
+                            switched = True
+                            break  # retry the op on the standby
+                        raise TimeoutError(
+                            f"TCPStore {op}({key!r}) timed out after {limit:.1f}s "
+                            f"(master {self._host}:{self._port} dead or "
+                            f"unresponsive)") from e
+                    except (ConnectionError, OSError) as e:
+                        last = e
+                        self._drop_sock()
+                        if not idempotent:
+                            # the op may or may not have executed server-side;
+                            # a blind retry could e.g. double-increment a rank
+                            # counter — surface the drop to the caller instead
+                            raise ConnectionError(
+                                f"TCPStore {op}({key!r}) connection lost mid-op: "
+                                f"{e}") from e
+                        delay = next(schedule, None)
+                        if delay is None or time.monotonic() + delay > deadline:
+                            break
+                        time.sleep(delay)
+                # this endpoint's budget is spent; unless the TimeoutError
+                # path already re-pointed us, try the standby (once)
+                if not switched and not self._switch_failover():
+                    break
             raise TimeoutError(
                 f"TCPStore {op}({key!r}): master {self._host}:{self._port} "
                 f"unreachable within {limit:.1f}s ({last})")
@@ -280,8 +328,93 @@ class _PyClient:
     def delete(self, key: bytes):
         self._roundtrip(_DELETE, key, None)
 
+    def snapshot(self, op_timeout: Optional[float] = None) -> Dict[bytes, bytes]:
+        """Full key-space dump (the warm standby's mirror primitive)."""
+        status, val = self._roundtrip(_SNAPSHOT, b"", None,
+                                      op_timeout=op_timeout)
+        if status != 0:
+            raise RuntimeError("store snapshot failed")
+        return pickle.loads(val)
+
     def close(self):
         self._drop_sock()
+
+
+class WarmStandby:
+    """Warm-standby TCPStore: high availability without consensus.
+
+    Runs its own server, mirrors the master's FULL key-space via the
+    snapshot op every ``interval`` seconds, and advertises its endpoint
+    on the master (``__standby/endpoint``) so clients that called
+    :meth:`TCPStore.enable_failover` re-point here when the master dies
+    instead of hanging the next rendezvous.
+
+    Scope (deliberate): mirror + client re-point only.  Writes that land
+    after failover exist on the standby alone; a master that comes back
+    is NOT reconciled, and keys written between the last snapshot and
+    the master's death are lost — acceptable for the rendezvous /
+    heartbeat control plane, whose keys are re-established by the next
+    generation anyway.
+    """
+
+    def __init__(self, master_host: str, master_port: int,
+                 host: str = "127.0.0.1", port: int = 0,
+                 interval: float = 0.5, timeout: float = 10.0,
+                 max_failures: Optional[int] = None):
+        self._server = _PyServer(port)
+        self.host, self.port = host, self._server.port
+        self.interval = float(interval)
+        # after ~timeout's worth of consecutive failed snapshots the master
+        # is gone: stop polling, keep serving the last mirrored state to
+        # failed-over clients
+        self.max_failures = (int(max_failures) if max_failures is not None
+                             else max(3, int(round(timeout
+                                                   / max(0.05, interval)))))
+        self._client = _PyClient(master_host, int(master_port), float(timeout))
+        self._client.set(STANDBY_ENDPOINT_KEY,
+                         f"{host}:{self.port}".encode())
+        self.mirrored = 0  # snapshots applied (monotonic)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._mirror_loop,
+                                        name="store-standby", daemon=True)
+        self._thread.start()
+
+    def _mirror_loop(self):
+        failures = 0
+        op_timeout = max(2.0, 2.0 * self.interval)
+        while not self._stop.is_set():
+            try:
+                kv = self._client.snapshot(op_timeout=op_timeout)
+                with self._server._cond:
+                    self._server._kv.clear()
+                    self._server._kv.update(kv)
+                    self._server._cond.notify_all()
+                self.mirrored += 1
+                failures = 0
+            except Exception:
+                failures += 1
+                if failures >= self.max_failures:
+                    print(f"[store] standby {self.host}:{self.port}: master "
+                          f"unreachable {failures}x; serving last mirror "
+                          f"({self.mirrored} snapshots)",
+                          file=sys.stderr, flush=True)
+                    return
+            self._stop.wait(self.interval)
+
+    def num_keys(self) -> int:
+        return self._server.num_keys()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._client.close()
+        self._server.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -466,6 +599,28 @@ class TCPStore:
 
     def delete_key(self, key) -> None:
         self._client.delete(self._k(key))
+
+    def enable_failover(self, timeout: Optional[float] = None) -> bool:
+        """Arm failover to the warm standby advertised on the master.
+
+        Reads the standby endpoint (published by :class:`WarmStandby` at
+        startup) and installs it on the client; when the master later
+        becomes unreachable the client re-points there instead of raising.
+        Returns ``False`` when no standby is advertised or the native
+        client (which has no failover hook) is in use."""
+        if not hasattr(self._client, "set_failover"):
+            return False
+        try:
+            ep = self._client.get(STANDBY_ENDPOINT_KEY, op_timeout=timeout)
+        except (TimeoutError, ConnectionError, OSError):
+            return False
+        if not ep:
+            return False
+        host, _, port = ep.decode().rpartition(":")
+        if not host or not port.isdigit():
+            return False
+        self._client.set_failover(host, int(port))
+        return True
 
     def num_keys(self) -> int:
         if self._server is None:

@@ -83,9 +83,14 @@ define_flag("log_level", 0, "Framework verbose log level (VLOG equivalent)")
 # Fault-tolerance flags (consumed by distributed.fault_tolerance)
 # ---------------------------------------------------------------------------
 define_flag("ft_heartbeat_interval", 5.0,
-            "Seconds between heartbeat lease renewals on the control store")
+            "Seconds between heartbeat lease renewals on the control store "
+            "(bounds 0.05..300; validated by fault_tolerance.policy."
+            "heartbeat_config — lower = faster failure detection, more "
+            "store traffic)")
 define_flag("ft_lease_ttl", 0.0,
-            "Seconds a silent peer keeps its membership lease; 0 = 3x interval")
+            "Seconds a silent peer keeps its membership lease; 0 = 3x "
+            "interval, must be >= 2x interval (worst-case detection "
+            "latency is ttl + interval)")
 define_flag("ft_store_max_retries", 5,
             "Reconnect attempts for a dropped control-store connection")
 define_flag("ft_store_backoff_base", 0.05,
@@ -97,6 +102,9 @@ define_flag("ft_inject_crash_step", -1,
             "Simulate a fail-stop worker crash before this train step (-1 off)")
 define_flag("ft_inject_crash_rank", -1,
             "Restrict the injected crash to this rank (-1 = every rank)")
+define_flag("ft_inject_crash_signal", 0,
+            "Deliver this signal (e.g. 9=SIGKILL) for the injected crash "
+            "instead of os._exit — exercises the no-cleanup kill path")
 define_flag("ft_inject_store_drop_rate", 0.0,
             "Probability an outgoing store op gets its connection dropped")
 define_flag("ft_inject_store_delay_ms", 0,

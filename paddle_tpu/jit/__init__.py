@@ -412,6 +412,18 @@ class TrainStep:
         self._params, self._buffers = _get_state(model)
         init_fn, update_fn = optimizer.functional()
         self._opt_state = init_fn(self._params)
+        wus = getattr(optimizer, "_wus", None)
+        if wus is not None:
+            # ZeRO-1 (shard_update) constrains the update to the optimizer's
+            # mesh; state committed to a single device would conflict with
+            # those constraints at trace time.  Start replicated ON the mesh —
+            # the first step's sharding constraints scatter the slots.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(wus[0], PartitionSpec())
+            self._params = jax.device_put(self._params, rep)
+            self._buffers = jax.device_put(self._buffers, rep)
+            self._opt_state = jax.device_put(self._opt_state, rep)
         self._update_fn = update_fn
         self._step = 0
         grad_clip = optimizer._grad_clip

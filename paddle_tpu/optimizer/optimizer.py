@@ -278,6 +278,32 @@ class Optimizer:
         if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
             self._lr.set_state_dict(state["LR_Scheduler"])
 
+    def sharded_state_dict(self) -> dict:
+        """Like ``state_dict`` but slot values stay live (possibly
+        ZeRO-1-sharded) jax Arrays — no all-gather onto the host.  Feed
+        to ``distributed.checkpoint.save_state_dict`` / the resharding
+        planner instead of ``state_dict`` when ``shard_update`` is on."""
+        self._ensure_state()
+        out = {"step": self._step_count,
+               "slots": [dict(s) for s in self._state]}
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def state_specs(self):
+        """The layout ``shard_update`` imposes on each optimizer slot, for
+        the resharding planner: ``(mesh, axis, [{slot: PartitionSpec}])``
+        aligned with ``self._state``; ``None`` when updates are not
+        sharded (everything replicated)."""
+        if self._wus is None:
+            return None
+        mesh, axis = self._wus
+        n = mesh.shape[axis]
+        self._ensure_state()
+        specs = [{k: _wus_partition_spec(np.shape(v), n, axis)
+                  for k, v in s.items()} for s in self._state]
+        return mesh, axis, specs
+
     # -- functional interface for jit/pjit trainers ----------------------------
     def functional(self):
         """Returns (init_fn, update_fn) over pytrees for the compiled path.

@@ -109,5 +109,40 @@ for nbits in (1, 8, 32):
     assert "resume-from 10" in r2.stdout, (nbits, r2.stdout)
 PY
 
+# 6. kill-during-sharded-AdamW: SIGKILL a dp=4 worker mid-step with ZeRO-1
+#    (Optimizer.shard_update) state live, resume the survivors on a SHRUNKEN
+#    mesh (dp=2, then dp=1) — final params + m/v must be bit-identical to an
+#    unkilled run that live-migrates (fleet.migrate_to_mesh) at the same step
+for dp2 in 2 1; do
+    run "sigkill sharded-adamw dp4 -> dp$dp2" 300 python - "$dp2" <<'PY'
+import os, pathlib, subprocess, sys, tempfile, textwrap
+dp2 = sys.argv[1]
+src = pathlib.Path("tests/test_chaos.py").read_text()
+body = src.split('SHARDED_TRAIN_SCRIPT = """')[1].split('"""')[0]
+d = tempfile.mkdtemp(prefix="chaos_zkill_")
+script = os.path.join(d, "train.py")
+pathlib.Path(script).write_text(textwrap.dedent(body))
+env = dict(os.environ, XLA_FLAGS="--xla_force_host_platform_device_count=8")
+
+def run(ck, spec, **fl):
+    e = dict(env, **{f"FLAGS_{k}": str(v) for k, v in fl.items()})
+    return subprocess.run([sys.executable, script,
+                           os.path.join(d, ck), "8", spec],
+                          capture_output=True, text=True, timeout=250, env=e)
+
+def digests(out):
+    return sorted(l for l in out.splitlines() if l.startswith("state-digest"))
+
+rA = run("ck", "4", ft_inject_seed=3, ft_inject_crash_step=5,
+         ft_inject_crash_signal=9)
+assert rA.returncode != 0 and "[inject] signal 9" in rA.stderr, rA.stderr
+rB = run("ck", dp2)
+assert rB.returncode == 0 and "resume-from 4" in rB.stdout, rB.stderr
+rR = run("ref", f"4-{dp2}")
+assert rR.returncode == 0, rR.stderr
+assert digests(rB.stdout) == digests(rR.stdout) != [], rB.stdout
+PY
+done
+
 echo "[chaos] sweep done: $FAIL failure(s)" >&2
 exit "$FAIL"

@@ -6,6 +6,8 @@
 #   bytes_gate    HBM bytes/step vs scripts/BYTES_BASELINE.json
 #   lint_gate     sharding/communication lint vs scripts/LINT_BASELINE.json
 #   schedule_gate pipeline-schedule matrix + host self-lint
+#   reshard_gate  resharding property suite + plan-peak audit vs
+#                 scripts/RESHARD_BASELINE.json
 #   host_lint     standalone self-lint summary line (rc 1 on any finding)
 #
 # Exit code: number of failed stages (0 = green).
@@ -31,6 +33,7 @@ stage tier-1 timeout -k 10 1200 python -m pytest tests/ -q -m 'not slow' \
 stage bytes_gate    ./scripts/bytes_gate.sh
 stage lint_gate     ./scripts/lint_gate.sh
 stage schedule_gate ./scripts/schedule_gate.sh
+stage reshard_gate  ./scripts/reshard_gate.sh
 stage host_lint     python -m paddle_tpu.analysis.host_lint
 
 echo "=== [ci] summary ===" >&2

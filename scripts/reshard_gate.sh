@@ -1,0 +1,75 @@
+#!/bin/bash
+# Resharding-engine regression gate.  Two checks:
+#
+#   1. the property suite (tests/test_resharding.py, non-slow selection):
+#      peak bound + collective subset over the full spec catalog, execution
+#      bit-identity samples, file-stream coverage/preference semantics
+#   2. the plan audit (paddle_tpu.distributed.resharding.audit): sweeps
+#      every (src spec, dst spec, dst mesh) and fails if any plan's modeled
+#      peak exceeds 2x the larger shard, claims an unexpected collective,
+#      or regresses vs the committed baseline
+#      (scripts/RESHARD_BASELINE.json)
+#
+# Refresh the baseline after an intentional change:
+#     scripts/reshard_gate.sh --update
+# Exit code: number of failed checks (0 = gate passes).
+cd "$(dirname "$0")/.." || exit 1
+GATE_NAME=reshard_gate
+GATE_BASELINE="scripts/RESHARD_BASELINE.json"
+. scripts/gate_lib.sh
+gate_init "$@"
+export XLA_FLAGS="--xla_force_host_platform_device_count=8${XLA_FLAGS:+ $XLA_FLAGS}"
+
+echo "[reshard_gate] property suite" >&2
+if ! timeout -k 10 600 python -m pytest tests/test_resharding.py -q \
+        -m 'not slow' -p no:cacheprovider >/dev/null 2>&1; then
+    echo "[reshard_gate] property suite: FAILED (rc=$?)" >&2
+    FAIL=$((FAIL + 1))
+else
+    echo "[reshard_gate] property suite: OK" >&2
+fi
+
+echo "[reshard_gate] plan audit" >&2
+if ! GATE_LINE=$(timeout -k 10 600 python -m \
+        paddle_tpu.distributed.resharding.audit 2>/dev/null); then
+    echo "[reshard_gate] plan audit: FAILED (audit rc=$?)" >&2
+    FAIL=$((FAIL + 1))
+else
+    gate_diff audit <<PY
+import json, os, sys
+exec(os.environ["GATE_PY_COMMON"])
+preset, baseline_path, new_path, update = sys.argv[1:5]
+line = """$GATE_LINE"""
+r = gate_result(line)
+gate_record(new_path, preset, r)
+# absolute invariants — fail regardless of baseline
+bad = []
+if r["max_peak_ratio"] > 2.0:
+    bad.append(f"max_peak_ratio {r['max_peak_ratio']} > 2.0")
+if not r["kinds_ok"]:
+    bad.append("plan emitted a collective outside spec_algebra's expected set")
+if r["n_bounded"] != r["n_plans"]:
+    bad.append(f"only {r['n_bounded']}/{r['n_plans']} plans bounded")
+if bad:
+    print(f"[reshard_gate] audit: FAILED ({'; '.join(bad)})", file=sys.stderr)
+    sys.exit(1)
+if int(update):
+    print(f"[reshard_gate] audit: recorded {r}", file=sys.stderr)
+    sys.exit(0)
+base = gate_base(baseline_path, preset, "reshard_gate",
+                 "scripts/reshard_gate.sh")
+if r["max_peak_ratio"] > base["max_peak_ratio"]:
+    print(f"[reshard_gate] audit: FAILED (max_peak_ratio regressed "
+          f"{base['max_peak_ratio']} -> {r['max_peak_ratio']})",
+          file=sys.stderr)
+    sys.exit(1)
+if r["n_plans"] < base["n_plans"]:
+    print(f"[reshard_gate] audit: FAILED (catalog shrank "
+          f"{base['n_plans']} -> {r['n_plans']} plans)", file=sys.stderr)
+    sys.exit(1)
+print(f"[reshard_gate] audit: OK ratio={r['max_peak_ratio']} "
+      f"bounded={r['n_bounded']}/{r['n_plans']}", file=sys.stderr)
+PY
+fi
+
+gate_finish

@@ -382,3 +382,89 @@ def test_checkpoint_prune_requires_committed_manifest(tmp_path):
     mgr.save(4, sd)
     assert mgr.complete_steps() == [4]
     assert not os.path.exists(os.path.join(root, "step_00000003.saving"))
+
+
+# ------------------------------------------------- heartbeat config surface
+
+
+def test_heartbeat_config_defaults_from_flags():
+    from paddle_tpu.distributed.fault_tolerance import heartbeat_config
+    from paddle_tpu.framework import flags
+
+    cfg = heartbeat_config()
+    assert cfg.interval == flags.get_flag("ft_heartbeat_interval")
+    assert cfg.ttl == 3 * cfg.interval  # ttl flag defaults to 0 = derive
+    assert cfg.op_timeout == max(2.0, 2 * cfg.interval)
+
+
+def test_heartbeat_config_validates_bounds():
+    from paddle_tpu.distributed.fault_tolerance import heartbeat_config
+
+    cfg = heartbeat_config(interval=1.0, ttl=4.0)
+    assert (cfg.interval, cfg.ttl) == (1.0, 4.0)
+    with pytest.raises(ValueError):
+        heartbeat_config(interval=0.01)  # below lower bound
+    with pytest.raises(ValueError):
+        heartbeat_config(interval=301.0)  # above upper bound
+    with pytest.raises(ValueError):
+        heartbeat_config(interval=2.0, ttl=3.0)  # ttl < 2x interval
+
+
+def test_detector_uses_heartbeat_config():
+    with TCPStore("127.0.0.1", 0, world_size=1, is_master=True,
+                  timeout=5.0) as store:
+        det = HeartbeatFailureDetector(store, 0, 1, interval=0.25)
+        assert det.interval == 0.25
+        assert det.ttl == 3 * det.interval  # derived: ttl flag defaults to 0
+        assert det.op_timeout >= 2.0
+
+
+# ------------------------------------------------------ warm-standby store
+
+
+def test_warm_standby_mirrors_and_fails_over():
+    """Satellite: store HA.  The standby mirrors the master's key-space;
+    when the master dies, a client with enable_failover() re-points to the
+    standby and reads the mirrored state — and later writes land there."""
+    from paddle_tpu.distributed.store import WarmStandby
+
+    master = TCPStore("127.0.0.1", 0, world_size=1, is_master=True,
+                      timeout=5.0, use_native=False)
+    sb = WarmStandby("127.0.0.1", master.port, interval=0.05, timeout=3.0)
+    client = TCPStore("127.0.0.1", master.port, world_size=1, timeout=3.0,
+                      use_native=False)
+    try:
+        client.set("job/epoch", b"7")
+        deadline = time.monotonic() + 5.0
+        while sb.mirrored < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sb.mirrored >= 1 and sb.num_keys() >= 2
+        assert client.enable_failover() is True
+
+        master._server.stop()  # coordinator host dies
+        master._server = None
+        assert client.get("job/epoch", timeout=8.0) == b"7"  # mirrored read
+        client.set("job/epoch", b"8")  # post-failover write
+        assert client.get("job/epoch", timeout=3.0) == b"8"
+    finally:
+        sb.stop()
+        client.close()
+        master.close()
+
+
+def test_enable_failover_without_standby_is_false():
+    with TCPStore("127.0.0.1", 0, world_size=1, is_master=True,
+                  timeout=3.0, use_native=False) as master:
+        client = TCPStore("127.0.0.1", master.port, world_size=1,
+                          timeout=3.0, use_native=False)
+        assert client.enable_failover() is False
+        client.close()
+
+
+def test_snapshot_returns_full_keyspace():
+    with TCPStore("127.0.0.1", 0, world_size=1, is_master=True,
+                  timeout=3.0, use_native=False) as store:
+        store.set("a", b"1")
+        store.set("b", b"2")
+        kv = store._client.snapshot()
+        assert kv[b"a"] == b"1" and kv[b"b"] == b"2"

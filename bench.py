@@ -338,6 +338,52 @@ def _lint_fields(lowered, lint=False, label="", expected=()):
     return {"lint_findings": len(rep), "lint_codes": rep.counts()}
 
 
+def _mem_fields(lowered, mem=False, label="", hbm_budget=None):
+    """``peak_bytes``/``mem_findings`` fields for a BENCH line from the
+    liveness-based memory lint (``paddle_tpu.analysis.memory_lint``):
+    per-device peak-resident bytes cross-validated against XLA's
+    ``memory_analysis()``, plus donation/remat advisors.  The ranked
+    findings report goes to stderr; stdout stays one JSON line."""
+    import sys
+
+    if not mem and hbm_budget is None:
+        return {}
+    from paddle_tpu.analysis import lint_memory
+
+    try:
+        rep = lint_memory(lowered.compile(), hbm_budget=hbm_budget)
+    except Exception as e:  # mem lint must never break the BENCH contract
+        return {"mem_error": repr(e)}
+    print(f"== memory lint{' (' + label + ')' if label else ''} ==",
+          file=sys.stderr)
+    print(rep.report(), file=sys.stderr)
+    fields = {"mem_findings": len(rep), "mem_codes": rep.counts()}
+    for k in ("peak_bytes", "xla_peak_bytes", "peak_agreement"):
+        if k in rep.meta:
+            fields[k] = rep.meta[k]
+    return fields
+
+
+def _merge_program_fields(dst, src, prefix):
+    """Fold a second program's lint/mem fields into ``dst``: finding counts
+    sum, per-code counts add, peak/error fields keep a ``<prefix>_`` key
+    (the unprefixed peak stays the primary program's figure)."""
+    for kind in ("lint", "mem"):
+        if f"{kind}_findings" in src:
+            dst[f"{kind}_findings"] = (dst.get(f"{kind}_findings", 0)
+                                       + src[f"{kind}_findings"])
+            codes = dict(dst.get(f"{kind}_codes", {}))
+            for c, n in src.get(f"{kind}_codes", {}).items():
+                codes[c] = codes.get(c, 0) + n
+            dst[f"{kind}_codes"] = codes
+        if f"{kind}_error" in src:
+            dst[f"{prefix}_{kind}_error"] = src[f"{kind}_error"]
+    for k in ("peak_bytes", "peak_agreement"):
+        if k in src:
+            dst[f"{prefix}_{k}"] = src[k]
+    return dst
+
+
 def _bench_decode(jax, paddle, backend, on_tpu, args):
     """Serving path: KV-cache greedy decode throughput (new tokens/s).
 
@@ -401,6 +447,9 @@ def _bench_decode(jax, paddle, backend, on_tpu, args):
             bf["bytes_per_step"] = bf["bytes_per_step"] / new  # per new token
         bf.update(_lint_fields(lowered, getattr(args, "lint", False),
                                label="decode"))
+        bf.update(_mem_fields(lowered, getattr(args, "mem", False),
+                              label="decode",
+                              hbm_budget=getattr(args, "hbm_budget", None)))
         bytes_fields = bf
     except Exception:
         bytes_fields = {"bytes_per_step": float(param_bytes),
@@ -498,14 +547,20 @@ def _bench_serve(jax, paddle, backend, on_tpu, args):
     else:
         frac_bound = 0.0
     lint_fields = {}
-    if getattr(args, "lint", False):
-        # the engine runs many programs; lint the k=1 decode chunk — the
-        # steady-state serving program (same arg recipe as Engine.warmup)
+    if getattr(args, "lint", False) or getattr(args, "mem", False):
+        # the engine runs many programs; lint the k=1 decode chunk (the
+        # steady-state serving program) AND the largest-bucket prefill —
+        # prefill is where the big activation peaks live.  Arg recipes
+        # mirror Engine.warmup; findings from both programs are merged
+        # (counts summed) so the gate sees the whole serving surface.
         try:
             import jax.numpy as jnp
 
             from paddle_tpu.framework import random as rnd
 
+            do_lint = getattr(args, "lint", False)
+            do_mem = getattr(args, "mem", False)
+            budget = getattr(args, "hbm_budget", None)
             zeros = np.zeros((max_batch,), np.int32)
             fn = eng._get_decode_fn(1)
             lowered = fn.lower(
@@ -516,7 +571,26 @@ def _bench_serve(jax, paddle, backend, on_tpu, args):
                 jnp.ones((max_batch,), jnp.float32),
                 jnp.zeros((eng._tok_seg_rows, max_batch), jnp.int32),
                 jnp.asarray(0, jnp.int32))
-            lint_fields = _lint_fields(lowered, True, label="serve-decode")
+            lint_fields = _lint_fields(lowered, do_lint, label="serve-decode")
+            lint_fields.update(_mem_fields(lowered, do_mem,
+                                           label="serve-decode",
+                                           hbm_budget=budget))
+            Pb, n = max(eng.prefill_buckets), 1
+            pfn = eng._get_prefill_fn(Pb, n)
+            plow = pfn.lower(
+                eng._params, eng._buffers, eng.k_pools, eng.v_pools,
+                eng._last_dev, jnp.zeros((n,), jnp.int32),
+                jnp.zeros((n, Pb), jnp.int32),
+                jnp.zeros((n, Pb // eng.block_size), jnp.int32),
+                jnp.ones((n,), jnp.int32), rnd.next_key(),
+                jnp.zeros((n,), jnp.float32),
+                jnp.zeros((n,), jnp.int32), jnp.ones((n,), jnp.float32),
+                jnp.zeros((eng._first_seg,), jnp.int32),
+                jnp.asarray(0, jnp.int32))
+            pf = _lint_fields(plow, do_lint, label="serve-prefill")
+            pf.update(_mem_fields(plow, do_mem, label="serve-prefill",
+                                  hbm_budget=budget))
+            _merge_program_fields(lint_fields, pf, "prefill")
         except Exception as e:
             lint_fields = {"lint_error": repr(e)}
     return {
@@ -602,6 +676,9 @@ def _bench_ocr(jax, paddle, backend, on_tpu, args):
                                  label="ocr")
     bytes_fields.update(_lint_fields(lowered, getattr(args, "lint", False),
                                      label="ocr"))
+    bytes_fields.update(_mem_fields(lowered, getattr(args, "mem", False),
+                                    label="ocr",
+                                    hbm_budget=getattr(args, "hbm_budget", None)))
     return {
         **bytes_fields,
         "metric": "ocr_det_train_images_per_sec",
@@ -674,6 +751,9 @@ def _bench_moe(jax, paddle, backend, on_tpu, args):
                                  label="moe")
     bytes_fields.update(_lint_fields(lowered, getattr(args, "lint", False),
                                      label="moe"))
+    bytes_fields.update(_mem_fields(lowered, getattr(args, "mem", False),
+                                    label="moe",
+                                    hbm_budget=getattr(args, "hbm_budget", None)))
 
     tokens_per_sec = batch * seq * steps / dt
     dev_kind, peak = _peak_flops(jax, on_tpu)
@@ -728,6 +808,16 @@ def main():
                          "donation misses + unintended collectives; adds "
                          "lint_findings/lint_codes to the BENCH line, ranked "
                          "report to stderr")
+    ap.add_argument("--mem", action="store_true",
+                    help="run the liveness-based memory lint "
+                         "(paddle_tpu.analysis.memory_lint) on the compiled "
+                         "step: peak-resident bytes cross-validated against "
+                         "XLA's memory_analysis(), donation/remat advisors; "
+                         "adds peak_bytes/mem_findings/mem_codes to the "
+                         "BENCH line, ranked report to stderr")
+    ap.add_argument("--hbm-budget", type=int, default=None,
+                    help="per-device HBM budget in bytes; implies --mem and "
+                         "adds the mem-over-budget check")
     ap.add_argument("--audit-only", action="store_true",
                     help="pretrain presets: lower + compile + cost-analyse "
                          "the step but skip the timed run (bytes_per_step "
@@ -736,6 +826,8 @@ def main():
     args = ap.parse_args()
     if args.audit_only:
         args.audit = True
+    if args.hbm_budget is not None:
+        args.mem = True
 
     fallback = False
     probe = "cpu" if args.device == "cpu" else ("tpu" if args.device == "tpu"
@@ -791,6 +883,8 @@ def main():
     lowered = lower_pretrain_step(step_fn, ids)
     bytes_fields = _bytes_fields(lowered, audit=args.audit, label=preset)
     bytes_fields.update(_lint_fields(lowered, args.lint, label=preset))
+    bytes_fields.update(_mem_fields(lowered, args.mem, label=preset,
+                                    hbm_budget=args.hbm_budget))
 
     if args.audit_only:
         print(json.dumps(_stamp({

@@ -61,6 +61,9 @@ from .host_lint import lint_source as host_lint_source
 from .host_lint import lint_tree as host_lint_tree
 from .jaxpr_lint import (
     DEFAULT_BIG_BUFFER, lint_donation, lint_jaxpr, lint_python_scalars)
+from .liveness import (
+    LivenessResult, analyze_lowered, analyze_text, xla_peak_bytes)
+from .memory_lint import GATED_MEM_CODES, lint_memory, lint_memory_text
 from .schedule_lint import (
     build_schedule, bubble_fraction, check_schedule, lint_schedule)
 from .spec_algebra import Transfer, expected_collectives, normalize_spec, transition
@@ -75,6 +78,8 @@ __all__ = [
     "CollectiveSig", "collective_sequence", "match_collectives",
     "lint_rank_divergence", "lint_hlo_rank_divergence",
     "host_lint_source", "host_lint_paths", "host_lint_tree",
+    "LivenessResult", "analyze_lowered", "analyze_text", "xla_peak_bytes",
+    "GATED_MEM_CODES", "lint_memory", "lint_memory_text",
 ]
 
 
@@ -125,8 +130,15 @@ def _declared_params(lowered, declared_specs) -> Dict[int, Tuple[str, int, bool]
 
 def lint_lowered(lowered, *, mesh=None, expected: Iterable[Any] = (),
                  declared_specs=None,
-                 big_buffer_bytes: int = DEFAULT_BIG_BUFFER) -> Report:
+                 big_buffer_bytes: int = DEFAULT_BIG_BUFFER,
+                 hbm_budget: Optional[int] = None,
+                 mem: bool = False) -> Report:
     """Lint an already-``lower()``-ed computation (donation + HLO levels).
+
+    ``hbm_budget`` (per-device bytes) or ``mem=True`` additionally runs the
+    liveness-based memory lint (:mod:`.memory_lint`): peak-resident bytes
+    cross-checked against ``memory_analysis()``, donation/remat advisors,
+    and the ``mem-over-budget`` check against the declared budget.
 
     Use :func:`check` when you still hold the Python callable — it adds the
     jaxpr-walk lints (upcasts, host transfers, Python scalars) on top.
@@ -134,7 +146,8 @@ def lint_lowered(lowered, *, mesh=None, expected: Iterable[Any] = (),
     rep = Report()
     rep.extend(lint_donation(lowered, big_buffer_bytes))
     try:
-        text = lowered.compile().as_text()
+        compiled = lowered.compile()
+        text = compiled.as_text()
     except Exception as e:  # backend without HLO text access
         rep.meta["hlo_error"] = repr(e)
         return rep
@@ -148,6 +161,14 @@ def lint_lowered(lowered, *, mesh=None, expected: Iterable[Any] = (),
         # hoist the collective out of the conditional; the jaxpr-level
         # walk in check() is the authoritative detector)
         rep.extend(lint_hlo_rank_divergence(text))
+        if mem or hbm_budget is not None:
+            mrep = lint_memory(compiled, hbm_budget=hbm_budget,
+                               declared_params=declared,
+                               big_buffer_bytes=big_buffer_bytes)
+            rep.extend(mrep)
+            for k in ("peak_bytes", "xla_peak_bytes", "peak_agreement"):
+                if k in mrep.meta:
+                    rep.meta[k] = mrep.meta[k]
     return rep
 
 
@@ -155,7 +176,8 @@ def check(fn, args: Tuple[Any, ...] = (), kwargs: Optional[dict] = None, *,
           mesh=None, in_specs=None, out_specs=None,
           donate_argnums=None, static_argnums=None,
           expected: Iterable[Any] = (), declared_specs=None,
-          big_buffer_bytes: int = DEFAULT_BIG_BUFFER) -> Report:
+          big_buffer_bytes: int = DEFAULT_BIG_BUFFER,
+          hbm_budget: Optional[int] = None, mem: bool = False) -> Report:
     """Statically analyze ``fn(*args, **kwargs)`` — traces and compiles,
     never executes.
 
@@ -202,6 +224,7 @@ def check(fn, args: Tuple[Any, ...] = (), kwargs: Optional[dict] = None, *,
         declared_specs = in_specs
     rep.extend(lint_lowered(lowered, mesh=mesh, expected=expected,
                             declared_specs=declared_specs,
-                            big_buffer_bytes=big_buffer_bytes))
+                            big_buffer_bytes=big_buffer_bytes,
+                            hbm_budget=hbm_budget, mem=mem))
     rep.meta["fn"] = getattr(fn, "__name__", type(fn).__name__)
     return rep

@@ -32,8 +32,10 @@ from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple, Uni
 
 from jax import core as jax_core
 
-from ..profiler.fusion_audit import _INSTR_RE, _split_type_op, shape_bytes
 from .findings import Report
+from .hlo_ir import BRANCHES_RE as _BRANCHES_RE
+from .hlo_ir import COMP_REF_RE as _COMP_REF_RE
+from .hlo_ir import shape_bytes, split_computations
 from .hlo_lint import COLLECTIVE_OPS
 
 __all__ = [
@@ -53,10 +55,6 @@ JAXPR_COLLECTIVES = frozenset({
 _RANK_SOURCE_PRIMS = ("axis_index", "axis_size")  # rank-identity producers
 _HLO_RANK_OPS = ("partition-id", "replica-id")
 
-_COMP_REF_RE = re.compile(
-    r"(?:to_apply|calls|condition|body|true_computation|false_computation)"
-    r"=%?([\w.\-]+)")
-_BRANCHES_RE = re.compile(r"branch_computations=\{([^}]*)\}")
 _GROUPS_NESTED_RE = re.compile(r"replica_groups=(\{\{.*?\}\})")
 _GROUPS_IOTA_RE = re.compile(r"replica_groups=(\[[^\]]*\]<=\[[^\]]*\])")
 _GROUPS_FLAT_RE = re.compile(r"replica_groups=(\{[^{}]*\})")
@@ -84,46 +82,10 @@ def _parse_groups(tail: str) -> str:
 
 
 def _parse_computations(text: str) -> List[Tuple[str, List[Tuple[str, str, str, List[str]]]]]:
-    """Split a full HLO dump into computations, in file order.
-
-    Returns ``[(comp_name, [(instr_name, opcode, type_str, tail), ...])]``
-    — a lighter sibling of :func:`.hlo_lint.parse_hlo_module` that keeps
-    EVERY computation (branch bodies, scan bodies), not just ENTRY.
-    """
-    comps: List[Tuple[str, list]] = []
-    cur: Optional[Tuple[str, list]] = None
-    head_re = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*(?:\(.*)?\{\s*$")
-    for raw in text.splitlines():
-        line = raw.strip()
-        if cur is None:
-            m = head_re.match(raw)
-            if m and not line.startswith("//"):
-                cur = (m.group(1), [])
-            continue
-        if line == "}" or line.startswith("}"):
-            comps.append(cur)
-            cur = None
-            continue
-        mi = _INSTR_RE.match(line)
-        if not mi or "=" not in line:
-            continue
-        type_str, opcode, tail = _split_type_op(mi.group("rest"))
-        if opcode:
-            cur[1].append((mi.group("name"), opcode, type_str, tail))
-    if cur is not None:
-        comps.append(cur)
-    if not comps and text.strip():   # bare instruction list (toy tests)
-        instrs = []
-        for raw in text.splitlines():
-            line = raw.strip()
-            mi = _INSTR_RE.match(line)
-            if not mi or "=" not in line:
-                continue
-            type_str, opcode, tail = _split_type_op(mi.group("rest"))
-            if opcode:
-                instrs.append((mi.group("name"), opcode, type_str, tail))
-        comps.append(("entry", instrs))
-    return comps
+    """Split a full HLO dump into computations, in file order — EVERY
+    computation (branch bodies, scan bodies), not just ENTRY.  Now a thin
+    alias of :func:`.hlo_ir.split_computations` (the hoisted parser)."""
+    return split_computations(text)
 
 
 def _norm_opcode(op: str) -> Optional[str]:

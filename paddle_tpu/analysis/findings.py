@@ -65,6 +65,21 @@ code                      level  meaning
                                  all-gather last resort (or broke the
                                  2x-shard peak bound) — the move
                                  materializes the full array per device
+``mem-over-budget``       mem    liveness-modeled peak-resident bytes
+                                 exceed the declared per-device HBM
+                                 budget — the program cannot fit
+``mem-donation-would-help`` mem  a non-donated large input has a matching
+                                 un-aliased output slot and donating it
+                                 provably lowers the modeled peak (the
+                                 finding carries the byte delta)
+``mem-remat-candidate``   mem    a large activation stays resident across
+                                 >= K compute instructions while the peak
+                                 is hit — remat would trade the bytes for
+                                 FLOPs (advisory, not gated)
+``mem-replicated-resident`` mem  a buffer is resident at global size on
+                                 every device despite a sharded declared
+                                 spec — the residency twin of
+                                 ``replicated-buffer``
 ========================  =====  ========================================
 
 Severity is ``high`` / ``medium`` / ``low``; ranking is by severity first,

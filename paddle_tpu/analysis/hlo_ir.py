@@ -1,0 +1,230 @@
+"""The ONE home of the HLO text parser.
+
+Four passes walk optimized HLO text (``compiled.as_text()``):
+``profiler.fusion_audit`` (per-fusion traffic), ``analysis.hlo_lint``
+(collectives / replicated buffers), ``analysis.collective_match``
+(cross-rank sequences over ALL computations) and ``analysis.liveness``
+(buffer lifetimes / peak residency).  They used to share regexes by
+importing each other; this module hoists the common primitives so the
+parser has one definition and no import cycles — it is pure stdlib (no
+jax, no intra-repo imports), so every layer can depend on it.
+
+What lives here is the *lexical* layer only: instruction splitting, type
+byte-sizing, computation splitting, header metadata.  Operand-resolution
+semantics stay in each consumer (fusion_audit requires the ``%`` sigil,
+hlo_lint accepts bare names) — hoisting those would silently change
+findings, and the lint/bytes gates pin byte-identical results.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Set, Tuple
+
+__all__ = [
+    "DTYPE_BYTES", "INSTR_RE", "SHAPE_RE", "COMP_REF_RE", "BRANCHES_RE",
+    "shape_bytes", "split_type_op", "paren_args", "entry_body",
+    "split_computations", "entry_name", "module_header", "output_aliases",
+]
+
+DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s4": 1, "u4": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1, "f8e5m2fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+SHAPE_RE = re.compile(r"(\w+)\[([^\]]*)\]")
+INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*(?P<rest>.+)$")
+
+# references from an instruction tail to other computations (call sites)
+COMP_REF_RE = re.compile(
+    r"(?:to_apply|calls|condition|body|true_computation|false_computation)"
+    r"=%?([\w.\-]+)")
+BRANCHES_RE = re.compile(r"branch_computations=\{([^}]*)\}")
+
+_NUM_PARTITIONS_RE = re.compile(r"num_partitions=(\d+)")
+_ALIAS_PARAM_RE = re.compile(r"\(\s*(\d+)\s*,")
+_ALIAS_PAIR_RE = re.compile(r"\{([\d,\s]*)\}:\s*\(\s*(\d+)")
+
+
+def _alias_block(text: str) -> str:
+    """The full brace-balanced ``input_output_alias={...}`` header block.
+
+    (A non-greedy regex stops at the first ``{}`` inside the first pair and
+    silently drops every donated param after it — the block nests braces,
+    so it needs a balanced scan.)"""
+    header = text.split("\n", 1)[0] if text.startswith("HloModule") else ""
+    key = "input_output_alias="
+    s = header.find(key)
+    if s < 0:
+        return ""
+    i = header.find("{", s)
+    if i < 0:
+        return ""
+    depth = 0
+    for j in range(i, len(header)):
+        if header[j] == "{":
+            depth += 1
+        elif header[j] == "}":
+            depth -= 1
+            if depth == 0:
+                return header[i + 1: j]
+    return header[i + 1:]
+_ENTRY_NAME_RE = re.compile(r"^ENTRY\s+%?([\w.\-]+)", re.M)
+_COMP_HEAD_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*(?:\(.*)?\{\s*$")
+
+
+def shape_bytes(type_str: str) -> int:
+    """Bytes of an HLO type string: ``f32[128,256]{1,0}``, tuples, scalars.
+
+    Dynamic dims (``<=N``) count at their bound; unknown dtypes count 0
+    (token/opaque)."""
+    total = 0
+    for dtype, dims in SHAPE_RE.findall(type_str):
+        width = DTYPE_BYTES.get(dtype)
+        if width is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            d = d.strip().lstrip("<=").strip()
+            if d:
+                n *= int(d)
+        total += n * width
+    if total == 0 and "[" not in type_str:
+        # bare scalar like "f32" (rare in text dumps)
+        total = DTYPE_BYTES.get(type_str.strip(), 0)
+    return total
+
+
+def split_type_op(rest: str) -> Tuple[str, str, str]:
+    """Split ``f32[2]{0} fusion(%a, %b), kind=...`` into
+    (type_str, opcode, tail-after-opcode)."""
+    rest = rest.strip()
+    if rest.startswith("("):  # tuple type — find balanced paren
+        depth = 0
+        for i, c in enumerate(rest):
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+        type_str, rest2 = rest[: i + 1], rest[i + 1:].strip()
+    else:
+        sp = rest.find(" ")
+        if sp < 0:
+            return rest, "", ""
+        type_str, rest2 = rest[:sp], rest[sp + 1:].strip()
+    m = re.match(r"([\w\-]+)", rest2)
+    opcode = m.group(1) if m else ""
+    return type_str, opcode, rest2[len(opcode):]
+
+
+def paren_args(tail: str) -> str:
+    """The balanced ``(...)`` operand list right after the opcode."""
+    start = tail.find("(")
+    if start < 0:
+        return ""
+    depth = 0
+    for i in range(start, len(tail)):
+        if tail[i] == "(":
+            depth += 1
+        elif tail[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return tail[start + 1: i]
+    return tail[start + 1:]
+
+
+def entry_body(text: str) -> str:
+    """The ENTRY computation's instruction lines (between ``ENTRY ... {``
+    and its closing ``}``), or the whole text for a bare instruction list
+    (toy tests)."""
+    m = re.search(r"^ENTRY [^\n]*\{\s*$", text, re.M)
+    if m:
+        rest = text[m.end():]
+        close = rest.find("\n}")
+        return rest[: close if close >= 0 else len(rest)]
+    return text
+
+
+Instr = Tuple[str, str, str, str]  # (name, opcode, type_str, tail)
+
+
+def split_computations(text: str) -> List[Tuple[str, List[Instr]]]:
+    """Split a full HLO dump into computations, in file order.
+
+    Returns ``[(comp_name, [(instr_name, opcode, type_str, tail), ...])]``
+    — EVERY computation (branch bodies, scan bodies), not just ENTRY.
+    A header-less bare instruction list (toy tests) comes back as one
+    computation named ``"entry"``.
+    """
+    comps: List[Tuple[str, list]] = []
+    cur: Optional[Tuple[str, list]] = None
+    for raw in text.splitlines():
+        line = raw.strip()
+        if cur is None:
+            m = _COMP_HEAD_RE.match(raw)
+            if m and not line.startswith("//"):
+                cur = (m.group(1), [])
+            continue
+        if line == "}" or line.startswith("}"):
+            comps.append(cur)
+            cur = None
+            continue
+        mi = INSTR_RE.match(line)
+        if not mi or "=" not in line:
+            continue
+        type_str, opcode, tail = split_type_op(mi.group("rest"))
+        if opcode:
+            cur[1].append((mi.group("name"), opcode, type_str, tail))
+    if cur is not None:
+        comps.append(cur)
+    if not comps and text.strip():   # bare instruction list (toy tests)
+        instrs = []
+        for raw in text.splitlines():
+            line = raw.strip()
+            mi = INSTR_RE.match(line)
+            if not mi or "=" not in line:
+                continue
+            type_str, opcode, tail = split_type_op(mi.group("rest"))
+            if opcode:
+                instrs.append((mi.group("name"), opcode, type_str, tail))
+        comps.append(("entry", instrs))
+    return comps
+
+
+def entry_name(text: str) -> Optional[str]:
+    """Name of the ENTRY computation (``None`` for a bare instruction
+    list — callers fall back to the last computation in file order)."""
+    m = _ENTRY_NAME_RE.search(text)
+    return m.group(1) if m else None
+
+
+def module_header(text: str) -> Tuple[int, Set[int]]:
+    """Header metadata: ``(num_partitions, donated param indices)``.
+
+    Donation comes from the ``input_output_alias`` block — each aliased
+    pair names the entry parameter whose buffer the output reuses."""
+    header = text.split("\n", 1)[0] if text.startswith("HloModule") else ""
+    num_partitions = 1
+    m = _NUM_PARTITIONS_RE.search(header)
+    if m:
+        num_partitions = int(m.group(1))
+    donated = {int(i) for i in _ALIAS_PARAM_RE.findall(_alias_block(text))}
+    return num_partitions, donated
+
+
+def output_aliases(text: str):
+    """``{output tuple index: param index}`` from the ``input_output_alias``
+    header: which ROOT element reuses which donated parameter's buffer.
+    A ``{}`` output index (non-tuple result) maps from index 0."""
+    out = {}
+    for oidx, pidx in _ALIAS_PAIR_RE.findall(_alias_block(text)):
+        first = oidx.split(",")[0].strip()
+        out[int(first) if first else 0] = int(pidx)
+    return out

@@ -24,9 +24,11 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Mapping, Optional, Set, Tuple
 
-from ..profiler.fusion_audit import (
-    _INSTR_RE, _paren_args, _split_type_op, shape_bytes)
 from .findings import Report
+from .hlo_ir import (
+    INSTR_RE as _INSTR_RE, entry_body, module_header,
+    paren_args as _paren_args, shape_bytes,
+    split_type_op as _split_type_op)
 
 __all__ = ["HloInstr", "HloModuleInfo", "parse_hlo_module", "lint_hlo_text"]
 
@@ -41,9 +43,6 @@ _PASS_OPS = {
     "get-tuple-element", "slice", "dynamic-slice",
 }
 
-_NUM_PARTITIONS_RE = re.compile(r"num_partitions=(\d+)")
-_ALIAS_BLOCK_RE = re.compile(r"input_output_alias=\{(.*?)\}\s*[,\n]")
-_ALIAS_PARAM_RE = re.compile(r"\(\s*(\d+)\s*,")
 _TARGET_RE = re.compile(r'custom_call_target="([^"]+)"')
 
 
@@ -105,22 +104,8 @@ class HloModuleInfo:
 def parse_hlo_module(text: str) -> HloModuleInfo:
     """Parse header metadata + ENTRY instruction stream of an HLO dump."""
     info = HloModuleInfo()
-    header = text.split("\n", 1)[0] if text.startswith("HloModule") else ""
-    m = _NUM_PARTITIONS_RE.search(header)
-    if m:
-        info.num_partitions = int(m.group(1))
-    m = _ALIAS_BLOCK_RE.search(header + "\n")
-    if m:
-        info.donated_params = {
-            int(i) for i in _ALIAS_PARAM_RE.findall(m.group(1))}
-
-    m = re.search(r"^ENTRY [^\n]*\{\s*$", text, re.M)
-    if m:
-        rest = text[m.end():]
-        close = rest.find("\n}")
-        entry = rest[: close if close >= 0 else len(rest)]
-    else:  # bare instruction list (toy tests)
-        entry = text
+    info.num_partitions, info.donated_params = module_header(text)
+    entry = entry_body(text)
 
     for raw in entry.splitlines():
         line = raw.strip()

@@ -15,6 +15,15 @@ prints one JSON line::
 dst_shard)`` — the gate fails above 2.0.  ``gather_peak_bytes`` is the
 peak of the gather-then-scatter baseline (full replica + shard) for the
 same worst-case pair, the number PERF.md compares against.
+
+The sweep also compiles every distinct collective step (the executor's
+own cached program) and checks the HLO-derived ACTUAL per-device peak
+against the modeled bound: ``hlo_max_io_ratio`` (compiled argument +
+output - alias vs the same 2x-shard denominator; gated at 2.0,
+violations listed in ``hlo_violating_plans``) and ``hlo_max_live_ratio``
+(temp-inclusive liveness peak from ``analysis.liveness`` — recorded
+only: the CPU backend emulates collectives through scratch buffers that
+a TPU runs in place).
 """
 
 from __future__ import annotations
@@ -33,14 +42,16 @@ def _catalog(mesh_cls, devices):
     return full, shrunk
 
 
-def run_audit(shape=(256, 256), dtype="float32"):
+def run_audit(shape=(256, 256), dtype="float32", hlo_check=True):
     import itertools
 
     import numpy as np
     import jax
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    from ...analysis.liveness import analyze_text, xla_peak_bytes
     from ...analysis.spec_algebra import expected_collectives
+    from .executor import _pspec, _step_fn
     from .planner import plan_reshard
 
     if len(jax.devices()) < 8:
@@ -64,6 +75,41 @@ def run_audit(shape=(256, 256), dtype="float32"):
     kinds_ok = True
     worst_peak = 0
     gather_peak = 0
+
+    # HLO cross-check: lower + compile each distinct collective step (the
+    # executor's own program, same cache key) and hold the compiled
+    # module's ACTUAL per-device footprint against the modeled bound.
+    # Gate number: I/O peak (argument + output - alias) vs 2x shard —
+    # the device-resident buffers the plan promises.  The temp-inclusive
+    # liveness peak is recorded (CPU collective emulation buffers inflate
+    # it; on TPU the collectives run in-place) but not gated.
+    hlo_cache = {}
+    hlo_plans = hlo_steps = io_violations = 0
+    max_io_ratio = max_live_ratio = 0.0
+    violating = []
+
+    def _step_peaks(step):
+        key = (step.mesh, step.kind, step.axis, step.dim, step.src_dim,
+               step.order_from, step.order_to, step.spec_before,
+               step.spec_after)
+        got = hlo_cache.get(key)
+        if got is None:
+            sds = jax.ShapeDtypeStruct(
+                shape, dtype,
+                sharding=NamedSharding(step.mesh, _pspec(step.spec_before)))
+            compiled = _step_fn(step).lower(sds).compile()
+            xp = xla_peak_bytes(compiled)
+            io = 0
+            if xp is not None:
+                ma = xp[1]
+                io = int(ma.argument_size_in_bytes
+                         + ma.output_size_in_bytes
+                         - ma.alias_size_in_bytes)
+            live = analyze_text(compiled.as_text()).peak_bytes
+            got = (io, live)
+            hlo_cache[key] = got
+        return got
+
     for (src, dst), dmesh in itertools.product(
             itertools.product(specs, specs), dst_meshes):
         plan = plan_reshard(full, src, dmesh, dst, shape, dtype)
@@ -79,17 +125,48 @@ def run_audit(shape=(256, 256), dtype="float32"):
         if plan.collective_kinds() - expected_collectives([(src, dst, 2)],
                                                           full):
             kinds_ok = False
-    return {"n_plans": n_plans, "n_bounded": n_bounded,
-            "max_peak_ratio": round(max_ratio, 4), "kinds_ok": kinds_ok,
-            "planned_peak_bytes": worst_peak,
-            "gather_peak_bytes": gather_peak}
+        if hlo_check:
+            coll_steps = [s for s in plan.steps if s.kind != "remesh"]
+            if coll_steps:
+                hlo_plans += 1
+                hlo_steps += len(coll_steps)
+                plan_io = plan_live = 0
+                for s in coll_steps:
+                    io, live = _step_peaks(s)
+                    plan_io = max(plan_io, io)
+                    plan_live = max(plan_live, live)
+                io_ratio = plan_io / denom
+                max_io_ratio = max(max_io_ratio, io_ratio)
+                max_live_ratio = max(max_live_ratio, plan_live / denom)
+                if io_ratio > 2.0:
+                    io_violations += 1
+                    if len(violating) < 8:
+                        violating.append(f"{src}->{dst}@{dmesh.shape} "
+                                         f"io_ratio={io_ratio:.2f}")
+
+    out = {"n_plans": n_plans, "n_bounded": n_bounded,
+           "max_peak_ratio": round(max_ratio, 4), "kinds_ok": kinds_ok,
+           "planned_peak_bytes": worst_peak,
+           "gather_peak_bytes": gather_peak}
+    if hlo_check:
+        out.update({
+            "hlo_plans_checked": hlo_plans,
+            "hlo_steps_checked": hlo_steps,
+            "hlo_programs_compiled": len(hlo_cache),
+            "hlo_max_io_ratio": round(max_io_ratio, 4),
+            "hlo_io_violations": io_violations,
+            "hlo_violating_plans": violating,
+            "hlo_max_live_ratio": round(max_live_ratio, 4),
+        })
+    return out
 
 
 def main(argv=None) -> int:
     result = run_audit()
     print(json.dumps(result, sort_keys=True))
     ok = (result["max_peak_ratio"] <= 2.0 and result["kinds_ok"]
-          and result["n_bounded"] == result["n_plans"])
+          and result["n_bounded"] == result["n_plans"]
+          and result.get("hlo_io_violations", 0) == 0)
     return 0 if ok else 1
 
 

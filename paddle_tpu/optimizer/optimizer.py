@@ -176,10 +176,11 @@ class Optimizer:
         from ..kernels.adamw import fused_enabled
 
         fused_on, interpret = fused_enabled()
-        # GSPMD has no partitioning rule for the Mosaic custom call, so the
-        # compiled fused kernel composes with shard_update only via shard_map
-        # (future work); interpret mode discharges to plain HLO and shards.
-        fused_on = fused_on and (interpret or self._wus is None)
+        # fused + shard_update compose for both kernel modes: interpret
+        # discharges to plain HLO (GSPMD partitions it), and the compiled
+        # Mosaic custom call routes through shard_map in Adam._fused_leaf
+        # (GSPMD has no partitioning rule for the custom call, so the
+        # per-shard world is entered explicitly).
 
         def update_all(params, grads, states, lr, step):
             new_params, new_states = [], []
@@ -317,8 +318,7 @@ class Optimizer:
         decoupled = self._decoupled_decay()
         from ..kernels.adamw import fused_enabled
 
-        fused_on, interpret = fused_enabled()
-        fused_on = fused_on and (interpret or self._wus is None)  # see _build_update_fn
+        fused_on, interpret = fused_enabled()  # composes with _wus, see _build_update_fn
 
         def init_fn(params):
             def per_leaf(p):
@@ -420,13 +420,36 @@ class Adam(Optimizer):
             return None  # NAdam/RAdam override the math — no fused kernel
         if set(slots) != {"m", "v"} or p32.dtype != jnp.float32:
             return None
+        import functools
+
         from ..kernels.adamw import adamw_update
 
-        p_new, m, v, p_out = adamw_update(
-            p32, g32, slots["m"], slots["v"], lr, step,
+        kernel = functools.partial(
+            adamw_update,
             beta1=self._beta1, beta2=self._beta2, epsilon=self._epsilon,
             weight_decay=self._weight_decay, decoupled=self._decoupled_decay(),
             apply_decay=apply_decay, out_dtype=out_dtype, interpret=interpret)
+        if self._wus is not None:
+            # ZeRO-1 composition: GSPMD has no partitioning rule for the
+            # Mosaic custom call, so enter the per-shard world explicitly —
+            # shard_map hands each device its slot shard and the kernel runs
+            # on shard-local data.  Bit-exact vs the unsharded kernel: the
+            # update is purely elementwise (tests/test_fused_adamw.py).
+            from jax.sharding import PartitionSpec as P
+
+            from ..framework.shard_map_compat import shard_map
+
+            mesh, axis = self._wus
+            spec = _wus_partition_spec(p32.shape, mesh.shape[axis], axis)
+            if spec != P():   # replicated leaves run the kernel as-is
+                fn = shard_map(kernel, mesh=mesh,
+                               in_specs=(spec, spec, spec, spec, P(), P()),
+                               out_specs=(spec, spec, spec, spec),
+                               check_vma=False)
+                p_new, m, v, p_out = fn(p32, g32, slots["m"], slots["v"],
+                                        lr, step)
+                return p_new, {"m": m, "v": v}, p_out
+        p_new, m, v, p_out = kernel(p32, g32, slots["m"], slots["v"], lr, step)
         return p_new, {"m": m, "v": v}, p_out
 
 

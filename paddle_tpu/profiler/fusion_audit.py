@@ -36,19 +36,25 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+# Parser primitives live in analysis/hlo_ir.py (the hoisted single-home
+# parser shared with hlo_lint / collective_match / liveness).  The private
+# aliases stay as back-compat re-exports for anything that imported them
+# from here.  hlo_ir is import-cycle-safe: it pulls in nothing from the
+# repo, and nothing under analysis/ imports this module at top level.
+from ..analysis.hlo_ir import (
+    DTYPE_BYTES as _DTYPE_BYTES,
+    INSTR_RE as _INSTR_RE,
+    SHAPE_RE as _SHAPE_RE,
+    entry_body as _entry_body,
+    paren_args as _paren_args,
+    shape_bytes,
+    split_type_op as _split_type_op,
+)
+
 __all__ = [
     "FusionRecord", "FusionAudit", "audit_hlo_text", "audit_compiled",
     "audit_lowered", "bytes_per_step", "shape_bytes",
 ]
-
-_DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1, "s4": 1, "u4": 1,
-    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1, "f8e5m2fnuz": 1,
-    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
-    "s32": 4, "u32": 4, "f32": 4,
-    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
-    "c128": 16,
-}
 
 # ops that move no HBM bytes of their own at top level
 _FREE_OPS = {
@@ -57,72 +63,7 @@ _FREE_OPS = {
     "reshape",  # layout-preserving reshape is a bitcast post-layout
 }
 
-_SHAPE_RE = re.compile(r"(\w+)\[([^\]]*)\]")
-_INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*(?P<rest>.+)$")
 _KIND_RE = re.compile(r"kind=k(\w+)")
-
-
-def shape_bytes(type_str: str) -> int:
-    """Bytes of an HLO type string: ``f32[128,256]{1,0}``, tuples, scalars.
-
-    Dynamic dims (``<=N``) count at their bound; unknown dtypes count 0
-    (token/opaque)."""
-    total = 0
-    for dtype, dims in _SHAPE_RE.findall(type_str):
-        width = _DTYPE_BYTES.get(dtype)
-        if width is None:
-            continue
-        n = 1
-        for d in dims.split(","):
-            d = d.strip().lstrip("<=").strip()
-            if d:
-                n *= int(d)
-        total += n * width
-    if total == 0 and "[" not in type_str:
-        # bare scalar like "f32" (rare in text dumps)
-        total = _DTYPE_BYTES.get(type_str.strip(), 0)
-    return total
-
-
-def _split_type_op(rest: str) -> Tuple[str, str, str]:
-    """Split ``f32[2]{0} fusion(%a, %b), kind=...`` into
-    (type_str, opcode, tail-after-opcode)."""
-    rest = rest.strip()
-    if rest.startswith("("):  # tuple type — find balanced paren
-        depth = 0
-        for i, c in enumerate(rest):
-            if c == "(":
-                depth += 1
-            elif c == ")":
-                depth -= 1
-                if depth == 0:
-                    break
-        type_str, rest2 = rest[: i + 1], rest[i + 1:].strip()
-    else:
-        sp = rest.find(" ")
-        if sp < 0:
-            return rest, "", ""
-        type_str, rest2 = rest[:sp], rest[sp + 1:].strip()
-    m = re.match(r"([\w\-]+)", rest2)
-    opcode = m.group(1) if m else ""
-    return type_str, opcode, rest2[len(opcode):]
-
-
-def _paren_args(tail: str) -> str:
-    """The balanced ``(...)`` operand list right after the opcode."""
-    start = tail.find("(")
-    if start < 0:
-        return ""
-    depth = 0
-    for i in range(start, len(tail)):
-        if tail[i] == "(":
-            depth += 1
-        elif tail[i] == ")":
-            depth -= 1
-            if depth == 0:
-                return tail[start + 1: i]
-    return tail[start + 1:]
 
 
 @dataclass
@@ -195,15 +136,7 @@ class FusionAudit:
 
 def audit_hlo_text(text: str) -> FusionAudit:
     """Audit the ENTRY computation of an optimized HLO text dump."""
-    # isolate ENTRY body (between "ENTRY ... {" and its closing "}")
-    entry = None
-    m = re.search(r"^ENTRY [^\n]*\{\s*$", text, re.M)
-    if m:
-        rest = text[m.end():]
-        close = rest.find("\n}")
-        entry = rest[: close if close >= 0 else len(rest)]
-    else:  # bare instruction list (toy tests)
-        entry = text
+    entry = _entry_body(text)
 
     sizes: Dict[str, int] = {}
     records: List[FusionRecord] = []

@@ -121,7 +121,8 @@ class Engine:
     def __init__(self, model, max_batch: int = 8, num_blocks: int = 256,
                  block_size: int = 128,
                  prefill_buckets: Tuple[int, ...] = (128, 256, 512, 1024),
-                 max_prefill_overhead: float = 1.0, decode_chunk: int = 32):
+                 max_prefill_overhead: float = 1.0, decode_chunk: int = 32,
+                 hbm_budget_bytes: Optional[int] = None):
         from ..jit import functional_call
 
         self.model = model
@@ -149,8 +150,7 @@ class Engine:
 
         self._params = {n: p._data for n, p in model.named_parameters()}
         self._buffers = {n: b._data for n, b in model.named_buffers()}
-        self.k_pools, self.v_pools = model.llama.init_paged_pools(
-            num_blocks, block_size)
+        self.hbm_budget_bytes = hbm_budget_bytes
 
         # block 0 is the shared trash block for inactive slots
         self._free = collections.deque(range(1, num_blocks))
@@ -178,6 +178,23 @@ class Engine:
         self._first_seg = 512
         self._first_buf = jnp.zeros((self._first_seg,), jnp.int32)
         self._first_idx = 0
+        # static HBM sizing BEFORE the pool allocation: params + KV pools +
+        # tables + program workspace, refused up front when the budget can't
+        # fit — the OOM happens here, in Python, with a component breakdown,
+        # not mid-serving inside XLA
+        if hbm_budget_bytes is not None:
+            plan = self.memory_plan()
+            if plan["total_bytes"] > hbm_budget_bytes:
+                detail = ", ".join(f"{k}={v / 1e6:.1f}MB"
+                                   for k, v in plan.items()
+                                   if k != "total_bytes")
+                raise ValueError(
+                    f"serving memory plan {plan['total_bytes'] / 1e6:.1f}MB "
+                    f"exceeds hbm_budget_bytes={hbm_budget_bytes / 1e6:.1f}MB"
+                    f" ({detail}); reduce num_blocks (kv_pool_bytes scales "
+                    f"linearly with it) or max_batch")
+        self.k_pools, self.v_pools = model.llama.init_paged_pools(
+            num_blocks, block_size)
         self._full_tok_bufs: List[object] = []
         self._full_first_bufs: List[object] = []
         # deferred-sync state: dispatch-ordered ledger of unmaterialized
@@ -191,6 +208,47 @@ class Engine:
                       "decode_calls": 0, "syncs": 0, "sync_time": 0.0}
 
     # -- public API ---------------------------------------------------------
+
+    def memory_plan(self) -> Dict[str, int]:
+        """Static HBM sizing of everything the engine keeps resident plus
+        the transient residency of its two program families — pure
+        arithmetic over the config, safe before any device allocation.
+
+        ``total_bytes`` = resident state + max(decode, prefill) workspace
+        (the two program families never run concurrently on one device).
+        The workspace terms are the analytic dominators: hidden states +
+        logits for a full-width decode chunk step; activations + attention
+        scores + logits at the largest prefill bucket on the widest ladder
+        rung.  ``analysis.lint_memory`` on the lowered programs is the
+        exact cross-check (``bench.py --preset serve --mem``)."""
+        import numpy as np
+
+        cfg = self.cfg
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        params_b = sum(int(np.prod(v.shape)) * jnp.dtype(v.dtype).itemsize
+                       for v in self._params.values())
+        buffers_b = sum(int(np.prod(v.shape)) * jnp.dtype(v.dtype).itemsize
+                        for v in self._buffers.values())
+        kv_pool_b = (2 * cfg.num_hidden_layers * self.num_blocks
+                     * cfg.kv_heads * self.block_size * cfg.head_dim
+                     * itemsize)
+        table_b = (self.max_batch * self.max_blocks_per_seq * 4
+                   + self._tok_seg_rows * self.max_batch * 4
+                   + self._first_seg * 4 + self.max_batch * 4)
+        decode_b = self.max_batch * (4 * cfg.hidden_size
+                                     + cfg.vocab_size) * itemsize
+        Pb = max(self.prefill_buckets)
+        n_pf = min(4, self.max_batch)
+        prefill_b = n_pf * (2 * Pb * cfg.hidden_size
+                            + cfg.num_attention_heads * Pb * Pb
+                            + Pb * cfg.vocab_size) * itemsize
+        plan = {"params_bytes": params_b, "buffers_bytes": buffers_b,
+                "kv_pool_bytes": kv_pool_b, "table_bytes": table_b,
+                "decode_workspace_bytes": decode_b,
+                "prefill_workspace_bytes": prefill_b}
+        plan["total_bytes"] = (params_b + buffers_b + kv_pool_b + table_b
+                               + max(decode_b, prefill_b))
+        return plan
 
     def add_request(self, req: GenRequest) -> str:
         if req.request_id is None:

@@ -5,6 +5,7 @@
 #   tier-1        pytest tests/ -m 'not slow'  (the seed contract)
 #   bytes_gate    HBM bytes/step vs scripts/BYTES_BASELINE.json
 #   lint_gate     sharding/communication lint vs scripts/LINT_BASELINE.json
+#   mem_gate      liveness peak + memory lint vs scripts/MEM_BASELINE.json
 #   schedule_gate pipeline-schedule matrix + host self-lint
 #   reshard_gate  resharding property suite + plan-peak audit vs
 #                 scripts/RESHARD_BASELINE.json
@@ -32,6 +33,7 @@ stage tier-1 timeout -k 10 1200 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 stage bytes_gate    ./scripts/bytes_gate.sh
 stage lint_gate     ./scripts/lint_gate.sh
+stage mem_gate      ./scripts/mem_gate.sh
 stage schedule_gate ./scripts/schedule_gate.sh
 stage reshard_gate  ./scripts/reshard_gate.sh
 stage host_lint     python -m paddle_tpu.analysis.host_lint

@@ -50,6 +50,12 @@ if not r["kinds_ok"]:
     bad.append("plan emitted a collective outside spec_algebra's expected set")
 if r["n_bounded"] != r["n_plans"]:
     bad.append(f"only {r['n_bounded']}/{r['n_plans']} plans bounded")
+if r.get("hlo_max_io_ratio", 0) > 2.0:
+    bad.append(f"compiled-HLO I/O peak ratio {r['hlo_max_io_ratio']} > 2.0: "
+               f"{r.get('hlo_violating_plans')}")
+if r.get("hlo_io_violations", 0):
+    bad.append(f"{r['hlo_io_violations']} plans break the 2x-shard bound in "
+               f"compiled HLO: {r.get('hlo_violating_plans')}")
 if bad:
     print(f"[reshard_gate] audit: FAILED ({'; '.join(bad)})", file=sys.stderr)
     sys.exit(1)
@@ -67,7 +73,13 @@ if r["n_plans"] < base["n_plans"]:
     print(f"[reshard_gate] audit: FAILED (catalog shrank "
           f"{base['n_plans']} -> {r['n_plans']} plans)", file=sys.stderr)
     sys.exit(1)
+if r.get("hlo_max_io_ratio", 0) > base.get("hlo_max_io_ratio", 2.0):
+    print(f"[reshard_gate] audit: FAILED (hlo_max_io_ratio regressed "
+          f"{base.get('hlo_max_io_ratio')} -> {r['hlo_max_io_ratio']})",
+          file=sys.stderr)
+    sys.exit(1)
 print(f"[reshard_gate] audit: OK ratio={r['max_peak_ratio']} "
+      f"hlo_io={r.get('hlo_max_io_ratio')} "
       f"bounded={r['n_bounded']}/{r['n_plans']}", file=sys.stderr)
 PY
 fi

@@ -621,6 +621,107 @@ def _bench_serve(jax, paddle, backend, on_tpu, args):
     }
 
 
+def _bench_serve_trace(jax, paddle, backend, on_tpu, args):
+    """Load-generator trace presets for the serving tier (ISSUE 11).
+
+    Runs the SAME arrival trace twice in one process — feature on, then
+    feature off — so the headline numbers are self-relative ratios that
+    hold on any machine (wall-clock noise cancels), plus deterministic
+    accounting (hit rate, prefill tokens) and absolute latency percentiles
+    for the record:
+
+    - ``shared_prefix``: prefix cache on vs off.  ``goodput_ratio`` is the
+      acceptance number (>= 1.5x on the CPU proxy); greedy outputs must be
+      bit-identical between the two runs.
+    - ``long_prompt``: chunked prefill on vs off (monolithic).
+      ``decode_gap_p99_ratio`` (on/off, < 1 is better) is the stall the
+      chunking removes.
+    """
+    import numpy as np
+
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.serving import Engine
+    from paddle_tpu.serving.loadgen import make_trace, run_trace
+    from paddle_tpu.serving.router import Router
+
+    paddle.seed(0)
+    dtype = "bfloat16" if on_tpu else "float32"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=12,
+                          num_attention_heads=16, num_key_value_heads=8,
+                          max_position_embeddings=2048, dtype=dtype)
+        max_batch, num_blocks = (args.batch or 16), 256
+        n_req, shared_len, long_len, max_new = 32, 1024, 1024, 32
+    else:
+        from paddle_tpu.models import llama_tiny_config
+
+        # traces run 512-token prompts + decode: lift the tiny config's
+        # position table so the reference outputs are in-contract
+        cfg = llama_tiny_config(dtype=dtype, max_position_embeddings=1024)
+        max_batch, num_blocks = (args.batch or 2), 24
+        n_req, shared_len, long_len, max_new = 8, 384, 512, 8
+    model = LlamaForCausalLM(cfg)
+    trace = make_trace(args.trace, cfg.vocab_size, seed=0,
+                       n_requests=n_req, shared_len=shared_len,
+                       long_len=long_len, max_new_tokens=max_new)
+
+    def run(**eng_kw):
+        eng = Engine(model, max_batch=max_batch, num_blocks=num_blocks,
+                     prefill_buckets=(128, 256, 512), **eng_kw)
+        eng.warmup()
+        r = Router()
+        r.add_replica(eng)
+        return run_trace(r, trace)
+
+    if args.trace == "shared_prefix":
+        cache_on = args.serve_cache == "on"
+        m_on = run(prefix_cache=cache_on)
+        m_off = run(prefix_cache=False)
+        identical = m_on["outputs"] == m_off["outputs"]
+        result = {
+            "metric": "serve_trace_goodput_ratio",
+            "value": round(m_on["goodput_tps"] / max(m_off["goodput_tps"],
+                                                     1e-9), 4),
+            "unit": "x_vs_cache_off",
+            "hit_rate": round(m_on["hit_rate"], 4),
+            "prefill_tokens_on": m_on["prefill_tokens"],
+            "prefill_tokens_off": m_off["prefill_tokens"],
+            "outputs_bit_identical": identical,
+        }
+    else:
+        m_on = run(prefill_chunk=128)
+        m_off = run()
+        identical = m_on["outputs"] == m_off["outputs"]
+        result = {
+            "metric": "serve_trace_decode_gap_p99_ratio",
+            "value": round(m_on["decode_gap_p99_ms"]
+                           / max(m_off["decode_gap_p99_ms"], 1e-9), 4),
+            "unit": "x_vs_monolithic_prefill",
+            "decode_gap_p99_on_ms": round(m_on["decode_gap_p99_ms"], 3),
+            "decode_gap_p99_off_ms": round(m_off["decode_gap_p99_ms"], 3),
+            "outputs_bit_identical": identical,
+        }
+    dev_kind, _ = _peak_flops(jax, on_tpu)
+    result.update({
+        "preset": "serve",
+        "trace": args.trace,
+        "device": dev_kind,
+        "backend": backend,
+        "requests": n_req,
+        "completed_on": m_on["completed"],
+        "completed_off": m_off["completed"],
+        "goodput_tps_on": round(m_on["goodput_tps"], 2),
+        "goodput_tps_off": round(m_off["goodput_tps"], 2),
+        "p50_ms": round(m_on["p50_ms"], 3),
+        "p99_ms": round(m_on["p99_ms"], 3),
+        "mfu": 0.0,
+        "vs_baseline": 0.0,
+    })
+    return result
+
+
 def _bench_ocr(jax, paddle, backend, on_tpu, args):
     """DBNet detector train step: images/s; FLOPs from XLA's cost analysis of
     the compiled program (convs don't have a tidy closed form like 6P)."""
@@ -818,6 +919,15 @@ def main():
     ap.add_argument("--hbm-budget", type=int, default=None,
                     help="per-device HBM budget in bytes; implies --mem and "
                          "adds the mem-over-budget check")
+    ap.add_argument("--trace", default=None,
+                    choices=["shared_prefix", "long_prompt"],
+                    help="serve preset only: run the load-generator trace "
+                         "comparison (feature on vs off in one process) and "
+                         "report p50/p99 latency, goodput, and the on/off "
+                         "ratios instead of the steady-state trace")
+    ap.add_argument("--serve-cache", default="on", choices=["on", "off"],
+                    help="serve --trace only: force the prefix cache off in "
+                         "the feature-on run (gate injection hook)")
     ap.add_argument("--audit-only", action="store_true",
                     help="pretrain presets: lower + compile + cost-analyse "
                          "the step but skip the timed run (bytes_per_step "
@@ -835,7 +945,9 @@ def main():
     if probe != "tpu":
         fallback = probe == "wedged"
         custom_shape = any(v is not None for v in (args.batch, args.seq, args.steps))
-        if fallback and not custom_shape:
+        # a cached plain-serve line cannot satisfy a --trace request (different
+        # metric contract) — trace runs always execute on the CPU proxy
+        if fallback and not custom_shape and not args.trace:
             cached = _cached_tpu_result(args.preset)
             if cached is not None:
                 # no _stamp: re-stamping would falsify capture provenance
@@ -862,7 +974,10 @@ def main():
         print(json.dumps(_stamp(result)))
         return
     if preset == "serve":
-        result = _bench_serve(jax, paddle, backend, on_tpu, args)
+        if args.trace:
+            result = _bench_serve_trace(jax, paddle, backend, on_tpu, args)
+        else:
+            result = _bench_serve(jax, paddle, backend, on_tpu, args)
         print(json.dumps(_stamp(result)))
         return
     if preset == "ocr":

@@ -318,6 +318,117 @@ def _read_region(chunk_arrays, chunks, offset, shape, dtype):
     return out
 
 
+class _ChunkPrefetcher:
+    """Background file-stream reader that runs AHEAD of shard assembly.
+
+    Resume is a strict pipeline per tensor: read chunk -> assemble shard ->
+    device put (mesh bring-up).  The npz member decompress is pure host IO,
+    so a single reader thread walking the planned fetch schedule overlaps it
+    with the assembly/device work of the PREVIOUS chunk.  Look-ahead is
+    bounded (``PADDLE_TPU_RESUME_PREFETCH_DEPTH`` chunks, default 4) so the
+    prefetch can never hold more than a few chunks beyond the shard being
+    built — the same peak-memory contract the streaming load already makes.
+
+    The consumer may request keys out of schedule order (shard callback
+    order is the runtime's); a key not yet prefetched is read synchronously
+    and counted as a miss.  Reads use the thread's OWN file handles — npz
+    handles are not thread-safe.  A read error is parked and re-raised on
+    ``get`` of that key, inside the consumer's classification try block.
+    """
+
+    def __init__(self, path, schedule, depth: int = 4):
+        self._path = path
+        self._order = list(dict.fromkeys(schedule))  # unique, schedule order
+        self._uses: Dict[tuple, int] = {}
+        for key in schedule:  # one chunk can feed several shard regions
+            self._uses[key] = self._uses.get(key, 0) + 1
+        self._depth = max(int(depth), 1)
+        self._cv = threading.Condition()
+        self._ready: Dict[tuple, object] = {}
+        self._errors: Dict[tuple, BaseException] = {}
+        self._inflight = None
+        self._stop = False
+        self.stats = {"prefetch_hits": 0, "prefetch_misses": 0,
+                      "prefetch_wait_s": 0.0, "prefetch_read_s": 0.0}
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        import time as _time
+
+        files: Dict[str, np.lib.npyio.NpzFile] = {}
+        try:
+            for key in self._order:
+                with self._cv:
+                    while not self._stop and len(self._ready) >= self._depth:
+                        self._cv.wait(0.05)
+                    if self._stop:
+                        return
+                    if self._uses.get(key, 0) <= 0:  # consumer beat us to it
+                        continue
+                    self._inflight = key
+                fname, member = key
+                t0 = _time.perf_counter()
+                try:
+                    if fname not in files:
+                        files[fname] = np.load(os.path.join(self._path, fname))
+                    raw = files[fname][member]
+                except BaseException as e:  # parked; re-raised on get()
+                    with self._cv:
+                        self._inflight = None
+                        self._errors[key] = e
+                        self._cv.notify_all()
+                    continue
+                dt = _time.perf_counter() - t0
+                with self._cv:
+                    self._inflight = None
+                    self.stats["prefetch_read_s"] += dt
+                    self._ready[key] = raw
+                    self._cv.notify_all()
+        finally:
+            for f in files.values():
+                f.close()
+
+    def get(self, file_name, member):
+        """The prefetched raw array, or ``None`` for a miss (caller reads
+        synchronously).  Blocks only while the wanted key is mid-read —
+        never for a key the reader has not started, so an out-of-schedule
+        consumer cannot deadlock against the depth bound."""
+        import time as _time
+
+        key = (file_name, member)
+        with self._cv:
+            if self._uses.get(key, 0) <= 0:
+                self.stats["prefetch_misses"] += 1
+                return None
+            t0 = _time.perf_counter()
+            while (self._inflight == key and key not in self._ready
+                   and key not in self._errors and not self._stop):
+                self._cv.wait(0.05)
+            self.stats["prefetch_wait_s"] += _time.perf_counter() - t0
+            self._uses[key] -= 1
+            if key in self._errors:
+                err = self._errors[key]
+                if self._uses[key] <= 0:
+                    del self._errors[key]
+                raise err
+            if key in self._ready:
+                raw = self._ready[key]
+                if self._uses[key] <= 0:
+                    del self._ready[key]
+                self.stats["prefetch_hits"] += 1
+                self._cv.notify_all()
+                return raw
+            self.stats["prefetch_misses"] += 1
+            return None
+
+    def close(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=10.0)
+
+
 def load_state_dict(state_dict, path: str, process_group=None,
                     coordinator_rank: int = 0, prefer_files=(), stats=None):
     """Load into ``state_dict`` IN PLACE, resharding to each tensor's current
@@ -342,12 +453,17 @@ def load_state_dict(state_dict, path: str, process_group=None,
 
     # lazily open each rank file once
     files: Dict[str, np.lib.npyio.NpzFile] = {}
+    prefetch: Optional[_ChunkPrefetcher] = None
 
     def fetch_chunk(c, crc_want, dtype_name):
         try:
-            if c.file_name not in files:
-                files[c.file_name] = np.load(os.path.join(path, c.file_name))
-            raw = files[c.file_name][c.key]
+            raw = (prefetch.get(c.file_name, c.key)
+                   if prefetch is not None else None)
+            if raw is None:
+                if c.file_name not in files:
+                    files[c.file_name] = np.load(
+                        os.path.join(path, c.file_name))
+                raw = files[c.file_name][c.key]
         except CheckpointCorruptionError:
             raise
         except (OSError, KeyError, ValueError, zlib.error,
@@ -386,6 +502,13 @@ def load_state_dict(state_dict, path: str, process_group=None,
 
     agg = {"tensors": 0, "reads": 0, "peak_bytes": 0, "bound_bytes": 0,
            "bounded": True}
+
+    # ---- plan phase: build every tensor's reshard program up front so the
+    # full file-read schedule is known before any bytes move.  This is what
+    # lets a background reader stream chunk N+1 while shard N is being
+    # assembled and device-put (mesh bring-up overlap on resume).
+    plans = []
+    schedule = []
     for name, (container, key_in_container, target) in flat_targets.items():
         if name not in meta.state_dict_metadata:
             raise KeyError(f"tensor {name!r} not present in checkpoint {path}")
@@ -403,9 +526,13 @@ def load_state_dict(state_dict, path: str, process_group=None,
             refs.append(ref)
             crcs[(c.file_name, c.key)] = getattr(c, "crc32", None)
         gshape = tuple(info["global_shape"])
-        regions = sorted({_slices_to_offset_shape(idx, gshape)
-                          for idx in sharding.addressable_devices_indices_map(
-                              gshape).values()})
+        # per-DEVICE region list: make_array_from_callback runs the callback
+        # once per addressable device, so replicated regions are fetched
+        # once per replica — the prefetch schedule must count every one
+        dev_regions = [_slices_to_offset_shape(idx, gshape)
+                       for idx in sharding.addressable_devices_indices_map(
+                           gshape).values()]
+        regions = sorted(set(dev_regions))
         plan = plan_file_reshard(name, refs, gshape, info["dtype"], regions,
                                  prefer_files=prefer_files)
         agg["tensors"] += 1
@@ -413,24 +540,44 @@ def load_state_dict(state_dict, path: str, process_group=None,
         agg["peak_bytes"] = max(agg["peak_bytes"], plan.peak_bytes)
         agg["bound_bytes"] = max(agg["bound_bytes"], plan.bound_bytes)
         agg["bounded"] = agg["bounded"] and plan.bounded
+        for region in dev_regions:
+            for r in plan.programs[region].reads:
+                schedule.append((r.chunk.file_name, r.chunk.key))
+        plans.append((container, key_in_container, target, tgt_arr,
+                      sharding, plan, info, crcs, gshape))
 
-        def cb(index, _plan=plan, _info=info, _crcs=crcs):
-            offset, shape = _slices_to_offset_shape(index, _info["global_shape"])
-            program = _plan.programs[(offset, shape)]
-            return read_shard(
-                program,
-                lambda r: fetch_chunk(r, _crcs[(r.file_name, r.key)],
-                                      _info["dtype"]),
-                np.dtype(_info["dtype"]))
+    if (schedule and os.environ.get("PADDLE_TPU_RESUME_PREFETCH", "1") != "0"):
+        prefetch = _ChunkPrefetcher(
+            path, schedule,
+            depth=int(os.environ.get("PADDLE_TPU_RESUME_PREFETCH_DEPTH", "4")))
 
-        new_arr = jax.make_array_from_callback(gshape, sharding, cb)
-        new_arr = new_arr.astype(tgt_arr.dtype)
-        if isinstance(target, Tensor):
-            target._data = new_arr
-        else:
-            container[key_in_container] = new_arr
-    for f in files.values():
-        f.close()
+    # ---- materialize phase: assemble each tensor's shards (reads overlap
+    # with the prefetcher's lookahead) and bind them back into the caller.
+    try:
+        for (container, key_in_container, target, tgt_arr, sharding,
+             plan, info, crcs, gshape) in plans:
+
+            def cb(index, _plan=plan, _info=info, _crcs=crcs):
+                offset, shape = _slices_to_offset_shape(index, _info["global_shape"])
+                program = _plan.programs[(offset, shape)]
+                return read_shard(
+                    program,
+                    lambda r: fetch_chunk(r, _crcs[(r.file_name, r.key)],
+                                          _info["dtype"]),
+                    np.dtype(_info["dtype"]))
+
+            new_arr = jax.make_array_from_callback(gshape, sharding, cb)
+            new_arr = new_arr.astype(tgt_arr.dtype)
+            if isinstance(target, Tensor):
+                target._data = new_arr
+            else:
+                container[key_in_container] = new_arr
+    finally:
+        if prefetch is not None:
+            prefetch.close()  # join the reader before reading its stats
+            agg.update(prefetch.stats)
+        for f in files.values():
+            f.close()
     if isinstance(stats, dict):
         stats.update(agg)
     return state_dict

@@ -21,6 +21,10 @@ Supported faults (all off by default):
 - **bit-flipped checkpoint shard** (``ft_inject_corrupt_step`` +
   :meth:`FaultInjector.corrupt_file`) — silent storage corruption, caught
   by the CRC manifest on load.
+- **serving replica kill** (``ft_inject_serve_kill_round`` /
+  ``ft_inject_serve_kill_replica``) — the serving router drops a replica
+  at an exact round; its in-flight requests must re-route and re-prefill
+  on survivors (``serving.router``).
 """
 
 from __future__ import annotations
@@ -39,7 +43,8 @@ class FaultInjector:
     def __init__(self, seed: int = 0, crash_step: int = -1,
                  crash_rank: int = -1, store_drop_rate: float = 0.0,
                  store_delay_ms: int = 0, corrupt_step: int = -1,
-                 crash_signal: int = 0):
+                 crash_signal: int = 0, serve_kill_round: int = -1,
+                 serve_kill_replica: int = -1):
         self.seed = int(seed)
         self.crash_step = int(crash_step)
         self.crash_rank = int(crash_rank)
@@ -47,6 +52,9 @@ class FaultInjector:
         self.store_drop_rate = float(store_drop_rate)
         self.store_delay_ms = int(store_delay_ms)
         self.corrupt_step = int(corrupt_step)
+        self.serve_kill_round = int(serve_kill_round)
+        self.serve_kill_replica = int(serve_kill_replica)
+        self._serve_kill_fired = False
         # independent streams so enabling one fault cannot shift another's
         # decisions (replayability across configurations)
         self._drop_rng = random.Random(f"{self.seed}/store-drop")
@@ -60,11 +68,15 @@ class FaultInjector:
                    store_drop_rate=flags.get_flag("ft_inject_store_drop_rate"),
                    store_delay_ms=flags.get_flag("ft_inject_store_delay_ms"),
                    corrupt_step=flags.get_flag("ft_inject_corrupt_step"),
-                   crash_signal=flags.get_flag("ft_inject_crash_signal"))
+                   crash_signal=flags.get_flag("ft_inject_crash_signal"),
+                   serve_kill_round=flags.get_flag("ft_inject_serve_kill_round"),
+                   serve_kill_replica=flags.get_flag(
+                       "ft_inject_serve_kill_replica"))
 
     def active(self) -> bool:
         return (self.crash_step >= 0 or self.store_drop_rate > 0.0
-                or self.store_delay_ms > 0 or self.corrupt_step >= 0)
+                or self.store_delay_ms > 0 or self.corrupt_step >= 0
+                or self.serve_kill_round >= 0)
 
     # -- fail-stop worker crash ---------------------------------------------
 
@@ -89,6 +101,23 @@ class FaultInjector:
         print(f"[inject] fail-stop crash at step {step}", file=sys.stderr,
               flush=True)
         os._exit(1)
+
+    # -- serving replica kill -----------------------------------------------
+
+    def serve_kill_due(self, round_no: int,
+                       alive: List[int]) -> Optional[int]:
+        """One-shot replica kill for the serving router: returns the victim
+        replica id when ``round_no`` reaches the injected round (the
+        configured replica if alive, else the lowest alive id), ``None``
+        otherwise.  Fires at most once per injector — the failover itself,
+        not a crash loop, is what the chaos test exercises."""
+        if (self.serve_kill_round < 0 or self._serve_kill_fired
+                or round_no < self.serve_kill_round or not alive):
+            return None
+        self._serve_kill_fired = True
+        if self.serve_kill_replica in alive:
+            return self.serve_kill_replica
+        return min(alive)
 
     # -- store faults --------------------------------------------------------
 

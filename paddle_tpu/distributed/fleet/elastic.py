@@ -231,10 +231,19 @@ class CheckpointManager:
             elif hasattr(target, "set_state_dict"):
                 target.set_state_dict(work)
             self.last_reshard_stats = stats
+            pf = ""
+            if stats.get("prefetch_hits", 0) or stats.get("prefetch_misses", 0):
+                # read_s accumulated on the background thread while shards
+                # assembled = wall time the overlap hid; wait_s = what leaked
+                hidden = max(0.0, stats.get("prefetch_read_s", 0.0)
+                             - stats.get("prefetch_wait_s", 0.0))
+                pf = (f" prefetch={stats['prefetch_hits']}/"
+                      f"{stats['prefetch_hits'] + stats['prefetch_misses']}"
+                      f" overlap_hidden={hidden * 1e3:.1f}ms")
             print(f"[reshard] resume step {step}: tensors={stats.get('tensors')}"
                   f" reads={stats.get('reads')} peak={stats.get('peak_bytes')}B"
                   f" bound={stats.get('bound_bytes')}B"
-                  f" bounded={stats.get('bounded')}"
+                  f" bounded={stats.get('bounded')}" + pf
                   + (f" prefer={prefer[0]}" if prefer else ""),
                   file=sys.stderr)
             return step

@@ -111,3 +111,7 @@ define_flag("ft_inject_store_delay_ms", 0,
             "Added latency per store op (simulates a slow/partitioned peer)")
 define_flag("ft_inject_corrupt_step", -1,
             "Bit-flip one checkpoint shard of this step after save (-1 off)")
+define_flag("ft_inject_serve_kill_round", -1,
+            "Kill a serving replica at this router round (-1 off)")
+define_flag("ft_inject_serve_kill_replica", -1,
+            "Replica id for the injected serving kill (-1 = lowest alive)")

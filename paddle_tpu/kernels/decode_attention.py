@@ -71,7 +71,10 @@ def _decode_reference(q, k_cache, v_cache, lengths, sm_scale: float):
     mask = jnp.arange(C)[None, :] < lengths[:, None]  # [B, C]
     s = jnp.where(mask[:, None, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bgrsc,bcgd->bsgrd", p, v_cache.astype(jnp.float32))
+    # the p@v contraction runs over masked positions too (weight 0); V there
+    # may be arbitrary pool trash including NaN, and 0*NaN = NaN — zero it
+    vf = jnp.where(mask[:, :, None, None], v_cache.astype(jnp.float32), 0.0)
+    o = jnp.einsum("bgrsc,bcgd->bsgrd", p, vf)
     return o.reshape(B, 1, h, d).astype(q.dtype)
 
 
@@ -608,6 +611,67 @@ def write_paged_token(k_pool, v_pool, block_table, lengths, k_new, v_new):
     slot = lengths % bs
     k_pool = k_pool.at[phys, :, slot].set(k_new[:, 0])
     v_pool = v_pool.at[phys, :, slot].set(v_new[:, 0])
+    return k_pool, v_pool
+
+
+def paged_chunk_attention(q, k_pool, v_pool, block_table, ctx_lengths,
+                          sm_scale: Optional[float] = None):
+    """Chunk attention over serving-layout paged pools (chunked prefill /
+    prefix-cache suffix prefill).
+
+    q: ``[B, S, H, D]`` — an S-token chunk per sequence at absolute positions
+    ``ctx_lengths[b] .. ctx_lengths[b]+S-1``; pools ``[NB, Hk, bs, D]``;
+    ``block_table`` ``[B, MAXB]``; ``ctx_lengths`` ``[B]`` int32 tokens
+    already resident BEFORE this chunk.  The chunk's own K/V must already be
+    written into the pools (:func:`write_paged_chunk`); the gather then sees
+    context and chunk through one table walk.  Chunk token ``j`` attends
+    cache positions ``<= ctx_lengths[b] + j`` — pad-tail rows past the true
+    chunk length only ever attend positions the caller later masks or
+    overwrites.  Gather-based (XLA) path; a streamed Pallas variant is a
+    RECAPTURE item."""
+    nb, hk, bs, d = k_pool.shape
+    B, S, h, _ = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    rep = h // hk
+    # [B, MAXB, Hk, bs, D] -> [B, C, Hk, D]
+    k = jnp.swapaxes(jnp.take(k_pool, block_table, axis=0), 2, 3).reshape(B, -1, hk, d)
+    v = jnp.swapaxes(jnp.take(v_pool, block_table, axis=0), 2, 3).reshape(B, -1, hk, d)
+    C = k.shape[1]
+    qf = q.astype(jnp.float32).reshape(B, S, hk, rep, d)
+    s = jnp.einsum("bsgrd,bcgd->bgrsc", qf, k.astype(jnp.float32)) * sm_scale
+    q_pos = ctx_lengths[:, None] + jnp.arange(S)[None, :]            # [B, S]
+    mask = jnp.arange(C)[None, None, :] <= q_pos[:, :, None]         # [B, S, C]
+    s = jnp.where(mask[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # zero V past every row's reach (union bound ctx+S): those positions are
+    # pool trash — possibly NaN — and 0*NaN = NaN in the p@v contraction
+    valid = jnp.arange(C)[None, :] < (ctx_lengths + S)[:, None]      # [B, C]
+    vf = jnp.where(valid[:, :, None, None], v.astype(jnp.float32), 0.0)
+    o = jnp.einsum("bgrsc,bcgd->bsgrd", p, vf)
+    return o.reshape(B, S, h, d).astype(q.dtype)
+
+
+def write_paged_chunk(k_pool, v_pool, block_table, ctx_lengths, k_chunk, v_chunk):
+    """Scatter an S-token chunk's K/V into paged pools starting at position
+    ``ctx_lengths[b]`` per sequence.
+
+    PRECONDITION: every ``ctx_lengths[b]`` is block-aligned and ``S`` is a
+    multiple of ``bs`` (the serving scheduler pads chunks to the block
+    ladder; table entries past a sequence's real blocks are 0 = trash, so
+    the pad tail lands harmlessly there).  ``k_chunk/v_chunk``:
+    ``[B, S, Hk, D]``."""
+    nb, hk, bs, d = k_pool.shape
+    B, S = k_chunk.shape[0], k_chunk.shape[1]
+    ctx_lengths = jnp.asarray(ctx_lengths, jnp.int32)
+    start_block = ctx_lengths // bs                                  # [B]
+    for i in range(S // bs):
+        phys = jnp.take_along_axis(
+            block_table, (start_block + i)[:, None], axis=1)[:, 0]   # [B]
+        kb = jnp.swapaxes(k_chunk[:, i * bs:(i + 1) * bs], 1, 2)     # [B,Hk,bs,D]
+        vb = jnp.swapaxes(v_chunk[:, i * bs:(i + 1) * bs], 1, 2)
+        k_pool = k_pool.at[phys].set(kb.astype(k_pool.dtype))
+        v_pool = v_pool.at[phys].set(vb.astype(v_pool.dtype))
     return k_pool, v_pool
 
 

@@ -298,6 +298,39 @@ def paged_attention_fn(hidden, w_qkv, w_o, k_pool, v_pool, block_table,
     return out, k_pool, v_pool
 
 
+def paged_chunk_attention_fn(hidden, w_qkv, w_o, k_pool, v_pool, block_table,
+                             lengths, cos, sin, cfg: LlamaConfig):
+    """Multi-token chunk GQA attention over paged KV pools (chunked prefill
+    and prefix-cache suffix prefill; see ``serving.Engine``).
+
+    ``hidden`` is an S-token chunk at absolute positions
+    ``lengths[b]..lengths[b]+S-1``; ``lengths`` is the block-aligned context
+    already resident in the pools.  Unlike the S=1 path there is no
+    ``lengths > 0`` inactive-slot convention — every row is an active chunk
+    (the scheduler dispatches chunks one sequence at a time), so a fresh
+    prompt legitimately starts at context 0.  The chunk's K/V is scattered
+    into its table-mapped blocks first, then one gather attends context +
+    chunk causally.
+    """
+    from ..kernels import decode_attention as da
+
+    h, hk, d = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    B, S, _ = hidden.shape
+    qkv = hidden @ w_qkv.astype(hidden.dtype)
+    q, k, v = jnp.split(qkv, [h * d, (h + hk) * d], axis=-1)
+    q = q.reshape(B, S, h, d)
+    k = k.reshape(B, S, hk, d)
+    v = v.reshape(B, S, hk, d)
+    pos = lengths[:, None] + jnp.arange(S)[None, :]
+    q, k = rope_mod.apply_rope(q, k, cos, sin, pos)
+    k_pool, v_pool = da.write_paged_chunk(
+        k_pool, v_pool, block_table, lengths,
+        k.astype(k_pool.dtype), v.astype(v_pool.dtype))
+    o = da.paged_chunk_attention(q, k_pool, v_pool, block_table, lengths)
+    out = o.reshape(B, S, h * d) @ w_o.astype(hidden.dtype)
+    return out, k_pool, v_pool
+
+
 def mlp_fn(hidden, w_gate_up, w_down, intermediate_size: int):
     """Pure SwiGLU MLP over raw arrays with fused gate_up matmul."""
     gu = hidden @ w_gate_up.astype(hidden.dtype)
@@ -334,6 +367,9 @@ class LlamaAttention(Layer):
             k_p, v_p, tbl, lengths = cache
 
             def attn_paged(hidden, w_qkv, w_o, kp, vp):
+                if hidden.shape[1] > 1:  # chunked prefill over paged pools
+                    return paged_chunk_attention_fn(hidden, w_qkv, w_o, kp, vp,
+                                                    tbl, lengths, _raw(cos), _raw(sin), cfg)
                 return paged_attention_fn(hidden, w_qkv, w_o, kp, vp,
                                           tbl, lengths, _raw(cos), _raw(sin), cfg)
 
@@ -509,9 +545,14 @@ class LlamaModel(Layer):
                                                aux_total, is_moe)
                 new_k.append(kv[0])
                 new_v.append(kv[1])
+            seq = input_ids.shape[1]
+            if seq > 1:  # chunk prefill: every row is an active chunk
+                new_lengths = lengths + jnp.asarray(seq, lengths.dtype)
+            else:        # decode: lengths == 0 marks an inactive slot
+                new_lengths = lengths + (lengths > 0).astype(lengths.dtype)
             new_cache = {"k": tuple(new_k), "v": tuple(new_v),
                          "block_table": tbl,
-                         "lengths": lengths + (lengths > 0).astype(lengths.dtype)}
+                         "lengths": new_lengths}
             if is_moe:
                 return self.norm(x), aux_total, new_cache
             return self.norm(x), new_cache

@@ -47,6 +47,7 @@ Pools are donated through the decode step, so XLA updates them in place.
 from __future__ import annotations
 
 import collections
+import hashlib
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -55,9 +56,28 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["Engine", "GenRequest", "RequestOutput"]
+__all__ = ["Engine", "GenRequest", "RequestOutput", "prefix_block_hashes"]
 
 NEG_INF = -1e30
+
+
+def prefix_block_hashes(ids, block_size: int) -> List[bytes]:
+    """Chain hashes of the FULL blocks of ``ids[:-1]`` — the cacheable
+    prefix of a prompt.  Hash ``i`` commits to blocks ``0..i`` (vLLM-style
+    chaining), so an index hit on hash ``i`` means the whole prefix through
+    block ``i`` is resident.  The last prompt token is never cached: at
+    least one suffix token always prefills, producing the first output's
+    logits.  Shared by the engine and the router (prefix-affinity routing).
+    """
+    ids = np.ascontiguousarray(np.asarray(ids, np.int32))
+    n = max((len(ids) - 1) // block_size, 0)
+    out: List[bytes] = []
+    h = b""
+    for i in range(n):
+        h = hashlib.sha1(
+            h + ids[i * block_size:(i + 1) * block_size].tobytes()).digest()
+        out.append(h)
+    return out
 
 
 @dataclass
@@ -100,6 +120,10 @@ class _Slot:
     blocks: List[int] = field(default_factory=list)
     out_count: int = 0                     # tokens emitted (incl. pending sync)
     admit_seq: int = 0                     # admission order (eviction priority)
+    # chunked/suffix prefill: prompt tokens not yet written to the cache
+    # (None once fully prefilled; such a slot decodes normally)
+    prefill_left: Optional[np.ndarray] = None
+    hashes: List[bytes] = field(default_factory=list)  # cacheable-prefix chain
 
 
 class Engine:
@@ -122,7 +146,9 @@ class Engine:
                  block_size: int = 128,
                  prefill_buckets: Tuple[int, ...] = (128, 256, 512, 1024),
                  max_prefill_overhead: float = 1.0, decode_chunk: int = 32,
-                 hbm_budget_bytes: Optional[int] = None):
+                 hbm_budget_bytes: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 prefill_chunk: Optional[int] = None):
         from ..jit import functional_call
 
         self.model = model
@@ -152,6 +178,25 @@ class Engine:
         self._buffers = {n: b._data for n, b in model.named_buffers()}
         self.hbm_budget_bytes = hbm_budget_bytes
 
+        # prefix caching (vLLM-style, scheduler-side only — the paged
+        # kernels address blocks indirectly so no kernel work is needed):
+        # a block serving >= 1 live slot carries a refcount in _ref; a
+        # registered block whose refcount drops to 0 parks in the _lru
+        # (hash -> block, oldest first) where a later admission can either
+        # HIT it (reacquire, skip its prefill) or RECLAIM it (allocation
+        # pressure pops the oldest cached block back into service)
+        self.prefix_cache = bool(prefix_cache)
+        if prefill_chunk is not None:
+            # chunks must be block-aligned so every chunk starts on a block
+            # boundary (write_paged_chunk's precondition)
+            prefill_chunk = max(1, -(-int(prefill_chunk) // block_size)) \
+                * block_size
+        self.prefill_chunk = prefill_chunk
+        self._ref: Dict[int, int] = {}        # block -> live-owner count
+        self._index: Dict[bytes, int] = {}    # chain-hash -> block
+        self._hash_of: Dict[int, bytes] = {}  # block -> registered hash
+        self._lru: "collections.OrderedDict[bytes, int]" = \
+            collections.OrderedDict()         # ref-0 cached blocks
         # block 0 is the shared trash block for inactive slots
         self._free = collections.deque(range(1, num_blocks))
         self._slots = [_Slot(idx=i) for i in range(max_batch)]
@@ -165,6 +210,7 @@ class Engine:
         self.decode_chunk = max(1, min(int(decode_chunk), self._tok_seg_rows))
         self._decode_fns: Dict[int, object] = {}
         self._prefill_fns: Dict[Tuple[int, int], object] = {}
+        self._chunk_fns: Dict[Tuple[int, bool], object] = {}
         # device-resident last-token vector: threaded chunk -> chunk, so no
         # decode round trip is ever needed to BUILD the next decode's inputs
         self._last_dev = jnp.zeros((max_batch,), jnp.int32)
@@ -205,7 +251,12 @@ class Engine:
         self.stats = {"decode_steps": 0, "prefills": 0, "evictions": 0,
                       "generated_tokens": 0, "decode_time": 0.0,
                       "prefill_time": 0.0, "prefill_tokens": 0,
-                      "decode_calls": 0, "syncs": 0, "sync_time": 0.0}
+                      "decode_calls": 0, "syncs": 0, "sync_time": 0.0,
+                      # prefix cache: blocks probed / blocks served from
+                      # cache (hit tokens = blocks * block_size saved from
+                      # prefill); chunk_prefills counts chunk-program calls
+                      "prefix_lookup_blocks": 0, "prefix_hit_blocks": 0,
+                      "prefix_hit_tokens": 0, "chunk_prefills": 0}
 
     # -- public API ---------------------------------------------------------
 
@@ -242,12 +293,28 @@ class Engine:
         prefill_b = n_pf * (2 * Pb * cfg.hidden_size
                             + cfg.num_attention_heads * Pb * Pb
                             + Pb * cfg.vocab_size) * itemsize
+        # prefix-cache metadata: sha1 digest (20B) + hash-index entry +
+        # refcount + LRU node per block — host-side, but counted so
+        # hbm_budget_bytes admission stays honest with caching on
+        prefix_b = self.num_blocks * 64 if self.prefix_cache else 0
+        # chunk-prefill workspace (chunked prefill / cache-hit suffix
+        # prefill, B=1): chunk activations + the full-capacity context
+        # gather + scores + final-chunk logits
+        chunk_b = 0
+        if self.prefix_cache or self.prefill_chunk is not None:
+            C = self.max_blocks_per_seq * self.block_size
+            chunk_b = (2 * Pb * cfg.hidden_size * itemsize
+                       + 2 * C * cfg.kv_heads * cfg.head_dim * itemsize
+                       + cfg.num_attention_heads * Pb * C * 4
+                       + Pb * cfg.vocab_size * itemsize)
         plan = {"params_bytes": params_b, "buffers_bytes": buffers_b,
                 "kv_pool_bytes": kv_pool_b, "table_bytes": table_b,
+                "prefix_cache_bytes": prefix_b,
                 "decode_workspace_bytes": decode_b,
-                "prefill_workspace_bytes": prefill_b}
+                "prefill_workspace_bytes": prefill_b,
+                "chunk_workspace_bytes": chunk_b}
         plan["total_bytes"] = (params_b + buffers_b + kv_pool_b + table_b
-                               + max(decode_b, prefill_b))
+                               + prefix_b + max(decode_b, prefill_b, chunk_b))
         return plan
 
     def add_request(self, req: GenRequest) -> str:
@@ -295,12 +362,81 @@ class Engine:
 
     def _round(self):
         self._admit()
-        active = [s for s in self._slots if s.req is not None]
+        self._advance_prefills()
+        # slots mid-chunked-prefill don't decode this round; decode rounds
+        # interleave BETWEEN their chunks (the point of chunked prefill)
+        active = [s for s in self._slots
+                  if s.req is not None and s.prefill_left is None]
         if not active:
             return
         k = self._pick_chunk(active)
         self._ensure_decode_blocks(k)
         self._dispatch_chunk(k)
+
+    # -- block pool (refcounted, prefix-cache aware) ------------------------
+
+    def _available(self) -> int:
+        """Blocks an allocation can claim: truly free + ref-0 cached."""
+        return len(self._free) + len(self._lru)
+
+    def _alloc_block(self) -> Optional[int]:
+        """Claim a block: the free pool first, then reclaim the oldest
+        ref-0 cached block (deregistering it — cache state is disposable)."""
+        if self._free:
+            b = self._free.popleft()
+        elif self._lru:
+            h, b = self._lru.popitem(last=False)
+            del self._index[h]
+            del self._hash_of[b]
+        else:
+            return None
+        self._ref[b] = 1
+        return b
+
+    def _free_block(self, b: int):
+        """Drop one ownership ref; at 0 the block parks in the prefix-cache
+        LRU (if registered) or returns to the free pool.  A block shared by
+        several live slots (refcount > 1) just decrements — this is what
+        makes eviction skip shared blocks."""
+        n = self._ref.get(b, 1) - 1
+        if n > 0:
+            self._ref[b] = n
+            return
+        self._ref.pop(b, None)
+        h = self._hash_of.get(b)
+        if h is not None:
+            self._lru[h] = b
+            self._lru.move_to_end(h)
+        else:
+            self._free.append(b)
+
+    def _acquire_cached(self, h: bytes) -> Optional[int]:
+        """Take a live ref on the block registered under ``h`` (a prefix
+        hit): shared live blocks gain a ref, parked blocks leave the LRU."""
+        b = self._index.get(h)
+        if b is None:
+            return None
+        if b in self._ref:
+            self._ref[b] += 1
+        else:
+            self._lru.pop(h, None)
+            self._ref[b] = 1
+        return b
+
+    def _register_prompt_blocks(self, slot: _Slot):
+        """Publish a slot's cacheable prompt blocks in the hash index.
+        Path A (dense prefill) registers at ADMIT time — its whole prompt
+        dispatches this round, before any later reader's program — while
+        chunked prefill registers only at the FINAL chunk (earlier rounds
+        haven't dispatched the later blocks' writes yet, so a hit would
+        read garbage)."""
+        if not self.prefix_cache:
+            return
+        for h, b in zip(slot.hashes, slot.blocks):
+            if h in self._index or b in self._hash_of:
+                continue                   # first writer wins
+            self._index[h] = b
+            self._hash_of[b] = h
 
     def _pick_chunk(self, active) -> int:
         """Largest power-of-two chunk within the LONGEST remaining budget.
@@ -328,6 +464,7 @@ class Engine:
         program inputs are snapshotted at admit time (the padding blocks are
         released immediately after — unallocated table entries write to the
         trash block, which the length mask never attends)."""
+        bs = self.block_size
         admitted = []      # (slot, req, Pb, ids_row, blocks_row, P)
         for slot in self._slots:
             if not self._waiting:
@@ -335,40 +472,83 @@ class Engine:
             if slot.req is not None:
                 continue
             req = self._waiting[0]
-            Pb = self._bucket(len(req.prompt_ids))
-            n_blocks = Pb // self.block_size
-            if n_blocks > self.num_blocks - 1:
-                # an evicted request's merged prompt outgrew the whole pool:
-                # no schedule can ever run it — fail loudly, don't spin
-                raise RuntimeError(
-                    f"request {req.request_id} needs {n_blocks} blocks but the "
-                    f"pool only has {self.num_blocks - 1} usable")
-            if len(self._free) < n_blocks:
-                break                      # pool pressure: stop admitting
+            P = len(req.prompt_ids)
+            hashes = (prefix_block_hashes(req.prompt_ids, bs)
+                      if self.prefix_cache else [])
+            n_hit = 0
+            for h in hashes:
+                if h not in self._index:
+                    break
+                n_hit += 1
+            self.stats["prefix_lookup_blocks"] += len(hashes)
+            chunked = (self.prefill_chunk is not None
+                       and P - n_hit * bs > self.prefill_chunk)
+            if n_hit == 0 and not chunked:
+                # -- path A: dense batched prefill of the whole prompt
+                Pb = self._bucket(P)
+                n_blocks = Pb // bs
+                if n_blocks > self.num_blocks - 1:
+                    # an evicted request's merged prompt outgrew the whole
+                    # pool: no schedule can ever run it — fail loudly
+                    raise RuntimeError(
+                        f"request {req.request_id} needs {n_blocks} blocks "
+                        f"but the pool only has {self.num_blocks - 1} usable")
+                if self._available() < n_blocks:
+                    break                  # pool pressure: stop admitting
+                self._waiting.popleft()
+                blocks = [self._alloc_block() for _ in range(n_blocks)]
+                self._admit_counter += 1
+                slot.req = req
+                slot.length = P
+                slot.blocks = blocks
+                slot.out_count = 1
+                slot.admit_seq = self._admit_counter
+                slot.hashes = hashes
+                # release bucket-padding blocks beyond the prompt's true
+                # need BEFORE snapshotting the program's block row: batched
+                # dispatch reorders prefills across buckets, so a freed
+                # padding block id left in the row could overwrite a later
+                # admission's real K/V (the padded tail's garbage goes to
+                # trash block 0 instead, which the length mask never attends)
+                needed = -(-slot.length // bs)
+                while len(slot.blocks) > max(needed, 1):
+                    self._free_block(slot.blocks.pop())
+                self._write_tbl_row(slot)
+                # eager registration is safe for path A: this admission's
+                # prefill dispatches within this _admit call, and any hit
+                # on these blocks dispatches its reader strictly later
+                self._register_prompt_blocks(slot)
+                ids_row = np.zeros((Pb,), np.int32)
+                ids_row[:P] = req.prompt_ids
+                blocks_row = np.zeros((n_blocks,), np.int32)
+                blocks_row[:len(slot.blocks)] = slot.blocks
+                admitted.append((slot, req, Pb, ids_row, blocks_row, P))
+                continue
+            # -- path B: prefix-hit suffix and/or chunked prefill — admit
+            # the slot now; its chunks dispatch in _advance_prefills,
+            # interleaved with decode rounds
+            hit_blocks = [self._acquire_cached(h) for h in hashes[:n_hit]]
+            n_sblocks = -(-P // bs) - n_hit
+            if self._available() < n_sblocks:
+                # roll the hit refs back and stop admitting (the request
+                # stays at the queue head for the next round)
+                for b in hit_blocks:
+                    self._free_block(b)
+                break
             self._waiting.popleft()
-            blocks = [self._free.popleft() for _ in range(n_blocks)]
+            suffix_blocks = [self._alloc_block() for _ in range(n_sblocks)]
             self._admit_counter += 1
             slot.req = req
-            slot.length = len(req.prompt_ids)
-            slot.blocks = blocks
-            slot.out_count = 1
+            slot.length = n_hit * bs       # context already resident
+            slot.blocks = hit_blocks + suffix_blocks
+            slot.out_count = 0             # first token comes at final chunk
             slot.admit_seq = self._admit_counter
-            # release bucket-padding blocks beyond the prompt's true need
-            # BEFORE snapshotting the program's block row: batched dispatch
-            # reorders prefills across buckets, so a freed padding block id
-            # left in the row could overwrite a later admission's real K/V
-            # (the padded tail's garbage goes to trash block 0 instead,
-            # which the length mask never attends)
-            needed = -(-slot.length // self.block_size)
-            while len(slot.blocks) > max(needed, 1):
-                self._free.append(slot.blocks.pop())
+            slot.hashes = hashes
+            slot.prefill_left = np.asarray(
+                req.prompt_ids[n_hit * bs:], np.int32)
             self._write_tbl_row(slot)
-            P = slot.length
-            ids_row = np.zeros((Pb,), np.int32)
-            ids_row[:P] = req.prompt_ids
-            blocks_row = np.zeros((n_blocks,), np.int32)
-            blocks_row[:len(slot.blocks)] = slot.blocks
-            admitted.append((slot, req, Pb, ids_row, blocks_row, P))
+            self.stats["prefix_hit_blocks"] += n_hit
+            self.stats["prefix_hit_tokens"] += n_hit * bs
         by_bucket: Dict[int, list] = {}
         for entry in admitted:
             by_bucket.setdefault(entry[2], []).append(entry)
@@ -388,6 +568,72 @@ class Engine:
         row[:len(slot.blocks)] = slot.blocks
         self._tbl[i] = row
 
+    def _advance_prefills(self):
+        """Dispatch ONE prefill chunk per mid-prefill slot (admission
+        order), so decode rounds interleave between a long prompt's chunks
+        instead of stalling behind its whole prefill."""
+        for slot in sorted((s for s in self._slots
+                            if s.req is not None
+                            and s.prefill_left is not None),
+                           key=lambda s: s.admit_seq):
+            self._prefill_chunk_step(slot)
+
+    def _prefill_chunk_step(self, slot: _Slot):
+        """One chunk of a path-B prefill: write ``take`` prompt tokens at
+        the slot's block-aligned context offset.  Non-final chunks are
+        exactly ``prefill_chunk`` tokens (a block multiple, keeping the
+        next chunk aligned); the final chunk is ragged, samples the first
+        output token, and registers the prompt's cacheable blocks."""
+        from ..framework import random as rnd
+
+        req = slot.req
+        ids = slot.prefill_left
+        total = len(ids)
+        take = (total if self.prefill_chunk is None
+                else min(total, self.prefill_chunk))
+        final = take == total
+        Cb = self._bucket(take)
+        fn = self._get_chunk_fn(Cb, final)
+        ids_row = np.zeros((Cb,), np.int32)
+        ids_row[:take] = ids[:take]
+        if final:
+            if self._first_idx + 1 > self._first_seg:
+                self._full_first_bufs.append(self._first_buf)
+                self._first_buf = jnp.zeros((self._first_seg,), jnp.int32)
+                self._first_idx = 0
+            fidx0 = self._first_idx
+            self._first_idx += 1
+        else:
+            fidx0 = self._first_idx        # unused by the non-final program
+        t0 = time.perf_counter()
+        self._first_buf, self._last_dev, self.k_pools, self.v_pools = fn(
+            self._params, self._buffers, self.k_pools, self.v_pools,
+            self._last_dev, jnp.asarray(slot.idx, jnp.int32),
+            jnp.asarray(ids_row), jnp.asarray(self._tbl[slot.idx].copy()),
+            jnp.asarray(slot.length, jnp.int32),
+            jnp.asarray(take, jnp.int32), rnd.next_key(),
+            jnp.asarray(req.temperature, jnp.float32),
+            jnp.asarray(req.top_k, jnp.int32),
+            jnp.asarray(req.top_p, jnp.float32),
+            self._first_buf, jnp.asarray(fidx0, jnp.int32))
+        dt = time.perf_counter() - t0      # dispatch cost only
+        req._prefill_dt += dt
+        slot.length += take
+        slot.prefill_left = None if final else ids[take:]
+        self.stats["prefill_time"] += dt
+        self.stats["prefill_tokens"] += Cb
+        self.stats["chunk_prefills"] += 1
+        if final:
+            slot.out_count = 1
+            self._pending.append(
+                ("prefill", req, len(self._full_first_bufs), fidx0))
+            self.stats["prefills"] += 1
+            self.stats["generated_tokens"] += 1
+            self._register_prompt_blocks(slot)
+            if slot.out_count >= req.max_new_tokens:
+                self._finish_order.append(req)
+                self._release(slot)
+
     def _ensure_decode_blocks(self, k: int = 1):
         """The next ``k`` decode steps write positions ``length`` through
         ``length + k - 1`` — allocate every block that window touches, per
@@ -399,11 +645,14 @@ class Engine:
                            key=lambda s: s.admit_seq):
             if slot.req is None:
                 continue           # evicted by an earlier slot's growth
+            if slot.prefill_left is not None:
+                continue           # mid-prefill: doesn't decode this round
             w = min(k, max(slot.req.max_new_tokens - slot.out_count, 1))
             need_idx = (slot.length + w - 1) // self.block_size
             while slot.req is not None and need_idx >= len(slot.blocks):
-                if self._free:
-                    slot.blocks.append(self._free.popleft())
+                b = self._alloc_block()
+                if b is not None:
+                    slot.blocks.append(b)
                     continue
                 actives = [s for s in self._slots if s.req is not None]
                 if len(actives) == 1 and actives[0] is slot:
@@ -424,14 +673,14 @@ class Engine:
         generated tokens prepended to the prompt) and free its blocks.  The
         merge needs token VALUES, so a deferred-sync backlog materializes
         here first."""
-        free_before = len(self._free)
+        free_before = self._available()
         self._sync_pending()
         req = slot.req
         if req is None:
             # the sync itself released this slot (the victim's pending first
             # token was its eos): nothing left to requeue
             return
-        if len(self._free) > free_before:
+        if self._available() > free_before:
             # the sync released eos-finished slots and refilled the pool:
             # the pressure that chose this victim is gone — abort the
             # preemption (the caller's allocation loop re-checks _free and
@@ -456,11 +705,13 @@ class Engine:
 
     def _release(self, slot: _Slot):
         for b in slot.blocks:
-            self._free.append(b)
+            self._free_block(b)      # shared blocks just drop a ref
         slot.req = None
         slot.length = 0
         slot.blocks = []
         slot.out_count = 0
+        slot.prefill_left = None
+        slot.hashes = []
         self._tbl[slot.idx] = 0                  # point at the trash block
 
     # -- compiled programs --------------------------------------------------
@@ -478,6 +729,49 @@ class Engine:
             fn = self._decode_fns[k] = jax.jit(
                 self._build_decode(k), donate_argnums=(2, 3, 6, 11))
         return fn
+
+    def _get_chunk_fn(self, Cb: int, final: bool):
+        fn = self._chunk_fns.get((Cb, final))
+        if fn is None:
+            fn = self._chunk_fns[(Cb, final)] = jax.jit(
+                self._build_chunk_prefill(Cb, final),
+                donate_argnums=(2, 3, 4, 14))
+        return fn
+
+    def _build_chunk_prefill(self, Cb: int, final: bool):
+        """B=1 chunk prefill over the paged pools: write a ``Cb``-token
+        chunk at the slot's block-aligned context offset and attend
+        context + chunk in one gather (``paged_chunk_attention_fn``).  Only
+        the FINAL chunk computes an output: the first sampled token at the
+        prompt's true last position ``n_valid - 1`` (non-final variants
+        skip sampling entirely — XLA drops the lm_head for them).  Pad-tail
+        positions past ``n_valid`` write to later table entries, which the
+        next chunk's dispatch-ordered writes overwrite (non-final) or the
+        trash block absorbs (final)."""
+        from ..jit import functional_call
+
+        model = self.model
+
+        def chunk(params, buffers, k_pools, v_pools, last, sidx, ids,
+                  tbl_row, ctx, n_valid, key, temp, top_k, top_p,
+                  firstbuf, fidx0):
+            cache = {"k": k_pools, "v": v_pools,
+                     "block_table": tbl_row[None, :], "lengths": ctx[None]}
+            out = functional_call(model, params, buffers, ids[None, :],
+                                  cache=cache, rng_key=key)
+            logits, new_cache = out[0], out[-1]
+            k_pools, v_pools = new_cache["k"], new_cache["v"]
+            if final:
+                lg = jnp.take_along_axis(
+                    logits, (n_valid - 1)[None, None, None], axis=1)[:, 0]
+                nxt = _sample_batch(lg, jax.random.fold_in(key, 1),
+                                    temp[None], top_k[None], top_p[None])
+                last = last.at[sidx].set(nxt[0])
+                firstbuf = jax.lax.dynamic_update_slice(
+                    firstbuf, nxt, (fidx0,))
+            return firstbuf, last, k_pools, v_pools
+
+        return chunk
 
     def _prefill_batch(self, group, Pb: int):
         """Dense-causal prefill of ``n`` same-bucket requests in ONE call;
@@ -560,14 +854,24 @@ class Engine:
         from ..framework import random as rnd
 
         fn = self._get_decode_fn(k)
-        lengths = np.array([s.length if s.req is not None else 0
+        # slots mid-chunked-prefill are NOT decoded: masked inactive
+        # (length 0) and their table rows zeroed in the dispatched
+        # snapshot, so a decode write at their context offset can't land
+        # in their real blocks
+        def _dec(s):
+            return s.req is not None and s.prefill_left is None
+        lengths = np.array([s.length if _dec(s) else 0
                             for s in self._slots], np.int32)
-        temps = np.array([s.req.temperature if s.req is not None else 0.0
+        temps = np.array([s.req.temperature if _dec(s) else 0.0
                           for s in self._slots], np.float32)
-        top_ks = np.array([s.req.top_k if s.req is not None else 0
+        top_ks = np.array([s.req.top_k if _dec(s) else 0
                            for s in self._slots], np.int32)
-        top_ps = np.array([s.req.top_p if s.req is not None else 1.0
+        top_ps = np.array([s.req.top_p if _dec(s) else 1.0
                            for s in self._slots], np.float32)
+        tbl = self._tbl.copy()
+        for s in self._slots:
+            if s.req is not None and s.prefill_left is not None:
+                tbl[s.idx] = 0
         if self._tok_row + k > self._tok_seg_rows:
             self._full_tok_bufs.append(self._tok_buf)
             self._tok_buf = jnp.zeros(
@@ -581,7 +885,7 @@ class Engine:
         # mutates _tbl while this chunk is still in flight
         self._tok_buf, lst, self.k_pools, self.v_pools = fn(
             self._params, self._buffers, self.k_pools, self.v_pools,
-            jnp.asarray(self._tbl.copy()), jnp.asarray(lengths),
+            jnp.asarray(tbl), jnp.asarray(lengths),
             self._last_dev, rnd.next_key(), jnp.asarray(temps),
             jnp.asarray(top_ks), jnp.asarray(top_ps),
             self._tok_buf, jnp.asarray(row0, jnp.int32))
@@ -591,7 +895,7 @@ class Engine:
         self.stats["decode_calls"] += 1
         recs = []
         for s in self._slots:
-            if s.req is None:
+            if s.req is None or s.prefill_left is not None:
                 continue
             take = min(k, s.req.max_new_tokens - s.out_count)
             recs.append((s.req, s.idx, take))
@@ -674,6 +978,25 @@ class Engine:
                     jnp.ones((n,), jnp.int32), rnd.next_key(),
                     jnp.zeros((n,), jnp.float32),
                     jnp.zeros((n,), jnp.int32), jnp.ones((n,), jnp.float32),
+                    jnp.zeros((self._first_seg,), jnp.int32),
+                    jnp.asarray(0, jnp.int32))
+        if self.prefix_cache or self.prefill_chunk is not None:
+            # chunk-prefill family: final variant at every bucket (suffix
+            # prefill picks its bucket by suffix length), non-final only at
+            # the chunk bucket (non-final chunks are always prefill_chunk)
+            variants = [(Pb, True) for Pb in self.prefill_buckets]
+            if self.prefill_chunk is not None:
+                variants.append((self._bucket(self.prefill_chunk), False))
+            for Cb, final in variants:
+                fn = self._get_chunk_fn(Cb, final)
+                _b, self._last_dev, self.k_pools, self.v_pools = fn(
+                    self._params, self._buffers, self.k_pools, self.v_pools,
+                    self._last_dev, jnp.asarray(0, jnp.int32),
+                    jnp.zeros((Cb,), jnp.int32),
+                    jnp.zeros((self.max_blocks_per_seq,), jnp.int32),
+                    jnp.asarray(0, jnp.int32), jnp.asarray(1, jnp.int32),
+                    rnd.next_key(), jnp.asarray(0.0, jnp.float32),
+                    jnp.asarray(0, jnp.int32), jnp.asarray(1.0, jnp.float32),
                     jnp.zeros((self._first_seg,), jnp.int32),
                     jnp.asarray(0, jnp.int32))
         jax.block_until_ready(self.k_pools)

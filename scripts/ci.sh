@@ -29,13 +29,14 @@ stage() {  # stage <name> <cmd...>
     return 0
 }
 
-stage tier-1 timeout -k 10 1200 python -m pytest tests/ -q -m 'not slow' \
+stage tier-1 timeout -k 10 2400 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 stage bytes_gate    ./scripts/bytes_gate.sh
 stage lint_gate     ./scripts/lint_gate.sh
 stage mem_gate      ./scripts/mem_gate.sh
 stage schedule_gate ./scripts/schedule_gate.sh
 stage reshard_gate  ./scripts/reshard_gate.sh
+stage serve_gate    ./scripts/serve_gate.sh
 stage host_lint     python -m paddle_tpu.analysis.host_lint
 
 echo "=== [ci] summary ===" >&2

@@ -1,0 +1,117 @@
+#!/bin/bash
+# Serving-tier regression gate.  Replays the two loadgen arrival traces
+# (`bench.py --preset serve --trace ...`) on the CPU proxy and fails when
+# the prefix cache or chunked prefill regress vs the committed baseline
+# (scripts/SERVE_BASELINE.json):
+#
+#   shared_prefix — 8 requests sharing a 384-token prefix.  Absolute
+#       invariants: every request completes in BOTH arms, greedy outputs
+#       are bit-identical cache-on vs cache-off, and cache-on goodput is
+#       >= 1.5x cache-off (the ISSUE acceptance floor; measured ~2.6x).
+#       Baseline-gated (deterministic, no wall clock): prefix-cache hit
+#       rate must not drop and cache-on prefill tokens must not grow.
+#   long_prompt — 512-token prompts arriving into live decode.  Absolute
+#       invariants: all complete, outputs bit-identical chunked vs
+#       monolithic, and decode-gap p99 with chunked prefill <= 0.85x the
+#       monolithic schedule (measured ~0.40x; a silently-disabled chunk
+#       path scores ~1.0x and fails).
+#
+# p50/p99 latency and goodput tps are recorded in the baseline for
+# provenance but never diffed — wall-clock numbers are CI noise.
+#
+# Defect injection (proves the gate can fail):
+#     SERVE_GATE_INJECT=cache-off scripts/serve_gate.sh   # must exit != 0
+# Refresh the baseline after an intentional change:
+#     scripts/serve_gate.sh --update
+# Exit code: number of failed traces (0 = gate passes).
+cd "$(dirname "$0")/.." || exit 1
+GATE_NAME=serve_gate
+GATE_BASELINE="scripts/SERVE_BASELINE.json"
+. scripts/gate_lib.sh
+gate_init "$@"
+
+check_shared() {
+    gate_bench serve 1200 --trace shared_prefix "$@" || return
+    gate_diff shared_prefix <<PY
+import json, os, sys
+exec(os.environ["GATE_PY_COMMON"])
+trace, baseline_path, new_path, update = sys.argv[1:5]
+line = """$GATE_LINE"""
+r = gate_result(line)
+entry = {k: r.get(k) for k in (
+    "value", "hit_rate", "outputs_bit_identical", "prefill_tokens_on",
+    "prefill_tokens_off", "requests", "completed_on", "completed_off",
+    "goodput_tps_on", "goodput_tps_off", "p50_ms", "p99_ms")}
+gate_record(new_path, trace, entry)
+fails = []
+if not (r.get("completed_on") == r.get("completed_off") == r.get("requests")):
+    fails.append(f"lost requests (on={r.get('completed_on')} "
+                 f"off={r.get('completed_off')} of {r.get('requests')})")
+if not r.get("outputs_bit_identical"):
+    fails.append("greedy outputs differ cache-on vs cache-off")
+if r.get("value", 0.0) < 1.5:
+    fails.append(f"goodput ratio {r.get('value', 0.0):.2f}x < 1.5x floor")
+if fails:
+    print(f"[serve_gate] {trace}: FAILED ({'; '.join(fails)})",
+          file=sys.stderr)
+    sys.exit(1)
+if int(update):
+    print(f"[serve_gate] {trace}: goodput {r['value']:.2f}x "
+          f"hit_rate {r['hit_rate']:.3f} (recorded)", file=sys.stderr)
+    sys.exit(0)
+base = gate_base(baseline_path, trace, "serve_gate", "scripts/serve_gate.sh")
+# deterministic fields: the trace and engine config are fixed, so any
+# drift here is a code regression, not scheduling noise
+if r.get("hit_rate", 0.0) + 1e-9 < base.get("hit_rate", 0.0):
+    print(f"[serve_gate] {trace}: FAILED (hit_rate "
+          f"{base['hit_rate']:.3f} -> {r['hit_rate']:.3f})", file=sys.stderr)
+    sys.exit(1)
+if r.get("prefill_tokens_on", 0) > base.get("prefill_tokens_on", 1 << 60):
+    print(f"[serve_gate] {trace}: FAILED (cache-on prefill tokens "
+          f"{base['prefill_tokens_on']} -> {r['prefill_tokens_on']})",
+          file=sys.stderr)
+    sys.exit(1)
+print(f"[serve_gate] {trace}: OK goodput {r['value']:.2f}x "
+      f"hit_rate {r['hit_rate']:.3f}", file=sys.stderr)
+PY
+}
+
+check_long() {
+    gate_bench serve 1200 --trace long_prompt "$@" || return
+    gate_diff long_prompt <<PY
+import json, os, sys
+exec(os.environ["GATE_PY_COMMON"])
+trace, baseline_path, new_path, update = sys.argv[1:5]
+line = """$GATE_LINE"""
+r = gate_result(line)
+entry = {k: r.get(k) for k in (
+    "value", "decode_gap_p99_on_ms", "decode_gap_p99_off_ms",
+    "outputs_bit_identical", "requests", "completed_on", "completed_off",
+    "goodput_tps_on", "goodput_tps_off", "p50_ms", "p99_ms")}
+gate_record(new_path, trace, entry)
+fails = []
+if not (r.get("completed_on") == r.get("completed_off") == r.get("requests")):
+    fails.append(f"lost requests (on={r.get('completed_on')} "
+                 f"off={r.get('completed_off')} of {r.get('requests')})")
+if not r.get("outputs_bit_identical"):
+    fails.append("greedy outputs differ chunked vs monolithic prefill")
+if r.get("value", 9.9) > 0.85:
+    fails.append(f"decode-gap p99 ratio {r.get('value', 9.9):.2f}x > 0.85x "
+                 "(chunked prefill not shielding decode)")
+if fails:
+    print(f"[serve_gate] {trace}: FAILED ({'; '.join(fails)})",
+          file=sys.stderr)
+    sys.exit(1)
+print(f"[serve_gate] {trace}: {'recorded' if int(update) else 'OK'} "
+      f"decode-gap p99 {r['value']:.2f}x", file=sys.stderr)
+PY
+}
+
+INJECT=()
+[ "${SERVE_GATE_INJECT:-}" = "cache-off" ] && INJECT=(--serve-cache off)
+
+check_shared "${INJECT[@]}"
+check_long
+
+# keep only our trace keys fresh if the baseline ever grows other sections
+gate_finish_merge

@@ -1,0 +1,211 @@
+"""serving.Router: multi-replica front-end — prefix-affinity routing,
+memory_plan-derived headroom, elastic join/leave, and the deterministic
+replica-kill chaos path (FLAGS_ft_inject_serve_kill_*).
+
+The exactly-once contract is the spine of every test here: each submitted
+request id appears in the collected outputs exactly once, and greedy
+outputs are bit-identical to an unkilled single-replica reference no
+matter how many replicas joined, left, or were killed mid-serve."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fault_tolerance.injection import (
+    FaultInjector, set_injector)
+from paddle_tpu.framework import flags
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.serving import Engine, GenRequest
+from paddle_tpu.serving.router import Router
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(llama_tiny_config())
+
+
+@pytest.fixture(autouse=True)
+def _no_injector():
+    """Isolate the process-wide injector: tests install their own and this
+    guarantees none leaks into the next test."""
+    set_injector(None)
+    yield
+    set_injector(None)
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("num_blocks", 16)
+    kw.setdefault("block_size", 128)
+    kw.setdefault("prefill_buckets", (128, 256))
+    return Engine(model, **kw)
+
+
+def _prompts(cfg, lengths, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size, size=(p,)).astype(np.int32)
+            for p in lengths]
+
+
+def _shared_prefix_prompts(cfg, n, prefix_len=260, tail_len=8, seed=3):
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(1, cfg.vocab_size, size=prefix_len).astype(np.int32)
+    return [np.concatenate([shared, rng.integers(1, cfg.vocab_size,
+                                                 size=tail_len).astype(np.int32)])
+            for _ in range(n)]
+
+
+def _reference(model, prompts, max_new):
+    refs = []
+    for p in prompts:
+        out = model.generate(paddle.to_tensor(p[None, :]),
+                             max_new_tokens=max_new)
+        refs.append(np.asarray(out._data)[0, len(p):].tolist())
+    return refs
+
+
+def test_router_prefix_affinity_beats_load(model):
+    """A request sharing a cached prefix routes to the replica that holds
+    it even when an empty replica is available; a fresh request flows to
+    the replica with more headroom."""
+    cfg = model.config
+    shared = _shared_prefix_prompts(cfg, 3)
+    fresh = _prompts(cfg, (30,), seed=9)[0]
+    r = Router()
+    r.add_replica(_engine(model))            # replica 0
+    r.add_replica(_engine(model))            # replica 1
+    # warm replica 0's prefix cache (ties break toward the lowest id)
+    rid0 = r.submit(GenRequest(prompt_ids=shared[0], max_new_tokens=4))
+    assert r._tracked[rid0].replica == 0
+    r.run_to_completion()
+    # prefix affinity: lands on 0 despite equal load
+    rid1 = r.submit(GenRequest(prompt_ids=shared[1], max_new_tokens=4))
+    assert r._tracked[rid1].replica == 0
+    # fresh prompt: replica 0's slots/blocks are now occupied by rid1, so
+    # headroom routes it to replica 1
+    rid2 = r.submit(GenRequest(prompt_ids=fresh, max_new_tokens=4))
+    assert r._tracked[rid2].replica == 1
+    outs = {o.request_id: o.output_ids for o in r.run_to_completion()}
+    refs = _reference(model, [shared[1], fresh], 4)
+    assert [outs[rid1], outs[rid2]] == refs
+
+
+def test_router_headroom_tracks_occupancy(model):
+    """replica_headroom_bytes shrinks as a replica's blocks are claimed and
+    accounts prefix-cache metadata via memory_plan()."""
+    r = Router()
+    a = r.add_replica(_engine(model))
+    b = r.add_replica(_engine(model))
+    h0 = r.replica_headroom_bytes(a)
+    assert h0 == r.replica_headroom_bytes(b)
+    rid = r.submit(GenRequest(
+        prompt_ids=_prompts(model.config, (200,), seed=2)[0],
+        max_new_tokens=4))
+    assert r._tracked[rid].replica == a
+    r.step()   # blocks are claimed at engine admission, not at submit
+    assert r.replica_headroom_bytes(a) < h0
+    plan = r._replicas[a].memory_plan()
+    assert plan["prefix_cache_bytes"] > 0
+    r.run_to_completion()
+
+
+def test_router_parks_until_replica_joins(model):
+    """Submissions with no replicas park; a late join drains them (elastic
+    scale-up) and they complete correctly."""
+    cfg = model.config
+    prompts = _prompts(cfg, (20, 40), seed=4)
+    refs = _reference(model, prompts, 5)
+    r = Router()
+    rids = [r.submit(GenRequest(prompt_ids=p, max_new_tokens=5))
+            for p in prompts]
+    assert all(r._tracked[rid].replica is None for rid in rids)
+    assert r.stats["parked_peak"] == 2
+    with pytest.raises(RuntimeError, match="parked"):
+        r.run_to_completion()
+    r.add_replica(_engine(model))
+    outs = {o.request_id: o.output_ids for o in r.run_to_completion()}
+    assert [outs[rid] for rid in rids] == refs
+
+
+def test_router_remove_replica_reroutes_exactly_once(model):
+    """Scale-down mid-serve: the removed replica's in-flight requests
+    re-prefill on the survivor and every request completes exactly once
+    with bit-identical greedy output."""
+    cfg = model.config
+    prompts = _prompts(cfg, (20, 150, 60, 90), seed=6)
+    refs = _reference(model, prompts, 8)
+    r = Router()
+    r.add_replica(_engine(model))
+    r.add_replica(_engine(model))
+    rids = [r.submit(GenRequest(prompt_ids=p, max_new_tokens=8))
+            for p in prompts]
+    collected = []
+    collected += r.step()
+    collected += r.step()
+    victim = next(r._tracked[rid].replica for rid in rids
+                  if r._tracked[rid].replica is not None)
+    moved = r.remove_replica(victim)
+    assert moved, "victim had no in-flight work to harvest"
+    while r.has_work():
+        collected += r.step()
+    assert sorted(o.request_id for o in collected) == sorted(rids)
+    outs = {o.request_id: o.output_ids for o in collected}
+    assert [outs[rid] for rid in rids] == refs
+    assert r.stats["rerouted"] == len(moved)
+
+
+def test_chaos_replica_kill_flags_bit_identical(model):
+    """Satellite 3: FLAGS_ft_inject_serve_kill_* kills a replica at an
+    exact round mid-serve.  Every in-flight request re-routes, re-prefills
+    on a survivor, completes exactly once, and greedy outputs are
+    bit-identical to an unkilled single-replica run."""
+    cfg = model.config
+    prompts = (_shared_prefix_prompts(cfg, 2)
+               + _prompts(cfg, (25, 140, 70), seed=8))
+    refs = _reference(model, prompts, 8)
+
+    # unkilled single-replica reference run
+    r_ref = Router()
+    r_ref.add_replica(_engine(model, max_batch=3))
+    ref_rids = [r_ref.submit(GenRequest(prompt_ids=p, max_new_tokens=8))
+                for p in prompts]
+    ref_outs = {o.request_id: o.output_ids for o in r_ref.run_to_completion()}
+    assert [ref_outs[rid] for rid in ref_rids] == refs
+
+    # chaos run: two replicas, kill replica 0 at round 2 via the flags
+    old = flags.get_flags(["ft_inject_serve_kill_round",
+                           "ft_inject_serve_kill_replica"])
+    flags.set_flags({"ft_inject_serve_kill_round": 2,
+                     "ft_inject_serve_kill_replica": 0})
+    try:
+        set_injector(FaultInjector.from_flags())
+        r = Router()
+        r.add_replica(_engine(model, max_batch=3))
+        r.add_replica(_engine(model, max_batch=3))
+        rids = [r.submit(GenRequest(prompt_ids=p, max_new_tokens=8))
+                for p in prompts]
+        outs = r.run_to_completion()
+    finally:
+        flags.set_flags(old)
+        set_injector(None)
+    assert r.stats["kills"] == 1
+    assert 0 not in r._replicas and 1 in r._replicas
+    # exactly once: no lost and no duplicated outputs
+    assert sorted(o.request_id for o in outs) == sorted(rids)
+    got = {o.request_id: o.output_ids for o in outs}
+    assert [got[rid] for rid in rids] == refs, \
+        "failover changed greedy outputs"
+    assert r.stats["rerouted"] >= 1
+
+
+def test_serve_kill_due_is_one_shot():
+    inj = FaultInjector(serve_kill_round=3, serve_kill_replica=7)
+    assert inj.active()
+    assert inj.serve_kill_due(2, [0, 7]) is None
+    assert inj.serve_kill_due(3, [0, 7]) == 7
+    assert inj.serve_kill_due(4, [0, 7]) is None   # latched
+    # configured victim already gone -> lowest alive
+    inj2 = FaultInjector(serve_kill_round=1, serve_kill_replica=9)
+    assert inj2.serve_kill_due(5, [2, 3]) == 2
+    assert inj2.serve_kill_due(6, [2, 3]) is None

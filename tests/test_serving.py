@@ -36,6 +36,15 @@ def _reference(model, prompts, max_new):
     return refs
 
 
+def _assert_pool_reclaimed(eng):
+    """No live owners, and the free pool plus the ref-0 prefix-cache LRU
+    partition the usable blocks exactly (no leaks, no double frees)."""
+    assert not eng._ref, f"live refs after drain: {eng._ref}"
+    pool = sorted(list(eng._free) + list(eng._lru.values()))
+    assert pool == list(range(1, eng.num_blocks))
+    np.testing.assert_array_equal(eng._tbl, 0)
+
+
 # ---------------------------------------------------------------------------
 # paged kernel numerics
 # ---------------------------------------------------------------------------
@@ -151,9 +160,7 @@ def test_block_accounting_invariant_after_eviction(model):
     for p in prompts:
         eng.add_request(GenRequest(prompt_ids=p, max_new_tokens=16))
     eng.run_to_completion()
-    assert len(eng._free) == eng.num_blocks - 1, "leaked blocks"
-    assert sorted(eng._free) == list(range(1, eng.num_blocks))
-    np.testing.assert_array_equal(eng._tbl, 0)
+    _assert_pool_reclaimed(eng)
 
 
 def test_impossible_request_raises_not_spins(model):
@@ -198,7 +205,7 @@ def test_engine_chunked_decode_matches_stepwise(model):
         for p in prompts:
             eng.add_request(GenRequest(prompt_ids=p, max_new_tokens=13))
         outs = {o.request_id: o.output_ids for o in eng.run_to_completion()}
-        return outs, eng.stats["generated_tokens"], len(eng._free)
+        return outs, eng.stats["generated_tokens"], eng._available()
 
     outs1, gen1, free1 = run(1)
     outs8, gen8, free8 = run(8)
@@ -236,7 +243,7 @@ def test_engine_eos_mid_chunk_discards_tail(model):
     assert out.finish_reason == "stop"
     assert out.output_ids == ref[:2]
     # the slot and all its blocks were reclaimed despite the mid-chunk stop
-    assert len(eng._free) == eng.num_blocks - 1
+    assert eng._available() == eng.num_blocks - 1
 
 
 def test_engine_drain_mode_single_sync(model):
@@ -353,8 +360,7 @@ def test_engine_fuzz_mixed_workload(model):
             assert len(out.output_ids) <= r.max_new_tokens
             assert all(0 <= t < cfg.vocab_size for t in out.output_ids)
     # pool fully reclaimed, no leaked or double-freed blocks
-    assert sorted(eng._free) == list(range(1, eng.num_blocks))
-    np.testing.assert_array_equal(eng._tbl, 0)
+    _assert_pool_reclaimed(eng)
 
 
 def test_eviction_requeue_preserves_sampling_knobs(model):
@@ -463,3 +469,216 @@ def test_evict_aborts_when_sync_frees_blocks(model, monkeypatch):
     eng._evict(slot_b)
     assert slot_b.req is None
     assert eng.stats["evictions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# prefix caching (ISSUE 11): refcounted shared blocks, LRU reclaim
+# ---------------------------------------------------------------------------
+
+def _shared_prefix_prompts(cfg, n, prefix_len=260, tail_len=8, seed=3):
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(1, cfg.vocab_size, size=prefix_len).astype(np.int32)
+    return [np.concatenate([shared, rng.integers(1, cfg.vocab_size,
+                                                 size=tail_len).astype(np.int32)])
+            for _ in range(n)]
+
+
+def test_prefix_cache_shared_prompt_prefills_once(model):
+    """A prefix appearing N times prefills exactly once: every later
+    admission takes all cacheable blocks as hits, and greedy outputs stay
+    bit-identical to cache-off and to model.generate."""
+    cfg = model.config
+    prompts = _shared_prefix_prompts(cfg, 4)          # 268 tokens each
+    refs = _reference(model, prompts, 6)
+    n_cacheable = (len(prompts[0]) - 1) // 128        # = 2 full blocks
+
+    def run(cache):
+        eng = Engine(model, max_batch=2, num_blocks=24, block_size=128,
+                     prefill_buckets=(128, 256, 512), prefix_cache=cache)
+        reqs = [GenRequest(prompt_ids=p, max_new_tokens=6) for p in prompts]
+        for r in reqs:
+            eng.add_request(r)
+        outs = {o.request_id: o.output_ids for o in eng.run_to_completion()}
+        return [outs[r.request_id] for r in reqs], eng
+
+    outs_on, eng_on = run(True)
+    outs_off, eng_off = run(False)
+    assert outs_on == refs
+    assert outs_off == refs                           # bit-identical on/off
+    # accounting: requests 2..4 each hit the full cacheable prefix
+    assert eng_on.stats["prefix_hit_blocks"] == 3 * n_cacheable
+    assert eng_on.stats["prefix_hit_tokens"] == 3 * n_cacheable * 128
+    assert eng_off.stats["prefix_hit_blocks"] == 0
+    # the shared blocks prefilled once: cache-on skipped 3 repeat prefills
+    assert (eng_on.stats["prefill_tokens"]
+            < eng_off.stats["prefill_tokens"])
+    # exactly the prefix's chain survives in the index
+    assert len(eng_on._index) == n_cacheable
+    _assert_pool_reclaimed(eng_on)
+    _assert_pool_reclaimed(eng_off)
+
+
+def test_prefix_refcount_shared_block_survives_owner_eviction(model):
+    """Refcounted eviction: a block shared by two live slots must never be
+    freed while any owner is alive — evicting one owner decrefs, the
+    survivor keeps decoding from the same physical block, and the evicted
+    request still completes correctly after re-admission."""
+    cfg = model.config
+    prompts = _shared_prefix_prompts(cfg, 2)
+    refs = _reference(model, prompts, 8)
+    eng = Engine(model, max_batch=2, num_blocks=24, block_size=128,
+                 prefill_buckets=(128, 256, 512))
+    reqs = [GenRequest(prompt_ids=p, max_new_tokens=8) for p in prompts]
+    for r in reqs:
+        eng.add_request(r)
+    eng._round()                       # both admitted, prefix shared
+    slots = [s for s in eng._slots if s.req is not None]
+    assert len(slots) == 2
+    shared = [b for b in slots[0].blocks if b in slots[1].blocks]
+    assert shared, "admissions did not share the prefix blocks"
+    for b in shared:
+        assert eng._ref[b] == 2
+    eng._evict(slots[1])               # one owner preempted
+    for b in shared:
+        assert eng._ref[b] == 1, "shared block lost its surviving owner"
+        assert b not in eng._free and b not in eng._lru.values(), \
+            "shared block freed while an owner is live"
+    outs = {o.request_id: o.output_ids for o in eng.run_to_completion()}
+    assert [outs[r.request_id] for r in reqs] == refs
+    _assert_pool_reclaimed(eng)
+
+
+def test_prefix_lru_reclaim_under_pressure(model):
+    """Ref-0 cached blocks are reclaimable: when the free list alone cannot
+    satisfy an admission, the oldest LRU entries are deregistered and
+    reused, and the evicted hashes disappear from the index."""
+    cfg = model.config
+    prompts = _shared_prefix_prompts(cfg, 1)          # 268 tokens, 3 blocks
+    fresh = _prompts(cfg, (500,), seed=11)[0]         # needs 4 blocks
+    refs = _reference(model, [prompts[0]], 4) + _reference(model, [fresh], 4)
+    eng = Engine(model, max_batch=1, num_blocks=6, block_size=128,
+                 prefill_buckets=(128, 256, 512))
+    r1 = GenRequest(prompt_ids=prompts[0], max_new_tokens=4)
+    eng.add_request(r1)
+    outs = {o.request_id: o.output_ids for o in eng.run_to_completion()}
+    assert len(eng._lru) == 2          # prefix parked at ref 0
+    parked_hashes = set(eng._index)
+    r2 = GenRequest(prompt_ids=fresh, max_new_tokens=4)
+    eng.add_request(r2)                # 4 blocks needed, only 3 free
+    outs.update({o.request_id: o.output_ids
+                 for o in eng.run_to_completion()})
+    assert [outs[r1.request_id], outs[r2.request_id]] == refs
+    # at least one of the parked prefix blocks was reclaimed: its hash is
+    # gone from the index (the fresh prompt's own chain replaces it)
+    assert len(parked_hashes & set(eng._index)) < len(parked_hashes), \
+        "LRU reclaim did not deregister"
+    _assert_pool_reclaimed(eng)
+
+
+def test_evict_vs_sync_release_keeps_refcounts_consistent(model):
+    """Extends the PR-7 eviction/sync race regression to refcounted blocks:
+    a sync that releases a prefix-sharing slot mid-_evict must leave the
+    shared blocks owned by the survivor (no double-free, no LRU parking
+    while a ref is live)."""
+    cfg = model.config
+    prompts = _shared_prefix_prompts(cfg, 2)
+    eng = Engine(model, max_batch=2, num_blocks=8, block_size=128,
+                 prefill_buckets=(128, 256, 512))
+    for p in prompts:
+        eng.add_request(GenRequest(prompt_ids=p, max_new_tokens=8))
+    eng._round()
+    slot_a, slot_b = [s for s in eng._slots if s.req is not None]
+    shared = [b for b in slot_a.blocks if b in slot_b.blocks]
+    assert shared and all(eng._ref[b] == 2 for b in shared)
+    eng._free.clear()
+    orig_sync = eng._sync_pending
+
+    def sync_releases_a():
+        orig_sync()
+        if slot_a.req is not None:
+            eng._release(slot_a)
+    eng._sync_pending = sync_releases_a
+    eng._evict(slot_b)                 # sync frees a's suffix -> abort
+    assert slot_b.req is not None, "preemption not aborted"
+    for b in shared:
+        assert eng._ref[b] == 1, \
+            "release of one owner must only decref shared blocks"
+        assert b not in eng._free and b not in eng._lru.values()
+
+
+def test_trash_block_nan_garbage_never_leaks(model):
+    """The trash block may hold arbitrary garbage — including NaN (a
+    warmup prefill past the model's position table writes exactly that).
+    The paged gather paths contract p@v over masked positions with weight
+    0, and 0*NaN = NaN, so V must be zeroed under the mask: greedy outputs
+    must be bit-identical to generate with an all-NaN trash block."""
+    cfg = model.config
+    prompts = _prompts(cfg, (20, 100), seed=5)
+    refs = _reference(model, prompts, 8)
+    eng = Engine(model, max_batch=2, num_blocks=8, block_size=128,
+                 prefill_buckets=(128,))
+    nan = jnp.full_like(np.asarray(eng.k_pools[0][0]), jnp.nan)
+    eng.k_pools = tuple(kp.at[0].set(nan) for kp in eng.k_pools)
+    eng.v_pools = tuple(vp.at[0].set(nan) for vp in eng.v_pools)
+    reqs = [GenRequest(prompt_ids=p, max_new_tokens=8) for p in prompts]
+    for r in reqs:
+        eng.add_request(r)
+    outs = {o.request_id: o.output_ids for o in eng.run_to_completion()}
+    assert [outs[r.request_id] for r in reqs] == refs
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def test_chunked_prefill_matches_monolithic(model):
+    """Splitting a long prompt's prefill into chunks must not change a
+    single output token vs the monolithic prefill (and both must match
+    generate)."""
+    cfg = model.config
+    prompts = _prompts(cfg, (200, 20, 150), seed=7)
+    refs = _reference(model, prompts, 6)
+
+    def run(chunk):
+        eng = Engine(model, max_batch=2, num_blocks=16, block_size=128,
+                     prefill_buckets=(128, 256), prefill_chunk=chunk)
+        reqs = [GenRequest(prompt_ids=p, max_new_tokens=6) for p in prompts]
+        for r in reqs:
+            eng.add_request(r)
+        outs = {o.request_id: o.output_ids for o in eng.run_to_completion()}
+        return [outs[r.request_id] for r in reqs], eng
+
+    outs_c, eng_c = run(128)
+    outs_m, eng_m = run(None)
+    assert outs_c == refs and outs_m == refs
+    assert eng_c.stats["chunk_prefills"] > 0
+    assert eng_m.stats["chunk_prefills"] == 0
+    _assert_pool_reclaimed(eng_c)
+
+
+def test_chunked_prefill_interleaves_with_decode(model):
+    """A long prompt admitted mid-decode prefills in chunks BETWEEN decode
+    rounds (decode keeps advancing) and neither stream corrupts the other —
+    the regression shape of the trash-block NaN bug."""
+    cfg = model.config
+    short, long_ = _prompts(cfg, (16, 230), seed=13)
+    ref_s = _reference(model, [short], 16)[0]
+    ref_l = _reference(model, [long_], 6)[0]
+    eng = Engine(model, max_batch=2, num_blocks=16, block_size=128,
+                 prefill_buckets=(128, 256), prefill_chunk=128,
+                 decode_chunk=4)
+    eng.add_request(GenRequest(prompt_ids=short, max_new_tokens=16,
+                               request_id="s"))
+    outs = {}
+    rounds = 0
+    while eng.has_work():
+        rounds += 1
+        if rounds == 2:
+            eng.add_request(GenRequest(prompt_ids=long_, max_new_tokens=6,
+                                       request_id="l"))
+        for o in eng.step():
+            outs[o.request_id] = o.output_ids
+    assert outs["s"] == ref_s
+    assert outs["l"] == ref_l
+    assert eng.stats["chunk_prefills"] >= 2
+    _assert_pool_reclaimed(eng)

@@ -63,6 +63,7 @@ _LOCK_TOKENS = {"lock", "rlock", "mutex", "mu", "cond", "condition",
 # paths (relative to the paddle_tpu package root) the self-lint covers
 DEFAULT_SUBDIRS = (
     "distributed/store.py",
+    "distributed/store_replicated.py",
     "distributed/launch",
     "distributed/fault_tolerance",
     "distributed/ps",

@@ -24,6 +24,7 @@ from .api import (  # noqa: F401
 )
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .store import TCPStore  # noqa: F401
+from .store_replicated import ReplicatedStore  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from . import rpc  # noqa: F401

@@ -25,6 +25,16 @@ Supported faults (all off by default):
   ``ft_inject_serve_kill_replica``) — the serving router drops a replica
   at an exact round; its in-flight requests must re-route and re-prefill
   on survivors (``serving.router``).
+- **store leader kill** (``ft_inject_store_kill_leader``) — the replicated
+  control-plane store's leader dies immediately after acking its N-th
+  client write; the ack is already on the wire, so the chaos tests can
+  assert a quorum-acked write survives failover
+  (``distributed.store_replicated``).
+- **store partition** (``ft_inject_store_partition``) — replica-to-replica
+  links between the configured groups drop while client links stay up, so
+  a minority leader stays reachable and can be asserted to never ack a
+  write (no split brain).  Heal at runtime via
+  :meth:`FaultInjector.set_store_partition`.
 """
 
 from __future__ import annotations
@@ -44,7 +54,8 @@ class FaultInjector:
                  crash_rank: int = -1, store_drop_rate: float = 0.0,
                  store_delay_ms: int = 0, corrupt_step: int = -1,
                  crash_signal: int = 0, serve_kill_round: int = -1,
-                 serve_kill_replica: int = -1):
+                 serve_kill_replica: int = -1, store_kill_leader: int = -1,
+                 store_partition: str = ""):
         self.seed = int(seed)
         self.crash_step = int(crash_step)
         self.crash_rank = int(crash_rank)
@@ -55,6 +66,9 @@ class FaultInjector:
         self.serve_kill_round = int(serve_kill_round)
         self.serve_kill_replica = int(serve_kill_replica)
         self._serve_kill_fired = False
+        self.store_kill_leader = int(store_kill_leader)
+        self._store_kill_fired = False
+        self.set_store_partition(store_partition)
         # independent streams so enabling one fault cannot shift another's
         # decisions (replayability across configurations)
         self._drop_rng = random.Random(f"{self.seed}/store-drop")
@@ -71,12 +85,17 @@ class FaultInjector:
                    crash_signal=flags.get_flag("ft_inject_crash_signal"),
                    serve_kill_round=flags.get_flag("ft_inject_serve_kill_round"),
                    serve_kill_replica=flags.get_flag(
-                       "ft_inject_serve_kill_replica"))
+                       "ft_inject_serve_kill_replica"),
+                   store_kill_leader=flags.get_flag(
+                       "ft_inject_store_kill_leader"),
+                   store_partition=flags.get_flag(
+                       "ft_inject_store_partition"))
 
     def active(self) -> bool:
         return (self.crash_step >= 0 or self.store_drop_rate > 0.0
                 or self.store_delay_ms > 0 or self.corrupt_step >= 0
-                or self.serve_kill_round >= 0)
+                or self.serve_kill_round >= 0 or self.store_kill_leader >= 0
+                or bool(self._partition_groups))
 
     # -- fail-stop worker crash ---------------------------------------------
 
@@ -120,6 +139,43 @@ class FaultInjector:
         return min(alive)
 
     # -- store faults --------------------------------------------------------
+
+    def store_kill_due(self, writes_acked: int) -> bool:
+        """One-shot leader kill for the replicated store.  A leader calls
+        this right after acking a client write with its own acked-write
+        count; the first leader to reach the configured threshold dies.
+        The ack is already on the wire when the kill fires — the write is
+        quorum-committed, which is exactly what the chaos test asserts
+        survives."""
+        if self.store_kill_leader < 0 or self._store_kill_fired:
+            return False
+        if writes_acked < self.store_kill_leader:
+            return False
+        self._store_kill_fired = True
+        return True
+
+    def set_store_partition(self, spec: str) -> None:
+        """(Re)configure the replica partition at runtime: ``'0|1,2'``
+        drops replica-to-replica links between group {0} and group {1,2};
+        ``''`` heals.  Replica ids absent from the spec keep all links."""
+        groups = []
+        for part in str(spec or "").split("|"):
+            ids = frozenset(int(tok) for tok in part.split(",") if tok.strip())
+            if ids:
+                groups.append(ids)
+        self._partition_groups: List[frozenset] = groups
+
+    def store_link_blocked(self, a: int, b: int) -> bool:
+        """True when the replica-to-replica link a<->b is partitioned
+        (checked sender-side in both directions, so one check per send
+        cuts the link symmetrically)."""
+        ga = gb = None
+        for g in self._partition_groups:
+            if a in g:
+                ga = g
+            if b in g:
+                gb = g
+        return ga is not None and gb is not None and ga is not gb
 
     def should_drop(self) -> bool:
         """One deterministic draw per store op."""

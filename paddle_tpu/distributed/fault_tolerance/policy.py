@@ -17,7 +17,8 @@ import time
 from typing import Callable, Iterator, NamedTuple, Optional, Tuple, Type
 
 __all__ = ["RetryPolicy", "Deadline", "retry_call", "HeartbeatConfig",
-           "heartbeat_config"]
+           "heartbeat_config", "StoreConsensusConfig",
+           "store_consensus_config"]
 
 
 class HeartbeatConfig(NamedTuple):
@@ -76,6 +77,65 @@ def heartbeat_config(interval: Optional[float] = None,
             f"live peer")
     return HeartbeatConfig(interval=interval, ttl=ttl,
                            op_timeout=max(2.0, 2.0 * interval))
+
+
+class StoreConsensusConfig(NamedTuple):
+    """Validated timing knobs for the replicated control-plane store
+    (``distributed.store_replicated``), all derived from the SAME
+    heartbeat flag surface as the failure detector so one pair of knobs
+    (``FLAGS_ft_heartbeat_interval`` / ``FLAGS_ft_lease_ttl``) tunes the
+    whole control plane coherently:
+
+    - ``heartbeat``: leader append/heartbeat cadence = the heartbeat
+      interval.  Followers hear from a live leader at least this often.
+    - ``lease_ttl``: the leader lease = the membership lease ttl.  The
+      leader serves linearizable reads only while a quorum's latest
+      acks are younger than this; past it, it steps down.
+    - ``election_timeout``: base follower silence before standing for
+      election; must be **>= 2 x lease_ttl** so a leader always loses
+      its lease (stops serving reads) strictly before any follower can
+      start a term that could elect a competing leader.  Actual
+      timeouts are randomized per election in
+      ``[election_timeout, 2 * election_timeout)``.
+    - ``clock_skew``: safety margin subtracted from the lease before
+      serving a read (0.25 x ttl): two replicas' monotonic clocks may
+      advance at slightly different rates, so the old leader must
+      consider its lease dead while the quorum still considers it live.
+    - ``op_timeout``: per-peer-RPC budget (same derivation as the
+      detector's store-op budget).
+    """
+
+    heartbeat: float
+    lease_ttl: float
+    election_timeout: float
+    clock_skew: float
+    op_timeout: float
+
+
+def store_consensus_config(
+        interval: Optional[float] = None, ttl: Optional[float] = None,
+        election_timeout: Optional[float] = None) -> StoreConsensusConfig:
+    """Derive replicated-store timings from ``heartbeat_config``.
+
+    ``interval``/``ttl`` pass through :func:`heartbeat_config` (same
+    bounds validation, same flag fallbacks); ``election_timeout``
+    defaults to ``2 * ttl`` and is validated to stay >= that floor.
+    Raises ``ValueError`` on a configuration that could elect a second
+    leader while the first still serves reads.
+    """
+    hb = heartbeat_config(interval, ttl)
+    if election_timeout is None:
+        election_timeout = 2.0 * hb.ttl
+    election_timeout = float(election_timeout)
+    if election_timeout < 2.0 * hb.ttl:
+        raise ValueError(
+            f"store election timeout {election_timeout} must be >= 2x the "
+            f"lease ttl ({hb.ttl}) — a follower could start an election "
+            f"while the old leader still serves lease reads")
+    return StoreConsensusConfig(heartbeat=hb.interval, lease_ttl=hb.ttl,
+                                election_timeout=election_timeout,
+                                clock_skew=0.25 * hb.ttl,
+                                op_timeout=hb.op_timeout)
 
 
 class Deadline:

@@ -69,6 +69,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "fault_tolerance.policy.heartbeat_config for the "
                         "validated bounds, FLAGS_ft_lease_ttl for the "
                         "companion lease knob)")
+    p.add_argument("--store_replicas", type=int,
+                   default=int(os.environ.get("PADDLE_STORE_REPLICAS", "1")),
+                   help="replicate the rendezvous/control store across this "
+                        "many quorum replicas (>= 2 upgrades to the "
+                        "leader-leased replicated store; acked writes then "
+                        "survive a store-host crash).  The master node binds "
+                        "ports master_port .. master_port+N-1, so the PJRT "
+                        "coordinator moves past that range; timings derive "
+                        "from FLAGS_ft_heartbeat_interval/FLAGS_ft_lease_ttl "
+                        "(fault_tolerance.policy.store_consensus_config)")
     p.add_argument("--log_dir", default=None, help="write per-process logs here")
     p.add_argument("--job_id", default="default", help="job name for logs")
     p.add_argument("training_script", help="the training program")
@@ -249,6 +259,14 @@ def launch(args) -> int:
     rdzv = None
     coordinator = None
     coord_base = None
+    n_store = max(1, int(getattr(args, "store_replicas", 1) or 1))
+    if n_store >= 2:
+        # children (and the rendezvous below) pick the replicated client
+        # path up from the environment — zero call-site changes
+        os.environ["PADDLE_STORE_REPLICAS"] = str(n_store)
+    # the replicated store occupies master_port..master_port+n-1, so the
+    # PJRT coordination service binds past the replica range
+    coord_off = n_store
     if args.rank < 0:
         # dynamic rank assignment over the native TCPStore (the reference's
         # launch-master role); requires --master and --nnodes
@@ -264,7 +282,7 @@ def launch(args) -> int:
         # machine of PJRT process 0 (= the rank-0 node by arrival order)
         host, port_s = args.master.replace("tcp://", "").rsplit(":", 1)
         coord_base = int(port_s) or rdzv.store.port
-        coordinator = f"{rdzv.peers[0]['host']}:{coord_base + 1}"
+        coordinator = f"{rdzv.peers[0]['host']}:{coord_base + coord_off}"
         print(f"[launch] rendezvous assigned node rank {args.rank}/{args.nnodes}"
               f" (jax coordinator {coordinator})", file=sys.stderr)
     incarnation = 0
@@ -290,7 +308,7 @@ def launch(args) -> int:
             # fresh PJRT coordination port per incarnation: the previous
             # service (on a possibly-dead host) must not be re-joined
             coordinator = (f"{rdzv.peers[0]['host']}:"
-                           f"{coord_base + 1 + incarnation}")
+                           f"{coord_base + coord_off + incarnation}")
             print(f"[launch] mesh shrunk to {args.nnodes} node(s); this host "
                   f"is now rank {args.rank} (gen {rdzv.gen}.{rdzv.subgen}, "
                   f"jax coordinator {coordinator})", file=sys.stderr)

@@ -78,18 +78,32 @@ def _is_local(host: str) -> bool:
     return target in local
 
 
+def _store_replicas() -> int:
+    """``--store_replicas`` reaches rendezvous through the environment
+    (launcher exports ``PADDLE_STORE_REPLICAS``) so no call site between
+    the CLI and the store constructor needs a new parameter."""
+    import os
+    try:
+        return max(1, int(os.environ.get("PADDLE_STORE_REPLICAS", "1")))
+    except ValueError:
+        return 1
+
+
 def _try_host(host: str, port: int, nnodes: int, timeout: float):
     """Host the master store when the master address is THIS machine (falling
     back to client if another local process already bound it); pure client
-    otherwise."""
+    otherwise.  With ``PADDLE_STORE_REPLICAS >= 2`` the hosted store is the
+    quorum-replicated one (ports ``port..port+n-1``) and clients follow
+    leader redirects — same ``TCPStore`` surface either way."""
+    n = _store_replicas()
     if _is_local(host):
         try:
             return TCPStore(host, port, world_size=nnodes, is_master=True,
-                            timeout=timeout)
+                            timeout=timeout, replicas=n)
         except OSError:
             pass
     return TCPStore(host, port, world_size=nnodes, is_master=False,
-                    timeout=timeout)
+                    timeout=timeout, replicas=n)
 
 
 def _collect_peers(store: TCPStore, prefix: str, nnodes: int, timeout: float,
@@ -251,7 +265,7 @@ def request_join(master: str, job_id: str = "default",
     round admits us within ``timeout``."""
     host, port_s = master.rsplit(":", 1)
     store = TCPStore(host, int(port_s), world_size=1, is_master=False,
-                     timeout=timeout)
+                     timeout=timeout, replicas=_store_replicas())
     try:
         k = store.add(f"rdzv/{job_id}/grow/pending", 1)  # my request id
         info = {"host": socket.gethostname()}

@@ -16,7 +16,7 @@ interoperate within one job.
 
 from __future__ import annotations
 
-import pickle
+import os
 import socket
 import struct
 import sys
@@ -56,6 +56,43 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def _recv_bytes(sock: socket.socket) -> bytes:
     (n,) = struct.unpack("!I", _recv_exact(sock, 4))
     return _recv_exact(sock, n) if n else b""
+
+
+def _encode_kv(kv: Dict[bytes, bytes], extra: bytes = b"") -> bytes:
+    """Length-prefixed key/value framing for the snapshot op: item count,
+    then per item a length-prefixed key and value, then ``extra`` verbatim
+    (the replicated store's catch-up metadata rides there).  Replaces the
+    old pickle payload — nothing executable crosses the socket."""
+    out = [struct.pack("!q", len(kv))]
+    for k, v in kv.items():
+        out.append(struct.pack("!I", len(k)) + k)
+        out.append(struct.pack("!I", len(v)) + v)
+    out.append(extra)
+    return b"".join(out)
+
+
+def _decode_kv(blob: bytes) -> Tuple[Dict[bytes, bytes], bytes]:
+    """Inverse of :func:`_encode_kv`: returns the map and any trailing
+    ``extra`` bytes.  Raises ``ValueError`` on a truncated frame."""
+    if len(blob) < 8:
+        raise ValueError("store snapshot frame truncated (no item count)")
+    (count,) = struct.unpack("!q", blob[:8])
+    off = 8
+    kv: Dict[bytes, bytes] = {}
+    for _ in range(count):
+        for slot in range(2):
+            if off + 4 > len(blob):
+                raise ValueError("store snapshot frame truncated")
+            (n,) = struct.unpack("!I", blob[off:off + 4])
+            off += 4
+            if off + n > len(blob):
+                raise ValueError("store snapshot frame truncated")
+            if slot == 0:
+                k = blob[off:off + n]
+            else:
+                kv[k] = blob[off:off + n]
+            off += n
+    return kv, blob[off:]
 
 
 class _PyServer:
@@ -140,9 +177,8 @@ class _PyServer:
                         conn.sendall(b"\x00" + struct.pack("!I", 0))
                     elif cmd == _SNAPSHOT:
                         # full key-space dump for the warm standby's mirror
-                        # (pickle: values are arbitrary bytes, keys too)
                         with self._cond:
-                            blob = pickle.dumps(dict(self._kv), protocol=2)
+                            blob = _encode_kv(dict(self._kv))
                         conn.sendall(b"\x00")
                         _send_bytes(conn, blob)
                     else:
@@ -334,7 +370,8 @@ class _PyClient:
                                       op_timeout=op_timeout)
         if status != 0:
             raise RuntimeError("store snapshot failed")
-        return pickle.loads(val)
+        kv, _extra = _decode_kv(val)
+        return kv
 
     def close(self):
         self._drop_sock()
@@ -354,26 +391,43 @@ class WarmStandby:
     is NOT reconciled, and keys written between the last snapshot and
     the master's death are lost — acceptable for the rendezvous /
     heartbeat control plane, whose keys are re-established by the next
-    generation anyway.
+    generation anyway.  For a control plane whose acked writes must
+    survive the coordinator dying, use
+    ``distributed.store_replicated.ReplicatedStore``; this class is
+    retained as the cheap 2-node degraded mode.
+
+    Timing derivation (``fault_tolerance.heartbeat_config``): the
+    polling ``interval`` defaults to the heartbeat interval and the
+    probe ``timeout`` to the lease ttl — a master silent for a full
+    membership-lease ttl is degraded exactly when the failure detector
+    would declare a peer dead.  ``max_failures`` is how many
+    consecutive intervals fit in one ttl (>= 3); past it the standby
+    enters DEGRADED mode: it keeps serving the last mirror AND keeps
+    probing at an exponentially backed-off cadence (capped at
+    ``max(5s, 10 x interval)``), resuming live mirroring if the master
+    returns.
     """
 
     def __init__(self, master_host: str, master_port: int,
                  host: str = "127.0.0.1", port: int = 0,
-                 interval: float = 0.5, timeout: float = 10.0,
+                 interval: Optional[float] = None,
+                 timeout: Optional[float] = None,
                  max_failures: Optional[int] = None):
+        from .fault_tolerance.policy import heartbeat_config
+        hb = heartbeat_config(interval=interval)
         self._server = _PyServer(port)
         self.host, self.port = host, self._server.port
-        self.interval = float(interval)
-        # after ~timeout's worth of consecutive failed snapshots the master
-        # is gone: stop polling, keep serving the last mirrored state to
-        # failed-over clients
+        self.interval = hb.interval
+        if timeout is None:
+            timeout = hb.ttl
         self.max_failures = (int(max_failures) if max_failures is not None
-                             else max(3, int(round(timeout
-                                                   / max(0.05, interval)))))
+                             else max(3, int(round(hb.ttl / hb.interval))))
         self._client = _PyClient(master_host, int(master_port), float(timeout))
         self._client.set(STANDBY_ENDPOINT_KEY,
                          f"{host}:{self.port}".encode())
         self.mirrored = 0  # snapshots applied (monotonic)
+        self.degraded = False  # True while serving a possibly-stale mirror
+        self.recoveries = 0  # master came back after a degraded stretch
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._mirror_loop,
                                         name="store-standby", daemon=True)
@@ -381,6 +435,8 @@ class WarmStandby:
 
     def _mirror_loop(self):
         failures = 0
+        delay = self.interval
+        backoff_cap = max(5.0, 10.0 * self.interval)
         op_timeout = max(2.0, 2.0 * self.interval)
         while not self._stop.is_set():
             try:
@@ -391,15 +447,25 @@ class WarmStandby:
                     self._server._cond.notify_all()
                 self.mirrored += 1
                 failures = 0
+                delay = self.interval
+                if self.degraded:
+                    self.degraded = False
+                    self.recoveries += 1
+                    print(f"[store] standby {self.host}:{self.port}: master "
+                          f"back; live mirroring resumed "
+                          f"(recovery #{self.recoveries})",
+                          file=sys.stderr, flush=True)
             except Exception:
                 failures += 1
-                if failures >= self.max_failures:
+                if failures >= self.max_failures and not self.degraded:
+                    self.degraded = True
                     print(f"[store] standby {self.host}:{self.port}: master "
                           f"unreachable {failures}x; serving last mirror "
-                          f"({self.mirrored} snapshots)",
+                          f"({self.mirrored} snapshots), probing backed off",
                           file=sys.stderr, flush=True)
-                    return
-            self._stop.wait(self.interval)
+                if self.degraded:
+                    delay = min(delay * 2.0, backoff_cap)
+            self._stop.wait(delay)
 
     def num_keys(self) -> int:
         return self._server.num_keys()
@@ -524,6 +590,24 @@ class _NativeClient:
 # public API (reference TCPStore surface)
 # ---------------------------------------------------------------------------
 
+def _replicated_endpoints_from_env(
+        host: str, port: int) -> Optional[List[Tuple[str, int]]]:
+    """Parse ``PADDLE_STORE_ENDPOINTS`` (exported by a ReplicaGroup) and
+    return it only when ``host:port`` is one of the listed replicas — the
+    scope check that keeps replication from hijacking unrelated stores
+    (collective p2p, rpc registry) built on other ports."""
+    raw = os.environ.get("PADDLE_STORE_ENDPOINTS", "")
+    if not raw:
+        return None
+    eps: List[Tuple[str, int]] = []
+    for tok in raw.split(","):
+        h, _, p = tok.strip().rpartition(":")
+        if h and p.isdigit():
+            eps.append((h, int(p)))
+    if (host, int(port)) not in eps:
+        return None
+    return eps
+
 class TCPStore:
     """Reference-compatible store: the coordinator (``is_master=True``) hosts
     the map; every process (coordinator included) is a client.
@@ -532,11 +616,29 @@ class TCPStore:
     >>> s1 = TCPStore("127.0.0.1", s0.port, world_size=2)
     >>> s1.set("k", b"v"); s0.get("k")
     b'v'
+
+    ``replicas >= 2`` upgrades the store to the quorum-replicated
+    control plane (``store_replicated``) behind the same client surface:
+    the master hosts an N-replica group instead of one server, clients
+    follow NotLeader redirects transparently.  Client processes adopt
+    replication through the ``PADDLE_STORE_ENDPOINTS`` env the group
+    exports (scoped: only a construction whose ``host:port`` appears in
+    the endpoint list is upgraded, so unrelated stores — p2p, rpc — on
+    other ports are untouched).
     """
 
     def __init__(self, host: str, port: int, world_size: int = 1,
                  is_master: bool = False, timeout: float = 300.0,
-                 use_native: Optional[bool] = None):
+                 use_native: Optional[bool] = None,
+                 replicas: Optional[int] = None):
+        n_replicas = int(replicas or 0)
+        env_eps = _replicated_endpoints_from_env(host, port)
+        if n_replicas >= 2 or env_eps:
+            from .store_replicated import attach_replicated
+            attach_replicated(self, host, port, world_size=int(world_size),
+                              is_master=bool(is_master), timeout=float(timeout),
+                              replicas=n_replicas, endpoints=env_eps)
+            return
         if use_native is None:
             from .fault_tolerance.injection import get_injector
             inj = get_injector()

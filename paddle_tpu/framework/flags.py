@@ -115,3 +115,11 @@ define_flag("ft_inject_serve_kill_round", -1,
             "Kill a serving replica at this router round (-1 off)")
 define_flag("ft_inject_serve_kill_replica", -1,
             "Replica id for the injected serving kill (-1 = lowest alive)")
+define_flag("ft_inject_store_kill_leader", -1,
+            "Kill the replicated-store leader after it has acked this many "
+            "client writes (-1 off; one-shot — fires on the first leader "
+            "whose acked-write count reaches the threshold)")
+define_flag("ft_inject_store_partition", "",
+            "Partition replicated-store replicas: groups of comma-separated "
+            "replica ids split by '|' (e.g. '0|1,2'); replica-to-replica "
+            "links across groups drop, client links stay up ('' = healed)")

@@ -34,6 +34,8 @@ behind one admission queue), rebuilt for the TPU serving tier:
 from __future__ import annotations
 
 import collections
+import json
+import sys
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
@@ -82,7 +84,7 @@ class Router:
                 ...
     """
 
-    def __init__(self):
+    def __init__(self, store=None, job_id: str = "default"):
         self._replicas: Dict[int, Engine] = {}
         self._next_replica = 0
         self._tracked: Dict[str, _Tracked] = {}
@@ -92,6 +94,25 @@ class Router:
         self.rounds = 0
         self.stats = {"routed": 0, "rerouted": 0, "kills": 0, "joins": 0,
                       "parked_peak": 0}
+        # optional control-plane store (TCPStore surface — plain, warm-
+        # standby or replicated): the router publishes its replica
+        # membership there so external schedulers/monitors see joins and
+        # kills; all writes are short-bounded so a degraded store slows
+        # membership visibility, never serving
+        self._store = store
+        self._job = job_id
+
+    def _publish_membership(self) -> None:
+        if self._store is None:
+            return
+        doc = json.dumps({"replicas": sorted(self._replicas),
+                          "round": self.rounds,
+                          "stats": dict(self.stats)})
+        try:
+            self._store.set(f"serve/{self._job}/replicas", doc, timeout=2.0)
+        except (OSError, RuntimeError, TimeoutError) as e:
+            print(f"[router] membership publish skipped: {e}",
+                  file=sys.stderr)
 
     # -- replica lifecycle --------------------------------------------------
 
@@ -104,6 +125,7 @@ class Router:
         self._replicas[replica_id] = engine
         self.stats["joins"] += 1
         self._drain_parked()
+        self._publish_membership()
         return replica_id
 
     def remove_replica(self, replica_id: int, requeue: bool = True) -> List[str]:
@@ -121,6 +143,7 @@ class Router:
             for t in sorted(harvested, key=lambda t: t.arrival):
                 self._place(t)
                 self.stats["rerouted"] += 1
+        self._publish_membership()
         return [t.rid for t in harvested]
 
     @property

@@ -144,5 +144,55 @@ assert digests(rB.stdout) == digests(rR.stdout) != [], rB.stdout
 PY
 done
 
+# 7. store consensus: the parameter-heavy replicated-store scenarios beyond
+#    the tier-1 proofs — serial leader assassinations on a 5-replica group
+#    (every election must converge and lose nothing), then partition
+#    flapping with writes in every window (the exactly-once add contract
+#    must hold across every heal)
+run "store 5-replica serial leader kills to the quorum floor" 300 python - <<'PY'
+from paddle_tpu.distributed.store_replicated import ReplicatedStore
+
+rs = ReplicatedStore(replicas=5, interval=0.05, timeout=60.0)
+try:
+    killed = []
+    for i in range(2):                       # 5 -> 3 alive: still a quorum
+        rs.set(f"pre{i}", str(i))
+        assert rs.add("kills", 1) == i + 1   # exactly-once across elections
+        lead = rs.group.leader_id(timeout=20.0, exclude=tuple(killed))
+        rs.kill_replica(lead)
+        killed.append(lead)
+    for i in range(2):                       # every acked write survived
+        assert rs.get(f"pre{i}") == str(i).encode()
+    assert rs.add("post", 1) == 1
+finally:
+    rs.group.stop()
+PY
+run "store partition flapping, exactly-once adds" 300 python - <<'PY'
+import time
+from paddle_tpu.distributed.fault_tolerance.injection import (
+    FaultInjector, set_injector)
+from paddle_tpu.distributed.store_replicated import ReplicatedStore
+
+rs = ReplicatedStore(replicas=3, interval=0.05, timeout=60.0)
+inj = FaultInjector(seed=11)
+set_injector(inj)
+try:
+    total = 0
+    for flap in range(4):
+        lead = rs.leader_id(timeout=20.0)
+        others = [i for i in range(3) if i != lead]
+        inj.set_store_partition(f"{lead}|{others[0]},{others[1]}")
+        rs.group.leader_id(timeout=20.0, exclude=(lead,))
+        for _ in range(5):
+            rs.add("flap-counter", 1)
+            total += 1
+        inj.set_store_partition("")          # heal; old leader rejoins
+        time.sleep(0.3)
+    assert rs.add("flap-counter", 0) == total, "adds lost or double-counted"
+finally:
+    set_injector(None)
+    rs.group.stop()
+PY
+
 echo "[chaos] sweep done: $FAIL failure(s)" >&2
 exit "$FAIL"

@@ -37,6 +37,12 @@ stage mem_gate      ./scripts/mem_gate.sh
 stage schedule_gate ./scripts/schedule_gate.sh
 stage reshard_gate  ./scripts/reshard_gate.sh
 stage serve_gate    ./scripts/serve_gate.sh
+stage store_chaos   bash -c "\
+    timeout -k 10 300 python -m pytest -q -p no:cacheprovider \
+        tests/test_store_replicated.py \
+    && timeout -k 10 600 python -m pytest -q -p no:cacheprovider \
+        tests/test_chaos.py -k 'store_leader or store_quorum \
+                                or store_partitioned or launcher_store'"
 stage host_lint     python -m paddle_tpu.analysis.host_lint
 
 echo "=== [ci] summary ===" >&2

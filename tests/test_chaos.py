@@ -365,3 +365,207 @@ def test_scale_up_rejoin_at_generation_bump():
     for r in world4.values():
         r.store.close()
     master.close()
+
+
+# --------------------------------------------------------------------------
+# Replicated control-plane store: chaos proofs (a)/(b)/(c) of the
+# leader-leased quorum store (distributed.store_replicated).  Faults come
+# from the same deterministic injection framework as everything above
+# (FLAGS_ft_inject_store_kill_leader / FLAGS_ft_inject_store_partition).
+# --------------------------------------------------------------------------
+
+def _replicated_store(**kw):
+    from paddle_tpu.distributed.store_replicated import ReplicatedStore
+
+    kw.setdefault("replicas", 3)
+    kw.setdefault("interval", 0.05)   # test-scale: lease 0.15s, election 0.3s
+    kw.setdefault("timeout", 30.0)
+    return ReplicatedStore(**kw)
+
+
+def test_store_leader_killed_mid_rendezvous_same_generation_completes(
+        monkeypatch):
+    """Proof (a): the store leader dies while a 2-node rendezvous is in
+    flight (after its 3rd acked write).  A new leader is elected from the
+    surviving replicas, the clients follow redirects, and the SAME
+    generation completes — rendezvous code unmodified."""
+    import threading
+
+    from paddle_tpu.distributed.fault_tolerance.injection import (
+        FaultInjector, set_injector)
+    from paddle_tpu.distributed.launch.rendezvous import rendezvous
+    from paddle_tpu.distributed.store_replicated import ENDPOINTS_ENV
+
+    rs = _replicated_store()
+    set_injector(FaultInjector(seed=1, store_kill_leader=3))
+    # clients adopt the replica group purely through the environment
+    monkeypatch.setenv(ENDPOINTS_ENV, ",".join(
+        f"{h}:{p}" for h, p in rs.group.endpoints))
+    first_leader = rs.leader_id()
+    addr = f"127.0.0.1:{rs.port}"
+    results, errs = {}, []
+
+    def join(i):
+        try:
+            results[i] = rendezvous(addr, nnodes=2, job_id="chaos-repl",
+                                    timeout=60.0)
+        except BaseException as e:
+            errs.append(e)
+
+    try:
+        threads = [threading.Thread(target=join, args=(i,), daemon=True)
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        assert not errs, errs
+        assert len(results) == 2
+        ranks = sorted(r.rank for r in results.values())
+        assert ranks == [0, 1]
+        gens = {r.gen for r in results.values()}
+        assert gens == {0}, f"generation changed across failover: {gens}"
+        # the kill actually fired and the cluster moved past that leader
+        assert not rs.group.server(first_leader).alive
+        assert rs.group.leader_id(exclude=(first_leader,)) != first_leader
+        for r in results.values():
+            r.store.close()
+    finally:
+        set_injector(None)
+        rs.group.stop()
+
+
+def test_store_quorum_acked_write_survives_leader_kill():
+    """Proof (b): the leader dies IMMEDIATELY after acking a write (flags-
+    driven one-shot kill: the ack is on the wire, so the write was quorum-
+    committed).  The write must be readable after failover."""
+    from paddle_tpu.distributed.fault_tolerance.injection import (
+        FaultInjector, set_injector)
+    from paddle_tpu.framework import flags
+    import time as _t
+
+    rs = _replicated_store()
+    flags.set_flags({"ft_inject_store_kill_leader": 1})
+    try:
+        set_injector(FaultInjector.from_flags())
+        first_leader = rs.leader_id()
+        rs.set("committed", b"survives")       # acked => quorum-replicated
+        # the one-shot kill fired on the acking leader
+        deadline = _t.monotonic() + 10.0
+        while (rs.group.server(first_leader).alive
+               and _t.monotonic() < deadline):
+            _t.sleep(0.02)
+        assert not rs.group.server(first_leader).alive
+        # a NEW leader serves the acked write (linearizable lease read)
+        assert rs.group.leader_id(exclude=(first_leader,)) != first_leader
+        assert rs.get("committed") == b"survives"
+        assert rs.add("post-failover", 1) == 1
+    finally:
+        set_injector(None)
+        flags.set_flags({"ft_inject_store_kill_leader": -1})
+        rs.group.stop()
+
+
+def test_store_partitioned_minority_leader_refuses_writes_no_split_brain():
+    """Proof (c): the leader is partitioned into a minority.  It never
+    acks another write (no quorum), its lease lapses so reads stop too,
+    the majority elects a fresh leader that serves clients throughout,
+    and on heal the old leader rejoins as FOLLOWER with its unacked log
+    tail discarded — at no point do two leaders both serve."""
+    import time as _t
+
+    from paddle_tpu.distributed.fault_tolerance.injection import (
+        FaultInjector, set_injector)
+    from paddle_tpu.distributed.store_replicated import ReplicatedClient
+
+    rs = _replicated_store()
+    inj = FaultInjector(seed=2)
+    set_injector(inj)
+    try:
+        rs.set("pre", b"1")                    # committed before the split
+        old = rs.leader_id()
+        others = [i for i in range(3) if i != old]
+        inj.set_store_partition(f"{old}|{others[0]},{others[1]}")
+
+        # a client wired DIRECTLY to the minority leader: its write must
+        # never be acked (the entry sits in the old leader's unacked tail)
+        lone = ReplicatedClient([rs.group.server(old).endpoint], timeout=2.0)
+        with pytest.raises(TimeoutError):
+            lone.set(b"doomed", b"split-brain")
+        lone.close()
+
+        # meanwhile the MAJORITY side elected and serves clients
+        new = rs.group.leader_id(timeout=15.0, exclude=(old,))
+        assert new != old
+        rs.set("during-partition", b"2")
+        assert rs.get("pre") == b"1"
+
+        # the minority leader's lease lapsed: it stepped down
+        srv_old = rs.group.server(old)
+        deadline = _t.monotonic() + 10.0
+        while _t.monotonic() < deadline:
+            with srv_old._cond:
+                if srv_old._role != "leader":
+                    break
+            _t.sleep(0.02)
+        with srv_old._cond:
+            assert srv_old._role != "leader", "minority leader never stepped down"
+
+        # heal: the old leader rejoins as follower and the doomed entry is
+        # truncated by the new leader's log — absent from EVERY replica
+        inj.set_store_partition("")
+        deadline = _t.monotonic() + 10.0
+        caught_up = False
+        while _t.monotonic() < deadline and not caught_up:
+            with srv_old._cond:
+                caught_up = (srv_old._role == "follower"
+                             and srv_old._kv.get(b"during-partition") == b"2")
+            _t.sleep(0.02)
+        assert caught_up, "healed replica never converged on the new log"
+        for srv in rs.group.replicas:
+            if not srv.alive:
+                continue
+            with srv._cond:
+                assert b"doomed" not in srv._kv
+                assert not any(k == b"doomed" for _, _, k, _ in srv._log)
+        assert rs.get("during-partition") == b"2"
+    finally:
+        set_injector(None)
+        rs.group.stop()
+
+
+def test_launcher_store_replicas_flag_end_to_end(tmp_path):
+    """--store_replicas 3: two auto-rank launcher processes rendezvous on
+    a replicated master store (consecutive ports) and both trainers run —
+    the full CLI -> env -> rendezvous -> TCPStore adoption path."""
+    import socket as _socket
+
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        eps = os.environ.get("PADDLE_STORE_ENDPOINTS", "")
+        print("ASSIGNED", os.environ["PADDLE_TRAINER_ID"],
+              "EPS", len([e for e in eps.split(",") if e]), flush=True)
+    """))
+    env = _env(ft_heartbeat_interval=0.1)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--master", f"127.0.0.1:{port}", "--nnodes", "2",
+           "--rank", "-1", "--store_replicas", "3", str(script)]
+    procs = [subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True, env=env)
+             for _ in range(2)]
+    outs = [p.communicate(timeout=180)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    assigned = sorted(line.split()[1] for out in outs
+                      for line in out.splitlines()
+                      if line.startswith("ASSIGNED"))
+    assert assigned == ["0", "1"], outs
+    # the store-hosting node exported the 3-replica endpoint list to its
+    # trainers; the pure-client node has no group of its own
+    eps_counts = sorted(int(line.split()[3]) for out in outs
+                        for line in out.splitlines()
+                        if line.startswith("ASSIGNED"))
+    assert eps_counts[-1] == 3, outs

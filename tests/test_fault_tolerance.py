@@ -468,3 +468,227 @@ def test_snapshot_returns_full_keyspace():
         store.set("b", b"2")
         kv = store._client.snapshot()
         assert kv[b"a"] == b"1" and kv[b"b"] == b"2"
+
+
+# ------------------------------------------------ replicated-store leases
+
+
+def _lease_server():
+    """A non-started ReplicaServer (no threads, no peers listening) with an
+    injectable clock — the lease arithmetic can then be driven explicitly."""
+    from paddle_tpu.distributed.fault_tolerance.policy import (
+        store_consensus_config)
+    from paddle_tpu.distributed.store_replicated import ReplicaServer
+
+    t = [0.0]
+    cfg = store_consensus_config(interval=0.1)  # ttl 0.3, skew 0.075
+    srv = ReplicaServer(0, cfg=cfg, clock=lambda: t[0], start=False)
+    srv.configure({0: srv.endpoint, 1: ("127.0.0.1", 1),
+                   2: ("127.0.0.1", 2)})
+    with srv._cond:
+        srv._role = "leader"
+        srv._term = 1
+        srv._log.append((1, 0, b"", b""))  # committed term-opening no-op
+        srv._noop_idx = 1
+        srv._commit = srv._applied = 1
+        srv._ack = {1: 0.0, 2: 0.0}
+    return srv, t, cfg
+
+
+def test_store_lease_serves_then_expires_at_skew_margin():
+    """The lease is (majority-th newest ack) + ttl - clock_skew: reads are
+    served strictly inside that window and refused AT the boundary."""
+    srv, t, cfg = _lease_server()
+    try:
+        # acks at 0.0 -> expiry 0.3, skew margin 0.075 -> serve until 0.225
+        t[0] = 0.224
+        with srv._cond:
+            assert srv._read_gate_locked() is None
+        t[0] = 0.226  # past expiry - skew: the margin must deny, 0.074s
+        with srv._cond:  # BEFORE the raw lease expiry at 0.3
+            assert srv._read_gate_locked() is not None
+    finally:
+        srv.stop()
+
+
+def test_store_lease_renewal_just_before_expiry_extends_it():
+    srv, t, cfg = _lease_server()
+    try:
+        t[0] = 0.22
+        with srv._cond:
+            assert srv._read_gate_locked() is None
+            srv._ack[1] = 0.2  # ONE fresh append-ack: quorum(self, peer1)
+        # the lease now runs from the 2nd-newest of (now, 0.2, 0.0) = 0.2
+        t[0] = 0.42
+        with srv._cond:
+            assert srv._read_gate_locked() is None
+        t[0] = 0.43  # 0.2 + 0.3 - 0.075 = 0.425 passed, no renewal since
+        with srv._cond:
+            assert srv._read_gate_locked() is not None
+    finally:
+        srv.stop()
+
+
+def test_store_lease_one_fresh_peer_is_not_quorum():
+    """With 3 replicas one fresh ack plus self is a quorum, but a SINGLE
+    stale majority peer pins the lease to the stale time — renewing one
+    link is not enough once the other ack is the majority-th newest."""
+    srv, t, cfg = _lease_server()
+    try:
+        with srv._cond:
+            srv._ack = {1: 10.0, 2: 0.0}
+        t[0] = 10.2
+        with srv._cond:
+            # 2nd newest of (10.2, 10.0, 0.0) is 10.0 -> serveable
+            assert srv._read_gate_locked() is None
+            srv._ack[1] = 0.0  # that link goes silent/regresses
+            # now 2nd newest is 0.0 -> lease long dead
+            assert srv._read_gate_locked() is not None
+    finally:
+        srv.stop()
+
+
+def test_store_uncommitted_noop_blocks_reads():
+    """A fresh leader must not serve reads before its term-opening no-op
+    commits (it may not yet know the full committed prefix)."""
+    srv, t, cfg = _lease_server()
+    try:
+        t[0] = 0.1
+        with srv._cond:
+            srv._commit = srv._applied = 0  # no-op appended, NOT committed
+            assert srv._read_gate_locked() is not None
+    finally:
+        srv.stop()
+
+
+def test_store_blocked_wait_stays_bounded_when_quorum_dies():
+    """A client parked in wait() while the leader loses its quorum: the
+    leader's lease lapses, the park aborts, and the CLIENT surfaces a
+    bounded TimeoutError instead of hanging on the dead group."""
+    from paddle_tpu.distributed.store_replicated import ReplicatedStore
+
+    rs = ReplicatedStore(replicas=3, interval=0.05, timeout=20.0)
+    try:
+        rs.set("k", b"v")
+        lead = rs.leader_id()
+        for rid in range(3):
+            if rid != lead:
+                rs.kill_replica(rid)  # majority gone: no quorum, no lease
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            rs.get("never-set", timeout=3.0)
+        assert time.monotonic() - t0 < 15.0
+        # writes are refused too (bounded), not silently buffered
+        with pytest.raises((TimeoutError, RuntimeError)):
+            rs.set("unackable", b"x", timeout=3.0)
+    finally:
+        rs.group.stop()
+
+
+# ------------------------------------------ warm-standby recovery (fix)
+
+
+def test_warm_standby_resumes_mirroring_after_master_recovers():
+    """Regression: the mirror loop used to give up for good after
+    max_failures; now it backs off while degraded and RESUMES live
+    mirroring when the master comes back."""
+    from paddle_tpu.distributed.store import WarmStandby, _PyServer
+
+    master = TCPStore("127.0.0.1", 0, world_size=1, is_master=True,
+                      timeout=5.0, use_native=False)
+    port = master.port
+    sb = WarmStandby("127.0.0.1", port, interval=0.05, timeout=3.0,
+                     max_failures=2)
+    try:
+        master.set("k", b"1")
+        deadline = time.monotonic() + 10.0
+        while sb.mirrored < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sb.mirrored >= 1
+
+        master._server.stop()  # master dies
+        master._server = None
+        deadline = time.monotonic() + 15.0
+        while not sb.degraded and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sb.degraded, "standby never entered degraded mode"
+        assert sb.num_keys() >= 1  # still serving the last mirror
+
+        revived = _PyServer(port)  # master host returns on the same port
+        try:
+            deadline = time.monotonic() + 20.0
+            while sb.recoveries < 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert sb.recoveries >= 1, "mirroring never resumed"
+            assert not sb.degraded
+            # live mirroring again: new writes reach the standby
+            writer = TCPStore("127.0.0.1", port, world_size=1,
+                              timeout=3.0, use_native=False)
+            writer.set("post-recovery", b"2")
+            writer.close()
+            deadline = time.monotonic() + 15.0
+            ok = False
+            while time.monotonic() < deadline and not ok:
+                with sb._server._cond:
+                    ok = sb._server._kv.get(b"post-recovery") == b"2"
+                time.sleep(0.05)
+            assert ok, "standby is not mirroring the revived master"
+        finally:
+            revived.stop()
+    finally:
+        sb.stop()
+        master.close()
+
+
+def test_differential_standby_loses_post_snapshot_write_replicated_keeps_it():
+    """The availability gap that motivates the replicated store, shown
+    side by side: a write acked AFTER the standby's last mirror is LOST on
+    master death, while the replicated store's quorum-acked write (leader
+    killed immediately after the ack) survives failover."""
+    from paddle_tpu.distributed.store import WarmStandby
+    from paddle_tpu.distributed.store_replicated import ReplicatedStore
+
+    # --- warm standby: acked write vanishes -----------------------------
+    master = TCPStore("127.0.0.1", 0, world_size=1, is_master=True,
+                      timeout=5.0, use_native=False)
+    # interval huge: the next mirror never happens inside the test window
+    sb = WarmStandby("127.0.0.1", master.port, interval=200.0, timeout=2.0)
+    client = TCPStore("127.0.0.1", master.port, world_size=1, timeout=3.0,
+                      use_native=False)
+    try:
+        deadline = time.monotonic() + 10.0
+        while sb.mirrored < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sb.mirrored >= 1
+        client.set("late-write", b"acked")          # master acked this
+        assert client.enable_failover() is True
+        master._server.stop()                       # ...then died
+        master._server = None
+        # the dying server may drain ONE in-flight request off an open
+        # connection; poll until failover to the standby actually lands
+        lost = b"?"
+        deadline = time.monotonic() + 10.0
+        while lost is not None and time.monotonic() < deadline:
+            lost = client.get("late-write", wait=False)
+            time.sleep(0.05)
+        assert lost is None                         # LOST
+    finally:
+        sb.stop()
+        client.close()
+        master.close()
+
+    # --- replicated: same shape of failure, write survives --------------
+    inj = FaultInjector(seed=3, store_kill_leader=1)
+    set_injector(inj)
+    rs = ReplicatedStore(replicas=3, interval=0.05, timeout=30.0)
+    try:
+        first = rs.leader_id()
+        rs.set("late-write", b"acked")              # kill fires on the ack
+        deadline = time.monotonic() + 10.0
+        while rs.group.server(first).alive and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not rs.group.server(first).alive
+        assert rs.get("late-write") == b"acked"     # KEPT
+    finally:
+        set_injector(None)
+        rs.group.stop()

@@ -1,0 +1,253 @@
+"""distributed.store_replicated: leader-leased quorum replication behind
+the TCPStore surface.
+
+The contract under test: every TCPStore consumer (rendezvous, the
+failure detector, checkpoint commit barriers, the serving router) runs
+UNMODIFIED on a replica group; acked writes survive leader death; a
+restarted replica catches up via snapshot + log tail; redirects and
+elections stay invisible to callers.  The kill/partition CHAOS proofs
+live in test_chaos.py — this file covers the steady-state machinery.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fault_tolerance.injection import set_injector
+from paddle_tpu.distributed.fault_tolerance.policy import (
+    store_consensus_config)
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.store_replicated import (
+    ENDPOINTS_ENV, ReplicatedClient, ReplicatedStore)
+
+
+@pytest.fixture(autouse=True)
+def _no_injector():
+    set_injector(None)
+    yield
+    set_injector(None)
+
+
+@pytest.fixture()
+def rs():
+    store = ReplicatedStore(replicas=3, interval=0.05, timeout=30.0)
+    yield store
+    store.group.stop()
+
+
+# ----------------------------------------------------------- basic surface
+
+def test_basic_ops_and_types(rs):
+    rs.set("str", "value")            # str coerces like TCPStore
+    assert rs.get("str") == b"value"
+    assert rs.get("absent", wait=False) is None
+    assert rs.add("ctr", 3) == 3
+    assert rs.add("ctr") == 4
+    rs.delete_key("str")
+    assert rs.get("str", wait=False) is None
+    assert rs.num_keys() >= 1
+
+
+def test_wait_unblocks_on_set(rs):
+    got = {}
+
+    def waiter():
+        got["v"] = rs.get("late")  # blocking get waits for the key
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    rs.set("late", b"now")
+    t.join(timeout=10.0)
+    assert got.get("v") == b"now"
+
+
+def test_every_client_sees_one_leader_view(rs):
+    """Clients pointed at DIFFERENT replicas converge on the same data:
+    followers redirect rather than serve stale reads."""
+    clients = [ReplicatedClient([ep], timeout=10.0)
+               for ep in rs.group.endpoints]
+    rs.set("k", b"v")
+    try:
+        for c in clients:
+            assert c.get(b"k") == b"v"
+    finally:
+        for c in clients:
+            c.close()
+
+
+def test_barrier_across_replicated_clients(rs, monkeypatch):
+    """TCPStore.barrier (generation-counted add/wait) over the replica
+    group, with one participant constructed via the env adoption path —
+    the zero-call-site upgrade the launcher uses."""
+    monkeypatch.setenv(ENDPOINTS_ENV, ",".join(
+        f"{h}:{p}" for h, p in rs.group.endpoints))
+    rs.world_size = 2
+    other = TCPStore(rs.host, rs.port, world_size=2, is_master=False,
+                     timeout=30.0)
+    assert isinstance(other._client, ReplicatedClient)
+    errs = []
+
+    def side(store):
+        try:
+            store.barrier("b", timeout=30.0)
+        except BaseException as e:  # noqa: BLE001 - surfaced via assert
+            errs.append(e)
+
+    threads = [threading.Thread(target=side, args=(s,), daemon=True)
+               for s in (rs, other)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    other.close()
+    assert not errs, errs
+
+
+def test_env_adoption_is_endpoint_scoped(rs, monkeypatch):
+    """PADDLE_STORE_ENDPOINTS upgrades only constructions whose host:port
+    IS one of the replicas — a store on any other port (p2p channels,
+    rpc) keeps the native single-server path."""
+    monkeypatch.setenv(ENDPOINTS_ENV, ",".join(
+        f"{h}:{p}" for h, p in rs.group.endpoints))
+    plain = TCPStore("127.0.0.1", 0, world_size=1, is_master=True,
+                     timeout=5.0)
+    try:
+        assert not isinstance(plain._client, ReplicatedClient)
+        plain.set("x", b"1")
+        assert plain.get("x") == b"1"
+        # and the replicated keyspace was NOT touched
+        assert rs.get("x", wait=False) is None
+    finally:
+        plain.close()
+
+
+# ----------------------------------------------------------- elections
+
+def test_leader_failover_preserves_acked_writes(rs):
+    rs.set("durable", b"1")
+    first = rs.leader_id()
+    rs.kill_replica(first)
+    second = rs.group.leader_id(timeout=15.0, exclude=(first,))
+    assert second != first
+    assert rs.get("durable") == b"1"
+    assert rs.add("post", 1) == 1   # the new term accepts writes
+
+
+def test_exactly_once_add_counts_across_failover(rs):
+    """Client-stamped (cid, seq) dedup: counters never double-count even
+    when the client retries adds around a leader death."""
+    total = 30
+    rs.kill_replica(rs.leader_id())
+    for _ in range(total):
+        rs.add("counter", 1)
+    assert rs.add("counter", 0) == total
+
+
+def test_restarted_replica_catches_up_and_rejoins(rs):
+    for i in range(8):
+        rs.set(f"k{i}", str(i))
+    victim = rs.leader_id()
+    rs.kill_replica(victim)
+    rs.group.leader_id(timeout=15.0, exclude=(victim,))
+    rs.set("after-kill", b"x")
+    srv = rs.restart_replica(victim)
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        with srv._cond:
+            if srv._synced and srv._kv.get(b"after-kill") == b"x":
+                break
+        time.sleep(0.05)
+    with srv._cond:
+        assert srv._synced, "restarted replica never caught up"
+        assert srv._kv.get(b"k3") == b"3"       # snapshot state
+        assert srv._kv.get(b"after-kill") == b"x"  # log tail
+    # the rejoined replica participates: kill the CURRENT leader and the
+    # remaining pair (including the restartee) still forms a quorum
+    cur = rs.leader_id()
+    rs.kill_replica(cur)
+    rs.group.leader_id(timeout=15.0, exclude=(cur,))
+    assert rs.get("k7") == b"7"
+
+
+# ----------------------------------------------------------- consumers
+
+def test_detector_runs_on_replicated_store(rs):
+    """The heartbeat failure detector — lease writes, membership sampling,
+    epoch publication — works unchanged over the replica group."""
+    from paddle_tpu.distributed.fault_tolerance import (
+        HeartbeatFailureDetector)
+
+    monitors = [HeartbeatFailureDetector(rs, r, 2, job_id="rdet",
+                                         interval=0.1).start()
+                for r in range(2)]
+    try:
+        assert monitors[0].membership() == (0, [0, 1])
+        monitors[1].stop()
+        epoch = monitors[0].wait_epoch(above=0, timeout=20.0)
+        assert epoch >= 1
+        _, alive = monitors[0].membership()
+        assert alive == [0]
+    finally:
+        for m in monitors:
+            m.stop()
+
+
+def test_router_publishes_membership_to_replicated_store(rs):
+    from paddle_tpu.serving.router import Router
+    import json
+
+    router = Router(store=rs, job_id="svc")
+    router.add_replica(object())
+    router.add_replica(object())
+    doc = json.loads(rs.get("serve/svc/replicas"))
+    assert doc["replicas"] == [0, 1]
+    # membership survives a store-leader death mid-serve
+    rs.kill_replica(rs.leader_id())
+    router.remove_replica(0, requeue=False)
+    doc = json.loads(rs.get("serve/svc/replicas"))
+    assert doc["replicas"] == [1]
+    assert doc["stats"]["joins"] == 2
+
+
+# ----------------------------------------------------------- configuration
+
+def test_consensus_config_derivation_and_validation():
+    cfg = store_consensus_config(interval=0.1)
+    assert cfg.heartbeat == pytest.approx(0.1)
+    assert cfg.lease_ttl == pytest.approx(0.3)        # 3x interval default
+    assert cfg.election_timeout == pytest.approx(0.6)  # 2x ttl floor
+    assert cfg.clock_skew == pytest.approx(0.075)      # 0.25x ttl
+    with pytest.raises(ValueError):
+        store_consensus_config(interval=0.1, election_timeout=0.5)
+    with pytest.raises(ValueError):  # heartbeat bounds still enforced
+        store_consensus_config(interval=0.001)
+
+
+def test_replica_group_rejects_degenerate_size():
+    from paddle_tpu.distributed.store_replicated import ReplicaGroup
+
+    with pytest.raises(ValueError):
+        ReplicaGroup(1)
+
+
+def test_enable_failover_reports_false_on_replicated(rs):
+    # redirects subsume the warm-standby re-point; there is no standby
+    assert rs.enable_failover() is False
+
+
+def test_master_group_exports_and_clears_endpoint_env():
+    before = os.environ.get(ENDPOINTS_ENV)
+    store = TCPStore("127.0.0.1", 0, world_size=1, is_master=True,
+                     timeout=10.0, replicas=3)
+    try:
+        eps = os.environ.get(ENDPOINTS_ENV, "")
+        assert len(eps.split(",")) == 3
+        assert f"127.0.0.1:{store.port}" in eps
+        store.set("k", b"v")
+        assert store.get("k") == b"v"
+    finally:
+        store.close()
+    assert os.environ.get(ENDPOINTS_ENV) == before

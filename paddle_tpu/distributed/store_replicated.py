@@ -498,6 +498,17 @@ class ReplicaServer:
                 if self._now() >= deadline:
                     return _ST_RETRY, b"", False  # no quorum within budget
                 self._cond.wait(min(0.05, max(0.005, self._cfg.heartbeat)))
+            # applied >= idx alone does not prove OUR entry committed: a new
+            # leader may have truncated the conflicting tail (replacing the
+            # entry at idx) and advanced commit past idx while this waiter
+            # slept, all before the term/role check above ever re-ran.  Ack
+            # only if the committed entry at the proposed index still carries
+            # the proposal term (Raft's standard client-ack rule).  idx below
+            # the snapshot base means this node was deposed and caught up in
+            # the meantime, so the entry's term is unknowable — redirect.
+            if idx <= self._base or self._term_at_locked(idx) != term0:
+                st, fr = self._redirect_locked()
+                return st, fr, False
             if op == _ADD:
                 result = self._add_results.pop(idx, None)
                 if result is None:  # replay of a deduped add: read the table
@@ -613,7 +624,8 @@ class ReplicaServer:
                                               prev_idx + len(entries)))
                 entries = entries[skip:]
                 prev_idx = self._base
-            elif prev_idx > 0 and self._term_at_locked(prev_idx) != prev_term:
+            elif (prev_idx > self._base
+                  and self._term_at_locked(prev_idx) != prev_term):
                 # log-matching violated at prev: drop the conflicting tail
                 del self._log[prev_idx - self._base - 1:]
                 return 1, struct.pack("!qq", self._term,
@@ -710,7 +722,12 @@ class ReplicaServer:
                     parts.append(struct.pack("!I", len(v)) + v)
                 payload = b"".join(parts)
                 term0 = self._term
-                n_sent = len(entries)
+            # lease time must be measured from BEFORE the RPC: the follower's
+            # no-election promise starts when it processes the append, which
+            # is at most t0 + rtt; stamping the response-receipt time would
+            # stretch the lease by up to a full round-trip past what the
+            # quorum actually promised.
+            t0 = self._now()
             try:
                 st, val = self._peer_call(rid, _APPEND, payload,
                                           self._rpc_timeout())
@@ -726,7 +743,8 @@ class ReplicaServer:
                     continue
                 if self._role != _LEADER or self._term != term0:
                     continue
-                self._ack[rid] = self._now()  # term-confirming contact
+                if t0 > self._ack.get(rid, float("-inf")):
+                    self._ack[rid] = t0  # term-confirming contact (RPC start)
                 if st == 0:
                     if aux > self._match.get(rid, 0):
                         self._match[rid] = aux
@@ -740,8 +758,6 @@ class ReplicaServer:
                                           min(aux + 1, max(1, ni - 1)))
                     ev.set()
                 # aux < 0: peer is recovering (pulls a snapshot); hold next
-                if n_sent:
-                    pass
 
     # -- follower: elections + catch-up --------------------------------------
 

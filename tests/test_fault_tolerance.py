@@ -585,6 +585,78 @@ def test_store_blocked_wait_stays_bounded_when_quorum_dies():
         rs.group.stop()
 
 
+def test_store_no_ack_for_entry_replaced_by_new_leader():
+    """Regression: a deposed leader's write waiter must NOT ack when a new
+    leader truncates the conflicting tail (replacing the entry at the
+    proposed index) and advances commit past it while the waiter sleeps —
+    applied >= idx alone used to exit the wait loop with status 0 for a
+    write that was discarded.  The ack requires the committed entry at the
+    proposed index to still carry the proposal term."""
+    import struct
+
+    from paddle_tpu.distributed.store_replicated import (
+        _FOLLOWER, _NOOP, _SET, _ST_NOT_LEADER)
+
+    srv, t, cfg = _lease_server()
+    try:
+        result = []
+
+        def write():
+            result.append(srv._on_client_write(_SET, b"k", b"v"))
+
+        th = threading.Thread(target=write, daemon=True)
+        th.start()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:  # entry appended at idx 2
+            with srv._cond:
+                if len(srv._log) == 2:
+                    break
+            time.sleep(0.001)
+        with srv._cond:
+            assert len(srv._log) == 2  # (term-1 no-op, pending write)
+        # a new leader (term 2) replicates ITS term-opening no-op at idx 2:
+        # log-matching truncates the unacked write and commit covers idx 2
+        entry = struct.pack("!qB", 2, _NOOP) + struct.pack("!I", 0) * 2
+        payload = (struct.pack("!qqqqq", 2, 1, 1, 1, 2)
+                   + struct.pack("!I", 1) + entry)
+        st, _ = srv._on_append(payload)
+        assert st == 0
+        th.join(timeout=5.0)
+        assert not th.is_alive()
+        status, _frame, acked = result[0]
+        assert status == _ST_NOT_LEADER and not acked
+        with srv._cond:
+            assert srv._role == _FOLLOWER
+            assert b"k" not in srv._kv  # the write really was discarded
+    finally:
+        srv.stop()
+
+
+def test_store_append_conflict_at_snapshot_base_never_truncates():
+    """A prev_term mismatch AT the snapshot base index (snapshot-covered
+    committed state) must not delete log entries — the old `prev_idx > 0`
+    guard turned it into `del log[-1:]`, dropping the newest entry."""
+    import struct
+
+    srv, t, cfg = _lease_server()
+    try:
+        with srv._cond:
+            srv._role = "follower"
+            srv._base = 1          # snapshot covers index 1 (term 1)
+            srv._base_term = 1
+            srv._log[:] = [(1, 0, b"", b"")]  # one live entry at index 2
+            srv._commit = srv._applied = 1
+        payload = struct.pack("!qqqqq", 1, 1, 1, 7, 0) + struct.pack("!I", 0)
+        st, val = srv._on_append(payload)  # prev_term 7 mismatches base
+        assert st == 0  # indexes <= base are committed: treated as matched
+        _rterm, match = struct.unpack("!qq", val)
+        assert match == 1
+        with srv._cond:
+            assert len(srv._log) == 1  # newest entry survived
+    finally:
+        srv.stop()
+
+
 # ------------------------------------------ warm-standby recovery (fix)
 
 

@@ -232,13 +232,19 @@ def _step_flops_of(lowered) -> float:
 
 
 def build_pretrain_step(preset: str, on_tpu: bool, batch=None, seq=None,
-                        steps=None, accum: int = 1, grad_dtype=None):
+                        steps=None, accum: int = 1, grad_dtype=None,
+                        wus: str = "off"):
     """Construct the pretrain TrainStep for a tiny/small/base/longctx preset.
 
     Shared by ``main`` and ``scripts/capture_evidence.py`` so the committed
     cost evidence describes the EXACT program the benchmark measures (same
     seed, hyperparams, input generation). Returns
     ``(step_fn, ids, model, cfg, (batch, seq, steps))``.
+
+    ``wus``: ``"off"`` (default), ``"seq"`` (ZeRO-1 ``shard_update`` over a
+    dp mesh spanning all devices, sequential tail all-gather) or
+    ``"overlap"`` (same sharded update, params re-gathered at the head of
+    the next step in layer buckets behind the forward).
     """
     import numpy as np
 
@@ -259,6 +265,13 @@ def build_pretrain_step(preset: str, on_tpu: bool, batch=None, seq=None,
     model = LlamaForCausalLM(cfg)
     opt = paddle.optimizer.AdamW(learning_rate=3e-4, weight_decay=0.1,
                                  parameters=model.parameters())
+    if wus and wus != "off":
+        import jax
+
+        import paddle_tpu.distributed as dist
+
+        mesh = dist.ProcessMesh(np.arange(jax.device_count()), ["dp"])
+        opt.shard_update(mesh, overlap_gather=(wus == "overlap"))
 
     def loss_fn(m, ids):
         return m.compute_loss(m(ids), ids)
@@ -362,6 +375,36 @@ def _mem_fields(lowered, mem=False, label="", hbm_budget=None):
         if k in rep.meta:
             fields[k] = rep.meta[k]
     return fields
+
+
+def _overlap_fields(lowered, overlap=False, label=""):
+    """``overlap_*`` fields for a BENCH line from the collective-overlap
+    analyzer (``paddle_tpu.analysis.overlap``): every collective in the
+    scheduled HLO classified as hidden-behind-compute or exposed
+    (``comm-exposed``).  The ranked findings report goes to stderr; stdout
+    stays one JSON line."""
+    import sys
+
+    if not overlap:
+        return {}
+    from paddle_tpu.analysis import overlap_lowered
+
+    try:
+        rep = overlap_lowered(lowered)
+    except Exception as e:  # overlap lint must never break the BENCH contract
+        return {"overlap_error": repr(e)}
+    print(f"== overlap lint{' (' + label + ')' if label else ''} ==",
+          file=sys.stderr)
+    print(rep.report(), file=sys.stderr)
+    return {
+        "overlap_findings": len(rep),
+        "overlap_collectives": rep.meta["overlap_collectives"],
+        "overlap_collective_bytes": rep.meta["overlap_collective_bytes"],
+        "overlap_exposed_bytes": rep.meta["overlap_exposed_bytes"],
+        "overlap_exposed_fraction": round(
+            rep.meta["overlap_exposed_fraction"], 4),
+        "overlap_exposed_by_kind": rep.meta["overlap_exposed_by_kind"],
+    }
 
 
 def _merge_program_fields(dst, src, prefix):
@@ -919,6 +962,19 @@ def main():
     ap.add_argument("--hbm-budget", type=int, default=None,
                     help="per-device HBM budget in bytes; implies --mem and "
                          "adds the mem-over-budget check")
+    ap.add_argument("--overlap", action="store_true",
+                    help="run the collective-overlap analyzer "
+                         "(paddle_tpu.analysis.overlap) on the compiled "
+                         "step: each collective classified as hidden-behind-"
+                         "compute or comm-exposed; adds overlap_* fields to "
+                         "the BENCH line, ranked report to stderr")
+    ap.add_argument("--wus", default="off",
+                    choices=["off", "seq", "overlap"],
+                    help="ZeRO-1 weight-update sharding for the pretrain "
+                         "presets: 'seq' = shard_update with the sequential "
+                         "tail all-gather, 'overlap' = head-of-next-step "
+                         "bucketed gather behind the forward; on CPU forces "
+                         "an 8-device host mesh")
     ap.add_argument("--trace", default=None,
                     choices=["shared_prefix", "long_prompt"],
                     help="serve preset only: run the load-generator trace "
@@ -947,12 +1003,20 @@ def main():
         custom_shape = any(v is not None for v in (args.batch, args.seq, args.steps))
         # a cached plain-serve line cannot satisfy a --trace request (different
         # metric contract) — trace runs always execute on the CPU proxy
-        if fallback and not custom_shape and not args.trace:
+        if (fallback and not custom_shape and not args.trace
+                and args.wus == "off"):
             cached = _cached_tpu_result(args.preset)
             if cached is not None:
                 # no _stamp: re-stamping would falsify capture provenance
                 print(json.dumps(cached))
                 return
+        if args.wus != "off":
+            # the ZeRO-1 dp mesh needs devices to shard over; fake 8 host
+            # devices (must land before the first jax import in-process)
+            import os
+
+            os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                       + " --xla_force_host_platform_device_count=8")
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -992,7 +1056,7 @@ def main():
     accum = max(1, args.accum)
     step_fn, ids, model, cfg, (batch, seq, steps) = build_pretrain_step(
         preset, on_tpu, batch=args.batch, seq=args.seq, steps=args.steps,
-        accum=accum, grad_dtype=args.grad_dtype)
+        accum=accum, grad_dtype=args.grad_dtype, wus=args.wus)
     n_params = sum(p.size for p in model.parameters())
 
     lowered = lower_pretrain_step(step_fn, ids)
@@ -1000,6 +1064,9 @@ def main():
     bytes_fields.update(_lint_fields(lowered, args.lint, label=preset))
     bytes_fields.update(_mem_fields(lowered, args.mem, label=preset,
                                     hbm_budget=args.hbm_budget))
+    bytes_fields.update(_overlap_fields(lowered, args.overlap, label=preset))
+    if args.wus != "off":
+        bytes_fields["wus"] = args.wus
 
     if args.audit_only:
         print(json.dumps(_stamp({

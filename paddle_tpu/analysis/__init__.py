@@ -64,6 +64,8 @@ from .jaxpr_lint import (
 from .liveness import (
     LivenessResult, analyze_lowered, analyze_text, xla_peak_bytes)
 from .memory_lint import GATED_MEM_CODES, lint_memory, lint_memory_text
+from .overlap import (
+    DEFAULT_OVERLAP_FACTOR, overlap_lowered, overlap_report)
 from .schedule_lint import (
     build_schedule, bubble_fraction, check_schedule, lint_schedule)
 from .spec_algebra import Transfer, expected_collectives, normalize_spec, transition
@@ -80,6 +82,7 @@ __all__ = [
     "host_lint_source", "host_lint_paths", "host_lint_tree",
     "LivenessResult", "analyze_lowered", "analyze_text", "xla_peak_bytes",
     "GATED_MEM_CODES", "lint_memory", "lint_memory_text",
+    "DEFAULT_OVERLAP_FACTOR", "overlap_report", "overlap_lowered",
 ]
 
 
@@ -132,13 +135,18 @@ def lint_lowered(lowered, *, mesh=None, expected: Iterable[Any] = (),
                  declared_specs=None,
                  big_buffer_bytes: int = DEFAULT_BIG_BUFFER,
                  hbm_budget: Optional[int] = None,
-                 mem: bool = False) -> Report:
+                 mem: bool = False, overlap: bool = False,
+                 overlap_factor: float = DEFAULT_OVERLAP_FACTOR) -> Report:
     """Lint an already-``lower()``-ed computation (donation + HLO levels).
 
     ``hbm_budget`` (per-device bytes) or ``mem=True`` additionally runs the
     liveness-based memory lint (:mod:`.memory_lint`): peak-resident bytes
     cross-checked against ``memory_analysis()``, donation/remat advisors,
     and the ``mem-over-budget`` check against the declared budget.
+
+    ``overlap=True`` additionally runs the collective-overlap analyzer
+    (:mod:`.overlap`) over the scheduled module text: collectives with
+    insufficient independent concurrent compute raise ``comm-exposed``.
 
     Use :func:`check` when you still hold the Python callable — it adds the
     jaxpr-walk lints (upcasts, host transfers, Python scalars) on top.
@@ -169,6 +177,12 @@ def lint_lowered(lowered, *, mesh=None, expected: Iterable[Any] = (),
             for k in ("peak_bytes", "xla_peak_bytes", "peak_agreement"):
                 if k in mrep.meta:
                     rep.meta[k] = mrep.meta[k]
+        if overlap:
+            orep = overlap_report(text, overlap_factor=overlap_factor)
+            rep.extend(orep)
+            for k, v in orep.meta.items():
+                if k.startswith("overlap_"):
+                    rep.meta[k] = v
     return rep
 
 
@@ -177,7 +191,9 @@ def check(fn, args: Tuple[Any, ...] = (), kwargs: Optional[dict] = None, *,
           donate_argnums=None, static_argnums=None,
           expected: Iterable[Any] = (), declared_specs=None,
           big_buffer_bytes: int = DEFAULT_BIG_BUFFER,
-          hbm_budget: Optional[int] = None, mem: bool = False) -> Report:
+          hbm_budget: Optional[int] = None, mem: bool = False,
+          overlap: bool = False,
+          overlap_factor: float = DEFAULT_OVERLAP_FACTOR) -> Report:
     """Statically analyze ``fn(*args, **kwargs)`` — traces and compiles,
     never executes.
 
@@ -225,6 +241,7 @@ def check(fn, args: Tuple[Any, ...] = (), kwargs: Optional[dict] = None, *,
     rep.extend(lint_lowered(lowered, mesh=mesh, expected=expected,
                             declared_specs=declared_specs,
                             big_buffer_bytes=big_buffer_bytes,
-                            hbm_budget=hbm_budget, mem=mem))
+                            hbm_budget=hbm_budget, mem=mem,
+                            overlap=overlap, overlap_factor=overlap_factor))
     rep.meta["fn"] = getattr(fn, "__name__", type(fn).__name__)
     return rep

@@ -80,6 +80,11 @@ code                      level  meaning
                                  every device despite a sharded declared
                                  spec — the residency twin of
                                  ``replicated-buffer``
+``comm-exposed``          hlo    a collective without enough independent
+                                 concurrent compute (dependence + shared-
+                                 capacity model over the scheduled HLO) —
+                                 its wire latency sits on the critical
+                                 path instead of hiding behind compute
 ========================  =====  ========================================
 
 Severity is ``high`` / ``medium`` / ``low``; ranking is by severity first,

@@ -1,0 +1,286 @@
+"""Overlap analyzer: which collectives are hidden behind compute, and
+which sit exposed on the critical path.
+
+PR 6 cut the bytes each collective moves; :mod:`.hlo_lint` verifies the
+collective SET; nothing so far asks the latency question: when the
+program reaches a collective, is there concurrent compute to hide its
+wire time, or does the step stall?  This module answers it statically,
+over the *scheduled* (compiled) HLO text, per computation (so scan/while
+bodies — the pipeline tick — are judged against the compute of one tick,
+which is what actually runs concurrently):
+
+- **dependence**: for each collective ``C``, walk the operand graph both
+  ways.  Compute instructions that are neither ancestors nor descendants
+  of ``C`` are the only ones an (async-capable) scheduler could run
+  while ``C``'s bytes are on the wire.
+- **capacity**: each independent compute instruction's *work bytes* can
+  hide at most one collective — a shared budget, consumed greedily in
+  schedule (text) order.  Without this, the ZeRO-1 *sequential* tail
+  all-gathers look overlapped: every leaf's gather is trivially
+  independent of every other leaf's update fusion, but there is one pool
+  of update compute and N gathers competing for it.
+- **threshold**: hiding ``b`` collective bytes needs
+  ``b * overlap_factor`` concurrent compute bytes.  Interconnect
+  bandwidth is below HBM bandwidth (ICI:HBM is ~4-8x on recent TPU
+  generations), so memory-bound compute must touch a multiple of the
+  collective's bytes to cover its latency; the default factor 2.0 is a
+  conservative lower bound of that ratio.
+- **async pairs**: when the scheduler already committed (``-start`` /
+  ``-done`` in the text), the instructions *between* the pair are the
+  measured concurrent window and are counted first; the pair is one
+  collective (bytes taken from the ``-done`` result).
+
+Work bytes are the instruction's output bytes — the memory-bound proxy
+the fusion auditor already uses — except fusions rooted in
+``dynamic-update-slice``, which write one slice in place: those count
+the slice, not the aliased buffer (otherwise a pipeline's
+``[n_micro, ...]`` output stash hides every ppermute for free).
+
+Collectives with insufficient hidden bytes raise ``comm-exposed``
+findings on the shared Report API; ``bytes`` on the finding is the
+*exposed* byte count (collective bytes scaled by the uncovered
+fraction), so ranking puts the biggest stall first and gates can diff
+totals.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .findings import Report
+from .hlo_ir import paren_args, shape_bytes, split_computations
+from .hlo_lint import COLLECTIVE_OPS
+
+__all__ = [
+    "DEFAULT_OVERLAP_FACTOR", "OVERLAP_MIN_BYTES",
+    "overlap_report", "overlap_lowered",
+]
+
+# hiding b collective bytes needs >= b * factor concurrent compute bytes
+# (ICI bandwidth below HBM bandwidth; see module docstring)
+DEFAULT_OVERLAP_FACTOR = 2.0
+
+# collectives below this are latency-bound scalars (loss psums, step
+# counters) — no amount of overlap engineering moves the step time
+OVERLAP_MIN_BYTES = 1024
+
+# opcodes that represent real work (FLOPs or a full-buffer memory pass);
+# pure data movement / layout ops are excluded on purpose — reordering a
+# transpose behind an all-gather hides nothing worth gating
+_COMPUTE_OPS = frozenset({
+    "fusion", "dot", "convolution", "custom-call", "reduce",
+    "reduce-window", "scatter", "select-and-scatter", "sort", "map",
+    "dynamic-update-slice", "cholesky", "triangular-solve", "fft",
+    "rng", "rng-bit-generator",
+})
+
+_OPERAND_RE = re.compile(r"%?([\w.\-]+)")
+_CALLS_RE = re.compile(r"calls=%?([\w.\-]+)")
+
+Instr = Tuple[str, str, str, str]  # (name, opcode, type_str, tail)
+
+
+def _operands(tail: str, known: Dict[str, int]) -> List[str]:
+    """Operand instruction names of one instruction, restricted to names
+    defined earlier in the same computation (filters dtypes/attrs)."""
+    args = paren_args(tail)
+    if not args:
+        return []
+    return [t for t in _OPERAND_RE.findall(args) if t in known]
+
+
+def _norm_collective(opcode: str) -> Optional[str]:
+    """Normalized collective kind; ``-done`` halves fold into their
+    ``-start`` (counted once), sync ops pass through."""
+    if opcode.endswith("-done"):
+        return None
+    if opcode.endswith("-start"):
+        opcode = opcode[: -len("-start")]
+    return opcode if opcode in COLLECTIVE_OPS else None
+
+
+def _reach(start: List[int], adj: Dict[int, List[int]]) -> set:
+    """All node indices reachable from ``start`` over ``adj``."""
+    seen = set(start)
+    stack = list(start)
+    while stack:
+        v = stack.pop()
+        for w in adj.get(v, ()):
+            if w not in seen:
+                seen.add(w)
+                stack.append(w)
+    return seen
+
+
+def _dus_update_bytes(instrs: List[Instr], types: Dict[str, str],
+                      tail: str) -> Optional[int]:
+    """Bytes of the update operand of a ``dynamic-update-slice`` — the
+    in-place write, i.e. the actual work."""
+    known = {n: i for i, (n, _, _, _) in enumerate(instrs)}
+    ops = _operands(tail, known)
+    if len(ops) >= 2:
+        t = types.get(ops[1])
+        if t is not None:
+            return shape_bytes(t)
+    return None
+
+
+def _work_bytes(opcode: str, type_str: str, tail: str,
+                comp_map: Dict[str, List[Instr]],
+                comp_types: Dict[str, Dict[str, str]]) -> int:
+    """Work proxy for one compute instruction (see module docstring)."""
+    if opcode == "fusion":
+        m = _CALLS_RE.search(tail)
+        if m and m.group(1) in comp_map:
+            body = comp_map[m.group(1)]
+            if body:
+                root_name, root_op, _, root_tail = body[-1]
+                if root_op == "dynamic-update-slice":
+                    b = _dus_update_bytes(body, comp_types[m.group(1)],
+                                          root_tail)
+                    if b is not None:
+                        return b
+    return shape_bytes(type_str)
+
+
+def overlap_report(text: str, *,
+                   overlap_factor: float = DEFAULT_OVERLAP_FACTOR,
+                   min_bytes: int = OVERLAP_MIN_BYTES) -> Report:
+    """Classify every collective in an HLO dump as overlapped or exposed.
+
+    Returns a Report whose ``comm-exposed`` findings name the stalling
+    collectives; ``meta`` carries the totals the bench/gate consume:
+    ``overlap_collective_bytes``, ``overlap_exposed_bytes``,
+    ``overlap_exposed_fraction``, ``overlap_exposed_by_kind``, and a
+    per-collective ``overlap_detail`` list.
+    """
+    rep = Report()
+    comps = split_computations(text)
+    comp_map: Dict[str, List[Instr]] = {name: instrs for name, instrs in comps}
+    comp_types: Dict[str, Dict[str, str]] = {
+        name: {n: t for n, _, t, _ in instrs} for name, instrs in comps}
+
+    total_bytes = 0
+    exposed_bytes = 0.0
+    by_kind: Dict[str, float] = {}
+    detail: List[dict] = []
+    n_coll = n_exposed = 0
+
+    for comp, instrs in comps:
+        known = {n: i for i, (n, _, _, _) in enumerate(instrs)}
+        fwd: Dict[int, List[int]] = {}   # producer -> consumers
+        back: Dict[int, List[int]] = {}  # consumer -> producers
+        for i, (name, opcode, type_str, tail) in enumerate(instrs):
+            for o in _operands(tail, known):
+                j = known[o]
+                fwd.setdefault(j, []).append(i)
+                back.setdefault(i, []).append(j)
+
+        # -done index for each -start (operand graph: done consumes start)
+        done_of: Dict[int, int] = {}
+        for i, (name, opcode, _, tail) in enumerate(instrs):
+            if opcode.endswith("-done"):
+                for j in back.get(i, ()):
+                    if instrs[j][1].endswith("-start"):
+                        done_of[j] = i
+
+        # compute pool of this computation: (index, work bytes), unconsumed
+        pool: Dict[int, int] = {}
+        for i, (name, opcode, type_str, tail) in enumerate(instrs):
+            if opcode in _COMPUTE_OPS and _norm_collective(opcode) is None:
+                if opcode == "dynamic-update-slice":
+                    w = _dus_update_bytes(instrs, comp_types[comp], tail)
+                    w = shape_bytes(type_str) if w is None else w
+                else:
+                    w = _work_bytes(opcode, type_str, tail,
+                                    comp_map, comp_types)
+                if w > 0:
+                    pool[i] = w
+        consumed: set = set()
+
+        for i, (name, opcode, type_str, tail) in enumerate(instrs):
+            kind = _norm_collective(opcode)
+            if kind is None:
+                continue
+            di = done_of.get(i)
+            nbytes = shape_bytes(instrs[di][2] if di is not None else type_str)
+            if nbytes < min_bytes:
+                continue
+            n_coll += 1
+            total_bytes += nbytes
+            required = nbytes * overlap_factor
+
+            anc = _reach([i], back)
+            desc = _reach([di] if di is not None else [i], fwd)
+            blocked = anc | desc | {i}
+            if di is not None:
+                blocked.add(di)
+            indep = [j for j in pool
+                     if j not in blocked and j not in consumed]
+            # async pair: the compiler's own schedule window first — the
+            # instructions it placed between start and done ARE the overlap
+            if di is not None:
+                indep.sort(key=lambda j: (0 if i < j < di else 1, j))
+            else:
+                indep.sort()
+
+            hidden = 0.0
+            for j in indep:
+                if hidden >= required:
+                    break
+                consumed.add(j)
+                hidden += pool[j]
+            hidden = min(hidden, required)
+            frac_exposed = (0.0 if required <= 0
+                            else max(0.0, 1.0 - hidden / required))
+            exp_b = nbytes * frac_exposed
+            detail.append({
+                "kind": kind, "bytes": nbytes, "hidden_compute": int(hidden),
+                "required_compute": int(required),
+                "exposed_bytes": int(exp_b), "where": f"{comp}/{name}",
+                "async": di is not None,
+            })
+            if frac_exposed <= 0.0:
+                continue
+            n_exposed += 1
+            exposed_bytes += exp_b
+            by_kind[kind] = by_kind.get(kind, 0.0) + exp_b
+            rep.add(
+                "comm-exposed",
+                "high" if frac_exposed >= 0.5 else "medium",
+                f"{kind} moves {nbytes} B with only {int(hidden)} B of "
+                f"independent concurrent compute (needs "
+                f"{int(required)} B at factor {overlap_factor:g}) — "
+                f"{frac_exposed:.0%} of its latency sits on the critical "
+                "path",
+                where=f"{comp}/{name}",
+                bytes=int(exp_b),
+                suggestion="restructure so compute that does not consume "
+                           "this collective's result is schedulable beside "
+                           "it (head-of-step gather buckets, double-"
+                           "buffered transfers), or fold it into a larger "
+                           "overlapped group")
+
+    rep.meta["overlap_factor"] = overlap_factor
+    rep.meta["overlap_collectives"] = n_coll
+    rep.meta["overlap_exposed_count"] = n_exposed
+    rep.meta["overlap_collective_bytes"] = int(total_bytes)
+    rep.meta["overlap_exposed_bytes"] = int(exposed_bytes)
+    rep.meta["overlap_exposed_fraction"] = (
+        exposed_bytes / total_bytes if total_bytes else 0.0)
+    rep.meta["overlap_exposed_by_kind"] = {
+        k: int(v) for k, v in sorted(by_kind.items())}
+    rep.meta["overlap_detail"] = detail
+    return rep
+
+
+def overlap_lowered(lowered, *,
+                    overlap_factor: float = DEFAULT_OVERLAP_FACTOR,
+                    min_bytes: int = OVERLAP_MIN_BYTES) -> Report:
+    """Compile a ``lower()``-ed computation and run :func:`overlap_report`
+    on the scheduled module text."""
+    compiled = lowered.compile()
+    return overlap_report(compiled.as_text(),
+                          overlap_factor=overlap_factor,
+                          min_bytes=min_bytes)

@@ -94,6 +94,8 @@ class Schedule:
     virtual: int
     total_ticks: int
     stash_slots: int                      # activation slots per stage
+    hop_ticks: int = 1                    # ticks a stage->stage+1 hop takes
+                                          # (2 when transfers double-buffer)
     ops: Dict[Key, SchedOp] = field(default_factory=dict)
     edges: List[SchedEdge] = field(default_factory=list)
 
@@ -116,13 +118,25 @@ def _canon_kind(kind: str) -> str:
 
 
 def build_schedule(kind: str, n_stages: int, n_micro: int,
-                   virtual_pp_degree: int = 1) -> Schedule:
+                   virtual_pp_degree: int = 1,
+                   double_buffer: bool = False) -> Schedule:
     """Elaborate the tick-level DAG that the matching ``pipeline_*_step``
-    implements (same closed-form timing; see pipeline.py docstrings)."""
+    implements (same closed-form timing; see pipeline.py docstrings).
+
+    ``double_buffer=True`` (GPipe only) models the double-buffered
+    transfer schedule: a stage->stage+1 hop takes TWO ticks — the message
+    posted at the end of tick t is on the wire during tick t+1 (its
+    ppermute rides beside tick t+1's compute, off the critical path) and
+    is consumed at tick t+2.  F(s, m) lands at ``t = 2s + m``,
+    total ``M + 2(S-1)`` ticks."""
     kind = _canon_kind(kind)
     S, M, V = n_stages, n_micro, virtual_pp_degree
     if S < 1 or M < 1:
         raise ValueError(f"need n_stages >= 1 and n_micro >= 1, got {S}, {M}")
+    if double_buffer and kind != "GPipe":
+        raise ValueError(
+            f"double_buffer schedules are elaborated for GPipe only, "
+            f"not {kind}")
     ops: Dict[Key, SchedOp] = {}
     edges: List[SchedEdge] = []
 
@@ -130,18 +144,22 @@ def build_schedule(kind: str, n_stages: int, n_micro: int,
         ops[op.key] = op
 
     if kind == "GPipe":
-        # pipeline_spmd_step: T = M + S - 1 ticks, F(s, m) at t = s + m;
-        # backward is autodiff through the scan, so the activation of every
-        # tick stays stashed until after the scan: T slots.
-        total = M + S - 1
+        # pipeline_spmd_step: T = M + h(S-1) ticks, F(s, m) at t = h*s + m
+        # with hop h = 1 (sequential transfer inside the tick) or h = 2
+        # (double-buffered: the transfer occupies its own tick, overlapped
+        # with the next microbatch's compute); backward is autodiff through
+        # the scan, so the activation of every tick stays stashed until
+        # after the scan: T slots.
+        h = 2 if double_buffer else 1
+        total = M + h * (S - 1)
         for s in range(S):
             for m in range(M):
-                add(SchedOp("F", s, m, s + m))
+                add(SchedOp("F", s, m, h * s + m))
                 if s > 0:
                     edges.append(SchedEdge(("F", s - 1, m, 0),
-                                           ("F", s, m, 0), True, 1))
+                                           ("F", s, m, 0), True, h))
         return Schedule(kind, S, M, 1, total, stash_slots=total,
-                        ops=ops, edges=edges)
+                        hop_ticks=h, ops=ops, edges=edges)
 
     if kind == "VPP":
         # pipeline_vpp_step: T = M*V + S - 1; device s at tick t runs slot
@@ -213,19 +231,20 @@ def _required_deps(sched: Schedule, key: Key) -> List[Tuple[Key, bool, int]]:
     is caught instead of trusted."""
     kind, s, m, j = key
     S = sched.n_stages
+    hop = sched.hop_ticks
     deps: List[Tuple[Key, bool, int]] = []
     if kind == "F":
         if sched.kind == "VPP":
             if s > 0:
-                deps.append((("F", s - 1, m, j), True, 1))
+                deps.append((("F", s - 1, m, j), True, hop))
             elif j > 0:
-                deps.append((("F", S - 1, m, j - 1), True, 1))
+                deps.append((("F", S - 1, m, j - 1), True, hop))
         elif s > 0:
-            deps.append((("F", s - 1, m, 0), True, 1))
+            deps.append((("F", s - 1, m, 0), True, hop))
     elif kind == "B":
         deps.append((("F", s, m, 0), False, 0))
         if s < S - 1:
-            deps.append((("B", s + 1, m, 0), True, 1))
+            deps.append((("B", s + 1, m, 0), True, hop))
     elif kind == "W":
         for m2 in range(sched.n_micro):
             deps.append((("F", s, m2, 0), False, 1))
@@ -286,9 +305,13 @@ def lint_schedule(sched: Schedule, *, costs: Mapping[str, float] = None
                 suggestion="total ticks must cover warmup + steady + "
                            "cooldown; re-derive from the closed form")
 
-    # -- matched sends + lag: every required dep must exist as an edge and
-    # be satisfiable in program order
-    edge_set = {(e.src, e.dst) for e in sched.edges}
+    # -- matched sends + lag: every required dep must exist as an edge,
+    # declare at least the lag the transfer needs, and be satisfiable in
+    # program order
+    edge_lag: Dict[Tuple[Key, Key], int] = {}
+    for e in sched.edges:
+        k2 = (e.src, e.dst)
+        edge_lag[k2] = max(edge_lag.get(k2, e.min_lag), e.min_lag)
     for key in sorted(sched.ops):
         for dep, comm, lag in _required_deps(sched, key):
             if dep not in sched.ops:
@@ -298,7 +321,7 @@ def lint_schedule(sched: Schedule, *, costs: Mapping[str, float] = None
                     "scheduled at all — recv with no producer",
                     where=_kstr(key))
                 continue
-            if (dep, key) not in edge_set:
+            if (dep, key) not in edge_lag:
                 what = "ppermute" if comm else "stash"
                 rep.add(
                     "schedule-missing-edge", "high",
@@ -307,6 +330,18 @@ def lint_schedule(sched: Schedule, *, costs: Mapping[str, float] = None
                     "(and garbage data in the compiled lockstep form)",
                     where=_kstr(key),
                     suggestion="restore the ppermute/stash for this hop")
+            elif comm and edge_lag[(dep, key)] < lag:
+                rep.add(
+                    "schedule-missing-edge", "high",
+                    f"ppermute edge {_kstr(dep)} -> {_kstr(key)} declares "
+                    f"min_lag {edge_lag[(dep, key)]} but the transfer takes "
+                    f"{lag} tick(s) (hop_ticks={sched.hop_ticks}) — the "
+                    "constraint is too weak to stop the consumer racing the "
+                    "in-flight buffer",
+                    where=_kstr(key),
+                    suggestion="declare min_lag >= hop_ticks on every comm "
+                               "edge so tick shifts cannot silently consume "
+                               "a buffer still in flight")
 
     for e in sched.edges:
         st, dt = sched.op_tick(e.src), sched.op_tick(e.dst)
@@ -355,11 +390,12 @@ def lint_schedule(sched: Schedule, *, costs: Mapping[str, float] = None
         warmup.append(min(ticks))
         cooldown.append(sched.total_ticks - 1 - max(ticks))
         last_tick = max(last_tick, max(ticks))
-        if min(ticks) != s:
+        if min(ticks) != s * sched.hop_ticks:
             rep.add(
                 "schedule-tick-count", "medium",
                 f"stage {s} first becomes active at tick {min(ticks)}, "
-                f"expected warmup of exactly {s} ticks (fill latency)",
+                f"expected warmup of exactly {s * sched.hop_ticks} ticks "
+                f"(fill latency at {sched.hop_ticks} tick(s)/hop)",
                 where=f"stage {s}")
     if last_tick >= 0 and sched.total_ticks > last_tick + 1:
         rep.add(
@@ -404,18 +440,21 @@ def lint_schedule(sched: Schedule, *, costs: Mapping[str, float] = None
                            "microbatches (later warmup / earlier backward)")
     rep.meta["peak_in_flight"] = peak_per_stage
 
-    bf = bubble_fraction(sched.kind, S, M, virtual=sched.virtual, costs=costs)
+    bf = bubble_fraction(sched.kind, S, M, virtual=sched.virtual, costs=costs,
+                         hop_ticks=sched.hop_ticks)
     rep.meta.update({f"bubble_{k}": v for k, v in bf.items()})
     return rep
 
 
 def check_schedule(kind: str, n_stages: int, n_micro: int,
                    virtual_pp_degree: int = 1, *,
+                   double_buffer: bool = False,
                    costs: Mapping[str, float] = None) -> Report:
     """Build + lint in one call (the ``analysis.check`` companion for
     schedules: nothing is traced or compiled)."""
     return lint_schedule(
-        build_schedule(kind, n_stages, n_micro, virtual_pp_degree),
+        build_schedule(kind, n_stages, n_micro, virtual_pp_degree,
+                       double_buffer=double_buffer),
         costs=costs)
 
 
@@ -424,32 +463,46 @@ def check_schedule(kind: str, n_stages: int, n_micro: int,
 
 
 def bubble_fraction(kind: str, n_stages: int, n_micro: int, virtual: int = 1,
-                    costs: Mapping[str, float] = None) -> Dict[str, float]:
+                    costs: Mapping[str, float] = None,
+                    hop_ticks: int = 1) -> Dict[str, float]:
     """Analytic bubble fraction of the COMPILED (lockstep) schedule.
 
     ``costs`` are per-microbatch per-stage costs in any consistent unit
     (``cost_model``'s roofline ms works): ``f`` forward, ``bx`` input
-    grad, ``w`` weight grad.  In the lockstep scan every stage executes
-    the full round body every round, so for GPipe/VPP/1F1B the fraction
-    reduces to idle_rounds/total_rounds independent of the costs; for ZB
-    the deferred W tail makes it genuinely cost-dependent (the ZBH1
-    trade: cheaper rounds, paid-once tail).
+    grad, ``w`` weight grad, and ``x`` per-round transfer/dispatch
+    overhead (the ppermute + its launch — the term the pp=2 measurement
+    showed the pure-compute model under-predicts by).  In the lockstep
+    scan every stage executes the full round body every round; with the
+    default ``x = 0`` every previously validated number is unchanged.
+
+    ``hop_ticks=2`` (the double-buffered GPipe transfer schedule) changes
+    the round cost from ``f + x`` to ``max(f, x)``: the ppermute moves
+    the PREVIOUS tick's message, so it runs beside this tick's compute
+    and only the longer of the two paces the round — the whole point of
+    double-buffering.  The fill cost rises to ``2(S-1)`` rounds; for
+    compute-dominated rounds (``x < f``, the deployed regime) the hidden
+    per-round ``x`` across ``M + 2(S-1)`` rounds beats the extra fill
+    once ``M`` is a few multiples of ``S``.
     """
     kind = _canon_kind(kind)
-    c = {"f": 1.0, "bx": 1.0, "w": 1.0}
+    c = {"f": 1.0, "bx": 1.0, "w": 1.0, "x": 0.0}
     c.update(costs or {})
     S, M, V = n_stages, n_micro, virtual
     if kind == "GPipe":
-        round_cost, rounds, tail = c["f"], M + S - 1, 0.0
+        if hop_ticks == 2:
+            round_cost = max(c["f"], c["x"])  # transfer rides beside compute
+            rounds, tail = M + 2 * (S - 1), 0.0
+        else:
+            round_cost, rounds, tail = c["f"] + c["x"], M + S - 1, 0.0
     elif kind == "VPP":
-        round_cost, rounds, tail = c["f"], M * V + S - 1, 0.0
+        round_cost, rounds, tail = c["f"] + c["x"], M * V + S - 1, 0.0
         M = M * V  # useful rounds per device
     elif kind == "1F1B":
         # fwd + recompute + input grad + weight grad per round
-        round_cost = 2 * c["f"] + c["bx"] + c["w"]
+        round_cost = 2 * c["f"] + c["bx"] + c["w"] + c["x"]
         rounds, tail = M + 2 * (S - 1), 0.0
     else:  # ZB
-        round_cost = 2 * c["f"] + c["bx"]
+        round_cost = 2 * c["f"] + c["bx"] + c["x"]
         rounds = M + 2 * (S - 1)
         tail = M * (c["f"] + c["w"])  # deferred full-batch W (+ recompute)
     total = rounds * round_cost + tail
@@ -465,17 +518,24 @@ def bubble_fraction(kind: str, n_stages: int, n_micro: int, virtual: int = 1,
 
 def measure_bubble_fraction(n_stages: int = 2, n_micro: int = 4,
                             dim: int = 512, mb: int = 64, reps: int = 7,
-                            schedule: str = "1F1B") -> Dict[str, float]:
-    """Scan-measure the bubble fraction of the compiled 1F1B schedule on
+                            schedule: str = "1F1B",
+                            double_buffer: bool = False) -> Dict[str, float]:
+    """Scan-measure the bubble fraction of a compiled pipeline schedule on
     the local mesh and compare with the analytic prediction.
 
     The lockstep scan costs ``T(M) = R(M) * t_round + overhead`` with
-    ``R = M + 2(S-1)``; timing at M and 2M cancels the overhead:
-    ``t_round = (T(2M) - T(M)) / M`` and the measured bubble at M is
-    ``1 - M * t_round / (R * t_round)`` — evaluated from wall clocks as
-    ``1 - M * t_round / T(M)`` so constant overhead shows up as honest
-    extra bubble.  Runs real compute (executes the program): slow-tier /
-    PERF-capture use only.
+    ``R = M + hop*(S-1)`` (hop 2 for 1F1B's F+B fill and for the
+    double-buffered GPipe transfer schedule, else 1); timing at M and 2M
+    cancels the overhead: ``t_round = (T(2M) - T(M)) / M`` and the
+    measured bubble at M is ``1 - M * t_round / (R * t_round)`` —
+    evaluated from wall clocks as ``1 - M * t_round / T(M)`` so constant
+    overhead shows up as honest extra bubble.  ``schedule`` may be
+    ``"1F1B"`` (fwd+bwd training round) or ``"GPipe"`` (forward-only
+    scan via ``pipeline_spmd_step``, optionally ``double_buffer`` —
+    the harness that isolates the per-round ppermute/dispatch overhead
+    the ``x`` cost models, since the two GPipe variants differ ONLY in
+    transfer placement).  Runs real compute (executes the program):
+    slow-tier / PERF-capture use only.
     """
     import jax
     import jax.numpy as jnp
@@ -483,10 +543,14 @@ def measure_bubble_fraction(n_stages: int = 2, n_micro: int = 4,
     from jax.sharding import Mesh, PartitionSpec as P
 
     from ..framework.shard_map_compat import shard_map
-    from ..distributed.parallel.pipeline import pipeline_1f1b_step
+    from ..distributed.parallel.pipeline import (pipeline_1f1b_step,
+                                                 pipeline_spmd_step)
 
-    if _canon_kind(schedule) != "1F1B":
-        raise NotImplementedError("measurement harness covers 1F1B")
+    kind = _canon_kind(schedule)
+    if kind not in ("1F1B", "GPipe"):
+        raise NotImplementedError("measurement harness covers 1F1B and GPipe")
+    if double_buffer and kind != "GPipe":
+        raise ValueError("double_buffer measurement is GPipe-only")
     S, M = n_stages, n_micro
     devs = jax.devices()
     if len(devs) < S:
@@ -510,37 +574,49 @@ def measure_bubble_fraction(n_stages: int = 2, n_micro: int = 4,
     sp = jnp.asarray(rng.normal(size=(S, dim, dim)), jnp.float32) * 0.05
 
     def compiled(m):
-        sched = pipeline_1f1b_step(first_fn, block_fn, last_fn, S, m,
-                                   axis_name="pp")
         data = jnp.asarray(rng.normal(size=(m, mb, dim)), jnp.float32)
-        fn = jax.jit(shard_map(
-            sched, mesh=mesh,
-            in_specs=(P("pp"), P(), P(), P()),
-            out_specs=(P(), P("pp"), P(), P())))
-        jax.block_until_ready(fn(sp, fp, lp, data))   # compile
-        jax.block_until_ready(fn(sp, fp, lp, data))   # warm caches
-        return fn, data
+        if kind == "GPipe":
+            sched = pipeline_spmd_step(block_fn, S, m, axis_name="pp",
+                                       remat=False,
+                                       double_buffer=double_buffer)
+            fn = jax.jit(shard_map(
+                sched, mesh=mesh, in_specs=(P("pp"), P()),
+                out_specs=P("pp")))
+            args = (sp, data)
+        else:
+            sched = pipeline_1f1b_step(first_fn, block_fn, last_fn, S, m,
+                                       axis_name="pp")
+            fn = jax.jit(shard_map(
+                sched, mesh=mesh,
+                in_specs=(P("pp"), P(), P(), P()),
+                out_specs=(P(), P("pp"), P(), P())))
+            args = (sp, fp, lp, data)
+        jax.block_until_ready(fn(*args))   # compile
+        jax.block_until_ready(fn(*args))   # warm caches
+        return fn, args
 
-    def once(fn, data):
+    def once(fn, args):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(sp, fp, lp, data))
+        jax.block_until_ready(fn(*args))
         return time.perf_counter() - t0
 
     # t_round comes from a DIFFERENCE of two clocks, so CPU-load drift
     # between the M and 2M loops would be amplified: interleave the two
     # measurements rep by rep and take the min of each (best = least
     # perturbed), which keeps both clocks under the same load profile.
-    fn_lo, data_lo = compiled(M)
-    fn_hi, data_hi = compiled(2 * M)
+    fn_lo, args_lo = compiled(M)
+    fn_hi, args_hi = compiled(2 * M)
     ts_lo, ts_hi = [], []
     for _ in range(reps):
-        ts_lo.append(once(fn_lo, data_lo))
-        ts_hi.append(once(fn_hi, data_hi))
+        ts_lo.append(once(fn_lo, args_lo))
+        ts_hi.append(once(fn_hi, args_hi))
     t_lo, t_hi = float(min(ts_lo)), float(min(ts_hi))
     t_round = (t_hi - t_lo) / M
-    rounds = M + 2 * (S - 1)
+    hop = 2 if (kind == "1F1B" or double_buffer) else 1
+    rounds = M + hop * (S - 1)
     measured = 1.0 - (M * t_round) / t_lo if t_lo > 0 else float("nan")
-    predicted = bubble_fraction("1F1B", S, M)["fraction"]
+    predicted = bubble_fraction(
+        kind, S, M, hop_ticks=2 if double_buffer else 1)["fraction"]
     return {
         "n_stages": S, "n_micro": M,
         "t_lo_s": t_lo, "t_hi_s": t_hi, "t_round_s": t_round,

@@ -108,7 +108,7 @@ class PipelineLayer(Layer):
 
 
 def pipeline_spmd_step(block_fn: Callable, n_stages: int, n_micro: int, axis_name: str = "pp",
-                       remat: bool = True):
+                       remat: bool = True, double_buffer: bool = False):
     """Build a GPipe schedule as a pure function FOR USE INSIDE ``shard_map``
     (manual over ``axis_name``; other mesh axes stay GSPMD-automatic).
 
@@ -127,43 +127,115 @@ def pipeline_spmd_step(block_fn: Callable, n_stages: int, n_micro: int, axis_nam
     scan gives the backward pipeline; with ``remat`` the saved state per tick
     is one microbatch activation — the activation bound 1F1B+recompute has
     (reference ``pipeline_parallel.py:575`` forward_backward_pipeline).
+
+    ``double_buffer=True`` moves each tick's ``ppermute`` OFF the critical
+    path: the carry holds two activation buffers — ``msg`` (posted at the
+    end of the previous tick, on the wire) and ``arrived`` (delivered two
+    ticks ago, consumed by this tick's compute).  The ppermute at the top
+    of the tick moves ``msg`` while ``block_fn`` runs on ``arrived`` —
+    data-independent, so the scheduler can overlap them (the
+    :mod:`analysis.overlap` analyzer proves it).  A hop then takes 2
+    ticks: F(s, m) at ``t = m + 2s``, T = n_micro + 2(n_stages-1).  Same
+    block computations on the same values — bit-identical outputs, one
+    extra in-flight buffer per stage.  The emitted schedule is elaborated
+    and linted deadlock-free (``analysis.schedule_lint``) before use;
+    a lint finding raises instead of compiling a hang.
     """
     if remat:
         block_fn = jax.checkpoint(block_fn)
 
+    # verifier-becomes-planner: the tick DAG this function is about to
+    # implement must lint clean BEFORE anything compiles (a deadlocked or
+    # mis-lagged schedule is a silent hang, not an exception)
+    from ...analysis.schedule_lint import build_schedule, lint_schedule
+    _lint = lint_schedule(build_schedule(
+        "GPipe", n_stages, n_micro, double_buffer=double_buffer))
+    if _lint:
+        raise ValueError(
+            "pipeline_spmd_step: emitted schedule fails static lint:\n"
+            + _lint.report())
+
+    if not double_buffer:
+        def schedule(stage_params, micro_inputs, *extra):
+            stage = jax.lax.axis_index(axis_name)
+            T = n_micro + n_stages - 1
+            mb_shape = micro_inputs.shape[1:]
+            # the carry becomes stage-dependent after tick 1; mark it varying
+            # over the pp axis up front so scan's carry type is stable (JAX
+            # vma typing)
+            state0 = pvary(jnp.zeros(mb_shape, micro_inputs.dtype),
+                           (axis_name,))
+            out0 = pvary(jnp.zeros((n_micro,) + mb_shape, micro_inputs.dtype),
+                         (axis_name,))
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+            def tick(carry, t):
+                state, outputs = carry
+                # stage 0 ingests microbatch t while any remain
+                incoming = jax.lax.dynamic_index_in_dim(
+                    micro_inputs, jnp.clip(t, 0, n_micro - 1), 0,
+                    keepdims=False)
+                state = jnp.where((stage == 0) & (t < n_micro), incoming,
+                                  state)
+                # stage s is active at tick t iff microbatch t-s is in range
+                active = (t >= stage) & (t - stage < n_micro)
+                new_state = block_fn(stage_params, state, *extra)
+                state = jnp.where(active, new_state, state)
+                # last stage emits microbatch t - (n_stages - 1)
+                out_idx = t - (n_stages - 1)
+                emit = (stage == n_stages - 1) & (out_idx >= 0)
+                updated = jax.lax.dynamic_update_index_in_dim(
+                    outputs, state, jnp.clip(out_idx, 0, n_micro - 1), 0)
+                outputs = jnp.where(emit, updated, outputs)
+                # rotate activations to the next stage over ICI
+                state = jax.lax.ppermute(state, axis_name, perm)
+                return (state, outputs), None
+
+            (_, outputs), _ = jax.lax.scan(tick, (state0, out0),
+                                           jnp.arange(T))
+            # local [1, n_micro, ...] -> global [pp, n_micro, ...]
+            return outputs[None]
+
+        return schedule
+
     def schedule(stage_params, micro_inputs, *extra):
         stage = jax.lax.axis_index(axis_name)
-        T = n_micro + n_stages - 1
+        T = n_micro + 2 * (n_stages - 1)
         mb_shape = micro_inputs.shape[1:]
-        # the carry becomes stage-dependent after tick 1; mark it varying over
-        # the pp axis up front so scan's carry type is stable (JAX vma typing)
-        state0 = pvary(jnp.zeros(mb_shape, micro_inputs.dtype), (axis_name,))
+        zero = jnp.zeros(mb_shape, micro_inputs.dtype)
+        msg0 = pvary(zero, (axis_name,))      # posted last tick, on the wire
+        arrived0 = pvary(zero, (axis_name,))  # delivered, ready to compute
         out0 = pvary(jnp.zeros((n_micro,) + mb_shape, micro_inputs.dtype),
                      (axis_name,))
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def tick(carry, t):
-            state, outputs = carry
+            msg, arrived, outputs = carry
+            # the transfer FIRST, consuming only the carry: this tick's
+            # compute below never touches `delivered`, so the two are
+            # schedulable side by side (the double buffer)
+            delivered = jax.lax.ppermute(msg, axis_name, perm)
             # stage 0 ingests microbatch t while any remain
             incoming = jax.lax.dynamic_index_in_dim(
                 micro_inputs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
-            state = jnp.where((stage == 0) & (t < n_micro), incoming, state)
-            # stage s is active at tick t iff microbatch t-s is in range
-            active = (t >= stage) & (t - stage < n_micro)
-            new_state = block_fn(stage_params, state, *extra)
-            state = jnp.where(active, new_state, state)
-            # last stage emits microbatch t - (n_stages - 1)
-            out_idx = t - (n_stages - 1)
+            x = jnp.where((stage == 0) & (t < n_micro), incoming, arrived)
+            # stage s computes microbatch m = t - 2s (two ticks per hop:
+            # one on the wire, one in the arrival buffer)
+            active = (t >= 2 * stage) & (t - 2 * stage < n_micro)
+            y = block_fn(stage_params, x, *extra)
+            y = jnp.where(active, y, arrived)
+            # last stage emits microbatch t - 2(n_stages - 1)
+            out_idx = t - 2 * (n_stages - 1)
             emit = (stage == n_stages - 1) & (out_idx >= 0)
             updated = jax.lax.dynamic_update_index_in_dim(
-                outputs, state, jnp.clip(out_idx, 0, n_micro - 1), 0)
+                outputs, y, jnp.clip(out_idx, 0, n_micro - 1), 0)
             outputs = jnp.where(emit, updated, outputs)
-            # rotate activations to the next stage over ICI
-            state = jax.lax.ppermute(state, axis_name, perm)
-            return (state, outputs), None
+            # post this tick's result; it rides the wire during tick t+1
+            return (y, delivered, outputs), None
 
-        (_, outputs), _ = jax.lax.scan(tick, (state0, out0), jnp.arange(T))
-        return outputs[None]  # local [1, n_micro, ...] -> global [pp, n_micro, ...]
+        (_, _, outputs), _ = jax.lax.scan(
+            tick, (msg0, arrived0, out0), jnp.arange(T))
+        return outputs[None]  # local [1, n_micro, ...] -> global [pp, ...]
 
     return schedule
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import re
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -358,6 +359,64 @@ def ignore_module(modules):
     pass
 
 
+_LAYER_IDX_RE = re.compile(r"(?:^|\.)layers\.(\d+)\.")
+
+
+def _overlap_gather_plan(names, n_buckets: int) -> List[List[str]]:
+    """Group param names into contiguous layer-group buckets for the
+    head-of-step re-gather (ZeRO-1 ``shard_update(overlap_gather=True)``).
+
+    Names matching ``...layers.<i>...`` are bucketed by layer index into
+    ``n_buckets`` contiguous groups; everything else (embeddings, final
+    norm, lm head) joins the first bucket — those leaves are either
+    consumed immediately (embedding) or independent of almost the whole
+    forward (head/norm), so their schedule position barely matters.
+    Bucketing only controls gather *issue order* (buckets are chained with
+    ``optimization_barrier``); correctness never depends on the grouping.
+    """
+    idx_of = {}
+    for n in names:
+        m = _LAYER_IDX_RE.search(n)
+        if m:
+            idx_of[n] = int(m.group(1))
+    layer_order = sorted(set(idx_of.values()))
+    if not layer_order:
+        return [list(names)]
+    g = max(1, min(int(n_buckets), len(layer_order)))
+    group_of = {li: i * g // len(layer_order)
+                for i, li in enumerate(layer_order)}
+    buckets: List[List[str]] = [[] for _ in range(g)]
+    for n in names:
+        buckets[group_of.get(idx_of.get(n, layer_order[0]), 0)].append(n)
+    return [b for b in buckets if b]
+
+
+def _gather_bucketed(params, plan, mesh):
+    """Re-gather sharded params to replicated, one bucket at a time.
+
+    Each bucket's leaves get a replicated sharding constraint (GSPMD emits
+    the all-gather); bucket k+1's *sharded* inputs are routed through an
+    ``optimization_barrier`` together with one of bucket k's gathered
+    outputs, so the scheduler cannot issue every gather up front — bucket
+    k+1's gather starts after bucket k's completes, i.e. behind bucket k's
+    forward compute.  ``optimization_barrier`` is identity on its operands:
+    bit-exactness with the sequential path is structural."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    out = dict(params)
+    prev = None
+    for bucket in plan:
+        vals = {n: out[n] for n in bucket}
+        if prev is not None:
+            vals, _ = jax.lax.optimization_barrier((vals, prev))
+        vals = {n: jax.lax.with_sharding_constraint(v, rep)
+                for n, v in vals.items()}
+        out.update(vals)
+        prev = vals[bucket[0]]
+    return out
+
+
 class TrainStep:
     """Compile forward+backward+optimizer into one XLA executable.
 
@@ -413,6 +472,9 @@ class TrainStep:
         init_fn, update_fn = optimizer.functional()
         self._opt_state = init_fn(self._params)
         wus = getattr(optimizer, "_wus", None)
+        overlap_active = getattr(optimizer, "_wus_overlap_active",
+                                 lambda: False)()
+        gather_plan = None
         if wus is not None:
             # ZeRO-1 (shard_update) constrains the update to the optimizer's
             # mesh; state committed to a single device would conflict with
@@ -421,10 +483,29 @@ class TrainStep:
             from jax.sharding import NamedSharding, PartitionSpec
 
             rep = NamedSharding(wus[0], PartitionSpec())
-            self._params = jax.device_put(self._params, rep)
+            if overlap_active:
+                # overlap_gather: the step consumes and produces *sharded*
+                # params (gathered to replicated at the head of step_fn, in
+                # layer buckets, behind the forward).  Start them sharded so
+                # step 1 compiles the same executable as steady state.
+                from ..optimizer.optimizer import _wus_partition_spec
+
+                mesh, axis = wus
+                n = mesh.shape[axis]
+                self._params = {
+                    name: jax.device_put(
+                        a, NamedSharding(
+                            mesh, _wus_partition_spec(a.shape, n, axis)))
+                    for name, a in self._params.items()}
+                gather_plan = _overlap_gather_plan(
+                    list(self._params),
+                    getattr(optimizer, "_wus_buckets", 4))
+            else:
+                self._params = jax.device_put(self._params, rep)
             self._buffers = jax.device_put(self._buffers, rep)
             self._opt_state = jax.device_put(self._opt_state, rep)
         self._update_fn = update_fn
+        self._gather_plan = gather_plan
         self._step = 0
         grad_clip = optimizer._grad_clip
 
@@ -438,6 +519,11 @@ class TrainStep:
             return jax.value_and_grad(loss_of)(params)
 
         def step_fn(params, buffers, opt_state, lr, step, key, args):
+            if gather_plan is not None:
+                # head-of-step bucketed re-gather of last step's sharded
+                # update: bucket k+1's all-gather issues behind bucket k's
+                # forward layers instead of serializing at the update tail
+                params = _gather_bucketed(params, gather_plan, wus[0])
             if grads_fn is not None:
                 loss, grads = grads_fn(params, buffers, *args)
             elif accumulate_steps > 1:

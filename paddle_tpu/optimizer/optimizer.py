@@ -19,6 +19,7 @@ training dtype is bf16.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -74,6 +75,8 @@ class Optimizer:
         self._state: Optional[List[Dict[str, jax.Array]]] = None
         self._jitted_update = None
         self._wus: Optional[tuple] = None  # (jax Mesh, axis name) — shard_update()
+        self._wus_overlap = False          # gather at head of next step, not tail
+        self._wus_buckets = 4              # layer groups per head-of-step gather
 
     # -- lr ------------------------------------------------------------------
     def get_lr(self) -> float:
@@ -113,7 +116,8 @@ class Optimizer:
         return None
 
     # -- cross-replica sharded weight update (ZeRO-1, arXiv:2004.13336) --------
-    def shard_update(self, mesh=None, axis: Optional[str] = None):
+    def shard_update(self, mesh=None, axis: Optional[str] = None,
+                     overlap_gather: bool = False, gather_buckets: int = 4):
         """Shard the weight update across the data-parallel mesh axis.
 
         The optimizer slots (m/v/master) and the whole update computation are
@@ -125,12 +129,23 @@ class Optimizer:
         on its slice and the all-gather moves bits unchanged
         (tests/test_fused_adamw.py asserts exact equality on the CPU mesh).
 
+        ``overlap_gather=True`` moves the all-gather off the update's tail:
+        ``functional()``'s update returns params still *sharded*, and the
+        consumer (``jit.TrainStep``) re-gathers them at the head of the next
+        step in ``gather_buckets`` layer groups, so bucket k+1's gather
+        rides behind bucket k's forward compute instead of serializing
+        after the update.  Same all-gather, different schedule position —
+        bits are unchanged (the gather is a data movement).  The eager
+        ``step()`` path ignores the flag (eager Tensors must stay
+        replicated between calls).
+
         ``mesh`` may be a ``ProcessMesh``, a jax ``Mesh`` or None (use the
         global mesh).  ``axis`` defaults to ``'dp'`` when present, else the
         first mesh axis.  Pass ``mesh=False`` to disable.
         """
         if mesh is False:
             self._wus = None
+            self._wus_overlap = False
             self._jitted_update = None
             return self
         if mesh is None:
@@ -145,8 +160,20 @@ class Optimizer:
         if axis not in jm.shape:
             raise ValueError(f"shard_update: axis {axis!r} not in mesh axes {tuple(jm.shape)}")
         self._wus = (jm, axis)
+        self._wus_overlap = bool(overlap_gather)
+        self._wus_buckets = max(1, int(gather_buckets))
         self._jitted_update = None  # retrace with constraints
         return self
+
+    def _wus_overlap_active(self) -> bool:
+        """Whether the functional update should leave params sharded for a
+        head-of-next-step gather.  ``OVERLAP_GATE_INJECT=serialize`` forces
+        the sequential tail-gather path regardless of ``overlap_gather`` —
+        the injection hook ``scripts/overlap_gate.sh`` uses to prove the
+        gate fails when overlap is lost."""
+        if os.environ.get("OVERLAP_GATE_INJECT", "") == "serialize":
+            return False
+        return self._wus is not None and self._wus_overlap
 
     def _wus_constrain(self, x, replicate: bool = False):
         if self._wus is None:
@@ -319,6 +346,7 @@ class Optimizer:
         from ..kernels.adamw import fused_enabled
 
         fused_on, interpret = fused_enabled()  # composes with _wus, see _build_update_fn
+        overlap = self._wus_overlap_active()
 
         def init_fn(params):
             def per_leaf(p):
@@ -352,7 +380,9 @@ class Optimizer:
                 if "master" in s:
                     slots_new["master"] = p32_new
                 slots_new = {k: self_ref._wus_constrain(v) for k, v in slots_new.items()}
-                return self_ref._wus_constrain(p_out, replicate=True), slots_new
+                # overlap: leave params sharded — TrainStep re-gathers them at
+                # the head of the next step, bucketed behind the forward
+                return self_ref._wus_constrain(p_out, replicate=not overlap), slots_new
 
             flat_p, treedef = jax.tree.flatten(params)
             flat_g = treedef.flatten_up_to(grads)

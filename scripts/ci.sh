@@ -9,6 +9,8 @@
 #   schedule_gate pipeline-schedule matrix + host self-lint
 #   reshard_gate  resharding property suite + plan-peak audit vs
 #                 scripts/RESHARD_BASELINE.json
+#   overlap_gate  collective-overlap analyzer (exposed all-gather drop
+#                 >= 50% + counts) vs scripts/OVERLAP_BASELINE.json
 #   host_lint     standalone self-lint summary line (rc 1 on any finding)
 #
 # Exit code: number of failed stages (0 = green).
@@ -37,6 +39,7 @@ stage mem_gate      ./scripts/mem_gate.sh
 stage schedule_gate ./scripts/schedule_gate.sh
 stage reshard_gate  ./scripts/reshard_gate.sh
 stage serve_gate    ./scripts/serve_gate.sh
+stage overlap_gate  ./scripts/overlap_gate.sh
 stage store_chaos   bash -c "\
     timeout -k 10 300 python -m pytest -q -p no:cacheprovider \
         tests/test_store_replicated.py \

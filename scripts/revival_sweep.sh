@@ -2,9 +2,18 @@
 # Manual post-revival measurement sweep (run AFTER the watcher's RECAPTURE
 # sweep finishes so the two don't contend for the chip):
 #   1. gradient-accumulation sweep on the base preset (the next MFU lever:
-#      one AdamW pass per k micro-batches; bf16 accumulator fits HBM)
-#   2. serving-engine run at the post-rework SHA (batched prefill + sampling)
-#   3. an on-chip smoke of the sampling program (has only ever run on CPU)
+#      one AdamW pass per k micro-batches; bf16 accumulator fits HBM).
+#      CPU-mesh proxy ladder (tiny, scan-measured step time, 2026-08-05):
+#      4488 -> 11102 -> 12238 tokens/s at accum 1 -> 2 -> 4 — the
+#      amortized optimizer is worth ~2.7x on a bandwidth-starved backend;
+#      these rows put the real-chip numbers next to that.
+#   2. ZeRO-1 gather/compute overlap A/B on the wus presets: --wus seq vs
+#      --wus overlap, --overlap so each line carries the analyzer's
+#      exposed-bytes split for the on-chip schedule (CPU-proxy drop on
+#      small: 81% of exposed all-gather bytes; the analytic ~47 ms/step
+#      optimizer win quoted in PERF.md is re-measured here)
+#   3. serving-engine run at the post-rework SHA (batched prefill + sampling)
+#   4. an on-chip smoke of the sampling program (has only ever run on CPU)
 # Results append to BENCH_ACCUM_SWEEP.jsonl (NOT the driver cache: the accum
 # rows change the preset's global-batch semantics; promote the winner into
 # BENCH_TPU_CACHE.jsonl only deliberately, with its "accum" field visible).
@@ -14,6 +23,13 @@ for args in "--accum 2 --grad-dtype bfloat16" "--accum 4 --grad-dtype bfloat16" 
     echo "[revival] base $args" >&2
     line=$(timeout 2400 python bench.py --preset base --device tpu $args 2>/dev/null | tail -1)
     [ -n "$line" ] && echo "$line" >> "$OUT" && echo "$line" | head -c 200 >&2 && echo >&2
+done
+for args in "--wus seq --overlap" "--wus overlap --overlap"; do
+    for preset in small base; do
+        echo "[revival] $preset $args" >&2
+        line=$(timeout 2400 python bench.py --preset $preset --device tpu $args 2>/dev/null | tail -1)
+        [ -n "$line" ] && echo "$line" >> "$OUT" && echo "$line" | head -c 200 >&2 && echo >&2
+    done
 done
 echo "[revival] serve (post-rework)" >&2
 line=$(timeout 2400 python bench.py --preset serve --device tpu 2>/dev/null | tail -1)

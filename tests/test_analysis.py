@@ -298,3 +298,101 @@ def test_lint_gate_diff_semantics():
     code = "unintended-collective"
     assert clean.counts().get(code, 0) == 0
     assert regressed.counts().get(code, 0) > clean.counts().get(code, 0)
+
+
+# ---------------------------------------------------------------------------
+# overlap analyzer: exposed collectives vs compute-hidden collectives
+
+
+# every compute op downstream of the gather: nothing can run beside it
+_EXPOSED_HLO = """\
+HloModule exposed
+
+ENTRY main {
+  p0 = f32[1024,1024]{1,0} parameter(0)
+  p1 = f32[1024,1024]{1,0} parameter(1)
+  ag = f32[4096,1024]{1,0} all-gather(p0), dimensions={0}
+  d0 = f32[4096,1024]{1,0} dot(ag, p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT d1 = f32[4096,1024]{1,0} dot(d0, p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+
+# async gather with >= 2x its bytes of independent dots schedulable
+# beside it (one inside the start/done window, one after)
+_OVERLAPPED_HLO = """\
+HloModule clean_overlap
+
+ENTRY main {
+  p0 = f32[256,512]{1,0} parameter(0)
+  p1 = f32[1024,1024]{1,0} parameter(1)
+  ags = (f32[256,512]{1,0}, f32[1024,512]{1,0}) all-gather-start(p0), dimensions={0}
+  ind = f32[1024,1024]{1,0} dot(p1, p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  agd = f32[1024,512]{1,0} all-gather-done(ags)
+  more = f32[1024,1024]{1,0} dot(ind, p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT r = f32[1024,512]{1,0} dot(more, agd), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+
+
+def test_overlap_exposed_collective_caught():
+    """A gather whose result feeds ALL downstream compute has zero
+    independent work to hide behind: one comm-exposed finding, fully
+    exposed, severity high."""
+    from paddle_tpu.analysis import overlap_report
+
+    rep = overlap_report(_EXPOSED_HLO)
+    assert rep.counts() == {"comm-exposed": 1}, rep.report()
+    (f,) = rep.by_code("comm-exposed")
+    assert f.severity == "high"
+    ag_bytes = 4096 * 1024 * 4
+    assert rep.meta["overlap_collective_bytes"] == ag_bytes
+    assert rep.meta["overlap_exposed_bytes"] == ag_bytes  # frac 1.0
+    assert rep.meta["overlap_exposed_fraction"] == pytest.approx(1.0)
+    assert rep.meta["overlap_exposed_by_kind"] == {"all-gather": ag_bytes}
+
+
+def test_overlap_hidden_collective_clean():
+    """An async gather with enough independent compute beside it must
+    report ZERO findings (false positives would poison the gate)."""
+    from paddle_tpu.analysis import overlap_report
+
+    rep = overlap_report(_OVERLAPPED_HLO)
+    assert len(rep) == 0, rep.report()
+    assert rep.meta["overlap_collectives"] == 1
+    assert rep.meta["overlap_exposed_bytes"] == 0
+    (d,) = rep.meta["overlap_detail"]
+    assert d["async"] and d["kind"] == "all-gather"
+    # required = bytes * factor, fully covered by the independent dots
+    assert d["hidden_compute"] >= d["required_compute"]
+
+
+def test_overlap_min_bytes_floor():
+    """Sub-KiB collectives (loop counters, flags) are noise, not latency:
+    below the floor the analyzer must not even count them."""
+    from paddle_tpu.analysis import overlap_report
+
+    tiny = _EXPOSED_HLO.replace("4096,1024", "8,8").replace("1024,1024", "8,8")
+    rep = overlap_report(tiny)
+    assert rep.meta["overlap_collectives"] == 0
+    assert len(rep) == 0, rep.report()
+
+
+def test_overlap_lowered_on_real_sharded_program(mesh):
+    """End-to-end through the compiled-HLO path: a matmul whose rhs must
+    be gathered right before the only dot is an exposed collective."""
+    from paddle_tpu.analysis import overlap_lowered
+
+    def f(a, b):
+        return a @ b
+
+    a = jnp.ones((512, 512))
+    b = jnp.ones((512, 512))
+    shard = jax.sharding.NamedSharding(mesh, P("x", "y"))
+    lowered = jax.jit(f, in_shardings=(shard, shard),
+                      out_shardings=shard).lower(a, b)
+    rep = overlap_lowered(lowered)
+    assert rep.meta["overlap_collectives"] >= 1
+    # whatever the partitioner emitted, meta invariants must hold
+    assert (rep.meta["overlap_exposed_bytes"]
+            <= rep.meta["overlap_collective_bytes"])
+    assert len(rep.meta["overlap_detail"]) == rep.meta["overlap_collectives"]

@@ -325,3 +325,122 @@ def test_allgather_roundtrip_bit_exact():
             y, NamedSharding(mesh, PartitionSpec()))
 
     np.testing.assert_array_equal(np.asarray(roundtrip(x)), np.asarray(x))
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 gather/compute overlap (PR-13): the post-update all-gather moves
+# to the head of the NEXT step, bucketed per layer group, and interleaves
+# with the forward — same dataflow, so training must stay bit-identical
+# ---------------------------------------------------------------------------
+
+
+def _overlap_net(depth=4, dim=64, seed=0):
+    import paddle_tpu.nn as nn
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Linear(dim, dim)
+            self.layers = nn.LayerList([nn.Linear(dim, dim)
+                                        for _ in range(depth)])
+            self.head = nn.Linear(dim, dim)
+
+        def forward(self, x):
+            h = self.embed(x)
+            for lyr in self.layers:
+                h = nn.functional.relu(lyr(h))
+            return self.head(h)
+
+    m = Net()
+    rng = np.random.default_rng(seed)
+    for n, p in m.named_parameters():
+        p._data = jnp.asarray(
+            rng.standard_normal(p.shape).astype(np.float32) * 0.05)
+    return m
+
+
+def _overlap_train(cls, overlap, n_steps=3, dim=64, buckets=2, **kw):
+    from paddle_tpu.jit import TrainStep
+
+    def loss_fn(model, x, y):
+        return ((model(x) - y) ** 2).mean()
+
+    model = _overlap_net(dim=dim)
+    opt = cls(learning_rate=1e-3, parameters=model.parameters(), **kw)
+    opt.shard_update(_mesh8(), overlap_gather=overlap, gather_buckets=buckets)
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.default_rng(99)
+    losses = []
+    for _ in range(n_steps):
+        x = paddle.to_tensor(rng.standard_normal((8, dim)).astype(np.float32))
+        y = paddle.to_tensor(rng.standard_normal((8, dim)).astype(np.float32))
+        losses.append(float(step(x, y)))
+    params = {n: np.asarray(a) for n, a in step._params.items()}
+    state = jax.tree_util.tree_map(np.asarray, step._opt_state)
+    return losses, params, state, step
+
+
+@needs_8_devices
+def test_overlap_gather_adam_bit_identical():
+    """Head-of-step bucketed gather vs sequential tail gather: identical
+    dataflow per leaf, so losses, params, AND m/v slots must match
+    bitwise over multiple steps — the overlap is free or it is wrong."""
+    l_s, p_s, s_s, _ = _overlap_train(paddle.optimizer.Adam, overlap=False)
+    l_o, p_o, s_o, st = _overlap_train(paddle.optimizer.Adam, overlap=True)
+    assert l_s == l_o, (l_s, l_o)
+    assert st._gather_plan is not None and len(st._gather_plan) == 2
+    for n in p_s:
+        np.testing.assert_array_equal(p_s[n], p_o[n], err_msg=n)
+    for a, b in zip(jax.tree_util.tree_leaves(s_s),
+                    jax.tree_util.tree_leaves(s_o)):
+        np.testing.assert_array_equal(a, b)
+
+
+@needs_8_devices
+def test_overlap_gather_adamw_slots_exact_params_close():
+    """The weight-decay fmsub is a contraction site the recompiled program
+    may fuse differently, so params carry ~ulp-of-update noise per step —
+    and unlike the synthetic-grad harness above, grads here flow through
+    the forward, so from step 2 the noise reaches m/v too.  Everything
+    must stay within a few ulps; wd=0 (the Adam test) is the bit-exact
+    bar."""
+    l_s, p_s, s_s, _ = _overlap_train(paddle.optimizer.AdamW, overlap=False,
+                                      weight_decay=WD)
+    l_o, p_o, s_o, _ = _overlap_train(paddle.optimizer.AdamW, overlap=True,
+                                      weight_decay=WD)
+    np.testing.assert_allclose(l_s, l_o, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(s_s),
+                    jax.tree_util.tree_leaves(s_o)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-10)
+    for n in p_s:
+        np.testing.assert_allclose(p_s[n], p_o[n], rtol=1e-5, atol=1e-7,
+                                   err_msg=n)
+
+
+@needs_8_devices
+def test_overlap_inject_serialize_disables_overlap(monkeypatch):
+    """The gate's defect injection: OVERLAP_GATE_INJECT=serialize makes
+    the overlap build silently fall back to the sequential tail gather —
+    exactly the regression class overlap_gate.sh must detect."""
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=[])
+    opt.shard_update(_mesh8(), overlap_gather=True)
+    assert opt._wus_overlap_active()
+    monkeypatch.setenv("OVERLAP_GATE_INJECT", "serialize")
+    assert not opt._wus_overlap_active()
+
+
+def test_overlap_gather_plan_buckets_layers():
+    """Layer-indexed params split into contiguous groups; non-layer params
+    (embed, head) ride in bucket 0 so no gather is orphaned."""
+    from paddle_tpu.jit import _overlap_gather_plan
+
+    names = (["embed.weight", "head.weight"]
+             + [f"layers.{i}.weight" for i in range(6)])
+    plan = _overlap_gather_plan(names, 3)
+    assert [sorted(b) for b in plan] == [
+        sorted(["embed.weight", "head.weight",
+                "layers.0.weight", "layers.1.weight"]),
+        ["layers.2.weight", "layers.3.weight"],
+        ["layers.4.weight", "layers.5.weight"]]
+    # no layer structure at all: one replicated bucket
+    assert _overlap_gather_plan(["a", "b"], 4) == [["a", "b"]]

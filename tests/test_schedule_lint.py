@@ -97,6 +97,70 @@ def test_vpp_requires_divisible_micro():
 
 
 # ---------------------------------------------------------------------------
+# double-buffered transfers: hop_ticks=2 schedules
+
+
+@pytest.mark.parametrize("S,M", [(2, 4), (4, 8)])
+def test_double_buffer_gpipe_lints_clean(S, M):
+    sched = build_schedule("GPipe", S, M, double_buffer=True)
+    assert sched.hop_ticks == 2
+    assert sched.total_ticks == M + 2 * (S - 1)
+    rep = lint_schedule(sched)
+    assert not rep.counts(), rep.report()
+
+
+def test_double_buffer_only_gpipe():
+    with pytest.raises(ValueError):
+        build_schedule("1F1B", 2, 4, double_buffer=True)
+
+
+def test_seeded_hop_lag_defect_caught():
+    """A double-buffered comm edge whose lag is quietly 1 instead of 2
+    means the consumer fires before the transfer lands: the verifier must
+    refuse the schedule."""
+    sched = build_schedule("GPipe", 2, 4, double_buffer=True)
+    bad = [dataclasses.replace(e, min_lag=1) if e.comm else e
+           for e in sched.edges]
+    sched = dataclasses.replace(sched, edges=bad)
+    rep = lint_schedule(sched)
+    assert rep.counts(), "lag-1 comm under hop_ticks=2 must not lint clean"
+
+
+def test_seeded_eager_warmup_caught():
+    """Stage s starting at tick s (single-hop warmup) in a hop_ticks=2
+    schedule consumes data a tick before the double-buffered transfer
+    delivers it."""
+    sched = build_schedule("GPipe", 2, 4, double_buffer=True)
+    key = ("F", 1, 0, 0)
+    sched.ops[key] = dataclasses.replace(sched.ops[key], tick=1)
+    rep = lint_schedule(sched)
+    assert rep.counts(), rep.report()
+
+
+def test_bubble_transfer_cost_model():
+    """x = per-hop transfer/dispatch overhead. Single-buffered GPipe pays
+    it serially (round f+x); double-buffered pays max(f, x) over two
+    rounds per hop. x=0 must reproduce the committed closed forms."""
+    # x=0: identical to the historical numbers
+    assert bubble_fraction("GPipe", 2, 4)["fraction"] == pytest.approx(1 / 5)
+    assert bubble_fraction("GPipe", 2, 4, hop_ticks=2)["fraction"] == (
+        pytest.approx(2 / 6))
+    # x > 0, x < f: double-buffering hides the transfer entirely —
+    # ideal time stays M*f while single-buffering pays M*(f+x)
+    costs = {"f": 1.0, "x": 0.4}
+    sb = bubble_fraction("GPipe", 2, 8, costs=costs)
+    db = bubble_fraction("GPipe", 2, 8, costs=costs, hop_ticks=2)
+    assert sb["total_units"] == pytest.approx((8 + 1) * 1.4)
+    assert db["total_units"] == pytest.approx(8 + 2)
+    assert db["total_units"] < sb["total_units"]
+    # x > f: the transfer dominates and double-buffering can no longer
+    # hide it — the model must show the regime flip, not hide it
+    slow = {"f": 1.0, "x": 3.0}
+    db2 = bubble_fraction("GPipe", 2, 8, costs=slow, hop_ticks=2)
+    assert db2["total_units"] == pytest.approx(3.0 * 10)
+
+
+# ---------------------------------------------------------------------------
 # rank-divergent collective (jaxpr level)
 
 

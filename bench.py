@@ -233,7 +233,7 @@ def _step_flops_of(lowered) -> float:
 
 def build_pretrain_step(preset: str, on_tpu: bool, batch=None, seq=None,
                         steps=None, accum: int = 1, grad_dtype=None,
-                        wus: str = "off"):
+                        wus: str = "off", plan=None):
     """Construct the pretrain TrainStep for a tiny/small/base/longctx preset.
 
     Shared by ``main`` and ``scripts/capture_evidence.py`` so the committed
@@ -245,6 +245,13 @@ def build_pretrain_step(preset: str, on_tpu: bool, batch=None, seq=None,
     dp mesh spanning all devices, sequential tail all-gather) or
     ``"overlap"`` (same sharded update, params re-gathered at the head of
     the next step in layer buckets behind the forward).
+
+    ``plan``: an ``analysis.autotune.PlanConfig`` (the tuner's output, or
+    a deserialized ``--plan`` file).  Explicit arguments win; unset ones
+    fall back to the plan's batch/seq/accum/grad_dtype/ZeRO fields, and the
+    plan's remat setting maps onto the model config (``recompute`` /
+    ``recompute_layers``) — so an A/B against a tuned plan needs no code
+    edits.
     """
     import numpy as np
 
@@ -254,8 +261,21 @@ def build_pretrain_step(preset: str, on_tpu: bool, batch=None, seq=None,
     if preset not in DEFAULTS:
         raise ValueError(f"not a pretrain preset: {preset!r} "
                          f"(choose from {sorted(DEFAULTS)})")
+    if plan is not None:
+        batch = batch or plan.batch
+        seq = seq or plan.seq
+        if accum == 1:
+            accum = plan.accum
+        grad_dtype = grad_dtype or plan.grad_dtype
+        if wus == "off":
+            wus = plan.wus
     dtype = "bfloat16" if on_tpu else "float32"
     cfg = build_config(preset, dtype)
+    if plan is not None and plan.remat != "off":
+        if plan.remat == "full":
+            cfg.recompute = True
+        elif plan.remat_layers is not None:
+            cfg.recompute_layers = plan.remat_layers
     d_batch, d_seq, d_steps = DEFAULTS[preset]
     batch = batch or d_batch
     seq = min(seq or d_seq, cfg.max_position_embeddings)
@@ -848,12 +868,14 @@ def _bench_ocr(jax, paddle, backend, on_tpu, args):
     }
 
 
-def _bench_moe(jax, paddle, backend, on_tpu, args):
-    """Llama-MoE train step (configs[4] shape: few dense layers' worth of
-    active params routed over many experts).  FLOPs from XLA cost analysis —
-    top-k routing makes the dense 6P closed form wrong."""
+def build_moe_step(on_tpu: bool, batch=None, seq=None, steps=None,
+                   accum: int = 1):
+    """Construct the MoE TrainStep (configs[4] shape).  Mirrors
+    ``build_pretrain_step``'s contract so the tuner can sweep the moe
+    preset too; shared by ``_bench_moe`` and the autotune tests."""
     import numpy as np
 
+    import paddle_tpu as paddle
     from paddle_tpu.models import LlamaForCausalLM
     from paddle_tpu.models.llama import LlamaConfig
 
@@ -864,22 +886,35 @@ def _bench_moe(jax, paddle, backend, on_tpu, args):
                           num_hidden_layers=12, num_attention_heads=16,
                           num_key_value_heads=8, max_position_embeddings=2048,
                           dtype=dtype, moe_num_experts=8, moe_top_k=2)
-        batch, seq, steps = (args.batch or 4), (args.seq or 2048), (args.steps or 10)
+        batch, seq, steps = (batch or 4), (seq or 2048), (steps or 10)
     else:
         from paddle_tpu.models import llama_tiny_config
 
         cfg = llama_tiny_config(dtype=dtype, moe_num_experts=4, moe_top_k=2)
-        batch, seq, steps = (args.batch or 2), (args.seq or 128), (args.steps or 3)
+        batch, seq, steps = (batch or 2), (seq or 128), (steps or 3)
     model = LlamaForCausalLM(cfg)
-    n_params = sum(p.size for p in model.parameters())
     opt = paddle.optimizer.AdamW(learning_rate=3e-4, parameters=model.parameters())
 
     def loss_fn(m, ids):
         return m.compute_loss(m(ids), ids)
 
-    step_fn = paddle.jit.TrainStep(model, loss_fn, opt)
+    step_fn = paddle.jit.TrainStep(model, loss_fn, opt, accumulate_steps=accum)
     rng = np.random.default_rng(0)
-    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32))
+    shape = (accum, batch, seq) if accum > 1 else (batch, seq)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, size=shape).astype(np.int32))
+    return step_fn, ids, model, cfg, (batch, seq, steps)
+
+
+def _bench_moe(jax, paddle, backend, on_tpu, args):
+    """Llama-MoE train step (configs[4] shape: few dense layers' worth of
+    active params routed over many experts).  FLOPs from XLA cost analysis —
+    top-k routing makes the dense 6P closed form wrong."""
+    import numpy as np
+
+    step_fn, ids, model, cfg, (batch, seq, steps) = build_moe_step(
+        on_tpu, batch=args.batch, seq=args.seq, steps=args.steps)
+    n_params = sum(p.size for p in model.parameters())
 
     loss = step_fn(ids)
     first_loss = float(np.asarray(loss._data))  # host read = true sync
@@ -989,11 +1024,31 @@ def main():
                          "the step but skip the timed run (bytes_per_step "
                          "without executing — lets the bytes gate cover "
                          "presets too slow to run on the CPU proxy)")
+    ap.add_argument("--plan", default=None, metavar="PATH",
+                    help="run a serialized PlanConfig JSON (see "
+                         "paddle_tpu.analysis.autotune) instead of the named "
+                         "preset defaults; explicit --batch/--seq/--accum/"
+                         "--wus flags still win over plan fields")
+    ap.add_argument("--tune", action="store_true",
+                    help="run the static auto-parallel sweep "
+                         "(paddle_tpu.analysis.autotune) over the preset's "
+                         "candidate grid, print the ranked table to stderr, "
+                         "adopt the chosen plan for the run, and add tune_* "
+                         "fields to the BENCH line")
+    ap.add_argument("--tune-out", default=None, metavar="PATH",
+                    help="with --tune: write the chosen plan as JSON here "
+                         "(replayable via --plan)")
     args = ap.parse_args()
     if args.audit_only:
         args.audit = True
     if args.hbm_budget is not None:
         args.mem = True
+    # read the plan file with plain json BEFORE the jax import: whether the
+    # plan wants a ZeRO dp mesh decides the 8-host-device XLA flag below
+    plan_dict = None
+    if args.plan:
+        with open(args.plan) as f:
+            plan_dict = json.load(f)
 
     fallback = False
     probe = "cpu" if args.device == "cpu" else ("tpu" if args.device == "tpu"
@@ -1004,15 +1059,20 @@ def main():
         # a cached plain-serve line cannot satisfy a --trace request (different
         # metric contract) — trace runs always execute on the CPU proxy
         if (fallback and not custom_shape and not args.trace
-                and args.wus == "off"):
+                and args.wus == "off" and not args.tune and not args.plan):
             cached = _cached_tpu_result(args.preset)
             if cached is not None:
                 # no _stamp: re-stamping would falsify capture provenance
                 print(json.dumps(cached))
                 return
-        if args.wus != "off":
+        if (args.wus != "off"
+                or (args.tune and args.preset in ("small", "base"))
+                or (plan_dict or {}).get("zero")):
             # the ZeRO-1 dp mesh needs devices to shard over; fake 8 host
-            # devices (must land before the first jax import in-process)
+            # devices (must land before the first jax import in-process).
+            # --tune only needs them where the grid has ZeRO candidates
+            # (small/base) — the 8-way split slows the single-program
+            # timed run, so tiny/moe sweeps stay on one device
             import os
 
             os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
@@ -1027,11 +1087,43 @@ def main():
     if fallback:
         backend = "cpu-fallback"
     on_tpu = backend not in ("cpu", "cpu-fallback")
-    preset = args.preset or ("base" if on_tpu else "tiny")
+    preset = (args.preset or (plan_dict or {}).get("preset")
+              or ("base" if on_tpu else "tiny"))
 
     import numpy as np
 
     import paddle_tpu as paddle
+
+    run_plan = None
+    if plan_dict is not None:
+        from paddle_tpu.analysis.autotune import PlanConfig
+
+        run_plan = PlanConfig.from_dict(plan_dict)
+
+    tune_fields = {}
+    if args.tune and preset in ("tiny", "small", "base", "longctx", "moe"):
+        import paddle_tpu.analysis.autotune as at
+
+        def _tune_builder(p):
+            if p.preset == "moe":
+                sf, pids, _m, _c, (b, s, _st) = build_moe_step(
+                    on_tpu, batch=p.batch, seq=p.seq, accum=p.accum)
+            else:
+                sf, pids, _m, _c, (b, s, _st) = build_pretrain_step(
+                    p.preset, on_tpu, plan=p)
+            return (lower_pretrain_step(sf, pids),
+                    max(1, p.accum) * b * s)
+
+        budget = args.hbm_budget or at.default_budget(preset, on_tpu)
+        res = at.sweep(preset, _tune_builder, hbm_budget=budget,
+                       on_tpu=on_tpu, n_devices=jax.device_count(),
+                       log=lambda m: print(m, file=sys.stderr))
+        print(res.table(), file=sys.stderr)
+        tune_fields = res.to_meta()
+        if res.chosen is not None:
+            run_plan = res.chosen.plan
+            if args.tune_out:
+                run_plan.save(args.tune_out)
 
     if preset == "decode":
         result = _bench_decode(jax, paddle, backend, on_tpu, args)
@@ -1049,14 +1141,26 @@ def main():
         print(json.dumps(_stamp(result)))
         return
     if preset == "moe":
+        if run_plan is not None:
+            args.batch = args.batch or run_plan.batch
+            args.seq = args.seq or run_plan.seq
         result = _bench_moe(jax, paddle, backend, on_tpu, args)
+        result.update(tune_fields)
         print(json.dumps(_stamp(result)))
         return
 
+    # mirror build_pretrain_step's plan resolution so the tokens/s math
+    # below sees the effective accum/wus
     accum = max(1, args.accum)
+    eff_wus = args.wus
+    if run_plan is not None:
+        if accum == 1:
+            accum = max(1, run_plan.accum)
+        if eff_wus == "off":
+            eff_wus = run_plan.wus
     step_fn, ids, model, cfg, (batch, seq, steps) = build_pretrain_step(
         preset, on_tpu, batch=args.batch, seq=args.seq, steps=args.steps,
-        accum=accum, grad_dtype=args.grad_dtype, wus=args.wus)
+        accum=accum, grad_dtype=args.grad_dtype, wus=eff_wus, plan=run_plan)
     n_params = sum(p.size for p in model.parameters())
 
     lowered = lower_pretrain_step(step_fn, ids)
@@ -1065,8 +1169,11 @@ def main():
     bytes_fields.update(_mem_fields(lowered, args.mem, label=preset,
                                     hbm_budget=args.hbm_budget))
     bytes_fields.update(_overlap_fields(lowered, args.overlap, label=preset))
-    if args.wus != "off":
-        bytes_fields["wus"] = args.wus
+    if eff_wus != "off":
+        bytes_fields["wus"] = eff_wus
+    bytes_fields.update(tune_fields)
+    if run_plan is not None:
+        bytes_fields["plan"] = run_plan.label()
 
     if args.audit_only:
         print(json.dumps(_stamp({

@@ -1,0 +1,38 @@
+"""Static auto-parallel tuner: the five analyzers turned from gates into a
+search.
+
+* :mod:`plan` — :class:`PlanConfig`, the serializable candidate record
+  (``bench.py --plan plan.json`` replays a tuner choice);
+* :mod:`scorer` — one static cost vector per candidate from ONE compile:
+  liveness peak vs HBM budget (hard constraint), fusion-audit
+  ``bytes_per_step`` + XLA FLOPs, exposed-collective bytes, closed-form
+  pipeline bubble, planner-modeled reshard transition cost;
+* :mod:`search` — the per-preset grid sweep: prune by HBM first, rank by
+  score, emit a ranked table + chosen plan (``bench.py --tune``,
+  ``scripts/tune_gate.sh``);
+* :mod:`remat_policy` — liveness-driven selective-remat/offload chosen
+  analytically from proven per-buffer peak deltas;
+* :mod:`replan` — mid-flight move of a running job onto the chosen plan
+  via ``fleet.migrate_to_mesh``, bit-identical to a checkpoint resume.
+
+Everything here is compile-time static analysis: no candidate is ever
+executed to be scored.
+"""
+
+from .plan import PlanConfig
+from .remat_policy import RematAction, RematPlan, plan_remat, plan_remat_lowered
+from .replan import replan_live
+from .scorer import (PlanScore, REF_CHIP, score_compiled, score_lowered,
+                     transition_cost)
+from .search import SweepResult, default_budget, default_grid, sweep
+
+__all__ = [
+    "PlanConfig", "PlanScore", "REF_CHIP", "RematAction", "RematPlan",
+    "SweepResult", "default_budget", "default_grid", "plan_remat",
+    "plan_remat_lowered", "replan_live", "score_compiled", "score_lowered",
+    "sweep", "transition_cost",
+]
+
+# hand-picked per-preset default microbatch sizes (mirrors bench.DEFAULTS;
+# the injected bad plan scales these past any budget)
+_DEFAULT_BATCH = {"tiny": 4, "small": 8, "base": 3, "longctx": 1, "moe": 2}

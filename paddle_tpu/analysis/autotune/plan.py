@@ -1,0 +1,100 @@
+"""Candidate parallel-plan configurations for the static auto-tuner.
+
+A :class:`PlanConfig` is one point of the (dp, tp, pp, microbatch/accum,
+ZeRO, overlap_gather, double_buffer, remat, grad dtype) search grid.  It is
+deliberately a plain serializable record — ``bench.py --plan plan.json``
+replays a tuner choice with no code edits, and ``scripts/tune_gate.sh``
+diffs the chosen plan against a committed baseline.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, replace
+from typing import Optional
+
+__all__ = ["PlanConfig"]
+
+
+@dataclass(frozen=True)
+class PlanConfig:
+    """One candidate configuration of the auto-parallel search space."""
+
+    preset: str = "tiny"
+    batch: Optional[int] = None     # per-microbatch size (None: preset default)
+    seq: Optional[int] = None       # sequence length (None: preset default)
+    accum: int = 1                  # gradient-accumulation microbatches
+    dp: int = 1                     # data-parallel degree (ZeRO axis size)
+    tp: int = 1                     # tensor-parallel degree (scored, not run)
+    pp: int = 1                     # pipeline stages (scored via bubble_fraction)
+    schedule: str = "1f1b"          # pipeline schedule kind when pp > 1
+    zero: bool = False              # ZeRO-1 sharded weight update (shard_update)
+    overlap_gather: bool = False    # head-of-step bucketed gather (needs zero)
+    double_buffer: bool = False     # pipeline transfer double-buffering (pp > 1)
+    remat: str = "off"              # "off" | "full" | "policy:<k>" (k layers)
+    grad_dtype: Optional[str] = None  # accumulation dtype override
+    source: str = "hand"            # "hand" | "tuner" | "injected"
+
+    @property
+    def wus(self) -> str:
+        """The ``--wus`` mode this plan maps to (off/seq/overlap)."""
+        if not self.zero:
+            return "off"
+        return "overlap" if self.overlap_gather else "seq"
+
+    @property
+    def remat_layers(self) -> Optional[int]:
+        """Layer count of a ``policy:<k>`` remat setting, else None."""
+        if self.remat.startswith("policy:"):
+            return int(self.remat.split(":", 1)[1])
+        return None
+
+    def label(self) -> str:
+        bits = [self.preset]
+        if self.batch is not None:
+            bits.append(f"b{self.batch}")
+        if self.accum != 1:
+            bits.append(f"a{self.accum}")
+        if self.dp != 1 or self.tp != 1 or self.pp != 1:
+            bits.append(f"dp{self.dp}tp{self.tp}pp{self.pp}")
+        if self.zero:
+            bits.append(f"zero-{self.wus}")
+        if self.pp > 1:
+            bits.append(self.schedule + ("-db" if self.double_buffer else ""))
+        if self.remat != "off":
+            bits.append(f"remat-{self.remat}")
+        if self.grad_dtype:
+            bits.append(self.grad_dtype)
+        if self.source != "hand":
+            bits.append(self.source)
+        return "/".join(bits)
+
+    # --- serialization ---------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json() + "\n")
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PlanConfig":
+        known = set(cls.__dataclass_fields__)
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    @classmethod
+    def from_json(cls, s: str) -> "PlanConfig":
+        return cls.from_dict(json.loads(s))
+
+    @classmethod
+    def from_file(cls, path: str) -> "PlanConfig":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+    def but(self, **kw) -> "PlanConfig":
+        """A copy with fields replaced (grid construction helper)."""
+        return replace(self, **kw)

@@ -1,0 +1,33 @@
+"""Mid-flight re-plan: move a RUNNING job from its current plan to the
+tuner's choice without a restart.
+
+The move itself is ``fleet.migrate_to_mesh`` — every sharded train-state
+leaf travels through the PR 9 resharding planner/executor onto the new
+plan's mesh, keeping its PartitionSpec — and the values land in a fresh
+step function built for the new plan.  The contract (chaos-tested in
+``tests/test_autotune.py``) is that continuing after ``replan_live`` is
+BIT-IDENTICAL to checkpointing on the old plan and resuming on the new
+one: the live path and the disk path are the same planner.
+"""
+
+from __future__ import annotations
+
+__all__ = ["replan_live"]
+
+
+def replan_live(old_step, new_step, dst_mesh) -> dict:
+    """Transfer ``old_step``'s train state into ``new_step`` (built for the
+    tuner-chosen plan) through the resharding engine.
+
+    ``old_step`` / ``new_step`` are ``jit.TrainStep``-like (``state_dict``
+    / ``set_state_dict``); ``dst_mesh`` is the new plan's jax Mesh (None:
+    values move as-is, for plans that only change schedule knobs).
+    Returns ``fleet.migrate_to_mesh``'s stats dict."""
+    from ...distributed.fleet import migrate_to_mesh
+
+    sd = old_step.state_dict()
+    stats = {"arrays": 0, "peak_bytes": 0, "bound_bytes": 0, "bounded": True}
+    if dst_mesh is not None:
+        stats = migrate_to_mesh(sd, dst_mesh)
+    new_step.set_state_dict(sd)
+    return stats

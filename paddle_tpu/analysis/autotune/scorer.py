@@ -1,0 +1,181 @@
+"""Static plan scorer: one cost vector per candidate config, composed from
+the five existing analyzers — nothing is executed.
+
+Per candidate the scorer reads, from ONE compile of the candidate program:
+
+* liveness peak vs the HBM budget (``analysis.liveness`` — the HARD
+  constraint; a plan that does not fit is pruned before ranking),
+* ``bytes_per_step`` from the fusion auditor and FLOPs from XLA cost
+  analysis (the roofline terms),
+* exposed-collective bytes from ``analysis.overlap`` (comm the schedule
+  cannot hide),
+* the pipeline ``bubble_fraction`` with the transfer term from
+  ``analysis.schedule_lint`` (closed form — pp > 1 candidates are scored
+  without building a pipeline),
+* the one-time reshard transition cost from the CURRENT plan via the PR 9
+  planner, amortized over a re-plan horizon.
+
+The scalar ``score`` is modeled seconds per token on a reference chip:
+``(max(flops/F, bytes/BW_hbm) + exposed/BW_ici) / (1 - bubble)`` plus the
+amortized transition, divided by tokens per step.  Absolute values are
+only as good as the reference constants; RANKINGS are what the tuner
+consumes, and those are validated against measured tok/s orderings in
+``tests/test_autotune.py`` and gated by ``scripts/tune_gate.sh``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..liveness import analyze_text, xla_peak_bytes
+from ..overlap import overlap_report
+from ..schedule_lint import bubble_fraction
+from .plan import PlanConfig
+
+__all__ = ["REF_CHIP", "PlanScore", "score_compiled", "score_lowered",
+           "transition_cost"]
+
+# Reference-chip constants for the scalar model (v5e-class): HBM bandwidth,
+# peak FLOP/s, interconnect bandwidth, host-link bandwidth.  Only ratios
+# matter for ranking; they are pinned so scores are deterministic.
+REF_CHIP = {
+    "hbm_bytes_per_s": 819e9,
+    "flops_per_s": 197e12,
+    "ici_bytes_per_s": 45e9,
+    "pcie_bytes_per_s": 32e9,
+}
+# a mid-flight re-plan pays its transition once per this many steps
+REPLAN_HORIZON_STEPS = 1000
+
+
+@dataclass
+class PlanScore:
+    """The static cost vector for one candidate plan."""
+    plan: PlanConfig
+    peak_bytes: int = 0            # liveness-model per-device peak
+    xla_peak_bytes: int = 0        # XLA's own number when exposed (cross-check)
+    hbm_budget: int = 0
+    fits: bool = True              # peak <= budget (the hard constraint)
+    bytes_per_step: float = 0.0    # HBM traffic per step (fusion audit)
+    flops_per_step: float = 0.0
+    exposed_bytes: float = 0.0     # collective bytes the schedule cannot hide
+    bubble: float = 0.0            # pipeline bubble fraction (pp > 1)
+    reshard_bytes: int = 0         # one-time transition traffic from current
+    reshard_peak: int = 0          # planner-modeled transition peak
+    tokens_per_step: int = 1
+    step_units: float = 0.0        # modeled seconds per step on REF_CHIP
+    score: float = float("inf")    # modeled seconds per TOKEN; lower is better
+    notes: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "plan": self.plan.label(), "fits": self.fits,
+            "peak_bytes": int(self.peak_bytes),
+            "hbm_budget": int(self.hbm_budget),
+            "bytes_per_step": float(self.bytes_per_step),
+            "flops_per_step": float(self.flops_per_step),
+            "exposed_bytes": float(self.exposed_bytes),
+            "bubble": round(float(self.bubble), 4),
+            "reshard_bytes": int(self.reshard_bytes),
+            "tokens_per_step": int(self.tokens_per_step),
+            "score": float(self.score),
+        }
+
+
+def _plan_bubble(plan: PlanConfig) -> float:
+    """Closed-form bubble fraction for a pp>1 plan (0.0 at pp=1)."""
+    if plan.pp <= 1:
+        return 0.0
+    n_micro = max(plan.accum, 1)
+    kind = plan.schedule
+    hop = 2 if (plan.double_buffer and kind == "gpipe") else 1
+    bf = bubble_fraction(kind, plan.pp, n_micro, hop_ticks=hop)
+    return float(bf["fraction"])
+
+
+def score_compiled(compiled, plan: PlanConfig, *, hbm_budget: int,
+                   tokens_per_step: int,
+                   reshard_bytes: int = 0, reshard_peak: int = 0,
+                   prune_only: bool = False) -> PlanScore:
+    """Score one compiled candidate program.
+
+    ``prune_only`` stops after the HBM constraint when it already failed —
+    the search driver prunes before paying for the full vector.
+    """
+    text = compiled.as_text()
+    res = analyze_text(text)
+    xp = xla_peak_bytes(compiled)
+    s = PlanScore(plan=plan, peak_bytes=int(res.peak_bytes),
+                  xla_peak_bytes=int(xp[0]) if xp else 0,
+                  hbm_budget=int(hbm_budget),
+                  tokens_per_step=int(tokens_per_step),
+                  reshard_bytes=int(reshard_bytes),
+                  reshard_peak=int(reshard_peak))
+    s.fits = s.peak_bytes <= hbm_budget
+    if not s.fits:
+        s.notes.append(
+            f"over budget by {(s.peak_bytes - hbm_budget) / 1e6:.1f} MB")
+        if prune_only:
+            return s
+
+    from ...profiler.fusion_audit import bytes_per_step as _bps
+    from ...utils.xla_cost import cost_of_executable
+    b = _bps(compiled=compiled)
+    s.bytes_per_step = float(b) if b else 0.0
+    cost = cost_of_executable(compiled) or {}
+    s.flops_per_step = float(cost.get("flops") or 0.0)
+
+    orep = overlap_report(text)
+    s.exposed_bytes = float(orep.meta.get("overlap_exposed_bytes", 0.0))
+    s.bubble = _plan_bubble(plan)
+
+    ref = REF_CHIP
+    roof = max(s.flops_per_step / ref["flops_per_s"],
+               s.bytes_per_step / ref["hbm_bytes_per_s"])
+    comm = s.exposed_bytes / ref["ici_bytes_per_s"]
+    s.step_units = (roof + comm) / max(1e-9, 1.0 - s.bubble)
+    s.step_units += (s.reshard_bytes / ref["ici_bytes_per_s"]
+                     / REPLAN_HORIZON_STEPS)
+    s.score = s.step_units / max(1, s.tokens_per_step)
+    return s
+
+
+def score_lowered(lowered, plan: PlanConfig, **kw) -> PlanScore:
+    """Compile a ``lower()``-ed candidate and score it."""
+    return score_compiled(lowered.compile(), plan, **kw)
+
+
+def transition_cost(state_dict, dst_mesh):
+    """One-time cost of moving a live job's state onto ``dst_mesh`` keeping
+    each leaf's spec (what ``fleet.migrate_to_mesh`` would execute), modeled
+    by the PR 9 planner: ``(moved_bytes, worst_step_peak, bounded)``."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from ...distributed.resharding import plan_reshard
+    from ...distributed.resharding.planner import _mesh_eq
+
+    moved, peak, bounded = 0, 0, True
+
+    def visit(d):
+        nonlocal moved, peak, bounded
+        for v in d.values():
+            if isinstance(v, dict):
+                visit(v)
+                continue
+            arr = getattr(v, "_data", v)
+            if not isinstance(arr, jax.Array):
+                continue
+            sh = arr.sharding
+            if not isinstance(sh, NamedSharding) or _mesh_eq(sh.mesh, dst_mesh):
+                continue
+            p = plan_reshard(sh.mesh, sh.spec, dst_mesh, sh.spec,
+                             arr.shape, arr.dtype)
+            moved += int(arr.nbytes)
+            peak = max(peak, p.peak_bytes)
+            bounded = bounded and p.bounded
+
+    if isinstance(state_dict, dict):
+        visit(state_dict)
+    return moved, peak, bounded

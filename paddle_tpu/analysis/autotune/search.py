@@ -1,0 +1,187 @@
+"""Search driver: sweep a per-preset candidate grid, prune by the HBM
+constraint FIRST, rank survivors by static score, and emit a ranked plan
+table plus a chosen plan.
+
+The driver is model-agnostic: callers supply ``builder(plan) ->
+(lowered, tokens_per_step)`` (``bench.py --tune`` builds pretrain programs;
+tests build toy ones), so this package never imports model code.  The
+hand-picked preset config is ALWAYS in the grid — the tuner's choice is
+therefore ≥ the hand-picked plan by static score by construction, and
+``scripts/tune_gate.sh`` fails if that ever stops being true.
+
+``TUNE_GATE_INJECT=bad-plan`` (gate defect injection) swaps the grid for
+``[hand, injected]`` where the injected plan's microbatch is scaled far
+past the HBM budget and its score is forced to look optimal — the HBM
+prune must reject it or the gate exits non-zero.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+from .plan import PlanConfig
+from .scorer import PlanScore, score_lowered
+
+__all__ = ["SweepResult", "default_grid", "default_budget", "sweep"]
+
+# per-preset CPU-proxy HBM budgets (bytes) for the sweep's hard constraint;
+# on-TPU sweeps default to the v5e 16 GB HBM instead
+CPU_BUDGETS = {"tiny": 256 << 20, "moe": 512 << 20}
+TPU_BUDGET = 16 << 30
+BAD_PLAN_BATCH_SCALE = 64
+
+
+def default_budget(preset: str, on_tpu: bool) -> int:
+    if on_tpu:
+        return TPU_BUDGET
+    return CPU_BUDGETS.get(preset, TPU_BUDGET)
+
+
+def default_grid(preset: str, *, on_tpu: bool = False,
+                 n_devices: int = 1) -> List[PlanConfig]:
+    """The candidate grid for one preset.  ``grid[0]`` is ALWAYS the
+    hand-picked preset config (source="hand")."""
+    hand = PlanConfig(preset=preset)
+    if os.environ.get("TUNE_GATE_INJECT", "") == "bad-plan":
+        # defect injection: a plan whose batch cannot fit the budget; the
+        # HBM constraint must prune it no matter how good it scores
+        from . import _DEFAULT_BATCH
+        base_b = _DEFAULT_BATCH.get(preset, 4)
+        bad = hand.but(batch=base_b * BAD_PLAN_BATCH_SCALE,
+                       source="injected")
+        return [hand, bad]
+
+    grid = [hand]
+    # microbatch/accum axis: amortize the weight-update pass (the measured
+    # CPU ladder: 4488 -> 12238 tok/s at accum 1 -> 4 on tiny)
+    for a in (2, 4):
+        grid.append(hand.but(accum=a, source="tuner"))
+    # ZeRO axis (needs a dp mesh): off / seq / bucketed-overlap gather
+    if n_devices >= 8 and preset in ("small", "base"):
+        grid.append(hand.but(zero=True, dp=8, source="tuner"))
+        grid.append(hand.but(zero=True, dp=8, overlap_gather=True,
+                             accum=2, source="tuner"))
+    # remat axis: trade FLOPs for resident bytes (batch step at fixed HBM)
+    if preset in ("base",):
+        grid.append(hand.but(batch=6, remat="full", accum=2, source="tuner"))
+    if on_tpu and preset in ("base", "small"):
+        grid.append(hand.but(accum=4, grad_dtype="bfloat16", source="tuner"))
+    return grid
+
+
+@dataclass
+class SweepResult:
+    """Ranked outcome of one grid sweep."""
+    preset: str
+    hbm_budget: int
+    ranked: List[PlanScore] = field(default_factory=list)   # fits, best first
+    pruned: List[PlanScore] = field(default_factory=list)   # HBM-rejected
+    chosen: Optional[PlanScore] = None
+    hand: Optional[PlanScore] = None
+    errors: List[str] = field(default_factory=list)
+
+    @property
+    def chosen_beats_hand(self) -> bool:
+        if self.chosen is None or self.hand is None:
+            return False
+        return self.chosen.score <= self.hand.score
+
+    def table(self) -> str:
+        """Human-readable ranked plan table (stderr display)."""
+        rows = [f"[tune] {self.preset}: budget={self.hbm_budget / 1e6:.0f} MB, "
+                f"{len(self.ranked)} fit / {len(self.pruned)} pruned"]
+        hdr = (f"  {'plan':38s} {'score':>12s} {'peak MB':>9s} "
+               f"{'GB/step':>8s} {'exp MB':>7s} {'bubble':>6s}")
+        rows.append(hdr)
+        for s in self.ranked + self.pruned:
+            tag = " <- chosen" if s is self.chosen else (
+                "  (hand)" if s is self.hand and s is not self.chosen else "")
+            mark = "" if s.fits else " OVER-BUDGET"
+            rows.append(
+                f"  {s.plan.label():38s} {s.score:12.3e} "
+                f"{s.peak_bytes / 1e6:9.1f} {s.bytes_per_step / 1e9:8.2f} "
+                f"{s.exposed_bytes / 1e6:7.1f} {s.bubble:6.3f}{mark}{tag}")
+        return "\n".join(rows)
+
+    def to_meta(self) -> dict:
+        """JSON-able fields for the BENCH line / gate baseline."""
+        meta = {
+            "tune_preset": self.preset,
+            "tune_budget": int(self.hbm_budget),
+            "tune_candidates": len(self.ranked) + len(self.pruned),
+            "tune_pruned": [s.plan.label() for s in self.pruned],
+            "tune_table": [s.to_dict() for s in self.ranked],
+        }
+        if self.chosen is not None:
+            meta["tune_chosen"] = self.chosen.plan.to_dict()
+            meta["tune_chosen_label"] = self.chosen.plan.label()
+            meta["tune_chosen_score"] = float(self.chosen.score)
+            meta["tune_chosen_injected"] = self.chosen.plan.source == "injected"
+        if self.hand is not None:
+            meta["tune_hand_score"] = float(self.hand.score)
+            meta["tune_beats_hand"] = self.chosen_beats_hand
+        return meta
+
+
+def sweep(preset: str,
+          builder: Callable[[PlanConfig], Tuple[object, int]],
+          *,
+          hbm_budget: int,
+          grid: Optional[List[PlanConfig]] = None,
+          on_tpu: bool = False,
+          n_devices: int = 1,
+          current_state: Optional[dict] = None,
+          dst_mesh_of: Optional[Callable[[PlanConfig], object]] = None,
+          log: Optional[Callable[[str], None]] = None) -> SweepResult:
+    """Sweep the grid: build + lower each candidate once, prune by the HBM
+    constraint first, rank the rest by static score.
+
+    ``builder(plan)`` returns ``(lowered, tokens_per_step)`` — or raises,
+    which records the candidate as an error instead of aborting the sweep.
+    ``current_state``/``dst_mesh_of`` (both optional) price the mid-flight
+    transition from a live job's state onto each candidate's mesh.
+    """
+    from .scorer import transition_cost
+
+    if grid is None:
+        grid = default_grid(preset, on_tpu=on_tpu, n_devices=n_devices)
+    out = SweepResult(preset=preset, hbm_budget=int(hbm_budget))
+    scored: List[PlanScore] = []
+    for plan in grid:
+        try:
+            lowered, tokens = builder(plan)
+        except Exception as e:  # candidate does not build: skip, keep sweeping
+            out.errors.append(f"{plan.label()}: {type(e).__name__}: {e}")
+            if log:
+                log(f"[tune] skip {plan.label()}: {e}")
+            continue
+        rb = rp = 0
+        if current_state is not None and dst_mesh_of is not None:
+            dst = dst_mesh_of(plan)
+            if dst is not None:
+                rb, rp, _ = transition_cost(current_state, dst)
+        s = score_lowered(lowered, plan, hbm_budget=hbm_budget,
+                          tokens_per_step=tokens, reshard_bytes=rb,
+                          reshard_peak=rp, prune_only=True)
+        if plan.source == "injected" and s.fits:
+            # the injection is only a valid probe if it actually overflows;
+            # a fitting "bad" plan means the injection itself is broken
+            s.notes.append("injected plan unexpectedly fits the budget")
+        scored.append(s)
+        if s is not None and plan is grid[0]:
+            out.hand = s
+        if log:
+            log(f"[tune] scored {plan.label()}: "
+                + (f"score={s.score:.3e}" if s.fits else "PRUNED (HBM)"))
+
+    # the injected bad plan advertises a perfect score — the HBM prune,
+    # which runs FIRST, is the only thing standing between it and "chosen"
+    for s in scored:
+        if s.plan.source == "injected":
+            s.score = 0.0
+    out.pruned = [s for s in scored if not s.fits]
+    out.ranked = sorted((s for s in scored if s.fits), key=lambda s: s.score)
+    out.chosen = out.ranked[0] if out.ranked else None
+    return out

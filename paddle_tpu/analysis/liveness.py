@@ -46,8 +46,8 @@ from .hlo_ir import (
     paren_args, shape_bytes, split_computations,
 )
 
-__all__ = ["Lifetime", "LivenessResult", "analyze_text", "analyze_lowered",
-           "xla_peak_bytes", "ALIAS_OPS", "FREE_OPS"]
+__all__ = ["Lifetime", "LivenessResult", "PreparedModule", "analyze_text",
+           "analyze_lowered", "xla_peak_bytes", "ALIAS_OPS", "FREE_OPS"]
 
 # ops that forward their operand's buffer (no new storage) — ``while``
 # because XLA threads ONE set of loop-carried buffers through init, body
@@ -270,101 +270,132 @@ def _sweep(comps, instrs, idx, operands, cache, *, param_bytes, zero_bufs,
     return peak, peak_at, peak_idx, lifetimes
 
 
+class PreparedModule:
+    """One parsed HLO dump, reusable across what-if liveness sweeps.
+
+    The regex parse over the full text dominates ``analyze_text`` on large
+    modules; the donation and remat advisors re-sweep once per candidate,
+    so they parse once here and re-run only the linear sweep.  The
+    sub-computation peak cache is shared across sweeps too — internal peaks
+    do not depend on entry-level what-ifs."""
+
+    def __init__(self, text: str, *, ignore_donation: bool = False):
+        self.num_partitions, self._donated = module_header(text)
+        self._alias_out = output_aliases(text)   # {output elem idx: param idx}
+        if ignore_donation:
+            self._donated, self._alias_out = set(), {}
+
+        self._comps = dict(split_computations(text))
+        entry = entry_name(text)
+        if entry not in self._comps:
+            entry = next(reversed(self._comps)) if self._comps else None
+        self.entry = entry
+        self._instrs = self._comps.get(entry, [])
+        self._idx = {inst[0]: i for i, inst in enumerate(self._instrs)}
+        self._operands = _parse_ops(self._instrs, self._idx)
+        self._cache: Dict[str, int] = {}
+
+        self._param_bytes: Dict[str, Tuple[int, int]] = {}
+        self._pidx_of: Dict[str, int] = {}
+        for iname, opcode, type_str, tail in self._instrs:
+            if opcode == "parameter":
+                m = re.match(r"\s*(\d+)", paren_args(tail))
+                pi = int(m.group(1)) if m else len(self._param_bytes)
+                self._param_bytes[iname] = (shape_bytes(type_str), pi)
+                self._pidx_of[iname] = pi
+
+        # ROOT output element buffers, in output order (alias resolution as
+        # in the sweep: chase bitcast/gte/reshape to the defining buffer)
+        instrs, idx, operands = self._instrs, self._idx, self._operands
+
+        def _resolve(n):
+            seen = set()
+            while n in idx and n not in seen:
+                seen.add(n)
+                i = idx[n]
+                if instrs[i][1] in ALIAS_OPS and operands[i]:
+                    n = operands[i][0]
+                    continue
+                break
+            return n
+
+        self._out_elems: List[Tuple[str, int]] = []    # (buffer name, bytes)
+        if instrs:
+            rname, ropcode, rtype, _rtail = instrs[-1]
+            rres = _resolve(rname)
+            if ropcode == "tuple" or (rres in idx and instrs[idx[rres]][1] == "tuple"):
+                ti = idx[rres] if rres in idx else idx[rname]
+                self._out_elems = [(_resolve(o), shape_bytes(instrs[idx[o]][2])
+                                    if o in idx else 0) for o in operands[ti]]
+            else:
+                self._out_elems = [(rres, shape_bytes(rtype))]
+
+    def analyze(self, *, extra_donated: Optional[Set[int]] = None,
+                drop_buffers: Optional[Set[str]] = None) -> LivenessResult:
+        donated = set(self._donated)
+        param_bytes, out_elems = self._param_bytes, self._out_elems
+
+        # outputs aliased into donated params occupy no storage of their own
+        zero_bufs = {out_elems[oi][0] for oi in self._alias_out
+                     if oi < len(out_elems)}
+        if extra_donated:
+            bytes_of_pi = {pi: b for _n, (b, pi) in param_bytes.items()}
+            claimed = set(self._alias_out)
+            for pi in sorted(extra_donated):
+                want = bytes_of_pi.get(pi, 0)
+                for oi, (buf, b) in enumerate(out_elems):
+                    if oi in claimed or b != want or buf in zero_bufs:
+                        continue
+                    claimed.add(oi)
+                    zero_bufs.add(buf)
+                    donated.add(pi)
+                    break
+        if drop_buffers:
+            # the remat what-if: treat these entry buffers as rematerialized
+            # (no resident storage of their own); params keep their storage
+            zero_bufs |= {b for b in drop_buffers if b not in param_bytes}
+
+        # non-aliased entry outputs: reserved up front by buffer assignment
+        out_resident = {buf: b for buf, b in out_elems
+                        if b and buf not in zero_bufs and buf not in param_bytes}
+
+        peak, peak_at, peak_idx, lifetimes = _sweep(
+            self._comps, self._instrs, self._idx, self._operands, self._cache,
+            param_bytes=param_bytes, zero_bufs=zero_bufs,
+            out_resident=out_resident)
+        donated_names = {n for n, pi in self._pidx_of.items() if pi in donated}
+
+        for n, lt in lifetimes.items():
+            if n in param_bytes:
+                lt.is_param = True
+                lt.param_index = self._pidx_of[n]
+                lt.donated = n in donated_names
+            if n in self._idx:
+                lt.opcode = self._instrs[self._idx[n]][1]
+            elif n in param_bytes:
+                lt.opcode = "parameter"
+
+        return LivenessResult(
+            peak_bytes=peak, peak_at=peak_at, peak_idx=peak_idx,
+            lifetimes=sorted(lifetimes.values(), key=lambda l: l.def_idx),
+            entry=self.entry or "", num_partitions=self.num_partitions,
+            donated_params=donated, entry_instrs=self._instrs)
+
+
 def analyze_text(text: str, *, extra_donated: Optional[Set[int]] = None,
-                 ignore_donation: bool = False) -> LivenessResult:
+                 ignore_donation: bool = False,
+                 drop_buffers: Optional[Set[str]] = None) -> LivenessResult:
     """Liveness-model peak for an optimized HLO text dump.
 
     ``extra_donated`` marks additional entry-parameter indices as donated
     (the what-if the donation advisor asks) — each claims the first
-    un-aliased same-size ROOT output slot; ``ignore_donation`` drops the
-    module's own alias header (defect injection)."""
-    num_partitions, donated = module_header(text)
-    alias_out = output_aliases(text)     # {output elem idx: param idx}
-    if ignore_donation:
-        donated, alias_out = set(), {}
-
-    comps = dict(split_computations(text))
-    entry = entry_name(text)
-    if entry not in comps:
-        entry = next(reversed(comps)) if comps else None
-    instrs = comps.get(entry, [])
-    idx = {inst[0]: i for i, inst in enumerate(instrs)}
-    operands = _parse_ops(instrs, idx)
-
-    param_bytes: Dict[str, Tuple[int, int]] = {}
-    pidx_of: Dict[str, int] = {}
-    for iname, opcode, type_str, tail in instrs:
-        if opcode == "parameter":
-            m = re.match(r"\s*(\d+)", paren_args(tail))
-            pi = int(m.group(1)) if m else len(param_bytes)
-            param_bytes[iname] = (shape_bytes(type_str), pi)
-            pidx_of[iname] = pi
-
-    # ROOT output element buffers, in output order (alias resolution as in
-    # the sweep: chase bitcast/gte/reshape to the defining buffer)
-    def _resolve(n):
-        seen = set()
-        while n in idx and n not in seen:
-            seen.add(n)
-            i = idx[n]
-            if instrs[i][1] in ALIAS_OPS and operands[i]:
-                n = operands[i][0]
-                continue
-            break
-        return n
-
-    out_elems: List[Tuple[str, int]] = []    # (buffer name, bytes)
-    if instrs:
-        rname, ropcode, rtype, _rtail = instrs[-1]
-        rres = _resolve(rname)
-        if ropcode == "tuple" or (rres in idx and instrs[idx[rres]][1] == "tuple"):
-            ti = idx[rres] if rres in idx else idx[rname]
-            out_elems = [(_resolve(o), shape_bytes(instrs[idx[o]][2])
-                          if o in idx else 0) for o in operands[ti]]
-        else:
-            out_elems = [(rres, shape_bytes(rtype))]
-
-    # outputs aliased into donated params occupy no storage of their own
-    zero_bufs = {out_elems[oi][0] for oi in alias_out if oi < len(out_elems)}
-    if extra_donated:
-        bytes_of_pi = {pi: b for _n, (b, pi) in param_bytes.items()}
-        claimed = set(alias_out)
-        for pi in sorted(extra_donated):
-            want = bytes_of_pi.get(pi, 0)
-            for oi, (buf, b) in enumerate(out_elems):
-                if oi in claimed or b != want or buf in zero_bufs:
-                    continue
-                claimed.add(oi)
-                zero_bufs.add(buf)
-                donated = donated | {pi}
-                break
-
-    # non-aliased entry outputs: reserved up front by buffer assignment
-    out_resident = {buf: b for buf, b in out_elems
-                    if b and buf not in zero_bufs and buf not in param_bytes}
-
-    cache: Dict[str, int] = {}
-    peak, peak_at, peak_idx, lifetimes = _sweep(
-        comps, instrs, idx, operands, cache,
-        param_bytes=param_bytes, zero_bufs=zero_bufs,
-        out_resident=out_resident)
-    donated_names = {n for n, pi in pidx_of.items() if pi in donated}
-
-    for n, lt in lifetimes.items():
-        if n in param_bytes:
-            lt.is_param = True
-            lt.param_index = pidx_of[n]
-            lt.donated = n in donated_names
-        if n in idx:
-            lt.opcode = instrs[idx[n]][1]
-        elif n in param_bytes:
-            lt.opcode = "parameter"
-
-    return LivenessResult(
-        peak_bytes=peak, peak_at=peak_at, peak_idx=peak_idx,
-        lifetimes=sorted(lifetimes.values(), key=lambda l: l.def_idx),
-        entry=entry or "", num_partitions=num_partitions,
-        donated_params=set(donated), entry_instrs=instrs)
+    un-aliased same-size ROOT output slot; ``drop_buffers`` names entry
+    buffers to treat as rematerialized (the what-if the remat advisor
+    asks — the peak drop is the buffer's PROVEN resident contribution);
+    ``ignore_donation`` drops the module's own alias header (defect
+    injection)."""
+    return PreparedModule(text, ignore_donation=ignore_donation).analyze(
+        extra_donated=extra_donated, drop_buffers=drop_buffers)
 
 
 def xla_peak_bytes(compiled) -> Optional[Tuple[int, object]]:

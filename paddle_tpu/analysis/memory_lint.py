@@ -10,8 +10,10 @@ Finding codes (see ``findings.py`` for the full taxonomy):
   sweep with that parameter donated PROVABLY lowers the peak (the finding
   carries the delta, not a guess).
 * ``mem-remat-candidate`` — a large long-lived activation stays resident
-  across ≥ K compute instructions while the peak is hit; advisory (low
-  severity) — rematerialization trades those bytes for FLOPs.
+  across ≥ K compute instructions while the peak is hit; low severity (not
+  gated) but ACTIONABLE: ``bytes`` carries the proven peak drop from
+  re-sweeping with the buffer rematerialized, which is what
+  ``analysis.autotune.remat_policy`` ranks by.
 * ``mem-replicated-resident`` — an entry parameter is resident at global
   size on every device although its declared spec shards it (the
   residency twin of hlo_lint's ``replicated-buffer``).
@@ -32,7 +34,7 @@ from typing import Dict, Optional, Tuple
 from .findings import Report
 from .hlo_ir import shape_bytes
 from .liveness import (
-    ALIAS_OPS, FREE_OPS, LivenessResult, analyze_text, xla_peak_bytes,
+    ALIAS_OPS, FREE_OPS, LivenessResult, PreparedModule, xla_peak_bytes,
 )
 
 __all__ = ["DEFAULT_BIG_BUFFER", "DEFAULT_REMAT_SPAN", "GATED_MEM_CODES",
@@ -107,7 +109,8 @@ def lint_memory_text(
     ``analysis._declared_params`` builds for hlo_lint."""
     big = _big_buffer_default() if big_buffer_bytes is None else big_buffer_bytes
     inject = os.environ.get("MEM_GATE_INJECT", "")
-    res = analyze_text(text, ignore_donation=(inject == "strip-donation"))
+    mod = PreparedModule(text, ignore_donation=(inject == "strip-donation"))
+    res = mod.analyze()
 
     rep = Report()
     rep.meta["peak_bytes"] = res.peak_bytes
@@ -138,9 +141,7 @@ def lint_memory_text(
     for lt in params:
         if lt.donated or lt.bytes < big or slots.get(lt.bytes, 0) <= 0:
             continue
-        what_if = analyze_text(
-            text, ignore_donation=(inject == "strip-donation"),
-            extra_donated={lt.param_index})
+        what_if = mod.analyze(extra_donated={lt.param_index})
         delta = res.peak_bytes - what_if.peak_bytes
         if delta > 0:
             slots[lt.bytes] -= 1
@@ -151,16 +152,25 @@ def lint_memory_text(
                     where=lt.name, bytes=delta,
                     suggestion=f"add argnum {lt.param_index} to donate_argnums")
 
-    # --- mem-remat-candidate (advisory) ----------------------------------
+    # --- mem-remat-candidate (actionable: proven delta) -------------------
+    # Each candidate is re-swept with its buffer rematerialized
+    # (``drop_buffers``); the finding's ``bytes`` is the PROVEN peak drop,
+    # not the buffer's size — the peak can move to another instruction when
+    # a buffer is dropped, so the two differ.  The selective-remat policy
+    # (``analysis.autotune.remat_policy``) ranks by this exact saving.
     for lt in res.lifetimes:
         if lt.is_param or lt.bytes < big or not lt.live_at_peak:
             continue
         span = _span_compute(res, lt)
         if span >= remat_span:
+            what_if = mod.analyze(drop_buffers={lt.name})
+            delta = max(0, res.peak_bytes - what_if.peak_bytes)
             rep.add("mem-remat-candidate", "low",
                     f"{lt.bytes / 1e6:.3f} MB activation resident across "
-                    f"{span} compute instructions while peak is hit",
-                    where=lt.name, bytes=lt.bytes,
+                    f"{span} compute instructions while peak is hit; "
+                    f"rematerializing it provably drops the peak by "
+                    f"{delta / 1e6:.3f} MB",
+                    where=lt.name, bytes=delta,
                     suggestion="consider jax.checkpoint/remat around its producer")
 
     # --- mem-replicated-resident -----------------------------------------

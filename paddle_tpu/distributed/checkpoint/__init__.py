@@ -117,6 +117,63 @@ def _slices_to_offset_shape(index, global_shape):
     return tuple(offset), tuple(shape)
 
 
+def _region_from_shards(arr, offset, shape):
+    """Assemble the global region ``[offset, offset+shape)`` of a live array
+    from its locally-addressable shards (write-side re-layout).  Requires
+    the region to be fully covered by local shards — i.e. a single-host
+    source, or a replicated multi-host one; anything else raises."""
+    out = np.zeros(shape, dtype=arr.dtype)
+    covered = np.zeros(shape, dtype=bool)
+    lo = np.array(offset, dtype=np.int64)
+    hi = lo + np.array(shape, dtype=np.int64)
+    for shard in arr.addressable_shards:
+        clo_t, cshape = _slices_to_offset_shape(shard.index, arr.shape)
+        clo = np.array(clo_t, dtype=np.int64)
+        chi = clo + np.array(cshape, dtype=np.int64)
+        ilo = np.maximum(lo, clo)
+        ihi = np.minimum(hi, chi)
+        if np.any(ilo >= ihi):
+            continue
+        src = tuple(slice(int(a - o), int(b - o)) for a, b, o in zip(ilo, ihi, clo))
+        dst = tuple(slice(int(a - o), int(b - o)) for a, b, o in zip(ilo, ihi, lo))
+        out[dst] = np.asarray(shard.data)[src]
+        covered[dst] = True
+    if not covered.all():
+        raise ValueError(
+            f"relayout region (offset={offset}, shape={shape}) is not fully "
+            "covered by locally-addressable shards — write-side re-layout "
+            "needs a single-host (or replicated) source")
+    return out
+
+
+def _relayout_target(name: str, arr, relayout):
+    """The target sharding for ``name`` under ``relayout``: a dict
+    (name -> NamedSharding, missing names keep their layout) or a jax Mesh
+    (every tensor keeps its PartitionSpec on the new mesh — the same
+    keep-the-spec contract as ``fleet.migrate_to_mesh``).  Returns None
+    when the tensor is already laid out that way."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from ..resharding.planner import _mesh_eq
+
+    if isinstance(relayout, dict):
+        dst = relayout.get(name)
+    elif isinstance(relayout, Mesh):
+        src = arr.sharding
+        spec = src.spec if isinstance(src, NamedSharding) else PartitionSpec()
+        dst = NamedSharding(relayout, spec)
+    else:
+        raise TypeError(f"relayout must be a jax Mesh or a name->NamedSharding "
+                        f"dict, got {type(relayout).__name__}")
+    if dst is None:
+        return None
+    src = arr.sharding
+    if (isinstance(src, NamedSharding) and isinstance(dst, NamedSharding)
+            and _mesh_eq(src.mesh, dst.mesh) and src.spec == dst.spec):
+        return None  # already in the target layout: normal per-shard path
+    return dst
+
+
 def _unwrap_state(state_dict) -> Dict[str, jax.Array]:
     flat = {}
     for name, t in state_dict.items():
@@ -133,12 +190,23 @@ def _unwrap_state(state_dict) -> Dict[str, jax.Array]:
 
 
 def save_state_dict(state_dict, path: str, process_group=None, coordinator_rank: int = 0,
-                    async_save: bool = False):
+                    async_save: bool = False, relayout=None, stats=None):
     """Save a (possibly sharded) state dict under directory ``path``.
 
     Every process writes its unique local shards; rank ``coordinator_rank``
     writes the global metadata.  With ``async_save`` the device->host copies
     happen now and file IO returns a future.
+
+    ``relayout`` re-layouts the checkpoint AT WRITE TIME: a jax Mesh (every
+    tensor keeps its PartitionSpec on that mesh) or a name->NamedSharding
+    dict.  Chunk boundaries then follow the TARGET topology, so a later
+    resume on that topology reads each shard as exactly one chunk — the
+    write-side counterpart of load's reshard-on-read.  Each tensor's move
+    is modeled through the resharding planner; ``stats`` (a dict, optional)
+    receives ``arrays``/``moved_bytes``/``peak_bytes``/``bound_bytes``/
+    ``bounded``.  Region assembly uses locally-addressable shards
+    (single-host or replicated sources; the coordinator writes the
+    re-laid-out chunks).
 
     Commit is ATOMIC: all files land in a ``<path>.saving`` staging
     directory, the manifest is written last (tmp + rename), and only then
@@ -152,12 +220,52 @@ def save_state_dict(state_dict, path: str, process_group=None, coordinator_rank:
     rank = get_rank()
     flat = _unwrap_state(state_dict)
 
+    relayout_agg = {"arrays": 0, "moved_bytes": 0, "peak_bytes": 0,
+                    "bound_bytes": 0, "bounded": True}
+
     meta = Metadata()
     payload = {}
     file_name = f"{rank}_0.distcp.npz"
     for name, arr in flat.items():
         chunks = []
         global_shape = arr.shape
+        dst_sharding = (_relayout_target(name, arr, relayout)
+                        if relayout is not None else None)
+        if dst_sharding is not None:
+            from jax.sharding import NamedSharding
+
+            from ..resharding import plan_reshard
+
+            src = arr.sharding
+            if isinstance(src, NamedSharding) and isinstance(dst_sharding,
+                                                             NamedSharding):
+                plan = plan_reshard(src.mesh, src.spec, dst_sharding.mesh,
+                                    dst_sharding.spec, global_shape, arr.dtype)
+                relayout_agg["arrays"] += 1
+                relayout_agg["moved_bytes"] += int(arr.nbytes)
+                relayout_agg["peak_bytes"] = max(relayout_agg["peak_bytes"],
+                                                 plan.peak_bytes)
+                relayout_agg["bound_bytes"] = max(relayout_agg["bound_bytes"],
+                                                  plan.bound_bytes)
+                relayout_agg["bounded"] = (relayout_agg["bounded"]
+                                           and plan.bounded)
+            if rank == coordinator_rank:
+                seen_offsets = set()
+                for idx in dst_sharding.devices_indices_map(
+                        tuple(global_shape)).values():
+                    offset, shape = _slices_to_offset_shape(idx, global_shape)
+                    if offset in seen_offsets:
+                        continue
+                    seen_offsets.add(offset)
+                    key = f"{name}|{','.join(map(str, offset))}"
+                    stored = _to_storage(_region_from_shards(arr, offset, shape))
+                    payload[key] = stored
+                    chunks.append(LocalTensorMetadata(
+                        offset, shape, file_name, key,
+                        crc32=zlib.crc32(np.ascontiguousarray(stored).tobytes())))
+            if chunks:
+                meta.add(name, global_shape, arr.dtype, chunks)
+            continue
         seen_offsets = set()
         for shard in arr.addressable_shards:
             if shard.replica_id != 0:
@@ -174,6 +282,8 @@ def save_state_dict(state_dict, path: str, process_group=None, coordinator_rank:
                 crc32=zlib.crc32(np.ascontiguousarray(stored).tobytes())))
         if chunks:
             meta.add(name, global_shape, arr.dtype, chunks)
+    if isinstance(stats, dict):
+        stats.update(relayout_agg)
 
     world = get_world_size()
 

@@ -83,8 +83,14 @@ class CheckpointManager:
             return target.state_dict()
         return target
 
-    def save(self, step: int, target, async_save: bool = False):
-        """Save ``target`` (a ``jit.TrainStep`` or a state dict) as step ``step``."""
+    def save(self, step: int, target, async_save: bool = False,
+             relayout=None, relayout_stats=None):
+        """Save ``target`` (a ``jit.TrainStep`` or a state dict) as step
+        ``step``.  ``relayout`` (a jax Mesh or name->NamedSharding dict)
+        re-layouts the shards at write time through the resharding planner
+        — checkpoint once in the topology the NEXT run will use, so its
+        resume reads every shard as one chunk; ``relayout_stats`` (a dict)
+        receives the planner's modeled move cost."""
         # settle the previous async save on the MAIN thread (pruning from the
         # IO thread would race its filesystem rendezvous), then prune — this
         # bounds retention for async users too (at most keep+1 on disk); the
@@ -95,7 +101,8 @@ class CheckpointManager:
             prev_fut.result()
             self._prune(self._async_step)
         sd = self._state_of(target)
-        fut = save_state_dict(sd, self._dir(step), async_save=async_save)
+        fut = save_state_dict(sd, self._dir(step), async_save=async_save,
+                              relayout=relayout, stats=relayout_stats)
         if async_save:
             self._last_async = fut
             self._async_step = step

@@ -417,6 +417,20 @@ def _gather_bucketed(params, plan, mesh):
     return out
 
 
+def _remat_wrapper(remat):
+    """Resolve a TrainStep ``remat`` setting to a loss-function wrapper:
+    None/"off" -> no wrapper, "full" -> plain ``jax.checkpoint`` (save
+    nothing), a string -> ``jax.checkpoint_policies.<name>``, a callable ->
+    used as the checkpoint policy directly."""
+    if remat is None or remat == "off":
+        return None
+    if remat == "full":
+        return jax.checkpoint
+    pol = remat if callable(remat) else getattr(jax.checkpoint_policies,
+                                                str(remat))
+    return lambda f: jax.checkpoint(f, policy=pol)
+
+
 class TrainStep:
     """Compile forward+backward+optimizer into one XLA executable.
 
@@ -433,7 +447,7 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, donate: bool = True, grads_fn=None,
-                 grad_dtype=None, accumulate_steps: int = 1):
+                 grad_dtype=None, accumulate_steps: int = 1, remat=None):
         """``grads_fn(params, buffers, *args) -> (loss, grads)`` replaces the
         default ``jax.value_and_grad`` over ``loss_fn`` when given — used by
         schedules that hand-roll their vjp (compiled 1F1B pipeline).
@@ -456,7 +470,14 @@ class TrainStep:
         the base-preset step — is paid once per k micro-batches.  Gradients
         accumulate in fp32 (or ``grad_dtype`` when set); loss returned is
         the micro-batch mean.  Incompatible with ``grads_fn`` (pipeline
-        schedules do their own accumulation)."""
+        schedules do their own accumulation).
+
+        ``remat``: wrap the loss in ``jax.checkpoint`` before
+        ``value_and_grad`` — "full" saves nothing (classic remat), a string
+        names a ``jax.checkpoint_policies`` member, a callable is the
+        policy itself.  Defaults to the optimizer's ``set_remat_policy``
+        value (the hook ``analysis.autotune``'s remat plans set); not
+        applied to a custom ``grads_fn``, which owns its own vjp."""
         accumulate_steps = int(accumulate_steps)
         if accumulate_steps < 1:
             raise ValueError(f"accumulate_steps must be >= 1, "
@@ -508,6 +529,10 @@ class TrainStep:
         self._gather_plan = gather_plan
         self._step = 0
         grad_clip = optimizer._grad_clip
+        if remat is None:
+            remat = getattr(optimizer, "_remat_policy", None)
+        self.remat = remat
+        remat_wrap = _remat_wrapper(remat)
 
         def grads_of(params, buffers, margs, mkey):
             def loss_of(p):
@@ -516,6 +541,8 @@ class TrainStep:
                     loss = self.loss_fn(model, *t_args)
                 return unwrap(loss)
 
+            if remat_wrap is not None:
+                loss_of = remat_wrap(loss_of)
             return jax.value_and_grad(loss_of)(params)
 
         def step_fn(params, buffers, opt_state, lr, step, key, args):

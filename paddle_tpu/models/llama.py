@@ -83,6 +83,11 @@ class LlamaConfig:
     # (K/V rotate via ppermute) instead of single-device flash
     context_parallel_axis: Optional[str] = None
     recompute: bool = False          # jax.checkpoint each decoder layer
+    # selective remat: jax.checkpoint only the FIRST k decoder layers —
+    # the application knob of analysis.autotune.remat_policy (layers are
+    # homogeneous, so the policy maps "bytes to drop" to a layer count);
+    # ignored when ``recompute`` is already True
+    recompute_layers: Optional[int] = None
     # MoE (Qwen2-MoE / DeepSeekMoE shape, BASELINE configs[4]): >1 turns the
     # MLP into an expert-parallel MoE FFN (incubate.moe.MoELayer over 'ep')
     moe_num_experts: int = 1
@@ -571,10 +576,14 @@ class LlamaModel(Layer):
             if is_moe:
                 return self.norm(x), aux_total, new_cache
             return self.norm(x), new_cache
-        if self.config.recompute:
+        rl = self.config.recompute_layers
+        if self.config.recompute or rl:
             from ..distributed.fleet.recompute import recompute as _rc
-            for layer in self.layers:
-                out = _rc(layer, x, cos, sin, position_ids)
+            for i, layer in enumerate(self.layers):
+                if self.config.recompute or (rl is not None and i < rl):
+                    out = _rc(layer, x, cos, sin, position_ids)
+                else:
+                    out = layer(x, cos, sin, position_ids)
                 x, aux_total = self._merge_aux(out, aux_total, is_moe)
         else:
             for layer in self.layers:

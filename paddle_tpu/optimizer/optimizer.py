@@ -77,6 +77,7 @@ class Optimizer:
         self._wus: Optional[tuple] = None  # (jax Mesh, axis name) — shard_update()
         self._wus_overlap = False          # gather at head of next step, not tail
         self._wus_buckets = 4              # layer groups per head-of-step gather
+        self._remat_policy = None          # set_remat_policy() — read by TrainStep
 
     # -- lr ------------------------------------------------------------------
     def get_lr(self) -> float:
@@ -163,6 +164,19 @@ class Optimizer:
         self._wus_overlap = bool(overlap_gather)
         self._wus_buckets = max(1, int(gather_buckets))
         self._jitted_update = None  # retrace with constraints
+        return self
+
+    def set_remat_policy(self, policy):
+        """Attach a rematerialization policy to this optimizer's train step.
+
+        ``jit.TrainStep`` reads it the same way it reads ``_wus``: the loss
+        is wrapped in ``jax.checkpoint`` before ``value_and_grad``.
+        ``policy`` is ``None``/"off" (disable), "full" (save nothing —
+        classic remat), the name of a ``jax.checkpoint_policies`` member
+        (e.g. "dots_saveable"), or a policy callable.  This is the knob
+        ``analysis.autotune`` plans choose; model-level selective remat
+        (``LlamaConfig.recompute_layers``) composes independently."""
+        self._remat_policy = policy
         return self
 
     def _wus_overlap_active(self) -> bool:

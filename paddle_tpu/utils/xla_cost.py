@@ -14,17 +14,26 @@ __all__ = ["flops_of_lowered", "cost_of_lowered", "cost_of_executable",
            "memory_of_executable"]
 
 
+def _as_cost_dict(cost) -> Optional[dict]:
+    """Normalize a cost-analysis result: executable-level ``cost_analysis``
+    returns a one-dict-per-program LIST on some jaxlib versions, HLO-level
+    returns the dict directly."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    return dict(cost) if cost and cost.get("flops") else None
+
+
 def cost_of_lowered(lowered) -> Optional[dict]:
     """The full cost dict (``flops``, ``bytes accessed``, ...) of a lowered
     computation, or None."""
     for get in (lambda: lowered.cost_analysis(),
                 lambda: lowered.compile().cost_analysis()):
         try:
-            cost = get()
+            cost = _as_cost_dict(get())
         except Exception:
             continue
-        if cost and cost.get("flops"):
-            return dict(cost)
+        if cost:
+            return cost
     return None
 
 
@@ -32,10 +41,9 @@ def cost_of_executable(compiled) -> Optional[dict]:
     """Executable-level cost analysis from an already-compiled object (avoids
     the extra compile ``cost_of_lowered``'s fallback would trigger)."""
     try:
-        cost = compiled.cost_analysis()
+        return _as_cost_dict(compiled.cost_analysis())
     except Exception:
         return None
-    return dict(cost) if cost and cost.get("flops") else None
 
 
 def memory_of_executable(compiled) -> Optional[dict]:

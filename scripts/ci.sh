@@ -11,6 +11,9 @@
 #                 scripts/RESHARD_BASELINE.json
 #   overlap_gate  collective-overlap analyzer (exposed all-gather drop
 #                 >= 50% + counts) vs scripts/OVERLAP_BASELINE.json
+#   tune_gate     static auto-parallel tuner (chosen >= hand-picked by
+#                 static score; HBM prune rejects the injected bad plan)
+#                 vs scripts/TUNE_BASELINE.json
 #   host_lint     standalone self-lint summary line (rc 1 on any finding)
 #
 # Exit code: number of failed stages (0 = green).
@@ -40,6 +43,7 @@ stage schedule_gate ./scripts/schedule_gate.sh
 stage reshard_gate  ./scripts/reshard_gate.sh
 stage serve_gate    ./scripts/serve_gate.sh
 stage overlap_gate  ./scripts/overlap_gate.sh
+stage tune_gate     ./scripts/tune_gate.sh
 stage store_chaos   bash -c "\
     timeout -k 10 300 python -m pytest -q -p no:cacheprovider \
         tests/test_store_replicated.py \

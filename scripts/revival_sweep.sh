@@ -24,6 +24,12 @@ for args in "--accum 2 --grad-dtype bfloat16" "--accum 4 --grad-dtype bfloat16" 
     line=$(timeout 2400 python bench.py --preset base --device tpu $args 2>/dev/null | tail -1)
     [ -n "$line" ] && echo "$line" >> "$OUT" && echo "$line" | head -c 200 >&2 && echo >&2
 done
+# tuner-chosen config on the real chip: the static sweep picks the plan,
+# the measured row lands next to the hand-picked accum rows above so the
+# ranking can be checked against chip truth (tune_* fields carry the table)
+echo "[revival] base --tune" >&2
+line=$(timeout 2400 python bench.py --preset base --device tpu --tune 2>/dev/null | tail -1)
+[ -n "$line" ] && echo "$line" >> "$OUT" && echo "$line" | head -c 200 >&2 && echo >&2
 for args in "--wus seq --overlap" "--wus overlap --overlap"; do
     for preset in small base; do
         echo "[revival] $preset $args" >&2

@@ -1,0 +1,113 @@
+#!/bin/bash
+# Static auto-parallel tuner regression gate.  Runs `bench.py --tune` on the
+# CPU-proxy presets (tiny pretrain + moe) and fails when:
+#
+#   - the tuner stops choosing a plan at least as good as the hand-picked
+#     preset config by static score (tune_beats_hand must stay true — the
+#     hand config is always in the grid, so losing to it means the scorer
+#     or the search broke);
+#   - the chosen/hand score ratio regresses by more than 25% vs the
+#     committed baseline (scripts/TUNE_BASELINE.json) — the tuner still
+#     "wins" but its margin collapsed;
+#   - the sweep reports errors for any candidate, or the chosen plan came
+#     from the defect injection.
+#
+# Defect injection (proves the gate can fail): an over-budget plan with a
+# forced-optimal score is added to the grid; the HBM constraint must prune
+# it or the gate exits non-zero:
+#     TUNE_GATE_INJECT=bad-plan is exercised BY THIS SCRIPT on every run —
+#     the injection leg is part of the gate, not an optional mode.
+# Refresh the baseline after an intentional change:
+#     scripts/tune_gate.sh --update
+# Exit code: number of failed checks (0 = gate passes).
+cd "$(dirname "$0")/.." || exit 1
+GATE_NAME=tune_gate
+GATE_BASELINE="scripts/TUNE_BASELINE.json"
+. scripts/gate_lib.sh
+gate_init "$@"
+
+check() {  # check <preset> <timeout-s> <extra bench args...>
+    local preset="$1" budget="$2"; shift 2
+    gate_bench "$preset" "$budget" --tune "$@" || return
+    gate_diff "$preset" <<PY
+import json, os, sys
+exec(os.environ["GATE_PY_COMMON"])
+preset, baseline_path, new_path, update = sys.argv[1:5]
+line = """$GATE_LINE"""
+result = gate_result(line)
+if "tune_chosen_label" not in result:
+    print(f"[tune_gate] {preset}: FAILED (no tune_* fields in BENCH line)",
+          file=sys.stderr)
+    sys.exit(1)
+chosen = result["tune_chosen_score"]
+hand = result["tune_hand_score"]
+entry = {
+    "chosen": result["tune_chosen_label"],
+    "chosen_score": chosen,
+    "hand_score": hand,
+    "score_ratio": chosen / hand if hand else 1.0,
+    "candidates": result["tune_candidates"],
+    "pruned": result["tune_pruned"],
+}
+gate_record(new_path, preset, entry)
+# absolute invariants first: chosen >= hand by static score, never injected
+if not result.get("tune_beats_hand"):
+    print(f"[tune_gate] {preset}: FAILED (chosen plan "
+          f"{result['tune_chosen_label']} loses to the hand config: "
+          f"{chosen:.3e} > {hand:.3e})", file=sys.stderr)
+    sys.exit(1)
+if result.get("tune_chosen_injected"):
+    print(f"[tune_gate] {preset}: FAILED (chosen plan came from the "
+          "defect injection)", file=sys.stderr)
+    sys.exit(1)
+if int(update):
+    print(f"[tune_gate] {preset}: chose {entry['chosen']} "
+          f"(ratio {entry['score_ratio']:.3f}, recorded)", file=sys.stderr)
+    sys.exit(0)
+base = gate_base(baseline_path, preset, "tune_gate",
+                 "scripts/tune_gate.sh")
+if entry["score_ratio"] > base["score_ratio"] * 1.25:
+    print(f"[tune_gate] {preset}: FAILED (chosen/hand score ratio "
+          f"{entry['score_ratio']:.3f} vs baseline "
+          f"{base['score_ratio']:.3f} — the tuner's margin collapsed)",
+          file=sys.stderr)
+    sys.exit(1)
+print(f"[tune_gate] {preset}: OK chose {entry['chosen']} "
+      f"(ratio {entry['score_ratio']:.3f}, baseline "
+      f"{base['score_ratio']:.3f})", file=sys.stderr)
+PY
+}
+
+inject() {  # inject <preset>: the HBM constraint must reject the bad plan
+    local preset="$1"
+    echo "[tune_gate] $preset (inject bad-plan)" >&2
+    local line
+    if ! line=$(TUNE_GATE_INJECT=bad-plan timeout -k 10 600 python bench.py \
+                --preset "$preset" --device cpu --tune --audit-only 2>/dev/null); then
+        echo "[tune_gate] $preset inject: FAILED (bench rc=$?)" >&2
+        FAIL=$((FAIL + 1))
+        return
+    fi
+    GATE_LINE="$line" python - "$preset" <<'PY' || FAIL=$((FAIL + 1))
+import json, os, sys
+preset = sys.argv[1]
+result = json.loads(os.environ["GATE_LINE"].strip().splitlines()[-1])
+pruned = result.get("tune_pruned", [])
+if not any("injected" in p for p in pruned):
+    print(f"[tune_gate] {preset} inject: FAILED (bad plan not pruned by "
+          f"the HBM constraint; pruned={pruned})", file=sys.stderr)
+    sys.exit(1)
+if result.get("tune_chosen_injected"):
+    print(f"[tune_gate] {preset} inject: FAILED (injected plan chosen)",
+          file=sys.stderr)
+    sys.exit(1)
+print(f"[tune_gate] {preset} inject: OK (pruned {pruned})", file=sys.stderr)
+PY
+}
+
+# the two CPU-proxy presets the tuner is validated on
+check tiny 600 --audit-only
+check moe  600
+inject tiny
+
+gate_finish

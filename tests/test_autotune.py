@@ -1,0 +1,356 @@
+"""Static auto-parallel tuner + liveness-driven remat policy
+(``paddle_tpu.analysis.autotune``).
+
+The contract under test, per ISSUE/PERF:
+
+- the tuner's static ranking of 3+ candidate configs matches the MEASURED
+  tokens/s ordering from bench.py's builders, on two CPU presets (tiny
+  pretrain, moe);
+- the HBM constraint is a hard prune: an injected over-budget plan
+  (``TUNE_GATE_INJECT=bad-plan``) is rejected no matter how well it scores;
+- the selective-remat policy makes a config fit a budget the base config
+  exceeds, its re-swept predicted peak agrees with
+  ``compiled.memory_analysis()`` of the APPLIED program within the
+  existing 10% liveness bound, and it buys a batch-size step at fixed
+  budget;
+- mid-flight re-plan (``replan_live``) is bit-identical to a cold
+  checkpoint resume on the new plan's mesh;
+- ``save_state_dict(relayout=...)`` writes shards in the TARGET topology
+  so the next run's resume reads each shard as one chunk.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.analysis.autotune as at
+from paddle_tpu.analysis.autotune import PlanConfig
+
+import bench
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("dp",))
+
+
+def _measured_tokens_per_sec(step_fn, ids, tokens_per_step, steps=6):
+    loss = step_fn(ids)  # compile + warmup
+    jax.block_until_ready(loss._data)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step_fn(ids)
+    float(np.asarray(loss._data))  # host read = true sync point
+    return tokens_per_step * steps / (time.perf_counter() - t0)
+
+
+# ---------------------------------------------------------------- plan config
+
+def test_plan_config_roundtrip(tmp_path):
+    p = PlanConfig(preset="tiny", accum=4, zero=True, overlap_gather=True,
+                   remat="policy:2", source="tuner")
+    assert p.wus == "overlap"
+    assert p.remat_layers == 2
+    assert "tiny" in p.label() and "tuner" in p.label()
+
+    q = PlanConfig.from_json(p.to_json())
+    assert q == p
+    path = str(tmp_path / "plan.json")
+    p.save(path)
+    assert PlanConfig.from_file(path) == p
+
+    # unknown keys from a future writer are ignored, not fatal
+    d = p.to_dict()
+    d["future_knob"] = 7
+    assert PlanConfig.from_dict(d) == p
+
+    r = p.but(zero=False, overlap_gather=False, remat="off")
+    assert r.wus == "off" and r.remat_layers is None
+    assert p.zero  # frozen: `but` copies
+
+
+def test_default_grid_hand_first_and_injection(monkeypatch):
+    monkeypatch.delenv("TUNE_GATE_INJECT", raising=False)
+    grid = at.default_grid("tiny")
+    assert grid[0] == PlanConfig(preset="tiny")
+    assert grid[0].source == "hand"
+    assert len(grid) >= 3
+
+    monkeypatch.setenv("TUNE_GATE_INJECT", "bad-plan")
+    inj = at.default_grid("tiny")
+    assert len(inj) == 2 and inj[1].source == "injected"
+    assert inj[1].batch >= 64 * 4  # scaled far past any CPU budget
+
+
+# ------------------------------------------------- sweep: rank + hard prune
+
+def _tiny_builder(plan):
+    step_fn, ids, _m, _c, (b, s, _st) = bench.build_pretrain_step(
+        plan.preset, False, plan=plan)
+    return bench.lower_pretrain_step(step_fn, ids), max(1, plan.accum) * b * s
+
+
+def _moe_builder(plan):
+    step_fn, ids, _m, _c, (b, s, _st) = bench.build_moe_step(
+        False, batch=plan.batch, seq=plan.seq, accum=plan.accum)
+    return bench.lower_pretrain_step(step_fn, ids), max(1, plan.accum) * b * s
+
+
+def _rank_vs_measured(preset, builder, require_gain=False):
+    """Sweep accum 1/2/4, then measure the same three configs; the static
+    ranking must match the measured tokens/s ordering (configs whose
+    measured rates are within 15% count as a tie — CPU-proxy timing noise
+    is real; gross inversions still fail)."""
+    hand = PlanConfig(preset=preset)
+    grid = [hand, hand.but(accum=2, source="tuner"),
+            hand.but(accum=4, source="tuner")]
+    res = at.sweep(preset, builder, hbm_budget=at.default_budget(preset, False),
+                   grid=grid)
+    assert not res.errors, res.errors
+    assert len(res.ranked) == 3 and not res.pruned
+    assert res.chosen_beats_hand
+
+    measured = {}
+    for plan in grid:
+        if preset == "moe":
+            step_fn, ids, _m, _c, (b, s, _st) = bench.build_moe_step(
+                False, accum=plan.accum)
+        else:
+            step_fn, ids, _m, _c, (b, s, _st) = bench.build_pretrain_step(
+                preset, False, plan=plan)
+        measured[plan.accum] = _measured_tokens_per_sec(
+            step_fn, ids, max(1, plan.accum) * b * s)
+
+    static_rank = [s.plan.accum for s in res.ranked]  # best first
+    for i, a in enumerate(static_rank):
+        for b_ in static_rank[i + 1:]:
+            # statically a beats b_; measured must agree modulo a 15% tie
+            assert measured[a] >= measured[b_] * 0.85, (
+                static_rank, measured)
+    # the chosen plan is measurably fastest (or tied with the fastest)
+    best = max(measured.values())
+    assert measured[res.chosen.plan.accum] >= best * 0.85, measured
+    if require_gain:
+        # the tuner's choice beats the hand config by measured tok/s
+        # (margin is modest: the conftest's highest-precision matmuls make
+        # the in-process run compute-bound, compressing the accum
+        # amortization the subprocess bench measures at 2.7x)
+        assert measured[res.chosen.plan.accum] > measured[1] * 1.05, measured
+
+
+def test_static_ranking_matches_measured_tiny():
+    _rank_vs_measured("tiny", _tiny_builder, require_gain=True)
+
+
+def test_static_ranking_matches_measured_moe():
+    _rank_vs_measured("moe", _moe_builder)
+
+
+def test_sweep_prunes_injected_bad_plan(monkeypatch):
+    monkeypatch.setenv("TUNE_GATE_INJECT", "bad-plan")
+    res = at.sweep("tiny", _tiny_builder,
+                   hbm_budget=at.default_budget("tiny", False))
+    labels = [s.plan.label() for s in res.pruned]
+    assert any("injected" in l for l in labels), (labels, res.errors)
+    assert res.chosen is not None
+    assert res.chosen.plan.source != "injected"
+    meta = res.to_meta()
+    assert meta["tune_chosen_injected"] is False
+    assert meta["tune_pruned"]
+
+
+# ------------------------------------------------------ remat/offload policy
+
+def test_remat_policy_buys_batch_step_at_fixed_budget():
+    """Fix a budget between tiny-b4's and tiny-b8's peaks: b4 trains plain,
+    b8 exceeds it, and the policy makes b8 fit — one batch-size step bought
+    without raising the budget.  The APPLIED program's XLA peak must honor
+    the prediction within the existing 10% liveness bound.  (The budget is
+    80% of b8's peak, not b4's + epsilon: the drop set also contains loss/
+    softmax buffers the layer-granular ``recompute_layers`` knob cannot
+    touch, so the applied floor sits above the analytic one.)"""
+    from paddle_tpu.analysis.liveness import analyze_lowered
+
+    def build(batch, recompute_layers=None):
+        plan = PlanConfig(preset="tiny", batch=batch)
+        if recompute_layers:
+            plan = plan.but(remat=f"policy:{recompute_layers}")
+        step_fn, ids, _m, cfg, _ = bench.build_pretrain_step(
+            "tiny", False, plan=plan)
+        return bench.lower_pretrain_step(step_fn, ids), cfg
+
+    low8, cfg8 = build(8)
+    base8 = analyze_lowered(low8)[0].peak_bytes
+    budget = int(base8 * 0.80)
+    assert base8 > budget  # the base b8 config exceeds the fixed budget
+
+    low4, _ = build(4)
+    assert analyze_lowered(low4)[0].peak_bytes <= budget  # b4 fits plain
+
+    plan = at.plan_remat_lowered(low8, hbm_budget=budget,
+                                 n_layers=cfg8.num_hidden_layers)
+    assert plan.candidates > 0
+    assert plan.actions, plan.summary()
+    assert plan.fits and plan.predicted_peak <= budget, plan.summary()
+    assert 1 <= plan.layers_to_remat <= cfg8.num_hidden_layers
+
+    # apply the policy through the model knob and check the real program
+    low8r, _ = build(8, recompute_layers=plan.layers_to_remat)
+    applied_live, applied_xla = analyze_lowered(low8r)
+    applied_live = applied_live.peak_bytes
+    assert applied_live < base8  # remat actually dropped resident bytes
+    if applied_xla:  # CPU backends that report memory_analysis
+        err = abs(applied_live - applied_xla) / applied_xla
+        assert err <= 0.10, (applied_live, applied_xla)
+        assert applied_xla <= budget * 1.10, (applied_xla, budget)
+
+
+def test_remat_candidate_delta_is_proven():
+    """Satellite: each ``mem-remat-candidate`` finding's ``bytes`` is the
+    re-swept (drop_buffers) peak delta, not the raw buffer size."""
+    from paddle_tpu.analysis.liveness import PreparedModule
+    from paddle_tpu.analysis.memory_lint import lint_memory_text
+
+    step_fn, ids, _m, _c, _ = bench.build_pretrain_step(
+        "tiny", False, batch=8)
+    text = bench.lower_pretrain_step(step_fn, ids).compile().as_text()
+    rep = lint_memory_text(text)
+    cands = [f for f in rep.findings if f.code == "mem-remat-candidate"]
+    assert cands
+    mod = PreparedModule(text)
+    base = mod.analyze().peak_bytes
+    for f in cands[:3]:  # spot-check: the advertised delta reproduces
+        want = base - mod.analyze(drop_buffers={f.where}).peak_bytes
+        assert f.bytes == max(0, want), (f.where, f.bytes, want)
+
+
+# ------------------------------------------------------- mid-flight re-plan
+
+def _build_sharded_step(n_dev):
+    mesh = _mesh(n_dev)
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+    opt.shard_update(mesh)
+
+    def loss_fn(m, x, y):
+        return ((m(x) - y) ** 2).mean()
+
+    return mesh, paddle.jit.TrainStep(model, loss_fn, opt)
+
+
+def _run_steps(step_fn, start, stop):
+    for i in range(start, stop):
+        rs = np.random.default_rng(100 + i)  # step-determined data
+        x = paddle.to_tensor(rs.normal(size=(16, 8)).astype(np.float32))
+        y = paddle.to_tensor(rs.normal(size=(16, 1)).astype(np.float32))
+        step_fn(x, y)
+
+
+def test_replan_live_bit_identical_to_checkpoint_resume(tmp_path):
+    from paddle_tpu.distributed.fleet import CheckpointManager
+
+    _, step8 = _build_sharded_step(8)
+    _run_steps(step8, 0, 3)
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    mgr.save(3, step8)
+
+    # path A: live mid-flight re-plan onto the dp=4 mesh
+    mesh4, stepA = _build_sharded_step(4)
+    stats = at.replan_live(step8, stepA, mesh4)
+    assert stats["arrays"] > 0 and stats["bounded"]
+    _run_steps(stepA, 3, 5)
+
+    # path B: cold resume from the checkpoint on the same dp=4 mesh
+    _, stepB = _build_sharded_step(4)
+    assert mgr.resume(stepB) == 3
+    _run_steps(stepB, 3, 5)
+
+    sa, sb = stepA.state_dict(), stepB.state_dict()
+    assert set(sa) == set(sb)
+    for k in sorted(sa):
+        a = np.asarray(sa[k]._data if hasattr(sa[k], "_data") else sa[k])
+        b = np.asarray(sb[k]._data if hasattr(sb[k], "_data") else sb[k])
+        assert a.tobytes() == b.tobytes(), f"{k} diverged after re-plan"
+
+
+def test_transition_cost_models_the_move():
+    _, step8 = _build_sharded_step(8)
+    _run_steps(step8, 0, 1)
+    moved, peak, bounded = at.transition_cost(step8.state_dict(), _mesh(4))
+    assert moved > 0 and peak > 0 and bounded
+
+
+# ------------------------------------------- write-side checkpoint re-layout
+
+def test_save_relayout_writes_target_topology(tmp_path):
+    from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                   save_state_dict)
+
+    mesh8, mesh4 = _mesh(8), _mesh(4)
+    x = jax.device_put(np.arange(64, dtype=np.float32).reshape(8, 8),
+                       NamedSharding(mesh8, P("dp", None)))
+    y = jax.device_put(np.ones((3, 5), np.float32), NamedSharding(mesh8, P()))
+    stats = {}
+    path = str(tmp_path / "ck_relayout")
+    save_state_dict({"x": x, "y": y}, path, relayout=mesh4, stats=stats)
+    assert stats["arrays"] == 2 and stats["moved_bytes"] > 0
+    assert stats["bounded"]
+
+    import pickle
+    with open(os.path.join(path, "metadata.pkl"), "rb") as f:
+        meta = pickle.load(f)
+    # x's chunks follow the TARGET (dp=4) layout: 4 row-slabs of 2 rows
+    offs = sorted(c.global_offset
+                  for c in meta.state_dict_metadata["x"]["chunks"])
+    assert offs == [(0, 0), (2, 0), (4, 0), (6, 0)]
+
+    # resume on the target mesh: every shard is exactly one chunk read
+    tgt = {"x": jax.device_put(np.zeros((8, 8), np.float32),
+                               NamedSharding(mesh4, P("dp", None))),
+           "y": jax.device_put(np.zeros((3, 5), np.float32),
+                               NamedSharding(mesh4, P()))}
+    lstats = {}
+    load_state_dict(tgt, path, stats=lstats)
+    assert np.array_equal(np.asarray(tgt["x"]), np.asarray(x))
+    assert np.array_equal(np.asarray(tgt["y"]), np.asarray(y))
+    assert lstats["reads"] == 5  # 4 x-slabs + 1 replicated y
+
+
+def test_save_relayout_equals_migrate_then_save(tmp_path):
+    """Re-layout at WRITE time and resume is bit-identical to migrating the
+    live state first and saving normally."""
+    from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                   save_state_dict)
+    from paddle_tpu.distributed.fleet import migrate_to_mesh
+
+    mesh8, mesh4 = _mesh(8), _mesh(4)
+    rng = np.random.default_rng(7)
+    src = {"w": jax.device_put(rng.normal(size=(16, 4)).astype(np.float32),
+                               NamedSharding(mesh8, P("dp", None)))}
+
+    pa = str(tmp_path / "a")
+    save_state_dict(dict(src), pa, relayout=mesh4)
+
+    mig = dict(src)
+    migrate_to_mesh(mig, mesh4)
+    pb = str(tmp_path / "b")
+    save_state_dict(mig, pb)
+
+    outs = []
+    for p in (pa, pb):
+        tgt = {"w": jax.device_put(np.zeros((16, 4), np.float32),
+                                   NamedSharding(mesh4, P("dp", None)))}
+        load_state_dict(tgt, p)
+        outs.append(np.asarray(tgt["w"]))
+    assert outs[0].tobytes() == outs[1].tobytes()

@@ -960,6 +960,59 @@ def _bench_moe(jax, paddle, backend, on_tpu, args):
     }
 
 
+def _bench_pp(jax, backend, on_tpu, args):
+    """``--pp N`` A/B: the lockstep SPMD pipeline vs the MPMD per-stage-
+    program runtime (``distributed.parallel.mpmd``) on the same toy model
+    and M/2M-differencing protocol, in ONE process — measured bubble and
+    tok/s per runtime in one BENCH line.
+
+    The spmd leg runs ``measure_bubble_fraction`` (the compiled lockstep
+    1F1B scan: every stage executes the full masked round body, R =
+    M + 2(S-1) rounds); the mpmd leg runs ``measure_mpmd_bubble`` with
+    ``--pp-schedule`` (1f1b or zb), where stages idle instead of running
+    masked rounds, so per-step work is M round-equivalents."""
+    from paddle_tpu.analysis.schedule_lint import measure_bubble_fraction
+    from paddle_tpu.distributed.parallel.mpmd import measure_mpmd_bubble
+
+    S = args.pp
+    M = max(args.accum, 2 * S)
+    dim, mb = 512, 64
+    runtimes = (("spmd", "mpmd") if args.pp_runtime == "both"
+                else (args.pp_runtime,))
+    result = {
+        "metric": f"pp{S}_pipeline_tokens_per_sec",
+        "unit": "tokens/s",
+        "device": _peak_flops(jax, on_tpu)[0], "backend": backend,
+        "pp": S, "n_micro": M, "pp_schedule": args.pp_schedule,
+        "pp_runtime": args.pp_runtime,
+    }
+    tok = M * mb
+    for rt in runtimes:
+        if rt == "spmd":
+            # lockstep measurement harness covers the 1F1B training round
+            r = measure_bubble_fraction(S, M, dim=dim, mb=mb,
+                                        schedule="1F1B")
+            result["spmd_bubble_measured"] = round(r["measured"], 4)
+            result["spmd_bubble_predicted"] = round(r["predicted"], 4)
+            result["spmd_tok_s"] = round(tok / r["t_lo_s"], 2)
+        else:
+            r = measure_mpmd_bubble(S, M, dim=dim, mb=mb,
+                                    schedule=args.pp_schedule)
+            result["mpmd_bubble_measured"] = round(r["measured"], 4)
+            result["mpmd_lockstep_predicted"] = round(
+                r["lockstep_predicted"], 4)
+            result["mpmd_tok_s"] = round(tok / r["t_lo_s"], 2)
+            result["mpmd_transfers_posted"] = int(r["transfers_posted"])
+            result["mpmd_transfer_bytes"] = int(r["transfer_bytes"])
+    if "spmd_tok_s" in result and "mpmd_tok_s" in result:
+        result["mpmd_vs_spmd_tok_s"] = round(
+            result["mpmd_tok_s"] / max(result["spmd_tok_s"], 1e-9), 4)
+    result["value"] = result.get("mpmd_tok_s",
+                                 result.get("spmd_tok_s", 0.0))
+    result["vs_baseline"] = result.get("mpmd_vs_spmd_tok_s", 0.0)
+    return result
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", default=None, choices=["tiny", "small", "base", "longctx", "ocr", "moe", "decode", "serve"])
@@ -1038,6 +1091,20 @@ def main():
     ap.add_argument("--tune-out", default=None, metavar="PATH",
                     help="with --tune: write the chosen plan as JSON here "
                          "(replayable via --plan)")
+    ap.add_argument("--pp", type=int, default=0,
+                    help="pipeline-stage count (>= 2) for the pipeline-"
+                         "runtime A/B: measure bubble fraction and tok/s of "
+                         "the lockstep SPMD schedule vs the MPMD per-stage-"
+                         "program runtime on an S-device mesh (CPU: forced "
+                         "host devices) and emit one BENCH line")
+    ap.add_argument("--pp-runtime", default="both",
+                    choices=["spmd", "mpmd", "both"],
+                    help="with --pp: which pipeline runtime(s) to measure; "
+                         "'both' A/Bs them in one process")
+    ap.add_argument("--pp-schedule", default="zb", choices=["1f1b", "zb"],
+                    help="with --pp: schedule the MPMD runtime executes "
+                         "(the spmd leg always measures the lockstep 1F1B "
+                         "harness)")
     args = ap.parse_args()
     if args.audit_only:
         args.audit = True
@@ -1067,12 +1134,14 @@ def main():
                 return
         if (args.wus != "off"
                 or (args.tune and args.preset in ("small", "base"))
+                or args.pp >= 2
                 or (plan_dict or {}).get("zero")):
             # the ZeRO-1 dp mesh needs devices to shard over; fake 8 host
             # devices (must land before the first jax import in-process).
             # --tune only needs them where the grid has ZeRO candidates
             # (small/base) — the 8-way split slows the single-program
-            # timed run, so tiny/moe sweeps stay on one device
+            # timed run, so tiny/moe sweeps stay on one device.
+            # --pp needs the S-device pipeline mesh the same way
             import os
 
             os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
@@ -1093,6 +1162,11 @@ def main():
     import numpy as np
 
     import paddle_tpu as paddle
+
+    if args.pp >= 2:
+        result = _bench_pp(jax, backend, on_tpu, args)
+        print(json.dumps(_stamp(result)))
+        return
 
     run_plan = None
     if plan_dict is not None:

@@ -68,6 +68,9 @@ from .overlap import (
     DEFAULT_OVERLAP_FACTOR, overlap_lowered, overlap_report)
 from .schedule_lint import (
     build_schedule, bubble_fraction, check_schedule, lint_schedule)
+from . import schedule_engine  # noqa: F401
+from .schedule_engine import (  # noqa: F401
+    ScheduleRejected, TickProgram, admit, emit_tick_program, emitted_bubble)
 from .spec_algebra import Transfer, expected_collectives, normalize_spec, transition
 
 __all__ = [
@@ -77,6 +80,8 @@ __all__ = [
     "expected_collectives", "normalize_spec", "transition",
     "DEFAULT_BIG_BUFFER",
     "build_schedule", "bubble_fraction", "check_schedule", "lint_schedule",
+    "ScheduleRejected", "TickProgram", "admit", "emit_tick_program",
+    "emitted_bubble",
     "CollectiveSig", "collective_sequence", "match_collectives",
     "lint_rank_divergence", "lint_hlo_rank_divergence",
     "host_lint_source", "host_lint_paths", "host_lint_tree",

@@ -9,9 +9,10 @@ Per candidate the scorer reads, from ONE compile of the candidate program:
   analysis (the roofline terms),
 * exposed-collective bytes from ``analysis.overlap`` (comm the schedule
   cannot hide),
-* the pipeline ``bubble_fraction`` with the transfer term from
-  ``analysis.schedule_lint`` (closed form — pp > 1 candidates are scored
-  without building a pipeline),
+* the pipeline bubble term of the EMITTED, lint-certified schedule
+  (``analysis.schedule_engine.emitted_bubble`` — the same admission gate
+  the MPMD runtime runs behind; pp > 1 candidates are scored without
+  building a pipeline, and a schedule the lint rejects cannot rank),
 * the one-time reshard transition cost from the CURRENT plan via the PR 9
   planner, amortized over a re-plan horizon.
 
@@ -30,7 +31,6 @@ from typing import List, Optional
 
 from ..liveness import analyze_text, xla_peak_bytes
 from ..overlap import overlap_report
-from ..schedule_lint import bubble_fraction
 from .plan import PlanConfig
 
 __all__ = ["REF_CHIP", "PlanScore", "score_compiled", "score_lowered",
@@ -83,35 +83,57 @@ class PlanScore:
         }
 
 
-def _plan_bubble(plan: PlanConfig) -> float:
-    """Closed-form bubble fraction for a pp>1 plan (0.0 at pp=1)."""
+def _plan_bubble(plan: PlanConfig, *, hop_cost: float = 0.0) -> float:
+    """Bubble fraction of the EMITTED schedule for a pp>1 plan (0.0 at
+    pp=1): routed through ``schedule_engine.emitted_bubble``, so the number
+    the tuner ranks with is the lint-certified tick DAG the MPMD runtime
+    would walk — a plan whose schedule fails the static lint raises
+    :class:`~..schedule_engine.ScheduleRejected` and cannot rank.
+    ``hop_cost`` is the per-round transfer term in roofline units (the
+    ``x`` cost)."""
     if plan.pp <= 1:
         return 0.0
+    from ..schedule_engine import emitted_bubble
+    from ..schedule_lint import _canon_kind
+
     n_micro = max(plan.accum, 1)
-    kind = plan.schedule
-    hop = 2 if (plan.double_buffer and kind == "gpipe") else 1
-    bf = bubble_fraction(kind, plan.pp, n_micro, hop_ticks=hop)
-    return float(bf["fraction"])
+    db = plan.double_buffer and _canon_kind(plan.schedule) == "GPipe"
+    costs = {"x": float(hop_cost)} if hop_cost else None
+    return emitted_bubble(plan.schedule, plan.pp, n_micro,
+                          double_buffer=db, costs=costs)
 
 
 def score_compiled(compiled, plan: PlanConfig, *, hbm_budget: int,
                    tokens_per_step: int,
                    reshard_bytes: int = 0, reshard_peak: int = 0,
-                   prune_only: bool = False) -> PlanScore:
+                   prune_only: bool = False,
+                   hop_cost: float = 0.0) -> PlanScore:
     """Score one compiled candidate program.
 
     ``prune_only`` stops after the HBM constraint when it already failed —
     the search driver prunes before paying for the full vector.
+
+    pp > 1 candidates are scored from the SAME whole-model compile with
+    per-chip normalization — each stage holds ~1/pp of the program, so the
+    fit check and the roofline divide by pp, and the scalar score
+    multiplies back by pp (chip-seconds per token: pp chips run
+    concurrently) — plus the emitted-schedule bubble term, which is what
+    lets a pipeline plan buy FIT on a tight budget without faking free
+    speedup.  A pp plan whose emitted schedule fails the static lint is
+    recorded as non-fitting (pruned), never ranked.
     """
     text = compiled.as_text()
     res = analyze_text(text)
     xp = xla_peak_bytes(compiled)
-    s = PlanScore(plan=plan, peak_bytes=int(res.peak_bytes),
+    pp = max(1, int(plan.pp))
+    s = PlanScore(plan=plan, peak_bytes=int(res.peak_bytes) // pp,
                   xla_peak_bytes=int(xp[0]) if xp else 0,
                   hbm_budget=int(hbm_budget),
                   tokens_per_step=int(tokens_per_step),
                   reshard_bytes=int(reshard_bytes),
                   reshard_peak=int(reshard_peak))
+    if pp > 1:
+        s.notes.append(f"pp{pp}: per-stage peak/roofline = whole-program/pp")
     s.fits = s.peak_bytes <= hbm_budget
     if not s.fits:
         s.notes.append(
@@ -119,6 +141,7 @@ def score_compiled(compiled, plan: PlanConfig, *, hbm_budget: int,
         if prune_only:
             return s
 
+    from ..schedule_engine import ScheduleRejected
     from ...profiler.fusion_audit import bytes_per_step as _bps
     from ...utils.xla_cost import cost_of_executable
     b = _bps(compiled=compiled)
@@ -128,16 +151,22 @@ def score_compiled(compiled, plan: PlanConfig, *, hbm_budget: int,
 
     orep = overlap_report(text)
     s.exposed_bytes = float(orep.meta.get("overlap_exposed_bytes", 0.0))
-    s.bubble = _plan_bubble(plan)
+    try:
+        s.bubble = _plan_bubble(plan, hop_cost=hop_cost)
+    except ScheduleRejected as e:
+        s.fits = False
+        s.score = float("inf")
+        s.notes.append(f"emitted schedule rejected by static lint: {e}")
+        return s
 
     ref = REF_CHIP
     roof = max(s.flops_per_step / ref["flops_per_s"],
-               s.bytes_per_step / ref["hbm_bytes_per_s"])
+               s.bytes_per_step / ref["hbm_bytes_per_s"]) / pp
     comm = s.exposed_bytes / ref["ici_bytes_per_s"]
     s.step_units = (roof + comm) / max(1e-9, 1.0 - s.bubble)
     s.step_units += (s.reshard_bytes / ref["ici_bytes_per_s"]
                      / REPLAN_HORIZON_STEPS)
-    s.score = s.step_units / max(1, s.tokens_per_step)
+    s.score = s.step_units * pp / max(1, s.tokens_per_step)
     return s
 
 

@@ -63,6 +63,14 @@ def default_grid(preset: str, *, on_tpu: bool = False,
         grid.append(hand.but(zero=True, dp=8, source="tuner"))
         grid.append(hand.but(zero=True, dp=8, overlap_gather=True,
                              accum=2, source="tuner"))
+    # pipeline axis (needs a multi-device mesh): ranked with the EMITTED
+    # schedule's bubble term (schedule_engine.emitted_bubble, lint-gated);
+    # per-chip peak and roofline are normalized by pp in the scorer, so a
+    # pp plan buys FIT on a tight budget rather than fake free speedup
+    if n_devices >= 2:
+        grid.append(hand.but(pp=2, accum=4, schedule="zb", source="tuner"))
+    if n_devices >= 4:
+        grid.append(hand.but(pp=4, accum=8, schedule="zb", source="tuner"))
     # remat axis: trade FLOPs for resident bytes (batch step at fixed HBM)
     if preset in ("base",):
         grid.append(hand.but(batch=6, remat="full", accum=2, source="tuner"))

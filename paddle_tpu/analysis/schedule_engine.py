@@ -1,0 +1,144 @@
+"""MPMD schedule engine: verifier-gated emission of executable tick programs.
+
+The schedule verifier (:mod:`.schedule_lint`) statically proves a pipeline
+tick DAG deadlock-free; this module makes it the RUNTIME'S ADMISSION GATE
+(ROADMAP item 2, arXiv:2412.14374): the MPMD executor
+(:mod:`paddle_tpu.distributed.parallel.mpmd`) never walks a schedule that
+did not come out of :func:`admit` — ``build_schedule(...)`` elaborated,
+``lint_schedule(...)`` clean, THEN lowered to a tick program.  A lint
+finding raises :class:`ScheduleRejected` before the first tick runs, so a
+mis-lagged or dropped-edge schedule is an exception, not a hang.
+
+Emission, not description: the tick program the executor walks is derived
+from the SAME ``Schedule`` object the linter certified — compute ops in
+tick order, and one :class:`Transfer` per ``comm`` edge, posted the tick
+its producer completes (the PR-13 double-buffer discipline: the transfer
+rides the wire while later ticks compute) and due the consumer's tick.
+
+Defect injection (``SCHEDULE_GATE_INJECT=mpmd-drop-edge``) drops the
+microbatch-1 comm edges from the emitted schedule before linting — the
+admission gate must then fire, which is how ``scripts/schedule_gate.sh``
+proves the gate is live rather than decorative.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from collections import defaultdict
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Tuple, Union
+
+from .findings import Report
+from .schedule_lint import (Key, SchedOp, Schedule, _canon_kind,
+                            build_schedule, lint_schedule)
+
+__all__ = ["ScheduleRejected", "Transfer", "TickProgram", "admit",
+           "emit_tick_program", "emitted_bubble"]
+
+
+class ScheduleRejected(ValueError):
+    """An emitted schedule failed the static lint — refused at admission."""
+
+
+@dataclass(frozen=True)
+class Transfer:
+    """One explicit stage->stage activation/grad move (a ``comm`` edge of the
+    certified DAG).  ``post_tick`` is the producer's tick — the executor
+    issues the device_put there, so the copy is in flight while unrelated
+    ticks compute — and ``due_tick`` is when the consumer reads it."""
+
+    src: Key
+    dst: Key
+    src_stage: int
+    dst_stage: int
+    micro: int
+    post_tick: int
+    due_tick: int
+
+
+@dataclass
+class TickProgram:
+    """Executable lowering of a lint-certified :class:`Schedule`: per tick,
+    the compute ops and the transfers posted that tick, in issue order."""
+
+    schedule: Schedule
+    report: Report                # the clean lint report (admission evidence)
+    ticks: List[List[Union[SchedOp, Transfer]]]
+    n_transfers: int
+
+
+def _injected(sched: Schedule) -> Schedule:
+    """Apply the gate's defect injection to the emitted schedule (the gate
+    leg proves a broken emission is refused, not executed)."""
+    if os.environ.get("SCHEDULE_GATE_INJECT", "") == "mpmd-drop-edge":
+        edges = [e for e in sched.edges if not (e.comm and e.src[2] == 1)]
+        sched = dataclasses.replace(sched, edges=edges)
+    return sched
+
+
+def admit(kind: str, n_stages: int, n_micro: int,
+          virtual_pp_degree: int = 1, *, double_buffer: bool = False,
+          costs: Mapping[str, float] = None) -> Tuple[Schedule, Report]:
+    """Emit + certify: ``build_schedule`` -> ``lint_schedule``; any finding
+    raises :class:`ScheduleRejected` carrying the full lint report.  This is
+    the ONLY way the MPMD runtime obtains a schedule."""
+    sched = _injected(build_schedule(kind, n_stages, n_micro,
+                                     virtual_pp_degree,
+                                     double_buffer=double_buffer))
+    rep = lint_schedule(sched, costs=costs)
+    if rep:
+        raise ScheduleRejected(
+            f"mpmd admission ({sched.kind} S={n_stages} M={n_micro}): "
+            "emitted schedule fails static lint:\n" + rep.report())
+    return sched, rep
+
+
+_KIND_ORDER = {"F": 0, "B": 1, "W": 2}
+
+
+def emit_tick_program(sched: Schedule, report: Optional[Report] = None
+                      ) -> TickProgram:
+    """Lower a certified schedule to the executor's walk order.
+
+    Within a tick: F before B before W (a same-tick F->B stash edge has
+    min_lag 0 — the last stage seeds backward the round its forward
+    completes — so the write must issue first), then by stage/chunk/micro;
+    each op is followed immediately by its outgoing transfers so the copy
+    is posted as soon as the value exists."""
+    outgoing: Dict[Key, List[Transfer]] = defaultdict(list)
+    n_transfers = 0
+    for e in sched.edges:
+        if not e.comm:
+            continue
+        so, do = sched.ops[e.src], sched.ops[e.dst]
+        outgoing[e.src].append(Transfer(e.src, e.dst, so.stage, do.stage,
+                                        so.micro, so.tick, do.tick))
+        n_transfers += 1
+    by_tick: Dict[int, List[SchedOp]] = defaultdict(list)
+    for op in sched.ops.values():
+        by_tick[op.tick].append(op)
+    ticks: List[List[Union[SchedOp, Transfer]]] = []
+    for t in range(sched.total_ticks):
+        items: List[Union[SchedOp, Transfer]] = []
+        for op in sorted(by_tick.get(t, ()),
+                         key=lambda o: (_KIND_ORDER[o.kind], o.stage,
+                                        o.chunk, o.micro)):
+            items.append(op)
+            items.extend(sorted(outgoing.get(op.key, ()),
+                                key=lambda x: x.dst_stage))
+        ticks.append(items)
+    return TickProgram(sched, report, ticks, n_transfers)
+
+
+def emitted_bubble(kind: str, n_stages: int, n_micro: int, *,
+                   virtual_pp_degree: int = 1, double_buffer: bool = False,
+                   costs: Mapping[str, float] = None) -> float:
+    """The bubble term of the EMITTED schedule, for the autotuner: admit
+    (lint gate — a schedule that fails lint cannot rank) and return the
+    certified report's ``bubble_fraction`` meta.  ``costs`` carries the
+    roofline per-microbatch stage costs incl. the transfer term ``x``."""
+    _canon_kind(kind)  # fail fast on typos before paying elaboration
+    _sched, rep = admit(kind, n_stages, n_micro, virtual_pp_degree,
+                        double_buffer=double_buffer, costs=costs)
+    return float(rep.meta["bubble_fraction"])

@@ -35,6 +35,11 @@ Supported faults (all off by default):
   a minority leader stays reachable and can be asserted to never ack a
   write (no split brain).  Heal at runtime via
   :meth:`FaultInjector.set_store_partition`.
+- **pipeline stage kill** (``ft_inject_stage_kill_tick`` /
+  ``ft_inject_stage_kill_stage``) — the MPMD pipeline executor drops the
+  device hosting a stage at an exact schedule tick; the runtime must
+  re-plan the stage→device assignment onto survivors and restart the
+  step (``distributed.parallel.mpmd``), not shrink the whole job.
 """
 
 from __future__ import annotations
@@ -55,7 +60,8 @@ class FaultInjector:
                  store_delay_ms: int = 0, corrupt_step: int = -1,
                  crash_signal: int = 0, serve_kill_round: int = -1,
                  serve_kill_replica: int = -1, store_kill_leader: int = -1,
-                 store_partition: str = ""):
+                 store_partition: str = "", stage_kill_tick: int = -1,
+                 stage_kill_stage: int = -1):
         self.seed = int(seed)
         self.crash_step = int(crash_step)
         self.crash_rank = int(crash_rank)
@@ -68,6 +74,9 @@ class FaultInjector:
         self._serve_kill_fired = False
         self.store_kill_leader = int(store_kill_leader)
         self._store_kill_fired = False
+        self.stage_kill_tick = int(stage_kill_tick)
+        self.stage_kill_stage = int(stage_kill_stage)
+        self._stage_kill_fired = False
         self.set_store_partition(store_partition)
         # independent streams so enabling one fault cannot shift another's
         # decisions (replayability across configurations)
@@ -89,12 +98,16 @@ class FaultInjector:
                    store_kill_leader=flags.get_flag(
                        "ft_inject_store_kill_leader"),
                    store_partition=flags.get_flag(
-                       "ft_inject_store_partition"))
+                       "ft_inject_store_partition"),
+                   stage_kill_tick=flags.get_flag("ft_inject_stage_kill_tick"),
+                   stage_kill_stage=flags.get_flag(
+                       "ft_inject_stage_kill_stage"))
 
     def active(self) -> bool:
         return (self.crash_step >= 0 or self.store_drop_rate > 0.0
                 or self.store_delay_ms > 0 or self.corrupt_step >= 0
                 or self.serve_kill_round >= 0 or self.store_kill_leader >= 0
+                or self.stage_kill_tick >= 0
                 or bool(self._partition_groups))
 
     # -- fail-stop worker crash ---------------------------------------------
@@ -136,6 +149,22 @@ class FaultInjector:
         self._serve_kill_fired = True
         if self.serve_kill_replica in alive:
             return self.serve_kill_replica
+        return min(alive)
+
+    # -- pipeline stage kill -------------------------------------------------
+
+    def stage_kill_due(self, tick: int, alive: List[int]) -> Optional[int]:
+        """One-shot stage kill for the MPMD pipeline executor: returns the
+        victim stage when ``tick`` reaches the injected tick (the configured
+        stage if alive, else the lowest alive stage), ``None`` otherwise.
+        Fires at most once per injector — the re-plan onto survivors, not a
+        crash loop, is what the chaos test exercises."""
+        if (self.stage_kill_tick < 0 or self._stage_kill_fired
+                or tick < self.stage_kill_tick or not alive):
+            return None
+        self._stage_kill_fired = True
+        if self.stage_kill_stage in alive:
+            return self.stage_kill_stage
         return min(alive)
 
     # -- store faults --------------------------------------------------------

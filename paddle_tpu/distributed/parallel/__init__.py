@@ -11,6 +11,8 @@ from .mp_layers import (  # noqa: F401
     ParallelCrossEntropy,
 )
 from . import context_parallel  # noqa: F401
+from . import mpmd  # noqa: F401
+from .mpmd import MPMDPipeline, StageAssignment  # noqa: F401
 from . import segment_parallel  # noqa: F401
 from . import sequence_parallel  # noqa: F401
 from .context_parallel import ring_attention  # noqa: F401

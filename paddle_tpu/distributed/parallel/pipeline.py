@@ -706,7 +706,11 @@ class PipelineParallel(Layer):
           stash bounded by 2*pp microbatches), ``"ZB"``/``"ZBH1"``
           (zero-bubble: weight-grad deferred off the critical path —
           ``pipeline_zb_step``), or ``"VPP"`` (circular virtual stages — model
-          must be built with ``virtual_pp_degree > 1``).
+          must be built with ``virtual_pp_degree > 1``);
+        - ``runtime``: ``"spmd"`` (default — the whole schedule compiles into
+          one lockstep program) or ``"mpmd"`` (per-stage programs + explicit
+          transfers, host-driven, lint-gated at admission; 1F1B/ZB only —
+          see ``distributed.parallel.mpmd``).
         """
         from ...jit import TrainStep
 
@@ -722,6 +726,11 @@ class PipelineParallel(Layer):
                 f"unknown pipeline schedule {schedule!r}; choose FThenB (GPipe), "
                 "1F1B, ZB/ZBH1, or VPP — a typo must not silently fall back to "
                 "FThenB")
+        runtime = str(pc.get("runtime", "spmd")).lower()
+        if runtime not in ("spmd", "mpmd"):
+            raise ValueError(
+                f"unknown pipeline runtime {runtime!r}; choose 'spmd' (one "
+                "lockstep program) or 'mpmd' (per-stage programs)")
         acc = int(pc["accumulate_steps"]) if "accumulate_steps" in pc else 0
         model = self._layers
         if acc >= 1 and getattr(model, "n_micro", None) not in (None, acc):
@@ -729,6 +738,8 @@ class PipelineParallel(Layer):
             model._fwd_jit = None
             if hasattr(model, "_manual_fn"):
                 model._manual_fn = None
+            if hasattr(model, "_mpmd_fn"):
+                model._mpmd_fn = None
             self._compiled = None
         if schedule.upper() == "VPP" and getattr(model, "virtual_pp_degree", 1) <= 1:
             raise ValueError(
@@ -737,9 +748,32 @@ class PipelineParallel(Layer):
                 "virtual_pp_degree=2))")
 
         sched_u = schedule.upper()
-        cache_key = (id(optimizer), id(loss_fn), sched_u, acc)
+        cache_key = (id(optimizer), id(loss_fn), sched_u, acc, runtime)
         if self._compiled is None or self._compiled_key != cache_key:
-            if sched_u in ("1F1B", "ZB", "ZBH1"):
+            if runtime == "mpmd":
+                if sched_u not in ("1F1B", "ZB", "ZBH1"):
+                    raise ValueError(
+                        "runtime='mpmd' trains with the manual-vjp schedules "
+                        f"(1F1B, ZB/ZBH1); got schedule={schedule!r}")
+                if loss_fn is not None:
+                    raise ValueError(
+                        "runtime='mpmd' hand-rolls its vjp with the model's "
+                        "built-in next-token loss (build_mpmd_train_fn); a "
+                        "custom loss_fn would be silently ignored")
+                if not hasattr(model, "build_mpmd_train_fn"):
+                    raise ValueError(
+                        f"runtime='mpmd' needs {type(model).__name__}."
+                        "build_mpmd_train_fn (see LlamaForCausalLMPipe)")
+                mpmd_sched = "ZB" if sched_u in ("ZB", "ZBH1") else "1F1B"
+                if getattr(model, "_mpmd_fn", None) is None or \
+                        getattr(model, "_mpmd_fn_schedule", None) != mpmd_sched:
+                    model._mpmd_fn = model.build_mpmd_train_fn(
+                        schedule=mpmd_sched)
+                    model._mpmd_fn_schedule = mpmd_sched
+                self._compiled = TrainStep(model, None, optimizer,
+                                           grads_fn=model._mpmd_fn,
+                                           host_grads=True)
+            elif sched_u in ("1F1B", "ZB", "ZBH1"):
                 if loss_fn is not None:
                     raise ValueError(
                         f"schedule={schedule!r} hand-rolls its vjp with the "

@@ -123,3 +123,9 @@ define_flag("ft_inject_store_partition", "",
             "Partition replicated-store replicas: groups of comma-separated "
             "replica ids split by '|' (e.g. '0|1,2'); replica-to-replica "
             "links across groups drop, client links stay up ('' = healed)")
+define_flag("ft_inject_stage_kill_tick", -1,
+            "Kill the device hosting a pipeline stage at this MPMD schedule "
+            "tick (-1 off; one-shot — the executor must re-plan the "
+            "stage->device assignment onto survivors and restart the step)")
+define_flag("ft_inject_stage_kill_stage", -1,
+            "Stage index for the injected stage kill (-1 = lowest alive)")

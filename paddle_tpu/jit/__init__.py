@@ -447,10 +447,17 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, donate: bool = True, grads_fn=None,
-                 grad_dtype=None, accumulate_steps: int = 1, remat=None):
+                 grad_dtype=None, accumulate_steps: int = 1, remat=None,
+                 host_grads: bool = False):
         """``grads_fn(params, buffers, *args) -> (loss, grads)`` replaces the
         default ``jax.value_and_grad`` over ``loss_fn`` when given — used by
         schedules that hand-roll their vjp (compiled 1F1B pipeline).
+
+        ``host_grads=True``: ``grads_fn`` runs EAGERLY on the host instead of
+        inside the step's jit — the MPMD pipeline runtime drives one jitted
+        program per stage with explicit inter-device transfers, so the
+        schedule walk cannot live under a single jit.  Only grad clip + the
+        optimizer update compile, as a separate jitted apply program.
 
         ``grad_dtype`` (e.g. ``"bfloat16"``): cast gradient buffers to this
         dtype between backward and the optimizer update — with fp32-stored
@@ -485,6 +492,9 @@ class TrainStep:
         if accumulate_steps > 1 and grads_fn is not None:
             raise ValueError("accumulate_steps is incompatible with grads_fn "
                              "(pipeline schedules accumulate internally)")
+        if host_grads and grads_fn is None:
+            raise ValueError("host_grads=True needs a grads_fn — it IS the "
+                             "host-driven schedule")
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -586,7 +596,32 @@ class TrainStep:
             new_params, new_state = update_fn(params, grads, opt_state, lr, step)
             return loss, new_params, new_state
 
-        self._jitted = jax.jit(step_fn, donate_argnums=(0, 2) if donate else ())
+        self._host_grads = bool(host_grads)
+        self._grads_fn = grads_fn
+        if host_grads:
+            if gather_plan is not None:
+                raise ValueError("host_grads is incompatible with the "
+                                 "overlap_gather ZeRO step")
+
+            # the schedule already ran on the host; compile only the tail —
+            # clip + optimizer update — as one program
+            def apply_fn(params, grads, opt_state, lr, step):
+                if grad_dtype is not None:
+                    gd = jnp.dtype(grad_dtype)
+                    grads = jax.tree.map(lambda g: g.astype(gd), grads)
+                if grad_clip is not None:
+                    flat = [(None, g) for g in jax.tree.leaves(grads)]
+                    clipped = [g for _, g in grad_clip(flat)]
+                    grads = jax.tree.unflatten(jax.tree.structure(grads),
+                                               clipped)
+                return update_fn(params, grads, opt_state, lr, step)
+
+            self._jitted = None
+            self._apply = jax.jit(
+                apply_fn, donate_argnums=(0, 2) if donate else ())
+        else:
+            self._jitted = jax.jit(
+                step_fn, donate_argnums=(0, 2) if donate else ())
 
     def __call__(self, *args):
         raw = unwrap(tuple(args))
@@ -594,9 +629,20 @@ class TrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         step = jnp.asarray(self._step, jnp.int32)
         key = rnd.next_key()
-        loss, self._params, self._opt_state = self._jitted(
-            self._params, self._buffers, self._opt_state, lr, step, key, raw
-        )
+        if self._host_grads:
+            loss, grads = self._grads_fn(self._params, self._buffers, *raw)
+            # a host-driven schedule (e.g. the MPMD executor) may hand grads
+            # back on its own stage devices; the update runs on the params'
+            # shardings, so land them there first
+            grads = jax.tree.map(
+                lambda p, g: jax.device_put(g, p.sharding), self._params,
+                grads)
+            self._params, self._opt_state = self._apply(
+                self._params, grads, self._opt_state, lr, step)
+        else:
+            loss, self._params, self._opt_state = self._jitted(
+                self._params, self._buffers, self._opt_state, lr, step, key,
+                raw)
         # reflect updated weights into the eager Layer
         for n, p in self.model.named_parameters():
             p._data = self._params[n]

@@ -49,7 +49,8 @@ stage store_chaos   bash -c "\
         tests/test_store_replicated.py \
     && timeout -k 10 600 python -m pytest -q -p no:cacheprovider \
         tests/test_chaos.py -k 'store_leader or store_quorum \
-                                or store_partitioned or launcher_store'"
+                                or store_partitioned or launcher_store \
+                                or mpmd_stage'"
 stage host_lint     python -m paddle_tpu.analysis.host_lint
 
 echo "=== [ci] summary ===" >&2

@@ -37,6 +37,18 @@ for args in "--wus seq --overlap" "--wus overlap --overlap"; do
         [ -n "$line" ] && echo "$line" >> "$OUT" && echo "$line" | head -c 200 >&2 && echo >&2
     done
 done
+# MPMD pipeline runtime A/B (per-stage programs + explicit ICI transfers
+# vs the lockstep SPMD scan): CPU-proxy numbers (2026-08-06) are pp=4 ZB
+# 1.71x tok/s over lockstep 1F1B (bubble 0.43 -> ~0) and pp=2 1.43x; these
+# rows measure the same A/B where the transfers ride real ICI instead of
+# host RAM, at both pp widths and both schedules
+for args in "--pp 2 --pp-runtime both --pp-schedule zb" \
+            "--pp 4 --pp-runtime both --pp-schedule zb" \
+            "--pp 4 --pp-runtime both --pp-schedule 1f1b"; do
+    echo "[revival] pp $args" >&2
+    line=$(timeout 2400 python bench.py --device tpu $args 2>/dev/null | tail -1)
+    [ -n "$line" ] && echo "$line" >> "$OUT" && echo "$line" | head -c 200 >&2 && echo >&2
+done
 echo "[revival] serve (post-rework)" >&2
 line=$(timeout 2400 python bench.py --preset serve --device tpu 2>/dev/null | tail -1)
 [ -n "$line" ] && echo "$line" >> "$OUT" && echo "$line" | head -c 200 >&2 && echo >&2

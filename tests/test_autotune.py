@@ -168,6 +168,56 @@ def test_sweep_prunes_injected_bad_plan(monkeypatch):
     assert meta["tune_pruned"]
 
 
+def test_pp_plans_rank_with_emitted_schedule_bubble(monkeypatch):
+    """The parked pp axis is live: pp>1 candidates score with the EMITTED,
+    lint-certified schedule's bubble term (schedule_engine.emitted_bubble)
+    and per-chip peak/roofline normalization — and a schedule the lint
+    rejects is pruned, never ranked."""
+    from paddle_tpu.analysis.schedule_engine import emitted_bubble
+    from paddle_tpu.analysis.autotune.scorer import score_compiled
+
+    monkeypatch.delenv("SCHEDULE_GATE_INJECT", raising=False)
+    hand = PlanConfig(preset="tiny")
+    ppp = hand.but(pp=2, accum=4, schedule="zb", source="tuner")
+    lowered, tokens = _tiny_builder(hand)
+    compiled = lowered.compile()
+    budget = at.default_budget("tiny", False)
+
+    s_hand = score_compiled(compiled, hand, hbm_budget=budget,
+                            tokens_per_step=tokens)
+    s_pp = score_compiled(compiled, ppp, hbm_budget=budget,
+                          tokens_per_step=tokens)
+    assert s_hand.bubble == 0.0
+    assert s_pp.bubble == pytest.approx(emitted_bubble("zb", 2, 4))
+    assert s_pp.bubble > 0
+    # per-chip normalization: each stage holds ~1/pp of the program
+    assert s_pp.peak_bytes == s_hand.peak_bytes // 2
+    # chip-seconds accounting: pp pays its bubble, no fake free speedup
+    assert s_pp.score > s_hand.score
+
+    # a rejected emitted schedule cannot rank (same injection the gate uses)
+    monkeypatch.setenv("SCHEDULE_GATE_INJECT", "mpmd-drop-edge")
+    s_bad = score_compiled(compiled, ppp, hbm_budget=budget,
+                           tokens_per_step=tokens)
+    assert not s_bad.fits and s_bad.score == float("inf")
+    assert any("rejected" in n for n in s_bad.notes)
+    # pp=1 plans don't touch the schedule engine: unaffected
+    s_ok = score_compiled(compiled, hand, hbm_budget=budget,
+                          tokens_per_step=tokens)
+    assert s_ok.fits
+
+
+def test_default_grid_pp_axis_on_multi_device_mesh(monkeypatch):
+    monkeypatch.delenv("TUNE_GATE_INJECT", raising=False)
+    assert not any(p.pp > 1 for p in at.default_grid("tiny", n_devices=1))
+    g2 = at.default_grid("tiny", n_devices=2)
+    assert [p.pp for p in g2 if p.pp > 1] == [2]
+    g8 = at.default_grid("tiny", n_devices=8)
+    pps = sorted(p.pp for p in g8 if p.pp > 1)
+    assert pps == [2, 4]
+    assert g8[0].source == "hand"   # hand stays first
+
+
 # ------------------------------------------------------ remat/offload policy
 
 def test_remat_policy_buys_batch_step_at_fixed_budget():

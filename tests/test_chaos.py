@@ -569,3 +569,103 @@ def test_launcher_store_replicas_flag_end_to_end(tmp_path):
                         for line in out.splitlines()
                         if line.startswith("ASSIGNED"))
     assert eps_counts[-1] == 3, outs
+
+
+# -- MPMD pipeline stage kill -> local re-plan (not whole-job shrink) --------
+
+
+def _mpmd_toy(S, M, dim=16, mb=4, seed=0):
+    import jax.numpy as jnp
+
+    def first_fn(fp, d):
+        return d @ fp
+
+    def block_fn(sp, x):
+        return jnp.tanh(x @ sp[0])
+
+    def last_fn(lp, y, d):
+        return ((y @ lp) ** 2).mean() / M
+
+    rng = np.random.default_rng(seed)
+    sp = jnp.asarray(rng.normal(size=(S, dim, dim)), jnp.float32) * 0.05
+    fp = jnp.asarray(rng.normal(size=(dim, dim)), jnp.float32) * 0.05
+    lp = jnp.asarray(rng.normal(size=(dim, 1)), jnp.float32) * 0.05
+    data = jnp.asarray(rng.normal(size=(M, mb, dim)), jnp.float32)
+    return (first_fn, block_fn, last_fn), (sp, fp, lp, data)
+
+
+def test_mpmd_stage_kill_replans_bit_identical():
+    """FLAGS_ft_inject-driven stage kill mid-step: the MPMD executor drops
+    the dead device, re-plans stage->device round-robin over the survivors
+    (params migrated through the PR-9 reshard engine), restarts the
+    schedule, and the step's losses/grads are BIT-IDENTICAL to a reference
+    executor built directly on the shrunken assignment."""
+    import jax
+    from paddle_tpu.distributed.fault_tolerance.injection import set_injector
+    from paddle_tpu.distributed.parallel.mpmd import MPMDPipeline
+    from paddle_tpu.framework import flags
+
+    S, M = 4, 8
+    devs = jax.devices()
+    if len(devs) < S:
+        pytest.skip(f"need {S} devices, have {len(devs)}")
+    devs = tuple(devs[:S])
+    (first_fn, block_fn, last_fn), args = _mpmd_toy(S, M)
+    flags.set_flags({"ft_inject_stage_kill_tick": 5,
+                     "ft_inject_stage_kill_stage": 1})
+    try:
+        set_injector(FaultInjector.from_flags())
+        pipe = MPMDPipeline(block_fn, S, M, first_fn=first_fn,
+                            last_fn=last_fn, schedule="1F1B", devices=devs)
+        out = pipe.step(*args)
+        assert pipe.stats["replans"] == 1
+        # stage 1's device died: every displaced stage migrated its params
+        assert pipe.stats["migrated_arrays"] > 0
+        assert len(pipe._assign.devices) == S - 1
+    finally:
+        set_injector(None)
+        flags.set_flags({"ft_inject_stage_kill_tick": -1,
+                         "ft_inject_stage_kill_stage": -1})
+
+    survivors = tuple(d for d in devs if d is not devs[1])
+    ref = MPMDPipeline(block_fn, S, M, first_fn=first_fn, last_fn=last_fn,
+                       schedule="1F1B", devices=survivors)
+    ref_out = ref.step(*args)
+    for got, want in zip(out, ref_out):
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_mpmd_stage_kill_zb_one_shot_then_clean_steps():
+    """ZB variant: the kill is one-shot (injection latch) — the replanned
+    step completes, and the NEXT step runs on the shrunken assignment with
+    no further re-plans, still bit-identical to the no-fault reference."""
+    import jax
+    from paddle_tpu.distributed.fault_tolerance.injection import set_injector
+    from paddle_tpu.distributed.parallel.mpmd import MPMDPipeline
+    from paddle_tpu.framework import flags
+
+    S, M = 2, 4
+    devs = tuple(jax.devices()[:S])
+    if len(devs) < S:
+        pytest.skip(f"need {S} devices")
+    (first_fn, block_fn, last_fn), args = _mpmd_toy(S, M, seed=7)
+    flags.set_flags({"ft_inject_stage_kill_tick": 0,
+                     "ft_inject_stage_kill_stage": 0})
+    try:
+        set_injector(FaultInjector.from_flags())
+        pipe = MPMDPipeline(block_fn, S, M, first_fn=first_fn,
+                            last_fn=last_fn, schedule="ZB", devices=devs)
+        out1 = pipe.step(*args)
+        assert pipe.stats["replans"] == 1
+        out2 = pipe.step(*args)
+        assert pipe.stats["replans"] == 1   # latched: no second kill
+    finally:
+        set_injector(None)
+        flags.set_flags({"ft_inject_stage_kill_tick": -1,
+                         "ft_inject_stage_kill_stage": -1})
+    ref = MPMDPipeline(block_fn, S, M, first_fn=first_fn, last_fn=last_fn,
+                       schedule="ZB", devices=(devs[1],))
+    ref_out = ref.step(*args)
+    for a, b, c in zip(out1, out2, ref_out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(c), np.asarray(a))

@@ -1,0 +1,180 @@
+"""MPMD pipeline runtime: bit-identity vs the single-program schedules,
+admission-gate behavior, transfer accounting."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.framework.shard_map_compat import shard_map
+from paddle_tpu.distributed.parallel.mpmd import (MPMDPipeline,
+                                                  StageAssignment)
+from paddle_tpu.distributed.parallel.pipeline import (
+    pipeline_1f1b_step, pipeline_spmd_step, pipeline_vpp_step,
+    pipeline_zb_step)
+from paddle_tpu.analysis import schedule_engine
+from paddle_tpu.analysis.schedule_engine import (ScheduleRejected, admit,
+                                                 emit_tick_program)
+
+S, M, DIM, MB = 4, 8, 32, 8
+
+
+def _first_fn(fp, d):
+    return d @ fp
+
+
+def _block_fn(sp, x):
+    return jnp.tanh(x @ sp[0])
+
+
+def _last_fn(lp, y, d):
+    return ((y @ lp) ** 2).mean() / M
+
+
+def _toy_params(seed=0):
+    rng = np.random.default_rng(seed)
+    sp = jnp.asarray(rng.normal(size=(S, DIM, DIM)), jnp.float32) * 0.05
+    fp = jnp.asarray(rng.normal(size=(DIM, DIM)), jnp.float32) * 0.05
+    lp = jnp.asarray(rng.normal(size=(DIM, 1)), jnp.float32) * 0.05
+    data = jnp.asarray(rng.normal(size=(M, MB, DIM)), jnp.float32)
+    return sp, fp, lp, data
+
+
+def _pp_mesh(n=S):
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"need {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), ("pp",))
+
+
+def _ref_train(kind):
+    mesh = _pp_mesh()
+    build = pipeline_zb_step if kind == "ZB" else pipeline_1f1b_step
+    sched = build(_first_fn, _block_fn, _last_fn, S, M)
+    return jax.jit(shard_map(
+        sched, mesh=mesh, in_specs=(P("pp"), P(), P(), P()),
+        out_specs=(P(), P("pp"), P(), P())))
+
+
+@pytest.mark.parametrize("kind", ["1F1B", "ZB"])
+def test_mpmd_train_bit_identity(kind):
+    """Losses and ALL grads bitwise equal to the single-program schedule."""
+    sp, fp, lp, data = _toy_params()
+    loss_r, gs_r, gf_r, gl_r = _ref_train(kind)(sp, fp, lp, data)
+    pipe = MPMDPipeline(_block_fn, S, M, first_fn=_first_fn,
+                        last_fn=_last_fn, schedule=kind)
+    loss_m, gs_m, gf_m, gl_m = pipe.step(sp, fp, lp, data)
+    np.testing.assert_array_equal(np.asarray(loss_r), np.asarray(loss_m))
+    np.testing.assert_array_equal(np.asarray(gs_r), np.asarray(gs_m))
+    np.testing.assert_array_equal(np.asarray(gf_r), np.asarray(gf_m))
+    np.testing.assert_array_equal(np.asarray(gl_r), np.asarray(gl_m))
+
+
+def test_mpmd_gpipe_forward_matches_spmd():
+    sp, _, _, data = _toy_params()
+    mesh = _pp_mesh()
+    sched = pipeline_spmd_step(_block_fn, S, M, remat=False)
+    ref = jax.jit(shard_map(sched, mesh=mesh, in_specs=(P("pp"), P()),
+                            out_specs=P("pp")))(sp, data)[-1]
+    pipe = MPMDPipeline(_block_fn, S, M, schedule="GPipe")
+    out = pipe.run_forward(sp, data)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_mpmd_gpipe_double_buffer_forward():
+    """The hop_ticks=2 (double-buffer posting) schedule admits and matches."""
+    sp, _, _, data = _toy_params()
+    mesh = _pp_mesh()
+    sched = pipeline_spmd_step(_block_fn, S, M, remat=False,
+                               double_buffer=True)
+    ref = jax.jit(shard_map(sched, mesh=mesh, in_specs=(P("pp"), P()),
+                            out_specs=P("pp")))(sp, data)[-1]
+    pipe = MPMDPipeline(_block_fn, S, M, schedule="GPipe",
+                        double_buffer=True)
+    assert pipe._sched.hop_ticks == 2
+    out = pipe.run_forward(sp, data)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_mpmd_vpp_forward_matches_vpp_step():
+    V = 2
+    rng = np.random.default_rng(1)
+    spv = jnp.asarray(rng.normal(size=(S, V, DIM, DIM)), jnp.float32) * 0.05
+    data = jnp.asarray(rng.normal(size=(M, MB, DIM)), jnp.float32)
+    block_v = lambda cp, x: jnp.tanh(x @ cp)
+    mesh = _pp_mesh()
+    sched = pipeline_vpp_step(block_v, S, M, V, remat=False)
+    ref = jax.jit(shard_map(sched, mesh=mesh, in_specs=(P("pp"), P()),
+                            out_specs=P("pp")))(spv, data)[-1]
+    pipe = MPMDPipeline(block_v, S, M, schedule="VPP", virtual_pp_degree=V)
+    out = pipe.run_forward(spv, data)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_admission_gate_rejects_dropped_edge(monkeypatch):
+    """The PR-8 verifier is the runtime's admission gate: an emitted
+    schedule with a dropped comm edge raises BEFORE any tick runs."""
+    real = schedule_engine.build_schedule
+
+    def broken(*a, **kw):
+        sched = real(*a, **kw)
+        sched.edges = [e for e in sched.edges if not e.comm]
+        return sched
+
+    monkeypatch.setattr(schedule_engine, "build_schedule", broken)
+    with pytest.raises(ValueError, match="static lint"):
+        MPMDPipeline(_block_fn, S, M, first_fn=_first_fn,
+                     last_fn=_last_fn, schedule="1F1B")
+
+
+def test_admission_gate_injection_env(monkeypatch):
+    """SCHEDULE_GATE_INJECT=mpmd-drop-edge (the schedule_gate leg) makes
+    every admission fail — the executor refuses to construct."""
+    monkeypatch.setenv("SCHEDULE_GATE_INJECT", "mpmd-drop-edge")
+    with pytest.raises(ScheduleRejected, match="static lint"):
+        admit("ZB", S, M)
+    with pytest.raises(ScheduleRejected):
+        MPMDPipeline(_block_fn, S, M, first_fn=_first_fn,
+                     last_fn=_last_fn, schedule="ZB")
+
+
+def test_tick_program_transfers_and_stash_bound():
+    sp, fp, lp, data = _toy_params()
+    pipe = MPMDPipeline(_block_fn, S, M, first_fn=_first_fn,
+                        last_fn=_last_fn, schedule="1F1B")
+    # every comm edge of the certified DAG becomes exactly one transfer
+    n_comm = sum(1 for e in pipe._sched.edges if e.comm)
+    assert pipe._program.n_transfers == n_comm
+    pipe.step(sp, fp, lp, data)
+    assert pipe.stats["transfers_posted"] == n_comm
+    assert pipe.stats["transfer_bytes"] == n_comm * MB * DIM * 4
+    assert pipe.stats["ticks"] == pipe._sched.total_ticks
+    # runtime stash high-water respects the verifier's per-stage bound
+    assert pipe.stats["stash_high_water"] <= pipe._sched.stash_slots
+    # admission evidence retained
+    assert not pipe.lint_report
+    assert float(pipe.lint_report.meta["bubble_fraction"]) > 0
+
+
+def test_stage_assignment_replan_round_robin():
+    devs = jax.devices()[:4]
+    a = StageAssignment(4, tuple(devs))
+    assert a.device(2) is devs[2]
+    b = a.without(devs[1])
+    assert b.device(0) is devs[0]
+    assert b.device(1) is devs[2]
+    assert b.device(3) is devs[0]   # wraps round-robin over 3 survivors
+    with pytest.raises(RuntimeError):
+        StageAssignment(2, (devs[0],)).without(devs[0])
+
+
+def test_emit_tick_program_orders_f_before_b():
+    sched, rep = admit("1F1B", S, M)
+    prog = emit_tick_program(sched, rep)
+    assert len(prog.ticks) == sched.total_ticks
+    for items in prog.ticks:
+        kinds = [o.kind for o in items if not hasattr(o, "post_tick")]
+        assert kinds == sorted(kinds, key=lambda k: {"F": 0, "B": 1,
+                                                     "W": 2}[k])

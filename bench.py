@@ -20,8 +20,11 @@ Presets:
   decode — KV-cache greedy generation (prefill 512 + 512 new tokens):
            serving-path throughput; vs_baseline = fraction of the
            weight-streaming bandwidth bound
+  ssd    — O(1)-cache decode family: kernel bit-identity, serve-vs-
+           generate parity on the RecurrentState backend, memory_plan
+           honesty, and the flat-vs-linear footprint curve at 8B scale
 
-Usage: python bench.py [--preset tiny|small|base|longctx|ocr|moe|decode|serve]
+Usage: python bench.py [--preset tiny|small|base|longctx|ocr|moe|decode|serve|ssd]
        [--device cpu|tpu] [--steps N] [--batch B] [--seq S]
        [--accum K] [--grad-dtype bfloat16|float32]
 """
@@ -785,6 +788,202 @@ def _bench_serve_trace(jax, paddle, backend, on_tpu, args):
     return result
 
 
+def _bench_ssd(jax, paddle, backend, on_tpu, args):
+    """O(1)-cache decode: the SSD/Mamba family's headline numbers.
+
+    One JSON line, four deterministic sections plus one timed number:
+
+    - ``kernel_bit_identical`` — the chunked Pallas scan (interpret mode on
+      the CPU proxy, compiled on TPU) vs ``ssd_scan_reference``;
+    - ``serve_matches_generate`` — tiny pure-SSD engine through the
+      ``RecurrentState`` backend vs ``model.generate`` greedy (``value`` is
+      the serve-loop new tokens/s while it runs);
+    - ``plan_within_10pct`` — ``memory_plan()``'s ``state_bytes`` /
+      ``kv_pool_bytes`` vs the live device arrays' actual bytes, for the
+      pure AND hybrid engines (the acceptance bound is 10%; the formulas
+      are exact so the measured error is ~0);
+    - the flat-vs-linear footprint story at 8B scale: per-sequence cache
+      bytes at 4k/16k/64k context for the SSD-8B config vs Llama-3-8B,
+      pure ``cache_spec`` arithmetic (no 8B params are instantiated).
+
+    ``SSD_GATE_INJECT=kv-backend`` prices the SSD family through paged-KV
+    arithmetic instead of its recurrent backend — the defect a missing
+    CacheBackend seam would produce.  The flat-footprint invariant breaks
+    and ``scripts/ssd_gate.sh`` must exit non-zero.
+
+    With ``--trace long_prompt``: additionally A/B the engine's dispatch
+    staging (host-side table/sampling uploads skipped when the schedule is
+    unchanged) on the llama long-prompt trace — ``staging_gap_p99_ratio``
+    is the per-dispatch decode-gap p99, staged over unstaged.
+    """
+    import os
+
+    import numpy as np
+
+    from paddle_tpu.kernels.ssd_scan import ssd_scan, ssd_scan_reference
+    from paddle_tpu.models import (SSDForCausalLM, ssd_8b_config,
+                                   ssd_tiny_config, ssd_tiny_hybrid_config)
+    from paddle_tpu.models.llama import llama3_8b_config
+    from paddle_tpu.models.ssd import ssd_cache_spec
+    from paddle_tpu.serving import Engine, GenRequest, make_backend
+
+    jnp = jax.numpy
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+
+    # -- kernel bit-identity (the training-path contract) -------------------
+    G, T, N, P, chunk = (8, 512, 128, 128, 128) if on_tpu \
+        else (3, 64, 8, 16, 16)
+    kx = rng.standard_normal((G, T, P)).astype(np.float32)
+    kb = rng.standard_normal((G, T, N)).astype(np.float32)
+    kc = rng.standard_normal((G, T, N)).astype(np.float32)
+    kla = -np.abs(rng.standard_normal((G, T)).astype(np.float32)) * 0.1
+    y_k, s_k = ssd_scan(kx, kb, kc, kla, chunk=chunk, interpret=not on_tpu)
+    y_r, s_r = ssd_scan_reference(jnp.asarray(kx), jnp.asarray(kb),
+                                  jnp.asarray(kc), jnp.asarray(kla),
+                                  chunk=chunk)
+    kernel_ok = bool(np.array_equal(np.asarray(y_k), np.asarray(y_r))
+                     and np.array_equal(np.asarray(s_k), np.asarray(s_r)))
+
+    # -- serve-vs-generate parity on the RecurrentState backend -------------
+    cfg = ssd_tiny_config()
+    model = SSDForCausalLM(cfg)
+    n_params = sum(p.size for p in model.parameters())
+    eng = Engine(model, num_blocks=32, block_size=16, max_batch=4,
+                 prefill_buckets=(32, 64))
+    lengths, max_new = (7, 13, 24, 18, 9, 21), 16
+    prompts = [rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
+               for n in lengths]
+    for i, p in enumerate(prompts):
+        eng.add_request(GenRequest(prompt_ids=p, max_new_tokens=max_new,
+                                   temperature=0.0, request_id=f"r{i}"))
+    t0 = time.perf_counter()
+    outs = {o.request_id: o for o in eng.run_to_completion()}
+    dt = time.perf_counter() - t0
+    new_tokens = sum(len(o.output_ids) for o in outs.values())
+    parity = all(
+        np.array_equal(
+            outs[f"r{i}"].output_ids,
+            np.asarray(model.generate(
+                paddle.to_tensor(p[None, :]),
+                max_new_tokens=max_new)._data)[0, len(p):])
+        for i, p in enumerate(prompts))
+
+    # -- memory_plan honesty: predicted vs live device bytes ----------------
+    def _state_nbytes(states):
+        return sum(int(a.size) * a.dtype.itemsize
+                   for st in states for a in st.values())
+
+    plan = eng.memory_plan()
+    state_actual = _state_nbytes(eng._ssd_state)
+    state_err = abs(plan["state_bytes"] - state_actual) / max(state_actual, 1)
+    paddle.seed(1)
+    eng_h = Engine(SSDForCausalLM(ssd_tiny_hybrid_config()), num_blocks=32,
+                   block_size=16, max_batch=4, prefill_buckets=(32, 64))
+    plan_h = eng_h.memory_plan()
+    hybrid_actual = (_state_nbytes(eng_h._ssd_state)
+                     + sum(int(a.size) * a.dtype.itemsize
+                           for pool in (eng_h.k_pools, eng_h.v_pools)
+                           for a in pool))
+    hybrid_plan = plan_h["state_bytes"] + plan_h["kv_pool_bytes"]
+    hybrid_err = abs(hybrid_plan - hybrid_actual) / max(hybrid_actual, 1)
+
+    # -- flat-vs-linear at 8B scale (pure cache_spec arithmetic) ------------
+    spec8 = ssd_cache_spec(ssd_8b_config())
+    if os.environ.get("SSD_GATE_INJECT", "") == "kv-backend":
+        # defect injection: price the SSD layers as if they paged KV — the
+        # footprint curve turns linear and the gate must catch it
+        cfg8 = ssd_8b_config()
+        spec8 = {"kinds": ("attention",) * cfg8.num_hidden_layers,
+                 "state_bytes_per_slot": 0,
+                 "kv_layers": cfg8.num_hidden_layers,
+                 "kv_bytes_per_token_layer":
+                     2 * cfg8.kv_heads * cfg8.head_dim
+                     * jnp.dtype(cfg8.dtype).itemsize}
+    lcfg = llama3_8b_config()
+    lspec = {"kinds": ("attention",) * lcfg.num_hidden_layers,
+             "state_bytes_per_slot": 0,
+             "kv_layers": lcfg.num_hidden_layers,
+             "kv_bytes_per_token_layer":
+                 2 * lcfg.kv_heads * lcfg.head_dim
+                 * jnp.dtype(lcfg.dtype).itemsize}
+    ctxs = (4096, 16384, 65536)
+    be8 = make_backend(spec8, num_blocks=1, block_size=128, max_slots=1)
+    bel = make_backend(lspec, num_blocks=1, block_size=128, max_slots=1)
+    ssd8 = {c: be8.seq_bytes(c) for c in ctxs}
+    llama8 = {c: bel.seq_bytes(c) for c in ctxs}
+
+    result = {
+        "metric": "ssd_serve_new_tokens_per_sec",
+        "value": round(new_tokens / dt, 2),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "mfu": 0.0,
+        "device": _peak_flops(jax, on_tpu)[0],
+        "backend": backend,
+        "preset": "ssd",
+        "params": n_params,
+        "requests": len(prompts),
+        "completed": len(outs),
+        "new_tokens": new_tokens,
+        "kernel_bit_identical": kernel_ok,
+        "serve_matches_generate": bool(parity),
+        "state_plan_err": round(state_err, 6),
+        "hybrid_plan_err": round(hybrid_err, 6),
+        "plan_within_10pct": bool(state_err <= 0.1 and hybrid_err <= 0.1),
+        "state_bytes_per_slot": spec8.get("state_bytes_per_slot", 0),
+        "ssd8b_seq_mb": {str(c): round(v / 1e6, 2) for c, v in ssd8.items()},
+        "llama8b_seq_mb": {str(c): round(v / 1e6, 2)
+                           for c, v in llama8.items()},
+        "footprint_flat": bool(ssd8[ctxs[0]] == ssd8[ctxs[-1]]),
+        "flat_vs_linear_64k": round(llama8[65536] / max(ssd8[65536], 1), 2),
+    }
+
+    # -- dispatch staging A/B (PR 13 remainder), opt-in: --trace long_prompt
+    if args.trace == "long_prompt":
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        from paddle_tpu.serving.loadgen import make_trace, run_trace
+        from paddle_tpu.serving.router import Router
+
+        paddle.seed(0)
+        lmodel = LlamaForCausalLM(llama_tiny_config(
+            dtype="float32", max_position_embeddings=1024))
+        trace = make_trace("long_prompt", lmodel.config.vocab_size, seed=0,
+                           n_requests=8, long_len=512, max_new_tokens=8)
+
+        def run_staged(staged):
+            e = Engine(lmodel, max_batch=2, num_blocks=24,
+                       prefill_buckets=(128, 256, 512),
+                       dispatch_staging=staged)
+            e.warmup()
+            r = Router()
+            r.add_replica(e)
+            m = run_trace(r, trace)
+            gaps = sorted(e._decode_gaps)
+            m["dispatch_gap_p99_ms"] = (
+                1e3 * float(np.percentile(gaps, 99)) if gaps else 0.0)
+            return m
+
+        m_on = run_staged(True)
+        m_off = run_staged(False)
+        result.update({
+            "trace": "long_prompt",
+            "staging_outputs_bit_identical":
+                m_on["outputs"] == m_off["outputs"],
+            "staged_dispatch_gap_p99_ms":
+                round(m_on["dispatch_gap_p99_ms"], 3),
+            "unstaged_dispatch_gap_p99_ms":
+                round(m_off["dispatch_gap_p99_ms"], 3),
+            "staging_gap_p99_ratio": round(
+                m_on["dispatch_gap_p99_ms"]
+                / max(m_off["dispatch_gap_p99_ms"], 1e-9), 4),
+            "staged_decode_gap_p99_ms": round(m_on["decode_gap_p99_ms"], 3),
+            "unstaged_decode_gap_p99_ms": round(m_off["decode_gap_p99_ms"],
+                                                3),
+        })
+    return result
+
+
 def _bench_ocr(jax, paddle, backend, on_tpu, args):
     """DBNet detector train step: images/s; FLOPs from XLA's cost analysis of
     the compiled program (convs don't have a tidy closed form like 6P)."""
@@ -1015,7 +1214,7 @@ def _bench_pp(jax, backend, on_tpu, args):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--preset", default=None, choices=["tiny", "small", "base", "longctx", "ocr", "moe", "decode", "serve"])
+    ap.add_argument("--preset", default=None, choices=["tiny", "small", "base", "longctx", "ocr", "moe", "decode", "serve", "ssd"])
     ap.add_argument("--device", default=None, choices=["cpu", "tpu"])
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--batch", type=int, default=None)
@@ -1208,6 +1407,10 @@ def main():
             result = _bench_serve_trace(jax, paddle, backend, on_tpu, args)
         else:
             result = _bench_serve(jax, paddle, backend, on_tpu, args)
+        print(json.dumps(_stamp(result)))
+        return
+    if preset == "ssd":
+        result = _bench_ssd(jax, paddle, backend, on_tpu, args)
         print(json.dumps(_stamp(result)))
         return
     if preset == "ocr":

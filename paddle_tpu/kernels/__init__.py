@@ -26,4 +26,4 @@ def use_pallas() -> bool:
         return False
 
 
-from . import adamw, flash_attention, rms_norm, rope, swiglu  # noqa: E402,F401
+from . import adamw, flash_attention, rms_norm, rope, ssd_scan, swiglu  # noqa: E402,F401

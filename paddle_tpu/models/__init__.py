@@ -14,6 +14,15 @@ from .llama import (  # noqa: F401
     llama3_8b_config,
     llama3_70b_config,
 )
+from . import ssd  # noqa: F401
+from .ssd import (  # noqa: F401
+    SSDConfig,
+    SSDForCausalLM,
+    SSDModel,
+    ssd_tiny_config,
+    ssd_tiny_hybrid_config,
+    ssd_8b_config,
+)
 from . import ernie  # noqa: F401
 from . import hf_compat  # noqa: F401
 from . import ocr  # noqa: F401

@@ -623,6 +623,10 @@ class LlamaForCausalLM(Layer):
     def init_cache(self, batch_size: int, max_len: int, dtype=None):
         return self.llama.init_cache(batch_size, max_len, dtype)
 
+    def init_paged_pools(self, num_blocks: int, block_size: int = 128,
+                         dtype=None):
+        return self.llama.init_paged_pools(num_blocks, block_size, dtype)
+
     def forward(self, input_ids, position_ids=None, cache=None):
         """Returns logits; with ``cache`` returns ``(logits, new_cache)``
         (the reference's ``use_cache=True`` contract)."""

@@ -42,6 +42,19 @@ TPU-native design:
   its block manager.
 
 Pools are donated through the decode step, so XLA updates them in place.
+
+**Cache backends.** What a sequence's "cache" IS is a policy, not a fact:
+the engine's block bookkeeping lives behind the ``CacheBackend`` seam
+(``cache_backend.py``).  Attention models ride the ``PagedKV`` backend
+(refcounted blocks + prefix cache, exactly the original behavior); the SSD
+family (``models/ssd.py``) rides ``RecurrentState`` — constant-size
+per-slot decode state, no blocks, no growth, no prefix hashing — and
+hybrid stacks ride both at once.  The engine picks its program family from
+``model.cache_spec()``: recurrent-family prefills are B=1 (the per-slot
+state scatter has no batched form yet) and chunked/prefix-hit prefill is
+structurally off (no block chain to hash); decode is the same masked
+``max_batch``-wide chunk program with the slot states threaded through the
+scan alongside the pools.
 """
 
 from __future__ import annotations
@@ -56,7 +69,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["Engine", "GenRequest", "RequestOutput", "prefix_block_hashes"]
+from .cache_backend import (CacheBackend, HybridCache, PagedKV,
+                            RecurrentState, make_backend)
+
+__all__ = ["Engine", "GenRequest", "RequestOutput", "prefix_block_hashes",
+           "CacheBackend", "PagedKV", "RecurrentState", "make_backend"]
 
 NEG_INF = -1e30
 
@@ -148,11 +165,30 @@ class Engine:
                  max_prefill_overhead: float = 1.0, decode_chunk: int = 32,
                  hbm_budget_bytes: Optional[int] = None,
                  prefix_cache: bool = True,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 dispatch_staging: bool = True):
         from ..jit import functional_call
 
         self.model = model
         self.cfg = model.config
+        # the CacheBackend seam: per-layer cache kinds + byte quantities
+        # from the model, policy objects from cache_backend.make_backend
+        if hasattr(model, "cache_spec"):
+            spec = model.cache_spec()
+        else:
+            from ..models.ssd import llama_cache_spec
+
+            spec = llama_cache_spec(model)
+        self._spec = spec
+        self._recurrent = any(k == "ssd" for k in spec["kinds"])
+        self._uses_pages = any(k == "attention" for k in spec["kinds"])
+        if self._recurrent:
+            # graceful degradation: no block chain to hash (pure SSD) or a
+            # hit would restore only the attention half (hybrid) — and
+            # chunked prefill rides the block-aligned context offset, which
+            # the recurrent prefill program doesn't model
+            prefix_cache = False
+            prefill_chunk = None
         self.max_batch = max_batch
         self.block_size = block_size
         self.num_blocks = num_blocks
@@ -185,20 +221,25 @@ class Engine:
         # (hash -> block, oldest first) where a later admission can either
         # HIT it (reacquire, skip its prefill) or RECLAIM it (allocation
         # pressure pops the oldest cached block back into service)
-        self.prefix_cache = bool(prefix_cache)
         if prefill_chunk is not None:
             # chunks must be block-aligned so every chunk starts on a block
             # boundary (write_paged_chunk's precondition)
             prefill_chunk = max(1, -(-int(prefill_chunk) // block_size)) \
                 * block_size
         self.prefill_chunk = prefill_chunk
-        self._ref: Dict[int, int] = {}        # block -> live-owner count
-        self._index: Dict[bytes, int] = {}    # chain-hash -> block
-        self._hash_of: Dict[int, bytes] = {}  # block -> registered hash
-        self._lru: "collections.OrderedDict[bytes, int]" = \
-            collections.OrderedDict()         # ref-0 cached blocks
-        # block 0 is the shared trash block for inactive slots
-        self._free = collections.deque(range(1, num_blocks))
+        self.backend = make_backend(spec, num_blocks, block_size, max_batch,
+                                    prefix_cache=prefix_cache)
+        self.prefix_cache = self.backend.supports_prefix_cache
+        # block-verb delegation target: the paged side of the backend (a
+        # zero-block dummy for pure-recurrent models so the _free/_ref/...
+        # introspection surface stays uniform), and the slot-state ledger
+        if isinstance(self.backend, PagedKV):
+            self._pages, self._rstate = self.backend, None
+        elif isinstance(self.backend, HybridCache):
+            self._pages, self._rstate = self.backend.pages, self.backend.state
+        else:
+            self._pages = PagedKV(1, block_size, 0, prefix_cache=False)
+            self._rstate = self.backend
         self._slots = [_Slot(idx=i) for i in range(max_batch)]
         self._tbl = np.zeros((max_batch, self.max_blocks_per_seq), np.int32)
         self._waiting: collections.deque = collections.deque()
@@ -233,14 +274,33 @@ class Engine:
             if plan["total_bytes"] > hbm_budget_bytes:
                 detail = ", ".join(f"{k}={v / 1e6:.1f}MB"
                                    for k, v in plan.items()
-                                   if k != "total_bytes")
+                                   if k != "total_bytes"
+                                   and isinstance(v, (int, float)))
                 raise ValueError(
                     f"serving memory plan {plan['total_bytes'] / 1e6:.1f}MB "
                     f"exceeds hbm_budget_bytes={hbm_budget_bytes / 1e6:.1f}MB"
                     f" ({detail}); reduce num_blocks (kv_pool_bytes scales "
                     f"linearly with it) or max_batch")
-        self.k_pools, self.v_pools = model.llama.init_paged_pools(
-            num_blocks, block_size)
+        pools_init = getattr(model, "init_paged_pools", None)
+        if pools_init is None:
+            pools_init = model.llama.init_paged_pools
+        self.k_pools, self.v_pools = pools_init(num_blocks, block_size)
+        # recurrent-family slot residency: per-SSD-layer state dicts,
+        # max_batch wide, scattered into by the prefill program and
+        # threaded through the decode scan (donated, updated in place)
+        self._ssd_state = (model.init_recurrent_slots(max_batch)
+                           if self._recurrent else ())
+        self._ssd_prefill_fns: Dict[int, object] = {}
+        # dispatch staging (host-dispatch overlap): device copies of the
+        # decode call's scheduler inputs, reused while the scheduler state
+        # they snapshot is unchanged — steady-state decode then uploads
+        # NOTHING per call (the lengths vector advances ON DEVICE and is
+        # re-staged from the program's own output)
+        self.dispatch_staging = bool(dispatch_staging)
+        self._sched_version = 0
+        self._staged = None                    # (version, tbl, lengths, ...)
+        self._last_dispatch_t: Optional[float] = None
+        self._decode_gaps: List[float] = []
         self._full_tok_bufs: List[object] = []
         self._full_first_bufs: List[object] = []
         # deferred-sync state: dispatch-ordered ledger of unmaterialized
@@ -280,9 +340,11 @@ class Engine:
                        for v in self._params.values())
         buffers_b = sum(int(np.prod(v.shape)) * jnp.dtype(v.dtype).itemsize
                         for v in self._buffers.values())
-        kv_pool_b = (2 * cfg.num_hidden_layers * self.num_blocks
-                     * cfg.kv_heads * self.block_size * cfg.head_dim
-                     * itemsize)
+        # pool + per-slot state residency come from the backend (for the
+        # attention-only PagedKV case this is EXACTLY the historical
+        # 2 * layers * kv_heads * bs * head_dim * itemsize * num_blocks)
+        kv_pool_b = self.backend.pool_bytes()
+        state_b = self.backend.state_bytes()
         table_b = (self.max_batch * self.max_blocks_per_seq * 4
                    + self._tok_seg_rows * self.max_batch * 4
                    + self._first_seg * 4 + self.max_batch * 4)
@@ -308,13 +370,23 @@ class Engine:
                        + cfg.num_attention_heads * Pb * C * 4
                        + Pb * cfg.vocab_size * itemsize)
         plan = {"params_bytes": params_b, "buffers_bytes": buffers_b,
-                "kv_pool_bytes": kv_pool_b, "table_bytes": table_b,
+                "kv_pool_bytes": kv_pool_b, "state_bytes": state_b,
+                "table_bytes": table_b,
                 "prefix_cache_bytes": prefix_b,
                 "decode_workspace_bytes": decode_b,
                 "prefill_workspace_bytes": prefill_b,
                 "chunk_workspace_bytes": chunk_b}
-        plan["total_bytes"] = (params_b + buffers_b + kv_pool_b + table_b
-                               + prefix_b + max(decode_b, prefill_b, chunk_b))
+        plan["total_bytes"] = (params_b + buffers_b + kv_pool_b + state_b
+                               + table_b + prefix_b
+                               + max(decode_b, prefill_b, chunk_b))
+        # the flat-vs-linear story, straight from the backend: one
+        # sequence's cache footprint at growing context lengths (flat for
+        # recurrent state, ~linear in blocks for paged KV, summed for
+        # hybrid) — what capacity planning actually compares across model
+        # families
+        plan["per_seq_cache_bytes"] = {
+            ctx: self.backend.seq_bytes(ctx)
+            for ctx in (4096, 16384, 65536)}
         return plan
 
     def add_request(self, req: GenRequest) -> str:
@@ -322,16 +394,21 @@ class Engine:
             self._req_counter += 1
             req.request_id = f"req-{self._req_counter}"
         P = len(req.prompt_ids)
-        if (P + req.max_new_tokens) > self.max_blocks_per_seq * self.block_size:
-            raise ValueError(
-                f"prompt ({P}) + max_new_tokens ({req.max_new_tokens}) exceeds "
-                f"the per-slot capacity "
-                f"{self.max_blocks_per_seq * self.block_size}")
-        if self._bucket(P) // self.block_size > self.num_blocks - 1:
-            raise ValueError(
-                f"prompt needs {self._bucket(P) // self.block_size} blocks but "
-                f"the pool only has {self.num_blocks - 1} usable; raise "
-                f"num_blocks")
+        if self._uses_pages:
+            # block-granular capacity checks only bind when the model's
+            # cache actually pages (a pure-recurrent sequence has no block
+            # chain and no per-slot KV capacity to exceed)
+            if (P + req.max_new_tokens) > \
+                    self.max_blocks_per_seq * self.block_size:
+                raise ValueError(
+                    f"prompt ({P}) + max_new_tokens ({req.max_new_tokens}) "
+                    f"exceeds the per-slot capacity "
+                    f"{self.max_blocks_per_seq * self.block_size}")
+            if self._bucket(P) // self.block_size > self.num_blocks - 1:
+                raise ValueError(
+                    f"prompt needs {self._bucket(P) // self.block_size} "
+                    f"blocks but the pool only has {self.num_blocks - 1} "
+                    f"usable; raise num_blocks")
         self._waiting.append(req)
         return req.request_id
 
@@ -373,55 +450,43 @@ class Engine:
         self._ensure_decode_blocks(k)
         self._dispatch_chunk(k)
 
-    # -- block pool (refcounted, prefix-cache aware) ------------------------
+    # -- block pool (delegated to the CacheBackend's paged side) ------------
+    # The engine's historical introspection surface (_free/_ref/_index/
+    # _hash_of/_lru) stays readable — tests and tools poke these directly —
+    # but the structures now LIVE on the backend.
+
+    @property
+    def _free(self):
+        return self._pages._free
+
+    @property
+    def _ref(self):
+        return self._pages._ref
+
+    @property
+    def _index(self):
+        return self._pages._index
+
+    @property
+    def _hash_of(self):
+        return self._pages._hash_of
+
+    @property
+    def _lru(self):
+        return self._pages._lru
 
     def _available(self) -> int:
         """Blocks an allocation can claim: truly free + ref-0 cached."""
-        return len(self._free) + len(self._lru)
+        return self._pages.available()
 
     def _alloc_block(self) -> Optional[int]:
-        """Claim a block: the free pool first, then reclaim the oldest
-        ref-0 cached block (deregistering it — cache state is disposable)."""
-        if self._free:
-            b = self._free.popleft()
-        elif self._lru:
-            h, b = self._lru.popitem(last=False)
-            del self._index[h]
-            del self._hash_of[b]
-        else:
-            return None
-        self._ref[b] = 1
-        return b
+        return self._pages.alloc()
 
     def _free_block(self, b: int):
-        """Drop one ownership ref; at 0 the block parks in the prefix-cache
-        LRU (if registered) or returns to the free pool.  A block shared by
-        several live slots (refcount > 1) just decrements — this is what
-        makes eviction skip shared blocks."""
-        n = self._ref.get(b, 1) - 1
-        if n > 0:
-            self._ref[b] = n
-            return
-        self._ref.pop(b, None)
-        h = self._hash_of.get(b)
-        if h is not None:
-            self._lru[h] = b
-            self._lru.move_to_end(h)
-        else:
-            self._free.append(b)
+        self._pages.release(b)
 
     def _acquire_cached(self, h: bytes) -> Optional[int]:
-        """Take a live ref on the block registered under ``h`` (a prefix
-        hit): shared live blocks gain a ref, parked blocks leave the LRU."""
-        b = self._index.get(h)
-        if b is None:
-            return None
-        if b in self._ref:
-            self._ref[b] += 1
-        else:
-            self._lru.pop(h, None)
-            self._ref[b] = 1
-        return b
+        return self._pages.gather(h)
 
     def _register_prompt_blocks(self, slot: _Slot):
         """Publish a slot's cacheable prompt blocks in the hash index.
@@ -432,11 +497,7 @@ class Engine:
         read garbage)."""
         if not self.prefix_cache:
             return
-        for h, b in zip(slot.hashes, slot.blocks):
-            if h in self._index or b in self._hash_of:
-                continue                   # first writer wins
-            self._index[h] = b
-            self._hash_of[b] = h
+        self._pages.register(slot.hashes, slot.blocks)
 
     def _pick_chunk(self, active) -> int:
         """Largest power-of-two chunk within the LONGEST remaining budget.
@@ -486,7 +547,7 @@ class Engine:
             if n_hit == 0 and not chunked:
                 # -- path A: dense batched prefill of the whole prompt
                 Pb = self._bucket(P)
-                n_blocks = Pb // bs
+                n_blocks = Pb // bs if self._uses_pages else 0
                 if n_blocks > self.num_blocks - 1:
                     # an evicted request's merged prompt outgrew the whole
                     # pool: no schedule can ever run it — fail loudly
@@ -498,6 +559,8 @@ class Engine:
                 self._waiting.popleft()
                 blocks = [self._alloc_block() for _ in range(n_blocks)]
                 self._admit_counter += 1
+                if self._rstate is not None:
+                    self._rstate.acquire_slot(slot.idx)
                 slot.req = req
                 slot.length = P
                 slot.blocks = blocks
@@ -553,6 +616,12 @@ class Engine:
         for entry in admitted:
             by_bucket.setdefault(entry[2], []).append(entry)
         for Pb, group in by_bucket.items():
+            if self._recurrent:
+                # recurrent-family prefill is B=1: the program scatters one
+                # slot's state row (no batched scatter form yet)
+                for entry in group:
+                    self._ssd_prefill_one(entry, Pb)
+                continue
             while group:
                 n = 4 if len(group) >= 4 else (2 if len(group) >= 2 else 1)
                 self._prefill_batch(group[:n], Pb)
@@ -567,6 +636,7 @@ class Engine:
         row = np.zeros((self.max_blocks_per_seq,), np.int32)
         row[:len(slot.blocks)] = slot.blocks
         self._tbl[i] = row
+        self._sched_version += 1
 
     def _advance_prefills(self):
         """Dispatch ONE prefill chunk per mid-prefill slot (admission
@@ -620,6 +690,7 @@ class Engine:
         req._prefill_dt += dt
         slot.length += take
         slot.prefill_left = None if final else ids[take:]
+        self._sched_version += 1           # host lengths moved off-device
         self.stats["prefill_time"] += dt
         self.stats["prefill_tokens"] += Cb
         self.stats["chunk_prefills"] += 1
@@ -641,6 +712,8 @@ class Engine:
         on pressure).  Writes past a finished sequence's window land in the
         trash block (unallocated table entries are 0) or its own about-to-be
         -freed blocks — never in another sequence's memory."""
+        if not self._uses_pages:
+            return                 # recurrent state never grows: no blocks
         for slot in sorted((s for s in self._slots if s.req is not None),
                            key=lambda s: s.admit_seq):
             if slot.req is None:
@@ -706,6 +779,9 @@ class Engine:
     def _release(self, slot: _Slot):
         for b in slot.blocks:
             self._free_block(b)      # shared blocks just drop a ref
+        if self._rstate is not None and slot.req is not None:
+            self._rstate.release_slot(slot.idx)
+        self._sched_version += 1
         slot.req = None
         slot.length = 0
         slot.blocks = []
@@ -845,6 +921,129 @@ class Engine:
 
         return prefill
 
+    # -- recurrent-family programs (SSD / hybrid stacks) --------------------
+
+    def _get_ssd_prefill_fn(self, Pb: int):
+        fn = self._ssd_prefill_fns.get(Pb)
+        if fn is None:
+            fn = self._ssd_prefill_fns[Pb] = jax.jit(
+                self._build_ssd_prefill(Pb),
+                donate_argnums=(2, 3, 4, 5, 14))
+        return fn
+
+    def _get_ssd_decode_fn(self, k: int):
+        fn = self._decode_fns.get(("ssd", k))
+        if fn is None:
+            fn = self._decode_fns[("ssd", k)] = jax.jit(
+                self._build_ssd_decode(k), donate_argnums=(2, 3, 4, 7, 12))
+        return fn
+
+    def _build_ssd_prefill(self, Pb: int):
+        """B=1 prefill for a model with recurrent layers: dense forward
+        over the padded prompt with ``n_valid`` masking (exact — zeroed
+        projections are no-ops on the scan), then scatter the resulting
+        per-layer decode state into the slot's row of the engine's state
+        arrays; hybrid attention layers additionally scatter their K/V
+        into the paged pools exactly like the attention-family program."""
+        from ..jit import functional_call
+
+        model = self.model
+
+        def prefill(params, buffers, ssd_states, k_pools, v_pools, last,
+                    sidx, ids, blocks, n_valid, key, temp, top_k, top_p,
+                    firstbuf, fidx0):
+            from ..kernels.decode_attention import write_paged_prefill
+
+            cache = model.init_cache(1, Pb)
+            cache["n_valid"] = n_valid
+            out = functional_call(model, params, buffers, ids[None, :],
+                                  cache=cache, rng_key=key)
+            logits, new_cache = out[0], out[-1]
+            new_states = tuple(
+                {kk: cur[kk].at[sidx].set(st[kk][0]) for kk in cur}
+                for cur, st in zip(ssd_states, new_cache["ssd"]))
+            k_pools = list(k_pools)
+            v_pools = list(v_pools)
+            for ai, (k_c, v_c) in enumerate(new_cache["kv"]):
+                k_pools[ai], v_pools[ai] = write_paged_prefill(
+                    k_pools[ai], v_pools[ai], blocks,
+                    k_c[0, :Pb], v_c[0, :Pb])
+            lg = jnp.take_along_axis(
+                logits, (n_valid - 1)[None, None, None], axis=1)[:, 0]
+            nxt = _sample_batch(lg, jax.random.fold_in(key, 1),
+                                temp[None], top_k[None], top_p[None])
+            last = last.at[sidx].set(nxt[0])
+            firstbuf = jax.lax.dynamic_update_slice(firstbuf, nxt, (fidx0,))
+            return firstbuf, last, new_states, tuple(k_pools), tuple(v_pools)
+
+        return prefill
+
+    def _ssd_prefill_one(self, entry, Pb: int):
+        slot, req, _Pb, ids_row, blocks_row, P = entry
+        from ..framework import random as rnd
+
+        fn = self._get_ssd_prefill_fn(Pb)
+        if self._first_idx + 1 > self._first_seg:
+            self._full_first_bufs.append(self._first_buf)
+            self._first_buf = jnp.zeros((self._first_seg,), jnp.int32)
+            self._first_idx = 0
+        fidx0 = self._first_idx
+        self._first_idx += 1
+        t0 = time.perf_counter()
+        (self._first_buf, self._last_dev, self._ssd_state, self.k_pools,
+         self.v_pools) = fn(
+            self._params, self._buffers, self._ssd_state, self.k_pools,
+            self.v_pools, self._last_dev, jnp.asarray(slot.idx, jnp.int32),
+            jnp.asarray(ids_row), jnp.asarray(blocks_row),
+            jnp.asarray(P, jnp.int32), rnd.next_key(),
+            jnp.asarray(req.temperature, jnp.float32),
+            jnp.asarray(req.top_k, jnp.int32),
+            jnp.asarray(req.top_p, jnp.float32),
+            self._first_buf, jnp.asarray(fidx0, jnp.int32))
+        dt = time.perf_counter() - t0                    # dispatch cost only
+        req._prefill_dt = dt
+        self._pending.append(
+            ("prefill", req, len(self._full_first_bufs), fidx0))
+        self.stats["prefills"] += 1
+        self.stats["prefill_time"] += dt
+        self.stats["prefill_tokens"] += Pb
+        self.stats["generated_tokens"] += 1
+
+    def _build_ssd_decode(self, k: int):
+        """The decode-chunk program with the slot-state arrays threaded
+        through the scan alongside the (possibly empty) paged pools — the
+        model's serving forward advances both; inactive slots hold their
+        state bit-exactly via the ``lengths == 0`` mask."""
+        from ..jit import functional_call
+
+        model = self.model
+
+        def decode(params, buffers, ssd_states, k_pools, v_pools, tbl,
+                   lengths, last, key, temps, top_ks, top_ps, tokbuf, row0):
+            def substep(carry, i):
+                st, kp, vp, lens, lst = carry
+                cache = {"ssd": st, "k": kp, "v": vp, "block_table": tbl,
+                         "lengths": lens}
+                out = functional_call(model, params, buffers, lst[:, None],
+                                      cache=cache,
+                                      rng_key=jax.random.fold_in(key, 2 * i))
+                logits, new_cache = out[0], out[-1]
+                nxt = _sample_batch(logits[:, 0],
+                                    jax.random.fold_in(key, 2 * i + 1),
+                                    temps, top_ks, top_ps)
+                lst = jnp.where(lens > 0, nxt, lst)
+                return (new_cache["ssd"], new_cache["k"], new_cache["v"],
+                        new_cache["lengths"], lst), lst
+
+            (st, kp, vp, lens, lst), toks = jax.lax.scan(
+                substep, (ssd_states, k_pools, v_pools, lengths, last),
+                jnp.arange(k))
+            tokbuf = jax.lax.dynamic_update_slice(
+                tokbuf, toks, (row0, jnp.zeros((), row0.dtype)))
+            return tokbuf, lst, st, kp, vp, lens
+
+        return decode
+
     def _dispatch_chunk(self, k: int):
         """Dispatch one k-sub-step decode chunk asynchronously and account
         for it: ownership ledger, host length mirrors, dispatch-decided
@@ -853,25 +1052,43 @@ class Engine:
         execution preserves dispatch order)."""
         from ..framework import random as rnd
 
-        fn = self._get_decode_fn(k)
         # slots mid-chunked-prefill are NOT decoded: masked inactive
         # (length 0) and their table rows zeroed in the dispatched
         # snapshot, so a decode write at their context offset can't land
         # in their real blocks
         def _dec(s):
             return s.req is not None and s.prefill_left is None
-        lengths = np.array([s.length if _dec(s) else 0
-                            for s in self._slots], np.int32)
-        temps = np.array([s.req.temperature if _dec(s) else 0.0
-                          for s in self._slots], np.float32)
-        top_ks = np.array([s.req.top_k if _dec(s) else 0
-                           for s in self._slots], np.int32)
-        top_ps = np.array([s.req.top_p if _dec(s) else 1.0
-                           for s in self._slots], np.float32)
-        tbl = self._tbl.copy()
-        for s in self._slots:
-            if s.req is not None and s.prefill_left is not None:
-                tbl[s.idx] = 0
+        # dispatch staging: in steady-state decode (no admissions,
+        # finishes, or block growth since the last chunk) the scheduler
+        # inputs are bit-reusable device arrays — the lengths vector was
+        # advanced ON DEVICE by the previous chunk and rides back in, so
+        # the call uploads nothing (on the remote tunnel each upload is a
+        # dispatch-path round trip; this is the PR-13 remainder)
+        staged = (self.dispatch_staging and self._staged is not None
+                  and self._staged[0] == self._sched_version)
+        if staged:
+            _, tbl_dev, len_dev, temps_dev, topk_dev, topp_dev = self._staged
+        else:
+            lengths = np.array([s.length if _dec(s) else 0
+                                for s in self._slots], np.int32)
+            temps = np.array([s.req.temperature if _dec(s) else 0.0
+                              for s in self._slots], np.float32)
+            top_ks = np.array([s.req.top_k if _dec(s) else 0
+                               for s in self._slots], np.int32)
+            top_ps = np.array([s.req.top_p if _dec(s) else 1.0
+                               for s in self._slots], np.float32)
+            # _tbl MUST be snapshotted: jnp.asarray may alias long-lived
+            # host memory (zero-copy on CPU), and with async dispatch the
+            # scheduler mutates _tbl while this chunk is still in flight
+            tbl = self._tbl.copy()
+            for s in self._slots:
+                if s.req is not None and s.prefill_left is not None:
+                    tbl[s.idx] = 0
+            tbl_dev = jnp.asarray(tbl)
+            len_dev = jnp.asarray(lengths)
+            temps_dev = jnp.asarray(temps)
+            topk_dev = jnp.asarray(top_ks)
+            topp_dev = jnp.asarray(top_ps)
         if self._tok_row + k > self._tok_seg_rows:
             self._full_tok_bufs.append(self._tok_buf)
             self._tok_buf = jnp.zeros(
@@ -880,16 +1097,30 @@ class Engine:
         row0 = self._tok_row
         self._tok_row += k
         t0 = time.perf_counter()
-        # _tbl MUST be snapshotted: jnp.asarray may alias long-lived host
-        # memory (zero-copy on CPU), and with async dispatch the scheduler
-        # mutates _tbl while this chunk is still in flight
-        self._tok_buf, lst, self.k_pools, self.v_pools = fn(
-            self._params, self._buffers, self.k_pools, self.v_pools,
-            jnp.asarray(tbl), jnp.asarray(lengths),
-            self._last_dev, rnd.next_key(), jnp.asarray(temps),
-            jnp.asarray(top_ks), jnp.asarray(top_ps),
-            self._tok_buf, jnp.asarray(row0, jnp.int32))
+        if self._last_dispatch_t is not None:
+            self._decode_gaps.append(t0 - self._last_dispatch_t)
+        if self._recurrent:
+            fn = self._get_ssd_decode_fn(k)
+            (self._tok_buf, lst, self._ssd_state, self.k_pools,
+             self.v_pools, lens_out) = fn(
+                self._params, self._buffers, self._ssd_state,
+                self.k_pools, self.v_pools, tbl_dev, len_dev,
+                self._last_dev, rnd.next_key(), temps_dev, topk_dev,
+                topp_dev, self._tok_buf, jnp.asarray(row0, jnp.int32))
+        else:
+            fn = self._get_decode_fn(k)
+            self._tok_buf, lst, self.k_pools, self.v_pools, lens_out = fn(
+                self._params, self._buffers, self.k_pools, self.v_pools,
+                tbl_dev, len_dev, self._last_dev, rnd.next_key(),
+                temps_dev, topk_dev, topp_dev,
+                self._tok_buf, jnp.asarray(row0, jnp.int32))
         self._last_dev = lst
+        self._last_dispatch_t = time.perf_counter()
+        if self.dispatch_staging:
+            # version is captured BEFORE the post-chunk finish releases
+            # below — a finish bumps it, correctly invalidating this entry
+            self._staged = (self._sched_version, tbl_dev, lens_out,
+                            temps_dev, topk_dev, topp_dev)
         self.stats["decode_time"] += time.perf_counter() - t0
         self.stats["decode_steps"] += k
         self.stats["decode_calls"] += 1
@@ -935,11 +1166,13 @@ class Engine:
                 return (new_cache["k"], new_cache["v"],
                         new_cache["lengths"], lst), lst
 
-            (kp, vp, _, lst), toks = jax.lax.scan(
+            (kp, vp, lens, lst), toks = jax.lax.scan(
                 substep, (k_pools, v_pools, lengths, last), jnp.arange(k))
             tokbuf = jax.lax.dynamic_update_slice(
                 tokbuf, toks, (row0, jnp.zeros((), row0.dtype)))
-            return tokbuf, lst, kp, vp
+            # final lengths ride back out so dispatch staging can reuse
+            # them as the NEXT chunk's input without a host round trip
+            return tokbuf, lst, kp, vp, lens
 
         return decode
 
@@ -954,17 +1187,49 @@ class Engine:
         zeros = np.zeros((self.max_batch,), np.int32)
         k = 1
         while k <= self.decode_chunk:
-            fn = self._get_decode_fn(k)
-            buf, _lst, self.k_pools, self.v_pools = fn(
-                self._params, self._buffers, self.k_pools, self.v_pools,
-                jnp.asarray(self._tbl), jnp.asarray(zeros),
-                jnp.asarray(zeros), rnd.next_key(),
-                jnp.asarray(zeros, jnp.float32), jnp.asarray(zeros),
-                jnp.ones((self.max_batch,), jnp.float32),
-                jnp.zeros((self._tok_seg_rows, self.max_batch), jnp.int32),
-                jnp.asarray(0, jnp.int32))
+            if self._recurrent:
+                fn = self._get_ssd_decode_fn(k)
+                (buf, _lst, self._ssd_state, self.k_pools, self.v_pools,
+                 _lens) = fn(
+                    self._params, self._buffers, self._ssd_state,
+                    self.k_pools, self.v_pools, jnp.asarray(self._tbl),
+                    jnp.asarray(zeros), jnp.asarray(zeros), rnd.next_key(),
+                    jnp.asarray(zeros, jnp.float32), jnp.asarray(zeros),
+                    jnp.ones((self.max_batch,), jnp.float32),
+                    jnp.zeros((self._tok_seg_rows, self.max_batch),
+                              jnp.int32),
+                    jnp.asarray(0, jnp.int32))
+            else:
+                fn = self._get_decode_fn(k)
+                buf, _lst, self.k_pools, self.v_pools, _lens = fn(
+                    self._params, self._buffers, self.k_pools, self.v_pools,
+                    jnp.asarray(self._tbl), jnp.asarray(zeros),
+                    jnp.asarray(zeros), rnd.next_key(),
+                    jnp.asarray(zeros, jnp.float32), jnp.asarray(zeros),
+                    jnp.ones((self.max_batch,), jnp.float32),
+                    jnp.zeros((self._tok_seg_rows, self.max_batch),
+                              jnp.int32),
+                    jnp.asarray(0, jnp.int32))
             jax.block_until_ready(buf)
             k *= 2
+        if self._recurrent:
+            for Pb in self.prefill_buckets:
+                fn = self._get_ssd_prefill_fn(Pb)
+                n_blk = Pb // self.block_size if self._uses_pages else 0
+                (_buf, self._last_dev, self._ssd_state, self.k_pools,
+                 self.v_pools) = fn(
+                    self._params, self._buffers, self._ssd_state,
+                    self.k_pools, self.v_pools, self._last_dev,
+                    jnp.asarray(0, jnp.int32), jnp.zeros((Pb,), jnp.int32),
+                    jnp.zeros((n_blk,), jnp.int32),
+                    jnp.asarray(1, jnp.int32), rnd.next_key(),
+                    jnp.asarray(0.0, jnp.float32),
+                    jnp.asarray(0, jnp.int32),
+                    jnp.asarray(1.0, jnp.float32),
+                    jnp.zeros((self._first_seg,), jnp.int32),
+                    jnp.asarray(0, jnp.int32))
+            jax.block_until_ready(self._ssd_state)
+            return
         for Pb in self.prefill_buckets:
             for n in (1, 2, 4):
                 if n > self.max_batch:

@@ -1,0 +1,342 @@
+"""CacheBackend — the seam that makes the serving tier model-agnostic.
+
+A sequence's "cache" used to mean one thing: a chain of paged KV blocks.
+The SSD model family (``models/ssd.py``) breaks that assumption — its decode
+state is a CONSTANT-size per-layer tensor, so there is nothing to page, hash
+or grow.  This module carves the cache policy out of the engine behind one
+protocol, with two concrete backends:
+
+- :class:`PagedKV` — the existing refcounted block pool + vLLM-style prefix
+  cache, extracted from the engine verbatim (behavior-identical; the engine
+  delegates its ``_free``/``_ref``/``_index``/``_hash_of``/``_lru``
+  attributes here so existing tests and tools keep working).
+- :class:`RecurrentState` — fixed per-slot state residency: ``alloc`` is a
+  no-op returning zero blocks, ``seq_bytes`` is FLAT in context length, and
+  prefix caching / block hashing are structurally unsupported (the router
+  degrades to headroom+load scoring).
+
+A hybrid stack (attention + SSD layers) composes both: block bookkeeping for
+its attention layers rides the paged side while the SSD layers' bytes ride
+the state side — one :class:`CacheBackend` answers for the whole model.
+
+The protocol verbs (``alloc`` / ``append`` / ``gather`` / ``release`` /
+``migrate`` / ``plan_bytes``) are what the engine, ``memory_plan()``, the
+prefix cache, and the router go through; ``migrate`` only PLANS today (the
+byte/unit manifest a future disaggregated tier would ship — ROADMAP item 1).
+
+Backends are constructed from a model's ``cache_spec()`` dict (see
+``SSDForCausalLM.cache_spec``): per-layer kinds plus the two byte
+quantities — ``kv_bytes_per_token_layer`` and ``state_bytes_per_slot`` —
+that fully determine footprint arithmetic without any model knowledge.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional
+
+__all__ = ["CacheBackend", "PagedKV", "RecurrentState", "make_backend"]
+
+
+class CacheBackend:
+    """Protocol base.  ``kind`` names the policy; ``supports_prefix_cache``
+    gates block-chain hashing (the router checks it before scoring
+    prefix affinity)."""
+
+    kind: str = "abstract"
+    supports_prefix_cache: bool = False
+
+    # -- block-granular bookkeeping (no-ops for blockless backends) ---------
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks an ``n_tokens`` context needs (0 on a blockless backend)."""
+        return 0
+
+    def available(self) -> int:
+        """Blocks an allocation could claim right now."""
+        return 0
+
+    def alloc(self) -> Optional[int]:
+        """Claim one block (None under pressure)."""
+        return None
+
+    def append(self) -> Optional[int]:
+        """Claim one GROWTH block for an already-resident sequence — same
+        pool as :meth:`alloc`, split out so policies could prioritize."""
+        return self.alloc()
+
+    def release(self, block: int) -> None:
+        """Drop one ownership ref on ``block``.  Exactly-once per ref:
+        releasing a block with no live refs raises."""
+        raise RuntimeError(f"release on blockless backend (block {block})")
+
+    # -- prefix reuse -------------------------------------------------------
+
+    def gather(self, h: bytes) -> Optional[int]:
+        """Take a live ref on the cached block registered under hash ``h``
+        (a prefix hit), or None."""
+        return None
+
+    def register(self, hashes: List[bytes], blocks: List[int]) -> None:
+        """Publish a sequence's cacheable prefix blocks under their chain
+        hashes (first writer wins)."""
+
+    # -- accounting ---------------------------------------------------------
+
+    def pool_bytes(self) -> int:
+        """Resident bytes of the device pool this backend addresses."""
+        return 0
+
+    def state_bytes(self) -> int:
+        """Resident bytes of fixed per-slot state across all slots."""
+        return 0
+
+    def seq_bytes(self, ctx_len: int) -> int:
+        """Per-sequence cache footprint at context length ``ctx_len`` —
+        THE curve: linear for paged KV, flat for recurrent state."""
+        return 0
+
+    def headroom_bytes(self) -> int:
+        """Bytes new admissions could still claim (router scoring)."""
+        return 0
+
+    def migrate(self, ctx_len: int) -> Dict:
+        """Manifest for moving one sequence's cache to a peer replica:
+        total bytes plus the unit list a transfer engine would ship.
+        Planning only — no device traffic happens here."""
+        return {"kind": self.kind, "bytes": 0, "units": []}
+
+    def plan_bytes(self) -> Dict[str, int]:
+        """The backend's contribution to ``Engine.memory_plan()``."""
+        return {"kv_pool_bytes": self.pool_bytes(),
+                "state_bytes": self.state_bytes()}
+
+
+class PagedKV(CacheBackend):
+    """Refcounted paged-KV block pool with the prefix-cache LRU.
+
+    Extracted from the engine's block bookkeeping verbatim: block 0 is the
+    shared trash block, ``_free`` holds virgin blocks, a block serving live
+    slots carries a refcount in ``_ref``, and a REGISTERED block whose
+    refcount drops to 0 parks in the ``_lru`` (hash -> block, oldest first)
+    where a later admission can ``gather`` it (skip its prefill) or
+    allocation pressure can reclaim it.
+    """
+
+    kind = "paged_kv"
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 bytes_per_token: int, prefix_cache: bool = True):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # summed over KV layers: 2 (K and V) * kv_heads * head_dim * itemsize
+        self.bytes_per_token = bytes_per_token
+        self.supports_prefix_cache = bool(prefix_cache)
+        self._ref: Dict[int, int] = {}        # block -> live-owner count
+        self._index: Dict[bytes, int] = {}    # chain-hash -> block
+        self._hash_of: Dict[int, bytes] = {}  # block -> registered hash
+        self._lru: "collections.OrderedDict[bytes, int]" = \
+            collections.OrderedDict()         # ref-0 cached blocks
+        self._free = collections.deque(range(1, num_blocks))
+
+    @property
+    def block_bytes(self) -> int:
+        return self.bytes_per_token * self.block_size
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.block_size)
+
+    def available(self) -> int:
+        return len(self._free) + len(self._lru)
+
+    def alloc(self) -> Optional[int]:
+        """The free pool first, then reclaim the oldest ref-0 cached block
+        (deregistering it — cache state is disposable)."""
+        if self._free:
+            b = self._free.popleft()
+        elif self._lru:
+            h, b = self._lru.popitem(last=False)
+            del self._index[h]
+            del self._hash_of[b]
+        else:
+            return None
+        self._ref[b] = 1
+        return b
+
+    def release(self, block: int) -> None:
+        """Drop one ref; at 0 the block parks in the prefix-cache LRU (if
+        registered) or returns to the free pool.  A block shared by several
+        live slots just decrements — this is what makes eviction skip
+        shared blocks.  Releasing an unowned block is a double-free bug in
+        the CALLER's ledger and raises rather than corrupting the pool."""
+        n = self._ref.get(block)
+        if n is None:
+            raise RuntimeError(
+                f"double release of block {block}: no live refs")
+        if n > 1:
+            self._ref[block] = n - 1
+            return
+        del self._ref[block]
+        h = self._hash_of.get(block)
+        if h is not None:
+            self._lru[h] = block
+            self._lru.move_to_end(h)
+        else:
+            self._free.append(block)
+
+    def gather(self, h: bytes) -> Optional[int]:
+        """Live ref on the block registered under ``h``: shared live blocks
+        gain a ref, parked blocks leave the LRU."""
+        b = self._index.get(h)
+        if b is None:
+            return None
+        if b in self._ref:
+            self._ref[b] += 1
+        else:
+            self._lru.pop(h, None)
+            self._ref[b] = 1
+        return b
+
+    def register(self, hashes: List[bytes], blocks: List[int]) -> None:
+        if not self.supports_prefix_cache:
+            return
+        for h, b in zip(hashes, blocks):
+            if h in self._index or b in self._hash_of:
+                continue                       # first writer wins
+            self._index[h] = b
+            self._hash_of[b] = h
+
+    def lookup_chain(self, hashes: List[bytes]) -> int:
+        """Longest consecutive resident prefix (in blocks)."""
+        n = 0
+        for h in hashes:
+            if h not in self._index:
+                break
+            n += 1
+        return n
+
+    def pool_bytes(self) -> int:
+        return self.num_blocks * self.block_bytes
+
+    def seq_bytes(self, ctx_len: int) -> int:
+        return self.blocks_for(ctx_len) * self.block_bytes
+
+    def headroom_bytes(self) -> int:
+        return self.available() * self.block_bytes
+
+    def migrate(self, ctx_len: int) -> Dict:
+        n = self.blocks_for(ctx_len)
+        return {"kind": self.kind, "bytes": n * self.block_bytes,
+                "units": [{"unit": "kv_block", "count": n,
+                           "bytes_each": self.block_bytes}]}
+
+
+class RecurrentState(CacheBackend):
+    """Constant-size per-slot decode state (the SSD layers' residency).
+
+    There are no blocks: ``blocks_for`` is 0, prefix caching is
+    structurally unsupported (no block chain to hash), and ``seq_bytes`` is
+    FLAT — the whole point.  Slot occupancy is tracked so release is
+    exactly-once, mirroring the paged pool's ledger discipline."""
+
+    kind = "recurrent"
+    supports_prefix_cache = False
+
+    def __init__(self, max_slots: int, state_bytes_per_slot: int):
+        self.max_slots = max_slots
+        self.state_bytes_per_slot = int(state_bytes_per_slot)
+        self._live: Dict[int, bool] = {}
+
+    def acquire_slot(self, idx: int) -> None:
+        if self._live.get(idx):
+            raise RuntimeError(f"slot {idx} already live")
+        self._live[idx] = True
+
+    def release_slot(self, idx: int) -> None:
+        if not self._live.pop(idx, False):
+            raise RuntimeError(f"double release of slot {idx}")
+
+    def free_slots(self) -> int:
+        return self.max_slots - len(self._live)
+
+    def state_bytes(self) -> int:
+        return self.max_slots * self.state_bytes_per_slot
+
+    def seq_bytes(self, ctx_len: int) -> int:
+        return self.state_bytes_per_slot      # flat, by construction
+
+    def headroom_bytes(self) -> int:
+        return self.free_slots() * self.state_bytes_per_slot
+
+    def migrate(self, ctx_len: int) -> Dict:
+        return {"kind": self.kind, "bytes": self.state_bytes_per_slot,
+                "units": [{"unit": "slot_state", "count": 1,
+                           "bytes_each": self.state_bytes_per_slot}]}
+
+
+class HybridCache(CacheBackend):
+    """Paged KV for the attention layers + recurrent state for the SSD
+    layers of one hybrid stack.  Block verbs forward to the paged side;
+    byte accounting sums both; prefix caching is OFF — a prefix-cache hit
+    would restore only the attention half of the context (the SSD state
+    for those tokens is not block-addressable), which is silently wrong,
+    so the backend refuses rather than degrades."""
+
+    kind = "hybrid"
+    supports_prefix_cache = False
+
+    def __init__(self, pages: PagedKV, state: RecurrentState):
+        self.pages = pages
+        self.state = state
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return self.pages.blocks_for(n_tokens)
+
+    def available(self) -> int:
+        return self.pages.available()
+
+    def alloc(self) -> Optional[int]:
+        return self.pages.alloc()
+
+    def release(self, block: int) -> None:
+        self.pages.release(block)
+
+    def pool_bytes(self) -> int:
+        return self.pages.pool_bytes()
+
+    def state_bytes(self) -> int:
+        return self.state.state_bytes()
+
+    def seq_bytes(self, ctx_len: int) -> int:
+        return self.pages.seq_bytes(ctx_len) + self.state.seq_bytes(ctx_len)
+
+    def headroom_bytes(self) -> int:
+        return self.pages.headroom_bytes() + self.state.headroom_bytes()
+
+    def migrate(self, ctx_len: int) -> Dict:
+        p = self.pages.migrate(ctx_len)
+        s = self.state.migrate(ctx_len)
+        return {"kind": self.kind, "bytes": p["bytes"] + s["bytes"],
+                "units": p["units"] + s["units"]}
+
+
+def make_backend(spec: Dict, num_blocks: int, block_size: int,
+                 max_slots: int, prefix_cache: bool = True) -> CacheBackend:
+    """Build the backend a model's ``cache_spec()`` calls for.
+
+    All-attention -> :class:`PagedKV` (prefix cache as configured);
+    all-SSD -> :class:`RecurrentState`; mixed -> :class:`HybridCache`
+    (prefix cache forced off — see the class docstring)."""
+    kinds = spec["kinds"]
+    has_kv = any(k == "attention" for k in kinds)
+    has_state = any(k == "ssd" for k in kinds)
+    if has_kv:
+        pages = PagedKV(num_blocks, block_size,
+                        spec["kv_layers"] * spec["kv_bytes_per_token_layer"],
+                        prefix_cache=prefix_cache and not has_state)
+    if not has_state:
+        return pages
+    state = RecurrentState(max_slots, spec["state_bytes_per_slot"])
+    if not has_kv:
+        return state
+    return HybridCache(pages, state)

@@ -10,9 +10,11 @@ behind one admission queue), rebuilt for the TPU serving tier:
   prefix blocks already live in that replica's prefix cache (longest
   consecutive hit against ``Engine._index`` — the same chain hashing the
   engine uses, so the router's prediction is exactly the hit the engine
-  will take), (b) the replica's ``memory_plan()``-derived HBM headroom
-  (static budget slack plus the live free-pool bytes), and (c) queue
-  load as the tiebreak.  Shared system prompts therefore pile onto the
+  will take; replicas whose cache backend has no block chain —
+  ``RecurrentState`` or hybrid stacks — score 0 and degrade gracefully
+  to the remaining terms), (b) the replica's ``memory_plan()``-derived
+  HBM headroom (static budget slack plus the backend's claimable
+  bytes), and (c) queue load as the tiebreak.  Shared system prompts therefore pile onto the
   replica that already prefilled them, and fresh traffic flows to the
   emptiest replica.
 - **Elastic join/leave; cache state is disposable.**  ``add_replica`` can
@@ -205,15 +207,17 @@ class Router:
     @staticmethod
     def _affinity(eng: Engine, prompt_ids) -> int:
         """Blocks of the prompt's cacheable prefix already resident in the
-        replica's prefix cache (longest consecutive chain hit)."""
+        replica's prefix cache (longest consecutive chain hit).  A replica
+        whose cache backend has no block chain to hash (``RecurrentState``
+        or a hybrid stack) scores 0 — routing degrades to headroom + load
+        for it, instead of assuming paged-KV semantics."""
+        backend = getattr(eng, "backend", None)
+        if backend is not None and not backend.supports_prefix_cache:
+            return 0
         if not eng.prefix_cache:
             return 0
-        n = 0
-        for h in prefix_block_hashes(prompt_ids, eng.block_size):
-            if h not in eng._index:
-                break
-            n += 1
-        return n
+        return eng._pages.lookup_chain(
+            prefix_block_hashes(prompt_ids, eng.block_size))
 
     @staticmethod
     def _load(eng: Engine) -> int:
@@ -222,15 +226,16 @@ class Router:
 
     def replica_headroom_bytes(self, replica_id: int) -> int:
         """Admission headroom: static ``memory_plan()`` slack under the
-        replica's HBM budget (0 when unbudgeted) plus the bytes of its
-        allocatable KV blocks (free pool + reclaimable ref-0 cache)."""
+        replica's HBM budget (0 when unbudgeted) plus the cache backend's
+        claimable bytes — allocatable KV blocks (free pool + reclaimable
+        ref-0 cache) for paged replicas, free state slots for recurrent
+        ones, the sum for hybrids."""
         eng = self._replicas[replica_id]
         plan = eng.memory_plan()
         static = 0
         if eng.hbm_budget_bytes is not None:
             static = max(eng.hbm_budget_bytes - plan["total_bytes"], 0)
-        per_block = plan["kv_pool_bytes"] // max(eng.num_blocks, 1)
-        return static + eng._available() * per_block
+        return static + eng.backend.headroom_bytes()
 
     # -- serving loop -------------------------------------------------------
 

@@ -1,0 +1,106 @@
+#!/bin/bash
+# O(1)-cache decode regression gate.  Runs `bench.py --preset ssd` on the
+# CPU proxy plus the CacheBackend conformance tests and fails when the SSD
+# family's contracts break (baseline: scripts/SSD_BASELINE.json):
+#
+#   Absolute invariants (no baseline needed):
+#     - the chunked Pallas scan in interpret mode is BIT-identical to
+#       ssd_scan_reference (the training-path parity contract);
+#     - serving through the RecurrentState backend reproduces
+#       model.generate greedy outputs exactly, every request completes;
+#     - memory_plan()'s state/pool bytes match the live device arrays
+#       within 10% (measured: exact) for the pure AND hybrid engines;
+#     - the per-sequence footprint at 8B scale is FLAT in context length
+#       (4k == 64k) — the headline the family exists for;
+#     - tests/test_cache_backend.py passes (alloc/evict/exactly-once
+#       release/migrate-plan conformance for both backends + hybrid).
+#
+#   Baseline-gated (deterministic arithmetic, any drift is a code change):
+#     - state_bytes_per_slot at 8B scale must not grow;
+#     - flat_vs_linear_64k (llama-8B 64k KV bytes / SSD-8B state bytes)
+#       must not shrink.
+#
+# Serve tokens/s is recorded for provenance, never diffed (wall clock).
+#
+# Defect injection (proves the gate can fail):
+#     SSD_GATE_INJECT=kv-backend scripts/ssd_gate.sh   # must exit != 0
+#   (prices the SSD layers through paged-KV arithmetic — the footprint
+#   curve turns linear, exactly the regression a broken backend seam
+#   would ship)
+# Refresh the baseline after an intentional change:
+#     scripts/ssd_gate.sh --update
+# Exit code: number of failed checks (0 = gate passes).
+cd "$(dirname "$0")/.." || exit 1
+GATE_NAME=ssd_gate
+GATE_BASELINE="scripts/SSD_BASELINE.json"
+. scripts/gate_lib.sh
+gate_init "$@"
+
+echo "[ssd_gate] cache_backend conformance" >&2
+if ! timeout -k 10 300 python -m pytest tests/test_cache_backend.py -q \
+        -p no:cacheprovider >&2; then
+    echo "[ssd_gate] conformance: FAILED (tests/test_cache_backend.py)" >&2
+    FAIL=$((FAIL + 1))
+fi
+
+check_ssd() {
+    gate_bench ssd 1200 || return
+    gate_diff ssd <<PY
+import json, os, sys
+exec(os.environ["GATE_PY_COMMON"])
+preset, baseline_path, new_path, update = sys.argv[1:5]
+line = """$GATE_LINE"""
+r = gate_result(line)
+entry = {k: r.get(k) for k in (
+    "value", "kernel_bit_identical", "serve_matches_generate",
+    "requests", "completed", "state_plan_err", "hybrid_plan_err",
+    "plan_within_10pct", "state_bytes_per_slot", "ssd8b_seq_mb",
+    "llama8b_seq_mb", "footprint_flat", "flat_vs_linear_64k")}
+gate_record(new_path, preset, entry)
+fails = []
+if not r.get("kernel_bit_identical"):
+    fails.append("chunked scan not bit-identical to reference")
+if not r.get("serve_matches_generate"):
+    fails.append("serve outputs differ from model.generate greedy")
+if r.get("completed") != r.get("requests"):
+    fails.append(f"lost requests ({r.get('completed')} of "
+                 f"{r.get('requests')})")
+if not r.get("plan_within_10pct"):
+    fails.append(f"memory_plan off by >10% (state "
+                 f"{r.get('state_plan_err')}, hybrid "
+                 f"{r.get('hybrid_plan_err')})")
+if not r.get("footprint_flat"):
+    fails.append("per-seq footprint not flat in context length "
+                 f"(4k={r['ssd8b_seq_mb'].get('4096')}MB vs "
+                 f"64k={r['ssd8b_seq_mb'].get('65536')}MB)")
+if fails:
+    print(f"[ssd_gate] ssd: FAILED ({'; '.join(fails)})", file=sys.stderr)
+    sys.exit(1)
+if int(update):
+    print(f"[ssd_gate] ssd: flat {r['ssd8b_seq_mb']['65536']}MB vs llama "
+          f"{r['llama8b_seq_mb']['65536']}MB at 64k "
+          f"({r['flat_vs_linear_64k']}x, recorded)", file=sys.stderr)
+    sys.exit(0)
+base = gate_base(baseline_path, preset, "ssd_gate", "scripts/ssd_gate.sh")
+if r.get("state_bytes_per_slot", 1 << 62) > base.get("state_bytes_per_slot",
+                                                     0):
+    print(f"[ssd_gate] ssd: FAILED (state_bytes_per_slot grew "
+          f"{base['state_bytes_per_slot']} -> {r['state_bytes_per_slot']})",
+          file=sys.stderr)
+    sys.exit(1)
+if r.get("flat_vs_linear_64k", 0.0) + 1e-9 < base.get("flat_vs_linear_64k",
+                                                      0.0):
+    print(f"[ssd_gate] ssd: FAILED (flat_vs_linear_64k shrank "
+          f"{base['flat_vs_linear_64k']} -> {r['flat_vs_linear_64k']})",
+          file=sys.stderr)
+    sys.exit(1)
+print(f"[ssd_gate] ssd: OK flat {r['ssd8b_seq_mb']['65536']}MB vs llama "
+      f"{r['llama8b_seq_mb']['65536']}MB at 64k "
+      f"({r['flat_vs_linear_64k']}x)", file=sys.stderr)
+PY
+}
+
+check_ssd
+
+# own only the "ssd" section if the baseline file ever grows others
+gate_finish_merge

@@ -1,0 +1,184 @@
+"""CacheBackend conformance: the seam between the serving engine and its
+cache policy.  Both concrete backends (paged KV blocks, recurrent state
+slots) plus the hybrid composition must honor the same ledger discipline —
+exactly-once release, pressure-driven reclaim, honest byte accounting —
+and ``make_backend`` must pick the right policy from a model's
+``cache_spec()``.  These tests are pure host-side bookkeeping (no jit)."""
+
+import pytest
+
+from paddle_tpu.serving.cache_backend import (
+    CacheBackend, HybridCache, PagedKV, RecurrentState, make_backend)
+
+
+def _spec(kinds, state=0, kv_layers=0, kv_bpt=0):
+    return {"kinds": tuple(kinds), "state_bytes_per_slot": state,
+            "kv_layers": kv_layers, "kv_bytes_per_token_layer": kv_bpt}
+
+
+# ---------------------------------------------------------------- PagedKV --
+
+class TestPagedKV:
+    def test_block_zero_is_trash(self):
+        be = PagedKV(num_blocks=8, block_size=16, bytes_per_token=4)
+        claimed = [be.alloc() for _ in range(7)]
+        assert 0 not in claimed and be.alloc() is None
+
+    def test_blocks_for_rounds_up(self):
+        be = PagedKV(8, 16, 4)
+        assert [be.blocks_for(n) for n in (1, 16, 17, 32)] == [1, 1, 2, 2]
+
+    def test_alloc_release_roundtrip(self):
+        be = PagedKV(4, 16, 4)
+        b = be.alloc()
+        assert be._ref[b] == 1
+        be.release(b)
+        assert b in be._free and b not in be._ref
+
+    def test_release_is_exactly_once(self):
+        be = PagedKV(4, 16, 4)
+        b = be.alloc()
+        be.release(b)
+        with pytest.raises(RuntimeError, match="double release"):
+            be.release(b)
+
+    def test_shared_block_release_decrements(self):
+        be = PagedKV(4, 16, 4)
+        b = be.alloc()
+        be.register([b"h0"], [b])
+        assert be.gather(b"h0") == b and be._ref[b] == 2
+        be.release(b)
+        assert be._ref[b] == 1            # still owned by the other slot
+        be.release(b)
+        assert b not in be._ref and be._lru[b"h0"] == b  # parks, registered
+
+    def test_gather_revives_parked_block(self):
+        be = PagedKV(4, 16, 4)
+        b = be.alloc()
+        be.register([b"h0"], [b])
+        be.release(b)                     # ref 0 -> parks in LRU
+        assert be.gather(b"h0") == b and be._ref[b] == 1
+        assert b"h0" not in be._lru
+
+    def test_pressure_reclaims_oldest_cached(self):
+        be = PagedKV(4, 16, 4)            # 3 usable blocks
+        blocks = [be.alloc() for _ in range(3)]
+        be.register([b"h0", b"h1", b"h2"], blocks)
+        for b in blocks:
+            be.release(b)                 # all parked, oldest first = h0
+        fresh = be.alloc()
+        assert fresh == blocks[0]         # LRU victim, deregistered
+        assert b"h0" not in be._index and be.lookup_chain([b"h1"]) == 1
+
+    def test_lookup_chain_longest_consecutive(self):
+        be = PagedKV(8, 16, 4)
+        bs = [be.alloc() for _ in range(3)]
+        be.register([b"a", b"b", b"c"], bs)
+        assert be.lookup_chain([b"a", b"b", b"x", b"c"]) == 2
+        assert be.lookup_chain([b"x"]) == 0
+
+    def test_prefix_cache_off_ignores_register(self):
+        be = PagedKV(8, 16, 4, prefix_cache=False)
+        b = be.alloc()
+        be.register([b"h"], [b])
+        assert be._index == {} and not be.supports_prefix_cache
+
+    def test_byte_accounting_linear(self):
+        be = PagedKV(8, 16, bytes_per_token=4)
+        assert be.block_bytes == 64
+        assert be.pool_bytes() == 8 * 64
+        assert be.seq_bytes(1) == 64 and be.seq_bytes(33) == 3 * 64
+        assert be.headroom_bytes() == be.available() * 64
+        m = be.migrate(33)
+        assert m["bytes"] == 3 * 64
+        assert m["units"] == [{"unit": "kv_block", "count": 3,
+                               "bytes_each": 64}]
+        assert be.plan_bytes() == {"kv_pool_bytes": 512, "state_bytes": 0}
+
+
+# --------------------------------------------------------- RecurrentState --
+
+class TestRecurrentState:
+    def test_blockless(self):
+        be = RecurrentState(4, 1000)
+        assert be.blocks_for(10_000) == 0 and be.available() == 0
+        assert be.alloc() is None and be.append() is None
+        assert not be.supports_prefix_cache and be.gather(b"h") is None
+
+    def test_slot_ledger_exactly_once(self):
+        be = RecurrentState(2, 1000)
+        be.acquire_slot(0)
+        with pytest.raises(RuntimeError, match="already live"):
+            be.acquire_slot(0)
+        be.release_slot(0)
+        with pytest.raises(RuntimeError, match="double release"):
+            be.release_slot(0)
+
+    def test_flat_seq_bytes(self):
+        be = RecurrentState(4, 1000)
+        assert be.seq_bytes(1) == be.seq_bytes(65536) == 1000  # THE point
+        assert be.state_bytes() == 4000
+        be.acquire_slot(0)
+        assert be.headroom_bytes() == 3000
+        m = be.migrate(65536)
+        assert m["bytes"] == 1000
+        assert m["units"] == [{"unit": "slot_state", "count": 1,
+                               "bytes_each": 1000}]
+
+
+# ------------------------------------------------------------ HybridCache --
+
+class TestHybridCache:
+    def _make(self):
+        return HybridCache(PagedKV(4, 16, 4), RecurrentState(2, 1000))
+
+    def test_blocks_ride_paged_side(self):
+        be = self._make()
+        b = be.alloc()
+        assert be.pages._ref[b] == 1 and be.blocks_for(17) == 2
+        be.release(b)
+        with pytest.raises(RuntimeError, match="double release"):
+            be.release(b)
+
+    def test_prefix_cache_structurally_off(self):
+        # a hit would restore only the attention half of the context
+        assert not self._make().supports_prefix_cache
+
+    def test_bytes_sum_both_sides(self):
+        be = self._make()
+        assert be.pool_bytes() == 4 * 64
+        assert be.state_bytes() == 2000
+        assert be.seq_bytes(32) == 2 * 64 + 1000
+        assert be.headroom_bytes() == 3 * 64 + 2000
+        m = be.migrate(32)
+        assert m["bytes"] == 2 * 64 + 1000
+        assert {u["unit"] for u in m["units"]} == {"kv_block", "slot_state"}
+
+
+# ------------------------------------------------------------ make_backend --
+
+class TestMakeBackend:
+    def test_all_attention_is_paged(self):
+        be = make_backend(_spec(["attention"] * 2, kv_layers=2, kv_bpt=8),
+                          num_blocks=8, block_size=16, max_slots=4)
+        assert isinstance(be, PagedKV) and be.supports_prefix_cache
+        assert be.bytes_per_token == 16
+
+    def test_all_ssd_is_recurrent(self):
+        be = make_backend(_spec(["ssd"] * 2, state=1000),
+                          num_blocks=8, block_size=16, max_slots=4)
+        assert isinstance(be, RecurrentState)
+        assert be.state_bytes_per_slot == 1000 and be.max_slots == 4
+
+    def test_mixed_is_hybrid_prefix_forced_off(self):
+        be = make_backend(_spec(["ssd", "attention"], state=1000,
+                                kv_layers=1, kv_bpt=8),
+                          num_blocks=8, block_size=16, max_slots=4,
+                          prefix_cache=True)
+        assert isinstance(be, HybridCache)
+        assert not be.supports_prefix_cache
+        assert not be.pages.supports_prefix_cache
+
+    def test_abstract_base_refuses_release(self):
+        with pytest.raises(RuntimeError, match="blockless"):
+            CacheBackend().release(3)

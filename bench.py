@@ -24,7 +24,11 @@ Presets:
            generate parity on the RecurrentState backend, memory_plan
            honesty, and the flat-vs-linear footprint curve at 8B scale
 
-Usage: python bench.py [--preset tiny|small|base|longctx|ocr|moe|decode|serve|ssd]
+  obs    — observability self-check: MPMD trace-vs-analytic bubble
+           cross-check, tracing overhead A/B, serving bit-identity +
+           lifecycle completeness, Chrome-trace schema validation
+
+Usage: python bench.py [--preset tiny|small|base|longctx|ocr|moe|decode|serve|ssd|obs]
        [--device cpu|tpu] [--steps N] [--batch B] [--seq S]
        [--accum K] [--grad-dtype bfloat16|float32]
 """
@@ -782,6 +786,10 @@ def _bench_serve_trace(jax, paddle, backend, on_tpu, args):
         "goodput_tps_off": round(m_off["goodput_tps"], 2),
         "p50_ms": round(m_on["p50_ms"], 3),
         "p99_ms": round(m_on["p99_ms"], 3),
+        # obs-registry snapshot of the feature-on run (queue depth / batch
+        # occupancy gauges, decode-gap + TTFT histograms, per-replica
+        # counters): the structured replacement for ad-hoc stat dicts
+        "metrics": m_on["metrics"],
         "mfu": 0.0,
         "vs_baseline": 0.0,
     })
@@ -1159,6 +1167,124 @@ def _bench_moe(jax, paddle, backend, on_tpu, args):
     }
 
 
+def _bench_obs(jax, paddle, backend, on_tpu, args):
+    """Observability self-check preset (``scripts/obs_gate.sh``): one
+    BENCH line proving the obs layer's three contracts.
+
+    1. **Bubble cross-check** — the MPMD op-span timeline's per-stage idle
+       fraction agrees with ``schedule_lint.dag_bubble_fraction`` priced
+       with the trace's own cost table (``value`` = rel err; a dropped or
+       mis-ticked span blows it — the ``OBS_GATE_INJECT=drop-span``
+       self-test relies on exactly that).
+    2. **Tracing never perturbs values, and costs < 5%** — a tiny-preset
+       A/B (traced vs untraced pretrain steps, min-of-reps) plus a
+       serving trace replayed tracing-off/tracing-on with bit-identical
+       outputs and a complete per-request lifecycle chain (exactly one
+       begin and one end per request id).
+    3. **Exportable** — the Chrome trace_event doc passes
+       ``obs.validate_chrome_trace``.
+    """
+    import numpy as np
+
+    from paddle_tpu import obs
+    from paddle_tpu.distributed.parallel.mpmd import mpmd_bubble_crosscheck
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+    from paddle_tpu.serving import Engine
+    from paddle_tpu.serving.loadgen import make_trace, run_trace
+    from paddle_tpu.serving.router import Router
+
+    # -- 1. trace-vs-analytic MPMD bubble (pp2, small dims: gate budget) --
+    cc = mpmd_bubble_crosscheck(n_stages=2, n_micro=4, dim=256, mb=32,
+                                steps=5, schedule="ZB")
+
+    # -- 2a. overhead A/B on the tiny pretrain preset ---------------------
+    step_fn, ids, _model, _cfg, (_b, _s, _st) = build_pretrain_step(
+        "tiny", on_tpu, steps=1)
+    step_fn(ids)                        # compile
+    n_steps, reps = 6, 3
+
+    def timed():
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            loss = step_fn(ids)
+        float(np.asarray(loss._data))   # host read = true sync
+        return time.perf_counter() - t0
+
+    was_on = obs.trace_enabled()
+    t_off, t_on = [], []
+    for _ in range(reps):               # interleave: drift cancels
+        obs.disable_tracing()
+        t_off.append(timed())
+        obs.enable_tracing(clear=False)
+        t_on.append(timed())
+    if not was_on:
+        obs.disable_tracing()
+    overhead = min(t_on) / max(min(t_off), 1e-9) - 1.0
+
+    # -- 2b. serving bit-identity + lifecycle completeness ----------------
+    paddle.seed(0)
+    cfg = llama_tiny_config(dtype="float32", max_position_embeddings=1024)
+    model = LlamaForCausalLM(cfg)
+    trace = make_trace("shared_prefix", cfg.vocab_size, seed=0,
+                       n_requests=6, shared_len=96, tail_len=8,
+                       max_new_tokens=8)
+
+    def serve_once():
+        eng = Engine(model, max_batch=2, num_blocks=24,
+                     prefill_buckets=(128, 256))
+        eng.warmup()
+        r = Router()
+        r.add_replica(eng)
+        return run_trace(r, trace)
+
+    obs.disable_tracing()
+    m_off = serve_once()
+    tr = obs.enable_tracing()
+    m_on = serve_once()
+    events = tr.events()
+    identical = m_on["outputs"] == m_off["outputs"]
+    rids = set(m_on["outputs"])
+    begins = {e["id"] for e in events
+              if e.get("ph") == "b" and e.get("cat") == "serve.request"}
+    ends = {e["id"] for e in events
+            if e.get("ph") == "e" and e.get("cat") == "serve.request"}
+    lifecycle_complete = rids <= begins and rids <= ends
+    dup_free = (
+        len([e for e in events if e.get("ph") == "b"
+             and e.get("cat") == "serve.request"]) == len(begins)
+        and len([e for e in events if e.get("ph") == "e"
+                 and e.get("cat") == "serve.request"]) == len(ends))
+
+    # -- 3. export schema --------------------------------------------------
+    doc = tr.to_chrome_trace(metrics=obs.registry().snapshot())
+    problems = obs.validate_chrome_trace(doc)
+    if not was_on and not args.otrace:
+        obs.disable_tracing()
+
+    gap_snap = m_on["metrics"].get("serve.decode_gap_ms{replica=0}", {})
+    dev_kind, _ = _peak_flops(jax, on_tpu)
+    return {
+        "metric": "obs_crosscheck_rel_err",
+        "value": round(cc["rel_err"], 4),
+        "unit": "rel_err",
+        "trace_bubble": round(cc["trace_bubble"], 4),
+        "analytic_bubble": round(cc["analytic_bubble"], 4),
+        "n_op_spans": int(cc["n_op_spans"]),
+        "overhead_frac": round(overhead, 4),
+        "outputs_bit_identical": identical,
+        "lifecycle_complete": bool(lifecycle_complete and dup_free),
+        "trace_valid": not problems,
+        "trace_problems": problems[:5],
+        "metrics_families": len(m_on["metrics"]),
+        "decode_gap_p99_ms": round(gap_snap.get("p99", 0.0), 3),
+        "preset": "obs",
+        "device": dev_kind,
+        "backend": backend,
+        "mfu": 0.0,
+        "vs_baseline": 0.0,
+    }
+
+
 def _bench_pp(jax, backend, on_tpu, args):
     """``--pp N`` A/B: the lockstep SPMD pipeline vs the MPMD per-stage-
     program runtime (``distributed.parallel.mpmd``) on the same toy model
@@ -1203,6 +1329,21 @@ def _bench_pp(jax, backend, on_tpu, args):
             result["mpmd_tok_s"] = round(tok / r["t_lo_s"], 2)
             result["mpmd_transfers_posted"] = int(r["transfers_posted"])
             result["mpmd_transfer_bytes"] = int(r["transfer_bytes"])
+            if args.otrace:
+                # trace-vs-analytic bubble cross-check: the op spans land
+                # in the live tracer (so the --otrace dump holds the
+                # timeline the numbers came from)
+                from paddle_tpu.distributed.parallel.mpmd import \
+                    mpmd_bubble_crosscheck
+
+                cc = mpmd_bubble_crosscheck(S, M, dim=dim, mb=mb, steps=5,
+                                            schedule=args.pp_schedule)
+                result["trace_bubble"] = round(cc["trace_bubble"], 4)
+                result["dag_bubble_analytic"] = round(
+                    cc["analytic_bubble"], 4)
+                result["trace_vs_analytic_rel_err"] = round(
+                    cc["rel_err"], 4)
+                result["trace_op_spans"] = int(cc["n_op_spans"])
     if "spmd_tok_s" in result and "mpmd_tok_s" in result:
         result["mpmd_vs_spmd_tok_s"] = round(
             result["mpmd_tok_s"] / max(result["spmd_tok_s"], 1e-9), 4)
@@ -1214,7 +1355,7 @@ def _bench_pp(jax, backend, on_tpu, args):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--preset", default=None, choices=["tiny", "small", "base", "longctx", "ocr", "moe", "decode", "serve", "ssd"])
+    ap.add_argument("--preset", default=None, choices=["tiny", "small", "base", "longctx", "ocr", "moe", "decode", "serve", "ssd", "obs"])
     ap.add_argument("--device", default=None, choices=["cpu", "tpu"])
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--batch", type=int, default=None)
@@ -1304,6 +1445,18 @@ def main():
                     help="with --pp: schedule the MPMD runtime executes "
                          "(the spmd leg always measures the lockstep 1F1B "
                          "harness)")
+    ap.add_argument("--otrace", default=None, metavar="PATH",
+                    help="enable the obs span tracer for the whole run and "
+                         "write a Chrome/Perfetto trace_event JSON (with "
+                         "the metrics-registry snapshot under 'metrics') "
+                         "here at exit; with --pp ... mpmd this also runs "
+                         "the trace-vs-analytic bubble cross-check and adds "
+                         "trace_bubble/dag_bubble_analytic fields")
+    ap.add_argument("--otrace-xla", action="store_true",
+                    help="with --otrace: additionally capture a "
+                         "jax.profiler device trace into <PATH>.xla/ "
+                         "(TensorBoard/XPlane format — compiled-program "
+                         "timings the host-side span tracer cannot see)")
     args = ap.parse_args()
     if args.audit_only:
         args.audit = True
@@ -1334,6 +1487,7 @@ def main():
         if (args.wus != "off"
                 or (args.tune and args.preset in ("small", "base"))
                 or args.pp >= 2
+                or args.preset == "obs"
                 or (plan_dict or {}).get("zero")):
             # the ZeRO-1 dp mesh needs devices to shard over; fake 8 host
             # devices (must land before the first jax import in-process).
@@ -1362,8 +1516,38 @@ def main():
 
     import paddle_tpu as paddle
 
+    if args.otrace:
+        import atexit
+
+        from paddle_tpu import obs as _obs
+
+        _obs.reset_metrics()
+        _obs.enable_tracing()
+        if args.otrace_xla:
+            jax.profiler.start_trace(args.otrace + ".xla")
+
+        def _dump_otrace():
+            if args.otrace_xla:
+                try:
+                    jax.profiler.stop_trace()
+                except RuntimeError:
+                    pass               # already stopped / never started
+            tr = _obs.tracer()
+            if tr is not None:
+                tr.dump(args.otrace, metrics=_obs.registry().snapshot())
+                print(f"[obs] trace written to {args.otrace}",
+                      file=sys.stderr)
+
+        # atexit covers every preset's return path with one hook
+        atexit.register(_dump_otrace)
+
     if args.pp >= 2:
         result = _bench_pp(jax, backend, on_tpu, args)
+        print(json.dumps(_stamp(result)))
+        return
+
+    if preset == "obs":
+        result = _bench_obs(jax, paddle, backend, on_tpu, args)
         print(json.dumps(_stamp(result)))
         return
 

@@ -40,8 +40,8 @@ from .findings import Report
 
 __all__ = [
     "SchedOp", "SchedEdge", "Schedule", "build_schedule", "lint_schedule",
-    "check_schedule", "bubble_fraction", "measure_bubble_fraction",
-    "SCHEDULE_KINDS",
+    "check_schedule", "bubble_fraction", "dag_bubble_fraction",
+    "measure_bubble_fraction", "SCHEDULE_KINDS",
 ]
 
 SCHEDULE_KINDS = ("GPipe", "1F1B", "ZB", "VPP")
@@ -513,6 +513,68 @@ def bubble_fraction(kind: str, n_stages: int, n_micro: int, virtual: int = 1,
         "round_cost": round_cost,
         "total_units": total,
         "ideal_units": ideal,
+    }
+
+
+def dag_bubble_fraction(kind: str, n_stages: int, n_micro: int,
+                        virtual: int = 1,
+                        costs: Mapping[str, float] = None,
+                        cost_of=None,
+                        double_buffer: bool = False) -> Dict[str, object]:
+    """Analytic per-stage idle fraction of the EMITTED tick DAG.
+
+    :func:`bubble_fraction` prices the *lockstep* runtime, where every
+    stage executes the full round body every round (masked during
+    fill/drain).  The MPMD executor walks the emitted tick DAG instead
+    — a stage IDLES through fill/drain ticks, and ZB co-schedules a
+    stage's F and B inside one tick — so its idle fraction is a
+    different (smaller) number the lockstep closed form cannot predict.
+    This prices the DAG itself: wall = Σ over ticks of the heaviest
+    stage's op cost in that tick, busy(s) = Σ of stage ``s``'s op
+    costs, idle(s) = 1 − busy(s)/wall.
+
+    ``costs`` uses the same per-microbatch unit vocabulary as
+    :func:`bubble_fraction` (``f``/``bx``/``w``/``x``): an F op costs
+    ``f + x``, a B op ``f + bx + x`` (recompute + input grad; plus
+    ``w`` for 1F1B where B carries the weight grad), the ZB deferred W
+    op ``M*(f + w) + x``.  ``cost_of(kind, stage) -> cost`` overrides
+    with an explicit table — pass per-(kind, stage) medians measured
+    from a runtime trace and this becomes the analytic half of the
+    observability cross-check: if the executor really walked the
+    certified DAG, the predicted idle fraction matches the
+    trace-derived one (``distributed.parallel.mpmd.
+    mpmd_bubble_crosscheck``, rel err ≤ 0.15 on the CPU mesh).
+    """
+    kind = _canon_kind(kind)
+    S, M = n_stages, n_micro
+    sched = build_schedule(kind, S, M, virtual,
+                           double_buffer=double_buffer)
+    if cost_of is None:
+        c = {"f": 1.0, "bx": 1.0, "w": 1.0, "x": 0.0}
+        c.update(costs or {})
+        per_kind = {
+            "F": c["f"] + c["x"],
+            "B": (c["f"] + c["bx"] + c["x"] if kind == "ZB"
+                  else c["f"] + c["bx"] + c["w"] + c["x"]),
+            "W": M * (c["f"] + c["w"]) + c["x"],
+        }
+        cost_of = lambda k, s: per_kind[k]
+    by_tick: Dict[int, Dict[int, float]] = {}
+    for op in sched.ops.values():
+        row = by_tick.setdefault(op.tick, {})
+        row[op.stage] = row.get(op.stage, 0.0) + cost_of(op.kind, op.stage)
+    wall = sum(max(row.values()) for row in by_tick.values())
+    busy = [0.0] * S
+    for row in by_tick.values():
+        for s, d in row.items():
+            busy[s] += d
+    per_stage = [0.0 if wall == 0 else (wall - b) / wall for b in busy]
+    return {
+        "fraction": sum(per_stage) / S,
+        "per_stage": per_stage,
+        "wall_units": wall,
+        "busy_units": busy,
+        "n_ticks": len(by_tick),
     }
 
 

@@ -24,6 +24,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ...obs import flight_event
+
 __all__ = ["HeartbeatFailureDetector"]
 
 #: pseudo-rank reported when the store itself (the coordinator host) is
@@ -70,6 +72,7 @@ class HeartbeatFailureDetector:
 
     def beat_once(self) -> None:
         self.store.add(self._lease_key(self.rank), 1, timeout=self.op_timeout)
+        flight_event("ft.lease-renew", rank=self.rank)
 
     def counters(self) -> Dict[int, int]:
         """Current lease counter per rank (0 = never renewed)."""
@@ -136,6 +139,8 @@ class HeartbeatFailureDetector:
                 and now - last_advance.get(r, start) > self.ttl)
             if expired:
                 declared.update(expired)
+                flight_event("ft.heartbeat-miss", expired=expired,
+                             dead=sorted(declared))
                 with self._dead_lock:
                     self._dead = sorted(declared)
                 try:
@@ -152,6 +157,7 @@ class HeartbeatFailureDetector:
                        timeout=t)
         self.store.set(f"ft/{self.job_id}/dead/{epoch}", json.dumps(dead),
                        timeout=t)
+        flight_event("ft.epoch-bump", epoch=epoch, alive=alive, dead=dead)
         return epoch
 
     # -- consumers -----------------------------------------------------------

@@ -147,9 +147,11 @@ class FaultInjector:
                 or round_no < self.serve_kill_round or not alive):
             return None
         self._serve_kill_fired = True
-        if self.serve_kill_replica in alive:
-            return self.serve_kill_replica
-        return min(alive)
+        victim = (self.serve_kill_replica
+                  if self.serve_kill_replica in alive else min(alive))
+        from ...obs import flight_event
+        flight_event("inject.serve-kill", victim=victim, round=round_no)
+        return victim
 
     # -- pipeline stage kill -------------------------------------------------
 
@@ -163,9 +165,11 @@ class FaultInjector:
                 or tick < self.stage_kill_tick or not alive):
             return None
         self._stage_kill_fired = True
-        if self.stage_kill_stage in alive:
-            return self.stage_kill_stage
-        return min(alive)
+        victim = (self.stage_kill_stage
+                  if self.stage_kill_stage in alive else min(alive))
+        from ...obs import flight_event
+        flight_event("inject.stage-kill", victim=victim, tick=tick)
+        return victim
 
     # -- store faults --------------------------------------------------------
 
@@ -181,6 +185,8 @@ class FaultInjector:
         if writes_acked < self.store_kill_leader:
             return False
         self._store_kill_fired = True
+        from ...obs import flight_event
+        flight_event("inject.store-kill", writes_acked=writes_acked)
         return True
 
     def set_store_partition(self, spec: str) -> None:

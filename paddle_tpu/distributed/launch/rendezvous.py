@@ -186,6 +186,9 @@ def invalidate_generation(store: TCPStore, job_id: str, gen: int,
     """Mark generation ``gen`` dead on the store (idempotent — every
     survivor may call it).  Late joiners and in-flight ``rendezvous`` polls
     observe the key and abort instead of waiting out their timeout."""
+    from ...obs import flight_event
+    flight_event("rdv.generation-invalidated", job_id=job_id, gen=gen,
+                 dead_ranks=sorted(dead_ranks))
     store.set(f"rdzv/{job_id}/{gen}/invalid", json.dumps(sorted(dead_ranks)))
 
 

@@ -69,6 +69,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..obs import dump_flight, flight_event
+
 from .store import (TCPStore, _ADD, _DELETE, _GET, _SET, _SNAPSHOT, _WAIT,
                     _decode_kv, _encode_kv, _recv_bytes, _recv_exact)
 from .fault_tolerance.injection import get_injector
@@ -376,6 +378,8 @@ class ReplicaServer:
             print(f"[store] replica {self._id} term {self._term}: "
                   f"{self._role} -> follower ({why})", file=sys.stderr,
                   flush=True)
+            flight_event("store.step-down", replica=self._id,
+                         term=self._term, why=why)
         self._role = _FOLLOWER
         self._noop_idx = None
         # a stale self-hint would bounce clients back here forever; the
@@ -526,7 +530,11 @@ class ReplicaServer:
         if inj is not None and inj.store_kill_due(n):
             print(f"[inject] store leader {self._id} dying after "
                   f"{n} acked writes", file=sys.stderr, flush=True)
+            flight_event("store.leader-kill", replica=self._id,
+                         term=self._term, writes_acked=n)
             self.kill()
+            dump_flight("store-leader-kill",
+                        victim=f"replica {self._id}", writes_acked=n)
 
     def _read_gate_locked(self) -> Optional[int]:
         """None when linearizable reads are serveable, else the status to
@@ -871,6 +879,8 @@ class ReplicaServer:
         print(f"[store] replica {self._id} elected leader for term "
               f"{self._term} (log at {self._noop_idx})", file=sys.stderr,
               flush=True)
+        flight_event("store.leader-elected", replica=self._id,
+                     term=self._term, log_index=self._noop_idx)
         self._cond.notify_all()
         for ev in self._send_ev.values():
             ev.set()
@@ -925,6 +935,8 @@ class ReplicaServer:
               f"{leader_rid}: snapshot at index {base_idx} "
               f"(term {base_term}), awaiting log tail", file=sys.stderr,
               flush=True)
+        flight_event("store.catch-up", replica=self._id,
+                     leader=leader_rid, index=base_idx)
 
 
 class ReplicaGroup:

@@ -1,0 +1,71 @@
+"""Runtime observability: span tracer, metrics registry, flight recorder.
+
+The static analyzers (:mod:`paddle_tpu.analysis`) predict what a run
+*should* do — liveness predicts peak HBM, ``schedule_lint`` predicts the
+pipeline bubble, ``overlap`` predicts exposed collective bytes.  This
+package records what a run actually *did*, cheap enough to leave wired
+into the runtimes:
+
+- :mod:`.trace` — structured span tracer.  Thread-safe, monotonic-clock
+  spans with categories and args, nestable, exported as Chrome/Perfetto
+  ``trace_event`` JSON (open the dump in ``ui.perfetto.dev``).  Disabled
+  is the default and costs one module-global read per call site — no
+  allocation, no locking (``tests/test_obs.py`` pins both).
+- :mod:`.metrics` — metrics registry: counters, gauges and fixed-bucket
+  histograms with p50/p95/p99, labeled families
+  (``serve.decode_gap_ms{replica=0}``), snapshot-to-JSON round-trippable.
+- :mod:`.flight` — flight recorder: a bounded ring buffer of recent
+  events (plus span completions when tracing is on), ALWAYS on, dumped
+  to a JSON postmortem artifact on every injected-fault path so chaos
+  tests can assert the victim and the recovery sequence.
+
+Naming taxonomy (events, spans and metrics share one namespace scheme —
+``<layer>.<noun-or-verb>``, label args carry the identity):
+
+===========================  ====================================================
+name                         producer / meaning
+===========================  ====================================================
+``mpmd.op``                  span cat: one F/B/W op (args tick/stage/micro/kind)
+``mpmd.xfer-post``           span: ``jax.device_put`` posted (args src/dst stage)
+``mpmd.xfer-due``            instant: due-tick consume of a posted transfer
+``mpmd.steps``               counter {schedule,pp}: executor steps completed
+``mpmd.ticks`` etc.          gauges {schedule,pp}: cumulative executor stats
+                             (ticks, transfers_posted, transfer_bytes, replans)
+``mpmd.stage-kill``          flight: injected stage failure (victim stage, tick)
+``mpmd.replan``              flight: survivors re-plan after a stage kill
+``serve.request``            async span chain: one request queued→…→emitted
+``serve.queue_depth``        gauge {replica}: waiting requests after a round
+``serve.batch_occupancy``    gauge {replica}: live decode slots / max_batch
+``serve.requests``           counter {replica}: requests emitted
+``serve.prefix_hit_blocks``  counter {replica}: prompt blocks served from cache
+``serve.prefill_tokens``     counter {replica}: prompt tokens prefilled
+``serve.decode_gap_ms``      histogram {replica}: decode-visible gap per chunk
+``serve.ttft_ms``            histogram {replica}: queued→first prefill dispatch
+``serve.kill``               flight: injected replica kill (victim replica)
+``serve.reroute``            flight: a harvested request re-placed after a kill
+``store.leader-elected``     flight: replica won an election (term)
+``store.step-down``          flight: leader stepped down (reason)
+``store.leader-kill``        flight: injected leader kill (victim replica)
+``store.catch-up``           flight: restarted replica caught up from leader
+``ft.lease-renew``           flight: heartbeat lease renewed (rank)
+``ft.heartbeat-miss``        flight: detector saw a lease expire (rank)
+``ft.epoch-bump``            flight: membership epoch published (alive/dead)
+``rdv.generation-invalidated``  flight: rendezvous generation declared dead
+===========================  ====================================================
+"""
+
+from .trace import (Tracer, enable_tracing, disable_tracing, tracer,
+                    trace_enabled, span, instant, validate_chrome_trace)
+from .metrics import (Counter, Gauge, Histogram, Registry, registry,
+                      reset_metrics)
+from .flight import (FlightRecorder, flight, flight_event, dump_flight,
+                     last_flight_dump)
+
+__all__ = [
+    "Tracer", "enable_tracing", "disable_tracing", "tracer",
+    "trace_enabled", "span", "instant",
+    "Counter", "Gauge", "Histogram", "Registry", "registry",
+    "reset_metrics",
+    "FlightRecorder", "flight", "flight_event", "dump_flight",
+    "last_flight_dump",
+]

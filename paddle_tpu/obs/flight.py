@@ -1,0 +1,130 @@
+"""Flight recorder: bounded ring of recent events, dumped on faults.
+
+Always on (an append to a bounded deque — no syscalls, no JSON until a
+dump), so a crash or an injected fault anywhere in the process leaves a
+postmortem artifact even when nobody thought to enable tracing.  The
+producers are the control-plane and chaos paths:
+
+- the fault-injection framework records every fired ``FLAGS_ft_inject_*``
+  (``inject.serve-kill`` / ``inject.stage-kill`` / ``inject.store-kill``
+  with the victim);
+- the recovering layer records the recovery sequence (``serve.reroute``,
+  ``mpmd.replan``, ``store.leader-elected``, …) and then calls
+  :func:`dump_flight` so the artifact holds the kill AND what the
+  runtime did about it;
+- the failure detector / rendezvous record membership churn
+  (``ft.lease-renew``, ``ft.heartbeat-miss``, ``ft.epoch-bump``,
+  ``rdv.generation-invalidated``).
+
+When the span tracer is enabled, completed spans tee a compact record
+in here too, so a postmortem shows what the process was doing just
+before the fault.
+
+Dumps land in ``$PADDLE_FLIGHT_DIR`` (default: the system temp dir) as
+``paddle_flight_<pid>_<seq>_<reason>.json``; :func:`last_flight_dump`
+returns the most recent path so chaos tests can find and assert on it.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["FlightRecorder", "flight", "flight_event", "dump_flight",
+           "last_flight_dump"]
+
+DEFAULT_CAPACITY = 2048
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._buf: "collections.deque" = collections.deque(maxlen=capacity)
+        self._seq = 0
+        self._dump_seq = 0
+        self.last_dump_path: Optional[str] = None
+
+    @property
+    def capacity(self) -> int:
+        return self._buf.maxlen
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    # -- producers -------------------------------------------------------------
+
+    def event(self, name: str, **args) -> None:
+        with self._lock:
+            self._seq += 1
+            self._buf.append({"seq": self._seq, "t": time.monotonic(),
+                              "kind": "event", "name": name,
+                              "args": args or {}})
+
+    def record_span(self, name: str, cat: str, dur_us: float,
+                    args: Optional[dict]) -> None:
+        with self._lock:
+            self._seq += 1
+            self._buf.append({"seq": self._seq, "t": time.monotonic(),
+                              "kind": "span", "name": name, "cat": cat,
+                              "dur_us": dur_us, "args": args or {}})
+
+    # -- consumers -------------------------------------------------------------
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._buf)
+
+    def events_named(self, name: str) -> List[Dict[str, Any]]:
+        return [e for e in self.snapshot()
+                if e["kind"] == "event" and e["name"] == name]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def dump(self, reason: str, path: Optional[str] = None,
+             **extra) -> str:
+        """Write the ring to a JSON postmortem; returns the path."""
+        with self._lock:
+            self._dump_seq += 1
+            events = list(self._buf)
+            seq = self._dump_seq
+        if path is None:
+            d = os.environ.get("PADDLE_FLIGHT_DIR", tempfile.gettempdir())
+            os.makedirs(d, exist_ok=True)
+            safe = "".join(c if c.isalnum() or c in "-_" else "-"
+                           for c in reason)
+            path = os.path.join(
+                d, f"paddle_flight_{os.getpid()}_{seq}_{safe}.json")
+        doc = {"reason": reason, "pid": os.getpid(),
+               "wall_time": time.time(), "n_events": len(events),
+               "events": events}
+        doc.update(extra)
+        with open(path, "w") as f:
+            json.dump(doc, f, default=str)
+        self.last_dump_path = path
+        return path
+
+
+_flight = FlightRecorder()
+
+
+def flight() -> FlightRecorder:
+    return _flight
+
+
+def flight_event(name: str, **args) -> None:
+    _flight.event(name, **args)
+
+
+def dump_flight(reason: str, path: Optional[str] = None, **extra) -> str:
+    return _flight.dump(reason, path=path, **extra)
+
+
+def last_flight_dump() -> Optional[str]:
+    return _flight.last_dump_path

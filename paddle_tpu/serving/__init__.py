@@ -71,6 +71,7 @@ import numpy as np
 
 from .cache_backend import (CacheBackend, HybridCache, PagedKV,
                             RecurrentState, make_backend)
+from .. import obs
 
 __all__ = ["Engine", "GenRequest", "RequestOutput", "prefix_block_hashes",
            "CacheBackend", "PagedKV", "RecurrentState", "make_backend"]
@@ -117,6 +118,7 @@ class GenRequest:
     _stopped: bool = field(default=False)
     _emitted: bool = field(default=False)
     _prefill_dt: float = field(default=0.0)
+    _queued_t: float = field(default=0.0)  # perf_counter at add_request
 
 
 @dataclass
@@ -317,6 +319,30 @@ class Engine:
                       # prefill); chunk_prefills counts chunk-program calls
                       "prefix_lookup_blocks": 0, "prefix_hit_blocks": 0,
                       "prefix_hit_tokens": 0, "chunk_prefills": 0}
+        # observability: the router stamps a replica id so registry
+        # families split per replica; standalone engines stay unlabeled
+        self.obs_replica: Optional[int] = None
+
+    # -- observability -------------------------------------------------------
+
+    def _obs_labels(self) -> dict:
+        if self.obs_replica is None:
+            return {}
+        return {"replica": self.obs_replica}
+
+    def _obs_mark(self, req: GenRequest, phase: str, **args) -> None:
+        """Phase mark on the request's lifecycle chain.  Tracing-only
+        (no-op when the tracer is off) and host-metadata-only, so traced
+        serving output is bit-identical to untraced.  ``lifecycle_begin``
+        dedups, so whichever layer sees the request first (router submit
+        or engine add_request) opens the chain."""
+        tr = obs.tracer()
+        if tr is None or req.request_id is None:
+            return
+        if self.obs_replica is not None:
+            args.setdefault("replica", self.obs_replica)
+        tr.lifecycle_begin(req.request_id)
+        tr.lifecycle_mark(req.request_id, phase, args=args or None)
 
     # -- public API ---------------------------------------------------------
 
@@ -409,6 +435,8 @@ class Engine:
                     f"prompt needs {self._bucket(P) // self.block_size} "
                     f"blocks but the pool only has {self.num_blocks - 1} "
                     f"usable; raise num_blocks")
+        req._queued_t = time.perf_counter()
+        self._obs_mark(req, "queued", prompt_len=P)
         self._waiting.append(req)
         return req.request_id
 
@@ -421,6 +449,12 @@ class Engine:
         materializes its tokens)."""
         self._round()
         self._sync_pending()
+        reg = obs.registry()
+        lbl = self._obs_labels()
+        reg.gauge("serve.queue_depth", **lbl).set(len(self._waiting))
+        reg.gauge("serve.batch_occupancy", **lbl).set(
+            sum(1 for s in self._slots if s.req is not None)
+            / max(1, self.max_batch))
         return self._drain_ready()
 
     def run_to_completion(self) -> List[RequestOutput]:
@@ -586,6 +620,7 @@ class Engine:
                 blocks_row = np.zeros((n_blocks,), np.int32)
                 blocks_row[:len(slot.blocks)] = slot.blocks
                 admitted.append((slot, req, Pb, ids_row, blocks_row, P))
+                self._obs_mark(req, "admitted", path="dense", bucket=Pb)
                 continue
             # -- path B: prefix-hit suffix and/or chunked prefill — admit
             # the slot now; its chunks dispatch in _advance_prefills,
@@ -612,6 +647,12 @@ class Engine:
             self._write_tbl_row(slot)
             self.stats["prefix_hit_blocks"] += n_hit
             self.stats["prefix_hit_tokens"] += n_hit * bs
+            if n_hit:
+                obs.registry().counter(
+                    "serve.prefix_hit_blocks",
+                    **self._obs_labels()).inc(n_hit)
+            self._obs_mark(req, "admitted", path="chunked",
+                           hit_blocks=n_hit)
         by_bucket: Dict[int, list] = {}
         for entry in admitted:
             by_bucket.setdefault(entry[2], []).append(entry)
@@ -676,16 +717,19 @@ class Engine:
         else:
             fidx0 = self._first_idx        # unused by the non-final program
         t0 = time.perf_counter()
-        self._first_buf, self._last_dev, self.k_pools, self.v_pools = fn(
-            self._params, self._buffers, self.k_pools, self.v_pools,
-            self._last_dev, jnp.asarray(slot.idx, jnp.int32),
-            jnp.asarray(ids_row), jnp.asarray(self._tbl[slot.idx].copy()),
-            jnp.asarray(slot.length, jnp.int32),
-            jnp.asarray(take, jnp.int32), rnd.next_key(),
-            jnp.asarray(req.temperature, jnp.float32),
-            jnp.asarray(req.top_k, jnp.int32),
-            jnp.asarray(req.top_p, jnp.float32),
-            self._first_buf, jnp.asarray(fidx0, jnp.int32))
+        with obs.span("serve.prefill-chunk", cat="serve",
+                      args={"bucket": Cb, "final": final}):
+            self._first_buf, self._last_dev, self.k_pools, self.v_pools = fn(
+                self._params, self._buffers, self.k_pools, self.v_pools,
+                self._last_dev, jnp.asarray(slot.idx, jnp.int32),
+                jnp.asarray(ids_row),
+                jnp.asarray(self._tbl[slot.idx].copy()),
+                jnp.asarray(slot.length, jnp.int32),
+                jnp.asarray(take, jnp.int32), rnd.next_key(),
+                jnp.asarray(req.temperature, jnp.float32),
+                jnp.asarray(req.top_k, jnp.int32),
+                jnp.asarray(req.top_p, jnp.float32),
+                self._first_buf, jnp.asarray(fidx0, jnp.int32))
         dt = time.perf_counter() - t0      # dispatch cost only
         req._prefill_dt += dt
         slot.length += take
@@ -694,6 +738,10 @@ class Engine:
         self.stats["prefill_time"] += dt
         self.stats["prefill_tokens"] += Cb
         self.stats["chunk_prefills"] += 1
+        reg = obs.registry()
+        lbl = self._obs_labels()
+        reg.counter("serve.prefill_tokens", **lbl).inc(Cb)
+        self._obs_mark(req, "prefill-chunk", take=take, final=final)
         if final:
             slot.out_count = 1
             self._pending.append(
@@ -701,6 +749,9 @@ class Engine:
             self.stats["prefills"] += 1
             self.stats["generated_tokens"] += 1
             self._register_prompt_blocks(slot)
+            if req._queued_t:
+                reg.histogram("serve.ttft_ms", **lbl).observe(
+                    (t0 + dt - req._queued_t) * 1e3)
             if slot.out_count >= req.max_new_tokens:
                 self._finish_order.append(req)
                 self._release(slot)
@@ -873,21 +924,31 @@ class Engine:
         fidx0 = self._first_idx
         self._first_idx += n
         t0 = time.perf_counter()
-        self._first_buf, self._last_dev, self.k_pools, self.v_pools = fn(
-            self._params, self._buffers, self.k_pools, self.v_pools,
-            self._last_dev, jnp.asarray(sidx), jnp.asarray(ids),
-            jnp.asarray(blocks), jnp.asarray(P), rnd.next_key(),
-            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
-            self._first_buf, jnp.asarray(fidx0, jnp.int32))
+        with obs.span("serve.prefill", cat="serve",
+                      args={"bucket": Pb, "n": n}):
+            self._first_buf, self._last_dev, self.k_pools, self.v_pools = fn(
+                self._params, self._buffers, self.k_pools, self.v_pools,
+                self._last_dev, jnp.asarray(sidx), jnp.asarray(ids),
+                jnp.asarray(blocks), jnp.asarray(P), rnd.next_key(),
+                jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+                self._first_buf, jnp.asarray(fidx0, jnp.int32))
         dt = time.perf_counter() - t0                    # dispatch cost only
+        reg = obs.registry()
+        lbl = self._obs_labels()
+        ttft = reg.histogram("serve.ttft_ms", **lbl)
         for j, (slot, req, *_rest) in enumerate(group):
             req._prefill_dt = dt
             self._pending.append(
                 ("prefill", req, len(self._full_first_bufs), fidx0 + j))
+            # first token is sampled by this call: TTFT-to-dispatch
+            if req._queued_t:
+                ttft.observe((t0 + dt - req._queued_t) * 1e3)
+            self._obs_mark(req, "prefill", bucket=Pb, batch=n)
         self.stats["prefills"] += n
         self.stats["prefill_time"] += dt
         self.stats["prefill_tokens"] += n * Pb
         self.stats["generated_tokens"] += n
+        reg.counter("serve.prefill_tokens", **lbl).inc(n * Pb)
 
     def _build_prefill(self, Pb: int, n: int):
         from ..jit import functional_call
@@ -990,16 +1051,19 @@ class Engine:
         fidx0 = self._first_idx
         self._first_idx += 1
         t0 = time.perf_counter()
-        (self._first_buf, self._last_dev, self._ssd_state, self.k_pools,
-         self.v_pools) = fn(
-            self._params, self._buffers, self._ssd_state, self.k_pools,
-            self.v_pools, self._last_dev, jnp.asarray(slot.idx, jnp.int32),
-            jnp.asarray(ids_row), jnp.asarray(blocks_row),
-            jnp.asarray(P, jnp.int32), rnd.next_key(),
-            jnp.asarray(req.temperature, jnp.float32),
-            jnp.asarray(req.top_k, jnp.int32),
-            jnp.asarray(req.top_p, jnp.float32),
-            self._first_buf, jnp.asarray(fidx0, jnp.int32))
+        with obs.span("serve.prefill", cat="serve",
+                      args={"bucket": Pb, "n": 1}):
+            (self._first_buf, self._last_dev, self._ssd_state, self.k_pools,
+             self.v_pools) = fn(
+                self._params, self._buffers, self._ssd_state, self.k_pools,
+                self.v_pools, self._last_dev,
+                jnp.asarray(slot.idx, jnp.int32),
+                jnp.asarray(ids_row), jnp.asarray(blocks_row),
+                jnp.asarray(P, jnp.int32), rnd.next_key(),
+                jnp.asarray(req.temperature, jnp.float32),
+                jnp.asarray(req.top_k, jnp.int32),
+                jnp.asarray(req.top_p, jnp.float32),
+                self._first_buf, jnp.asarray(fidx0, jnp.int32))
         dt = time.perf_counter() - t0                    # dispatch cost only
         req._prefill_dt = dt
         self._pending.append(
@@ -1008,6 +1072,13 @@ class Engine:
         self.stats["prefill_time"] += dt
         self.stats["prefill_tokens"] += Pb
         self.stats["generated_tokens"] += 1
+        reg = obs.registry()
+        lbl = self._obs_labels()
+        reg.counter("serve.prefill_tokens", **lbl).inc(Pb)
+        if req._queued_t:
+            reg.histogram("serve.ttft_ms", **lbl).observe(
+                (t0 + dt - req._queued_t) * 1e3)
+        self._obs_mark(req, "prefill", bucket=Pb, batch=1)
 
     def _build_ssd_decode(self, k: int):
         """The decode-chunk program with the slot-state arrays threaded
@@ -1098,22 +1169,29 @@ class Engine:
         self._tok_row += k
         t0 = time.perf_counter()
         if self._last_dispatch_t is not None:
-            self._decode_gaps.append(t0 - self._last_dispatch_t)
-        if self._recurrent:
-            fn = self._get_ssd_decode_fn(k)
-            (self._tok_buf, lst, self._ssd_state, self.k_pools,
-             self.v_pools, lens_out) = fn(
-                self._params, self._buffers, self._ssd_state,
-                self.k_pools, self.v_pools, tbl_dev, len_dev,
-                self._last_dev, rnd.next_key(), temps_dev, topk_dev,
-                topp_dev, self._tok_buf, jnp.asarray(row0, jnp.int32))
-        else:
-            fn = self._get_decode_fn(k)
-            self._tok_buf, lst, self.k_pools, self.v_pools, lens_out = fn(
-                self._params, self._buffers, self.k_pools, self.v_pools,
-                tbl_dev, len_dev, self._last_dev, rnd.next_key(),
-                temps_dev, topk_dev, topp_dev,
-                self._tok_buf, jnp.asarray(row0, jnp.int32))
+            gap = t0 - self._last_dispatch_t
+            self._decode_gaps.append(gap)
+            obs.registry().histogram(
+                "serve.decode_gap_ms",
+                **self._obs_labels()).observe(gap * 1e3)
+        with obs.span("serve.decode-chunk", cat="serve",
+                      args={"k": k, "staged": staged}):
+            if self._recurrent:
+                fn = self._get_ssd_decode_fn(k)
+                (self._tok_buf, lst, self._ssd_state, self.k_pools,
+                 self.v_pools, lens_out) = fn(
+                    self._params, self._buffers, self._ssd_state,
+                    self.k_pools, self.v_pools, tbl_dev, len_dev,
+                    self._last_dev, rnd.next_key(), temps_dev, topk_dev,
+                    topp_dev, self._tok_buf, jnp.asarray(row0, jnp.int32))
+            else:
+                fn = self._get_decode_fn(k)
+                (self._tok_buf, lst, self.k_pools, self.v_pools,
+                 lens_out) = fn(
+                    self._params, self._buffers, self.k_pools, self.v_pools,
+                    tbl_dev, len_dev, self._last_dev, rnd.next_key(),
+                    temps_dev, topk_dev, topp_dev,
+                    self._tok_buf, jnp.asarray(row0, jnp.int32))
         self._last_dev = lst
         self._last_dispatch_t = time.perf_counter()
         if self.dispatch_staging:
@@ -1133,6 +1211,7 @@ class Engine:
             s.out_count += take
             s.length += k
             self.stats["generated_tokens"] += take
+            self._obs_mark(s.req, "decode-round", k=take)
             if s.out_count >= s.req.max_new_tokens:
                 self._finish_order.append(s.req)
                 self._release(s)
@@ -1330,6 +1409,14 @@ class Engine:
 
     def _emit(self, req: GenRequest, reason: str) -> RequestOutput:
         req._emitted = True
+        tr = obs.tracer()
+        if tr is not None and req.request_id is not None:
+            tr.lifecycle_end(
+                req.request_id,
+                args={"reason": reason,
+                      "tokens": len(req.prior_output) + len(req._out_vals)})
+        obs.registry().counter(
+            "serve.requests", **self._obs_labels()).inc()
         return RequestOutput(
             request_id=req.request_id,
             prompt_ids=np.asarray(

@@ -29,6 +29,7 @@ from typing import Dict, List
 import numpy as np
 
 from . import GenRequest
+from ..obs import registry, reset_metrics
 from .router import Router
 
 __all__ = ["TraceRequest", "make_trace", "run_trace"]
@@ -89,7 +90,13 @@ def run_trace(router: Router, trace: List[TraceRequest],
     A round's wall time is attributed to decode when it advanced any
     replica's decode-call counter — ``decode_gap_*`` percentiles are over
     those rounds' durations, i.e. the time between consecutive decode-token
-    deliveries that a long prefill can stretch."""
+    deliveries that a long prefill can stretch.
+
+    The ``"metrics"`` key is the obs-registry snapshot for the run (queue
+    depth / batch occupancy gauges, decode-gap and TTFT histograms,
+    per-replica counters) — the structured replacement for the ad-hoc
+    stat keys, which stay for compatibility."""
+    reset_metrics()                # isolate this run's registry families
     pending = sorted(trace, key=lambda r: r.arrival_s)
     arrivals: Dict[str, float] = {}
     done: Dict[str, tuple] = {}
@@ -143,6 +150,7 @@ def run_trace(router: Router, trace: List[TraceRequest],
         "hit_rate": hits / max(lookups, 1),
         "prefill_tokens": prefill_tokens,
         "outputs": {rid: list(o.output_ids) for rid, (o, _) in done.items()},
+        "metrics": registry().snapshot(),
     }
 
 

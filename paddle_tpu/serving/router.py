@@ -45,6 +45,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from . import Engine, GenRequest, RequestOutput, prefix_block_hashes
+from .. import obs
+from ..obs import dump_flight, flight_event
 
 __all__ = ["Router"]
 
@@ -125,7 +127,12 @@ class Router:
             replica_id = self._next_replica
         self._next_replica = max(self._next_replica, replica_id) + 1
         self._replicas[replica_id] = engine
+        try:
+            engine.obs_replica = replica_id    # label its registry families
+        except AttributeError:
+            pass    # duck-typed stubs (bare object()) take no attributes
         self.stats["joins"] += 1
+        flight_event("serve.join", replica=replica_id)
         self._drain_parked()
         self._publish_membership()
         return replica_id
@@ -142,9 +149,16 @@ class Router:
             t.replica = None
         if requeue:
             # preserve submission order for determinism
+            tr = obs.tracer()
             for t in sorted(harvested, key=lambda t: t.arrival):
                 self._place(t)
                 self.stats["rerouted"] += 1
+                flight_event("serve.reroute", rid=t.rid,
+                             from_replica=replica_id, to_replica=t.replica)
+                if tr is not None:
+                    tr.lifecycle_mark(t.rid, "rerouted",
+                                      args={"from": replica_id,
+                                            "to": t.replica})
         self._publish_membership()
         return [t.rid for t in harvested]
 
@@ -169,6 +183,11 @@ class Router:
             top_k=req.top_k, top_p=req.top_p, eos_token_id=req.eos_token_id,
             arrival=time.perf_counter())
         self._tracked[t.rid] = t
+        tr = obs.tracer()
+        if tr is not None:
+            # the router opens the chain; engine add_request's begin dedups
+            tr.lifecycle_begin(t.rid)
+            tr.lifecycle_mark(t.rid, "submitted")
         self._place(t)
         return t.rid
 
@@ -288,5 +307,10 @@ class Router:
             return
         victim = inj.serve_kill_due(self.rounds, sorted(self._replicas))
         if victim is not None:
-            self.remove_replica(victim)
+            flight_event("serve.kill", replica=victim, round=self.rounds)
+            rerouted = self.remove_replica(victim)
             self.stats["kills"] += 1
+            # dump AFTER re-routing so the postmortem holds the kill and
+            # the recovery sequence
+            dump_flight("serve-kill", victim=f"replica {victim}",
+                        round=self.rounds, rerouted=rerouted)

@@ -17,6 +17,9 @@
 #   tune_gate     static auto-parallel tuner (chosen >= hand-picked by
 #                 static score; HBM prune rejects the injected bad plan)
 #                 vs scripts/TUNE_BASELINE.json
+#   obs_gate      observability layer: Perfetto trace schema, trace-vs-
+#                 analytic bubble crosscheck, tracing overhead <= 5%,
+#                 bit-identical serving vs scripts/OBS_BASELINE.json
 #   host_lint     standalone self-lint summary line (rc 1 on any finding)
 #
 # Exit code: number of failed stages (0 = green).
@@ -48,6 +51,7 @@ stage serve_gate    ./scripts/serve_gate.sh
 stage ssd_gate      ./scripts/ssd_gate.sh
 stage overlap_gate  ./scripts/overlap_gate.sh
 stage tune_gate     ./scripts/tune_gate.sh
+stage obs_gate      ./scripts/obs_gate.sh
 stage store_chaos   bash -c "\
     timeout -k 10 300 python -m pytest -q -p no:cacheprovider \
         tests/test_store_replicated.py \

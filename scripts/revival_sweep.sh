@@ -49,6 +49,16 @@ for args in "--pp 2 --pp-runtime both --pp-schedule zb" \
     line=$(timeout 2400 python bench.py --device tpu $args 2>/dev/null | tail -1)
     [ -n "$line" ] && echo "$line" >> "$OUT" && echo "$line" | head -c 200 >&2 && echo >&2
 done
+# observability recapture: the MPMD A/B again, but dumping the span trace
+# (+ a jax.profiler XLA capture via --otrace-xla) so the on-chip per-stage
+# timeline and its trace-vs-analytic bubble crosscheck land as artifacts;
+# open /tmp/revival_otrace.json in ui.perfetto.dev, the .xla dir in
+# tensorboard.  CPU-proxy rel_err (2026-08-06): pp2 0.064, pp4 ~0.000.
+echo "[revival] pp --otrace (obs recapture)" >&2
+line=$(timeout 2400 python bench.py --device tpu --pp 4 --pp-runtime mpmd \
+       --pp-schedule zb --otrace /tmp/revival_otrace.json --otrace-xla \
+       2>/dev/null | tail -1)
+[ -n "$line" ] && echo "$line" >> "$OUT" && echo "$line" | head -c 200 >&2 && echo >&2
 echo "[revival] serve (post-rework)" >&2
 line=$(timeout 2400 python bench.py --preset serve --device tpu 2>/dev/null | tail -1)
 [ -n "$line" ] && echo "$line" >> "$OUT" && echo "$line" | head -c 200 >&2 && echo >&2

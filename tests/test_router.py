@@ -155,11 +155,15 @@ def test_router_remove_replica_reroutes_exactly_once(model):
     assert r.stats["rerouted"] == len(moved)
 
 
-def test_chaos_replica_kill_flags_bit_identical(model):
+def test_chaos_replica_kill_flags_bit_identical(model, tmp_path, monkeypatch):
     """Satellite 3: FLAGS_ft_inject_serve_kill_* kills a replica at an
     exact round mid-serve.  Every in-flight request re-routes, re-prefills
     on a survivor, completes exactly once, and greedy outputs are
-    bit-identical to an unkilled single-replica run."""
+    bit-identical to an unkilled single-replica run.  The kill also leaves
+    a flight-recorder postmortem naming the victim and the recovery."""
+    from paddle_tpu.obs import flight, last_flight_dump
+
+    monkeypatch.setenv("PADDLE_FLIGHT_DIR", str(tmp_path))
     cfg = model.config
     prompts = (_shared_prefix_prompts(cfg, 2)
                + _prompts(cfg, (25, 140, 70), seed=8))
@@ -178,6 +182,7 @@ def test_chaos_replica_kill_flags_bit_identical(model):
                            "ft_inject_serve_kill_replica"])
     flags.set_flags({"ft_inject_serve_kill_round": 2,
                      "ft_inject_serve_kill_replica": 0})
+    flight().clear()
     try:
         set_injector(FaultInjector.from_flags())
         r = Router()
@@ -197,6 +202,25 @@ def test_chaos_replica_kill_flags_bit_identical(model):
     assert [got[rid] for rid in rids] == refs, \
         "failover changed greedy outputs"
     assert r.stats["rerouted"] >= 1
+
+    # postmortem artifact: dumped at the kill, AFTER recovery ran, so it
+    # holds the injection, the kill, and the reroute sequence in order
+    import json
+
+    path = last_flight_dump()
+    assert path is not None and path.startswith(str(tmp_path))
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "serve-kill"
+    assert doc["victim"] == "replica 0"
+    assert doc["rerouted"], "dump should list the harvested request ids"
+    names = [e["name"] for e in doc["events"]]
+    assert "inject.serve-kill" in names
+    assert "serve.kill" in names and "serve.reroute" in names
+    assert names.index("inject.serve-kill") < names.index("serve.reroute")
+    inject_ev = next(e for e in doc["events"]
+                     if e["name"] == "inject.serve-kill")
+    assert inject_ev["args"]["victim"] == 0
 
 
 def test_serve_kill_due_is_one_shot():

@@ -351,6 +351,7 @@ def _bytes_fields(lowered, audit=False, label=""):
             if "bytes_per_step" not in fields and a.total_bytes:
                 fields["bytes_per_step"] = float(a.total_bytes)
                 fields["bytes_source"] = "hlo_audit"
+            fields["audit_pallas_candidates"] = len(a.pallas_candidates())
             print(f"== fusion audit{' (' + label + ')' if label else ''} ==",
                   file=sys.stderr)
             print(a.report(), file=sys.stderr)
@@ -376,6 +377,41 @@ def _lint_fields(lowered, lint=False, label="", expected=()):
           file=sys.stderr)
     print(rep.report(), file=sys.stderr)
     return {"lint_findings": len(rep), "lint_codes": rep.counts()}
+
+
+def _kernel_lint_fields(lint=False, preset=""):
+    """``kernel_lint_*`` fields for a BENCH line from the Pallas kernel
+    verifier (``paddle_tpu.analysis.pallas_lint``) over the registered
+    kernels this preset exercises: finding counts per ``krn-*`` code plus
+    the modeled per-kernel resident-VMEM bytes (reported like liveness's
+    peak).  The per-kernel summary goes to stderr; stdout stays one JSON
+    line."""
+    import sys
+
+    if not lint:
+        return {}
+    from paddle_tpu.kernels import registry as kernel_registry
+
+    try:
+        kernel_registry.load_all()
+        reports = kernel_registry.check_all(presets=preset or None)
+    except Exception as e:  # kernel lint must never break the BENCH contract
+        return {"kernel_lint_error": repr(e)}
+    total, codes, vmem = 0, {}, {}
+    print(f"== kernel lint{' (' + preset + ')' if preset else ''} ==",
+          file=sys.stderr)
+    for name, rep in sorted(reports.items()):
+        total += len(rep)
+        for c, n in rep.counts().items():
+            codes[c] = codes.get(c, 0) + n
+        vmem[name] = int(rep.meta.get("kernel_vmem_bytes", 0))
+        print(f"  {name}: {len(rep)} finding(s), "
+              f"vmem {vmem[name] / 1e6:.3f} MB", file=sys.stderr)
+        if rep:
+            print(rep.report(), file=sys.stderr)
+    return {"kernel_lint_findings": total, "kernel_lint_codes": codes,
+            "kernel_lint_kernels": len(reports),
+            "kernel_vmem_bytes": vmem}
 
 
 def _mem_fields(lowered, mem=False, label="", hbm_budget=None):
@@ -1584,6 +1620,7 @@ def main():
 
     if preset == "decode":
         result = _bench_decode(jax, paddle, backend, on_tpu, args)
+        result.update(_kernel_lint_fields(args.lint, preset))
         print(json.dumps(_stamp(result)))
         return
     if preset == "serve":
@@ -1591,14 +1628,17 @@ def main():
             result = _bench_serve_trace(jax, paddle, backend, on_tpu, args)
         else:
             result = _bench_serve(jax, paddle, backend, on_tpu, args)
+        result.update(_kernel_lint_fields(args.lint, preset))
         print(json.dumps(_stamp(result)))
         return
     if preset == "ssd":
         result = _bench_ssd(jax, paddle, backend, on_tpu, args)
+        result.update(_kernel_lint_fields(args.lint, preset))
         print(json.dumps(_stamp(result)))
         return
     if preset == "ocr":
         result = _bench_ocr(jax, paddle, backend, on_tpu, args)
+        result.update(_kernel_lint_fields(args.lint, preset))
         print(json.dumps(_stamp(result)))
         return
     if preset == "moe":
@@ -1607,6 +1647,7 @@ def main():
             args.seq = args.seq or run_plan.seq
         result = _bench_moe(jax, paddle, backend, on_tpu, args)
         result.update(tune_fields)
+        result.update(_kernel_lint_fields(args.lint, preset))
         print(json.dumps(_stamp(result)))
         return
 
@@ -1627,6 +1668,7 @@ def main():
     lowered = lower_pretrain_step(step_fn, ids)
     bytes_fields = _bytes_fields(lowered, audit=args.audit, label=preset)
     bytes_fields.update(_lint_fields(lowered, args.lint, label=preset))
+    bytes_fields.update(_kernel_lint_fields(args.lint, preset))
     bytes_fields.update(_mem_fields(lowered, args.mem, label=preset,
                                     hbm_budget=args.hbm_budget))
     bytes_fields.update(_overlap_fields(lowered, args.overlap, label=preset))

@@ -13,7 +13,7 @@ Two levels, both running **without executing the model**:
   calls (the Mosaic / shard_map gap); replicated buffers that the caller
   declared sharded.
 
-Three cross-rank / schedule-level analyzers ride on the same Report API:
+Further analyzers ride on the same Report API:
 
 - :mod:`.schedule_lint` — pipeline-schedule verifier: builds the
   tick-level dependency DAG of the GPipe/1F1B/VPP/zero-bubble step
@@ -30,6 +30,11 @@ Three cross-rank / schedule-level analyzers ride on the same Report API:
 - :mod:`.host_lint` — AST concurrency self-lint of the host-side
   distributed code (unbounded store ops, barriers in rank branches,
   blocking store calls under locks).
+- :mod:`.pallas_lint` — Pallas kernel verifier (``check_kernel``): grid
+  write-race, output coverage, OOB/padding reads, scratch-carry vs
+  ``dimension_semantics``, in-place aliasing, and VMEM budget — proven
+  from the traced ``pallas_call`` alone; the admission seam behind
+  ``kernels.registry`` (``FLAGS_kernel_admission``).
 
 Entry point::
 
@@ -66,6 +71,10 @@ from .liveness import (
 from .memory_lint import GATED_MEM_CODES, lint_memory, lint_memory_text
 from .overlap import (
     DEFAULT_OVERLAP_FACTOR, overlap_lowered, overlap_report)
+from . import pallas_lint  # noqa: F401
+from .pallas_lint import (  # noqa: F401
+    BlockUse, KernelSpec, ScratchUse, check_kernel, extract_kernel_specs,
+    lint_kernel_spec)
 from .schedule_lint import (
     build_schedule, bubble_fraction, check_schedule, lint_schedule)
 from . import schedule_engine  # noqa: F401
@@ -88,6 +97,8 @@ __all__ = [
     "LivenessResult", "analyze_lowered", "analyze_text", "xla_peak_bytes",
     "GATED_MEM_CODES", "lint_memory", "lint_memory_text",
     "DEFAULT_OVERLAP_FACTOR", "overlap_report", "overlap_lowered",
+    "BlockUse", "KernelSpec", "ScratchUse", "check_kernel",
+    "extract_kernel_specs", "lint_kernel_spec",
 ]
 
 

@@ -85,6 +85,30 @@ code                      level  meaning
                                  capacity model over the scheduled HLO) —
                                  its wire latency sits on the critical
                                  path instead of hiding behind compute
+``krn-write-race``        krn    two grid points differing along a
+                                 ``parallel`` axis write the same output
+                                 block — store order undefined
+``krn-coverage-hole``     krn    output block footprints miss elements
+                                 over the grid — holes keep garbage
+``krn-oob-read``          krn    block index outside the array's block
+                                 range (high), or a ragged last block
+                                 whose padding is read unmasked (medium)
+``krn-parallel-carry``    krn    VMEM scratch read before written — state
+                                 carried across a grid axis declared
+                                 ``parallel`` (the ssd_scan chunk state)
+``krn-alias-mismatch``    krn    ``input_output_aliases`` pairs operands
+                                 with differing shape/dtype — the
+                                 in-place store reinterprets bytes
+``krn-alias-raw``         krn    aliased input read through different
+                                 blocks than it is overwritten through —
+                                 reads already-clobbered data
+``krn-vmem-over-budget``  krn    modeled resident working set (double-
+                                 buffered blocks + scratch) exceeds the
+                                 per-core VMEM bound
+``krn-dynamic-index``     krn    index map depends on scalar-prefetch
+                                 data or the grid is too large to
+                                 enumerate — footprint checks skipped
+                                 for that operand (advisory)
 ========================  =====  ========================================
 
 Severity is ``high`` / ``medium`` / ``low``; ranking is by severity first,

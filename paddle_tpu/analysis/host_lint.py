@@ -67,6 +67,10 @@ DEFAULT_SUBDIRS = (
     "distributed/launch",
     "distributed/fault_tolerance",
     "distributed/ps",
+    # thread-shared observability layer (tracer ring, metrics registry,
+    # flight recorder) and the serving cache backend's eviction locking
+    "obs",
+    "serving/cache_backend.py",
 )
 
 

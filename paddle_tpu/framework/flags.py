@@ -75,6 +75,10 @@ define_flag("check_nan_inf_level", 0, "0: abort on nan/inf; >0: warn only")
 define_flag("benchmark", False, "Synchronize after every eager op (for timing)")
 define_flag("use_pallas_kernels", True, "Use Pallas kernels for fused ops when on TPU")
 define_flag("pallas_interpret", False, "Run Pallas kernels in interpreter mode (CPU/testing)")
+define_flag("kernel_admission", False,
+            "Refuse registered Pallas kernels that fail the static verifier "
+            "(analysis.pallas_lint) before their first call — the "
+            "schedule_engine.admit() pattern applied to kernels")
 define_flag("deterministic", False, "Prefer deterministic kernels")
 define_flag("eager_jit_ops", True, "Cache per-op jitted callables for eager dispatch")
 define_flag("log_level", 0, "Framework verbose log level (VLOG equivalent)")

@@ -26,4 +26,5 @@ def use_pallas() -> bool:
         return False
 
 
+from . import registry  # noqa: E402,F401  (before kernel modules: they register)
 from . import adamw, flash_attention, rms_norm, rope, ssd_scan, swiglu  # noqa: E402,F401

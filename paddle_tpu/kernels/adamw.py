@@ -43,6 +43,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from . import registry
+
 LANE = 128  # TPU lane width: flat buffers are viewed as [rows, 128]
 
 
@@ -177,6 +179,7 @@ def adamw_update(p32, g32, m, v, lr, step, *, beta1, beta2, epsilon,
     (``p_out is p_new32`` when no cast is needed) — the master-weight mode
     costs one extra low-precision write instead of a full read+write pass.
     """
+    registry.ensure_admitted("adamw_fused")
     return _adamw_fused_call(
         p32, g32, m, v, jnp.asarray(lr, jnp.float32),
         jnp.asarray(step, jnp.int32),
@@ -185,6 +188,23 @@ def adamw_update(p32, g32, m, v, lr, step, *, beta1, beta2, epsilon,
         apply_decay=bool(apply_decay),
         out_dtype=None if out_dtype is None else jnp.dtype(out_dtype).name,
         block_rows=int(block_rows), interpret=bool(interpret))
+
+
+def _registry_example():
+    sds = jax.ShapeDtypeStruct
+    z = sds((2048,), jnp.float32)
+    fn = functools.partial(
+        _adamw_fused_call, beta1=0.9, beta2=0.999, epsilon=1e-8,
+        weight_decay=0.01, decoupled=True, apply_decay=True,
+        out_dtype="bfloat16", block_rows=8, interpret=False)
+    return fn, (z, z, z, z, sds((), jnp.float32), sds((), jnp.int32))
+
+
+registry.register(
+    "adamw_fused", _registry_example,
+    presets=("tiny", "small", "base", "longctx", "moe", "ocr"),
+    description="single-pass fused AdamW: p/m/v aliased in place + bf16 "
+                "cast epilogue")
 
 
 def fused_enabled() -> Tuple[bool, bool]:

@@ -32,6 +32,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from . import registry
+
 NEG_INF = -1e30
 
 
@@ -307,9 +309,11 @@ def masked_multihead_attention(q, k_cache, v_cache, lengths, sm_scale: Optional[
         block_k = 256 if C % 256 == 0 else 128
         vmem_bytes = 4 * block_k * hk * d * jnp.dtype(k_cache.dtype).itemsize
         if vmem_bytes <= 8 * 2 ** 20:
+            registry.ensure_admitted("decode_mmha_fused")
             return _pallas_decode_fused(q, k_cache, v_cache, lengths,
                                         sm_scale, block_k=block_k,
                                         interpret=interpret)
+        registry.ensure_admitted("decode_mmha")
         return _pallas_decode(q, k_cache, v_cache, lengths, sm_scale, interpret=interpret)
     return _decode_reference(q, k_cache, v_cache, lengths, sm_scale)
 
@@ -591,9 +595,11 @@ def paged_decode_attention(q, k_pool, v_pool, block_table, lengths,
         # whole [hk, bs, d] block double-buffers within VMEM budget
         vmem_bytes = 4 * hk * bs * d * jnp.dtype(k_pool.dtype).itemsize
         if vmem_bytes <= 8 * 2 ** 20:
+            registry.ensure_admitted("paged_decode_fused")
             return _pallas_paged_decode_fused(q, k_pool, v_pool, block_table,
                                               lengths, sm_scale,
                                               interpret=interpret)
+        registry.ensure_admitted("paged_decode")
         return _pallas_paged_decode(q, k_pool, v_pool, block_table, lengths,
                                     sm_scale, interpret=interpret)
     return _paged_pool_reference(q, k_pool, v_pool, block_table, lengths, sm_scale)
@@ -687,3 +693,80 @@ def write_paged_prefill(k_pool, v_pool, blocks, k_seq, v_seq):
     vs = jnp.swapaxes(v_seq.reshape(n, bs, hk, d), 1, 2)
     return k_pool.at[blocks].set(ks.astype(k_pool.dtype)), \
         v_pool.at[blocks].set(vs.astype(v_pool.dtype))
+
+
+# ---------------------------------------------------------------------------
+# kernel-registry entries (verified by analysis.pallas_lint; see registry.py)
+# ---------------------------------------------------------------------------
+
+def _dense_shapes():
+    sds = jax.ShapeDtypeStruct
+    B, h, hk, d, C = 2, 8, 2, 128, 512
+    return (sds((B, 1, h, d), jnp.float32), sds((B, C, hk, d), jnp.float32),
+            sds((B, C, hk, d), jnp.float32), sds((B,), jnp.int32))
+
+
+def _paged_shapes():
+    sds = jax.ShapeDtypeStruct
+    B, h, hk, d, nb, bs, maxb = 2, 8, 2, 128, 16, 128, 4
+    return (sds((B, 1, h, d), jnp.float32), sds((nb, hk, bs, d), jnp.float32),
+            sds((nb, hk, bs, d), jnp.float32), sds((B, maxb), jnp.int32),
+            sds((B,), jnp.int32))
+
+
+registry.register(
+    "decode_mmha",
+    lambda: (lambda q, k, v, ln: _pallas_decode(q, k, v, ln, 1.0), _dense_shapes()),
+    presets=("decode", "serve"),
+    description="per-(batch, kv-head) dense decode attention")
+registry.register(
+    "decode_mmha_fused",
+    lambda: (lambda q, k, v, ln: _pallas_decode_fused(q, k, v, ln, 1.0,
+                                                      block_k=256),
+             _dense_shapes()),
+    presets=("decode", "serve"),
+    description="fused-heads dense decode: ANY-space cache + manual "
+                "double-buffered DMA")
+registry.register(
+    "paged_decode",
+    lambda: (lambda q, k, v, bt, ln: _pallas_paged_decode(q, k, v, bt, ln,
+                                                          1.0),
+             _paged_shapes()),
+    presets=("serve",),
+    description="paged decode attention, per-(batch, kv-head) programs")
+registry.register(
+    "paged_decode_fused",
+    lambda: (lambda q, k, v, bt, ln: _pallas_paged_decode_fused(
+        q, k, v, bt, ln, 1.0), _paged_shapes()),
+    presets=("serve",),
+    description="fused-heads paged decode: one DMA per live block")
+
+
+def _chunk_shapes():
+    sds = jax.ShapeDtypeStruct
+    B, S, h, hk, d, nb, bs, maxb = 2, 128, 8, 2, 128, 16, 128, 4
+    return (sds((B, S, h, d), jnp.float32), sds((nb, hk, bs, d), jnp.float32),
+            sds((nb, hk, bs, d), jnp.float32), sds((B, maxb), jnp.int32),
+            sds((B,), jnp.int32))
+
+
+registry.register(
+    "paged_chunk_attention",
+    lambda: (lambda q, k, v, bt, ln: paged_chunk_attention(q, k, v, bt, ln),
+             _chunk_shapes()),
+    presets=("serve",),
+    description="chunked-prefill attention over paged pools (XLA gather "
+                "path; certified to contain no unverified pallas_call)")
+registry.register(
+    "write_paged_chunk",
+    lambda: (lambda k, v, bt, ln, kc, vc: write_paged_chunk(k, v, bt, ln,
+                                                            kc, vc),
+             (jax.ShapeDtypeStruct((16, 2, 128, 128), jnp.float32),
+              jax.ShapeDtypeStruct((16, 2, 128, 128), jnp.float32),
+              jax.ShapeDtypeStruct((2, 4), jnp.int32),
+              jax.ShapeDtypeStruct((2,), jnp.int32),
+              jax.ShapeDtypeStruct((2, 128, 2, 128), jnp.float32),
+              jax.ShapeDtypeStruct((2, 128, 2, 128), jnp.float32))),
+    presets=("serve",),
+    description="paged-pool chunk scatter (XLA path; certified "
+                "pallas_call-free)")

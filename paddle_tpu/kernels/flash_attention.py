@@ -26,6 +26,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from . import registry
+
 NEG_INF = -1e30
 
 
@@ -601,6 +603,49 @@ def flash_attention(q, k, v, causal: bool = False, mask=None, sm_scale: Optional
             f"(mask=None, D in 64/128/256, S % block == 0); got D={D}, Sq={Sq}, Sk={Sk}")
     pallas_ok = (use_pallas() or interpret) and kernel_shapes_ok
     if pallas_ok:
+        registry.ensure_admitted("flash_fwd_resident")
+        registry.ensure_admitted("flash_fwd_stream")
         return _pallas_flash(q, k, v, causal, sm_scale,
                              block_q=block_q, block_k=block_k, interpret=interpret)
     return _attention_reference(q, k, v, causal, mask, sm_scale)
+
+
+def _registry_args():
+    sds = jax.ShapeDtypeStruct
+    BH, S, D = 2, 256, 128
+    return sds((BH, S, D), jnp.float32)
+
+
+def _registry_fwd_resident():
+    z = _registry_args()
+    return (lambda q, k, v: _flash_fwd_impl(q, k, v, False, 1.0, 128, 128,
+                                            False), (z, z, z))
+
+
+def _registry_fwd_stream():
+    # causal=True exercises the clamped KV index map (the evaluated, non-
+    # affine path of the verifier) plus the online-softmax scratch carry
+    z = _registry_args()
+    return (lambda q, k, v: _flash_fwd_stream(q, k, v, True, 1.0, 128, 128,
+                                              False), (z, z, z))
+
+
+def _registry_bwd_stream():
+    z = _registry_args()
+    lse = jax.ShapeDtypeStruct((2, 1, 256), jnp.float32)
+    return (lambda q, k, v, o, lse, do: _flash_bwd_stream(
+        q, k, v, o, lse, do, True, 1.0, 128, 128, False),
+        (z, z, z, z, lse, z))
+
+
+_FLASH_PRESETS = ("tiny", "small", "base", "longctx", "moe", "ocr")
+registry.register("flash_fwd_resident", _registry_fwd_resident,
+                  presets=_FLASH_PRESETS,
+                  description="flash attention forward, full-KV residency")
+registry.register("flash_fwd_stream", _registry_fwd_stream,
+                  presets=_FLASH_PRESETS,
+                  description="streaming flash forward: causal KV paging + "
+                              "online-softmax VMEM carry")
+registry.register("flash_bwd_stream", _registry_bwd_stream,
+                  presets=_FLASH_PRESETS,
+                  description="streaming flash backward (dk/dv + dq passes)")

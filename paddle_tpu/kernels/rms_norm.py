@@ -23,6 +23,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from . import registry
+
 
 def _rms_norm_ref(x, weight=None, epsilon=1e-6):
     x32 = x.astype(jnp.float32)
@@ -104,6 +106,19 @@ def rms_norm(x, weight=None, epsilon: float = 1e-6, interpret: bool = False):
         raise ValueError(
             f"rms_norm(interpret=True) requires last dim % 128 == 0; got {x.shape[-1]}")
     if (use_pallas() or interpret) and kernel_ok:
+        registry.ensure_admitted("rms_norm")
         w = weight if weight is not None else jnp.ones((x.shape[-1],), x.dtype)
         return _rms_norm_pallas(x, w, epsilon, interpret)
     return _rms_norm_ref(x, weight, epsilon)
+
+
+def _registry_example():
+    sds = jax.ShapeDtypeStruct
+    return (lambda x, w: _rms_norm_fwd_kernel_call(x, w, 1e-6),
+            (sds((64, 256), jnp.bfloat16), sds((256,), jnp.bfloat16)))
+
+
+registry.register(
+    "rms_norm", _registry_example,
+    presets=("tiny", "small", "base", "longctx", "moe", "ocr"),
+    description="fused RMSNorm forward: row statistics kept in VMEM")

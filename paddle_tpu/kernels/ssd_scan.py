@@ -36,6 +36,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from . import registry
+
 LANE = 128  # TPU lane width; N and P should be multiples of it on real TPUs
 
 
@@ -198,6 +200,14 @@ def _ssd_scan_call(x, b, c, la, *, chunk, interpret):
             jax.ShapeDtypeStruct((G, N, P), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((N, P), jnp.float32)],
+        # the state accumulator carries across the chunk axis (reset at
+        # ci == 0), so that axis MUST run sequentially; g-rows are
+        # independent recurrences and may run in any order.  pallas_lint's
+        # scratch-carry check certifies exactly this declaration
+        # (tests/test_pallas_lint.py proves the ("parallel", "parallel")
+        # variant is refused).
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(x, b, c, la)
     return y, s
@@ -236,10 +246,26 @@ def ssd_scan(x, b, c, la, *, chunk: int = 64,
     """
     if x.shape[1] % chunk:
         raise ValueError(f"T={x.shape[1]} not a multiple of chunk={chunk}")
+    registry.ensure_admitted("ssd_scan")
     return _ssd_scan_diff(
         jnp.asarray(x, jnp.float32), jnp.asarray(b, jnp.float32),
         jnp.asarray(c, jnp.float32), jnp.asarray(la, jnp.float32),
         int(chunk), bool(interpret))
+
+
+def _registry_example():
+    G, T, P, N, chunk = 2, 128, 8, 4, 64
+    f32 = jnp.float32
+    sds = jax.ShapeDtypeStruct
+    return (functools.partial(_ssd_scan_call, chunk=chunk, interpret=False),
+            (sds((G, T, P), f32), sds((G, T, N), f32),
+             sds((G, T, N), f32), sds((G, T), f32)))
+
+
+registry.register(
+    "ssd_scan", _registry_example, presets=("ssd",),
+    description="chunked SSD scan: VMEM state carried across the "
+                "sequential chunk axis")
 
 
 def fused_enabled() -> Tuple[bool, bool]:

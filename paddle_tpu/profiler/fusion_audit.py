@@ -22,6 +22,22 @@ avoidable traffic are flagged:
   unfused-AdamW pattern ``kernels/adamw.py`` eliminates).
 
 The report ranks by waste so the top entries are the next kernels to write.
+Records matching a shape a Pallas kernel provably collapses additionally
+carry a ``fusible`` classification (``pallas-candidate``), one of three
+patterns:
+
+- ``elementwise-chain`` — the producer of a missed Loop→Loop fusion: one
+  kernel keeps the intermediate in VMEM (the fused-AdamW move);
+- ``norm-prologue``     — a reduction (Input-kind) fusion feeding a single
+  elementwise consumer: the reduce+normalize pair ``kernels/rms_norm.py``
+  fuses;
+- ``cast-epilogue``     — a top-level ``convert``/``copy``/``transpose``
+  consuming a fusion's output: foldable into the producer kernel's store.
+
+:meth:`FusionAudit.pallas_candidates` returns them as a machine-readable
+worklist (name, pattern, bytes a kernel saves) — the input queue for
+generated kernels, which must then pass ``analysis.pallas_lint`` through
+the ``kernels.registry`` admission seam.
 
 Works on the text HLO (``compiled.as_text()``) because jaxlib exposes
 cost_analysis only as a module-level aggregate — per-fusion numbers must
@@ -76,6 +92,9 @@ class FusionRecord:
     bytes_in_unique: int = 0  # unique operand buffers
     operands: List[str] = field(default_factory=list)
     notes: List[str] = field(default_factory=list)
+    # pallas-candidate pattern ("elementwise-chain" / "norm-prologue" /
+    # "cast-epilogue"); empty when no kernel-shaped rewrite applies
+    fusible: str = ""
 
     @property
     def bytes_accessed(self) -> int:
@@ -113,6 +132,24 @@ class FusionAudit:
         return sorted(self.records, key=lambda r: (r.waste, r.bytes_accessed),
                       reverse=True)
 
+    def pallas_candidates(self) -> List[Dict[str, object]]:
+        """Machine-readable worklist of records classified ``fusible`` —
+        the next kernels to write (or generate), ranked by the HBM bytes a
+        kernel saves.  Each entry: ``{"name", "fusible": "pallas-candidate",
+        "pattern", "bytes_saved"}``.  Generated kernels re-enter through
+        ``kernels.registry`` and must pass the pallas_lint admission gate."""
+        out = []
+        for r in self.records:
+            if not r.fusible:
+                continue
+            # a folded cast/copy removes its whole round-trip; the chain and
+            # norm patterns kill the intermediate output buffer
+            saved = (r.bytes_accessed if r.fusible == "cast-epilogue"
+                     else r.bytes_out)
+            out.append({"name": r.name, "fusible": "pallas-candidate",
+                        "pattern": r.fusible, "bytes_saved": saved})
+        return sorted(out, key=lambda d: -d["bytes_saved"])
+
     def report(self, top: int = 12) -> str:
         lines = [
             f"fusion audit: {len(self.records)} traffic-moving instructions, "
@@ -131,6 +168,12 @@ class FusionAudit:
             lines.append(
                 f"missed fusion: {prod} -> {cons} round-trips "
                 f"{b / 1e6:.3f} MB intermediate through HBM")
+        cands = self.pallas_candidates()
+        if cands:
+            lines.append(
+                f"pallas candidates: {len(cands)} "
+                f"({sum(c['bytes_saved'] for c in cands) / 1e6:.3f} MB "
+                "saved by kernels; registry admission gates each)")
         return "\n".join(lines)
 
 
@@ -188,6 +231,26 @@ def audit_hlo_text(text: str) -> FusionAudit:
             c = by_name[cons[0]]
             if c.opcode == "fusion" and c.kind in ("Loop", "Input", ""):
                 audit.missed_fusions.append((rec.name, c.name, rec.bytes_out))
+
+    # fusible classification: shapes a Pallas kernel provably collapses
+    for prod, _, _ in audit.missed_fusions:
+        by_name[prod].fusible = "elementwise-chain"
+    for rec in records:
+        if rec.fusible:
+            continue
+        cons = consumers.get(rec.name, [])
+        if (rec.opcode == "fusion" and rec.kind == "Input"
+                and len(cons) == 1 and cons[0] in by_name
+                and by_name[cons[0]].opcode == "fusion"):
+            # reduce feeding one elementwise consumer: rms_norm's shape
+            rec.fusible = "norm-prologue"
+        elif (rec.opcode in ("convert", "copy", "transpose")
+              and any(o in by_name and by_name[o].opcode == "fusion"
+                      for o in rec.operands)):
+            rec.fusible = "cast-epilogue"
+    for rec in records:
+        if rec.fusible:
+            rec.notes.append(f"fusible=pallas-candidate ({rec.fusible})")
     return audit
 
 

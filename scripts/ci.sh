@@ -20,6 +20,9 @@
 #   obs_gate      observability layer: Perfetto trace schema, trace-vs-
 #                 analytic bubble crosscheck, tracing overhead <= 5%,
 #                 bit-identical serving vs scripts/OBS_BASELINE.json
+#   kernel_gate   Pallas kernel verifier: every registered kernel clean
+#                 (write-race/coverage/OOB/carry/alias/VMEM), seeded
+#                 defects refused vs scripts/KERNEL_BASELINE.json
 #   host_lint     standalone self-lint summary line (rc 1 on any finding)
 #
 # Exit code: number of failed stages (0 = green).
@@ -52,6 +55,7 @@ stage ssd_gate      ./scripts/ssd_gate.sh
 stage overlap_gate  ./scripts/overlap_gate.sh
 stage tune_gate     ./scripts/tune_gate.sh
 stage obs_gate      ./scripts/obs_gate.sh
+stage kernel_gate   ./scripts/kernel_gate.sh
 stage store_chaos   bash -c "\
     timeout -k 10 300 python -m pytest -q -p no:cacheprovider \
         tests/test_store_replicated.py \

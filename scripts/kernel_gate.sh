@@ -1,0 +1,93 @@
+#!/bin/bash
+# Pallas kernel verifier gate.  Runs the admission-gated kernel registry's
+# CLI (`python -m paddle_tpu.kernels.registry`) — the static verifier
+# (analysis.pallas_lint) over every registered kernel — plus the verifier's
+# own test suite, against scripts/KERNEL_BASELINE.json:
+#
+#   Absolute invariants (no baseline needed):
+#     - every registered kernel is clean: zero krn-* findings (write-race,
+#       coverage, OOB, parallel-carry, aliasing, VMEM budget) — the CLI
+#       exits non-zero on ANY finding;
+#     - tests/test_pallas_lint.py passes (every krn-* code fires on its
+#       seeded defect; ssd_scan's state-carry certification; admission
+#       refusal before first call).
+#
+#   Baseline-gated (deterministic, any drift is a code change):
+#     - the registered-kernel count must not shrink (a kernel silently
+#       dropping its registration leaves the verifier blind to it);
+#     - per-kernel modeled resident VMEM must not grow (block-shape or
+#       scratch regressions show up here before any TPU run does).
+#
+# Defect injection (proves the gate can fail):
+#     KERNEL_GATE_INJECT=write-race     scripts/kernel_gate.sh  # exit != 0
+#     KERNEL_GATE_INJECT=parallel-carry scripts/kernel_gate.sh  # exit != 0
+#   Both legs also run inside every normal gate invocation below.
+# Refresh the baseline after an intentional change:
+#     scripts/kernel_gate.sh --update
+# Exit code: number of failed checks (0 = gate passes).
+cd "$(dirname "$0")/.." || exit 1
+GATE_NAME=kernel_gate
+GATE_BASELINE="scripts/KERNEL_BASELINE.json"
+. scripts/gate_lib.sh
+gate_init "$@"
+
+echo "[kernel_gate] verifier unit/contract tests" >&2
+if ! timeout -k 10 600 python -m pytest tests/test_pallas_lint.py -q \
+        -m "not slow" -p no:cacheprovider >&2; then
+    echo "[kernel_gate] conformance: FAILED (tests/test_pallas_lint.py)" >&2
+    FAIL=$((FAIL + 1))
+fi
+
+echo "[kernel_gate] registry verifier (absolute: all kernels clean)" >&2
+if ! GATE_LINE=$(timeout -k 10 600 python -m paddle_tpu.kernels.registry \
+                 2>/dev/null); then
+    echo "[kernel_gate] registry: FAILED (krn-* findings or rc != 0):" >&2
+    timeout -k 10 600 python -m paddle_tpu.kernels.registry >/dev/null
+    FAIL=$((FAIL + 1))
+else
+    gate_diff kernels <<PY
+import json, os, sys
+exec(os.environ["GATE_PY_COMMON"])
+preset, baseline_path, new_path, update = sys.argv[1:5]
+r = gate_result("""$GATE_LINE""")
+vmem = {n: k["vmem_bytes"] for n, k in r["kernels"].items()}
+entry = {"kernel_count": r["kernel_count"], "vmem_bytes": vmem}
+gate_record(new_path, preset, entry)
+if int(update):
+    print(f"[kernel_gate] kernels: {r['kernel_count']} clean, vmem "
+          f"recorded", file=sys.stderr)
+    sys.exit(0)
+base = gate_base(baseline_path, preset, "kernel_gate",
+                 "scripts/kernel_gate.sh")
+fails = []
+if r["kernel_count"] < base["kernel_count"]:
+    fails.append(f"registered kernels shrank {base['kernel_count']} -> "
+                 f"{r['kernel_count']} (a registration was dropped)")
+for name, nbytes in sorted(vmem.items()):
+    if nbytes > base["vmem_bytes"].get(name, nbytes):
+        fails.append(f"{name} modeled VMEM grew "
+                     f"{base['vmem_bytes'][name]} -> {nbytes} bytes")
+if fails:
+    print(f"[kernel_gate] kernels: FAILED ({'; '.join(fails)})",
+          file=sys.stderr)
+    sys.exit(1)
+print(f"[kernel_gate] kernels: OK {r['kernel_count']} clean, vmem within "
+      f"baseline", file=sys.stderr)
+PY
+fi
+
+# both seeded-defect legs, every run: the gate must be able to fail
+for inj in write-race parallel-carry; do
+    code="krn-${inj}"
+    echo "[kernel_gate] injection: $inj (must be refused)" >&2
+    out=$(KERNEL_GATE_INJECT="$inj" timeout -k 10 600 \
+          python -m paddle_tpu.kernels.registry 2>/dev/null)
+    rc=$?
+    if [ "$rc" -eq 0 ] || ! printf '%s' "$out" | grep -q "$code"; then
+        echo "[kernel_gate] injection $inj: FAILED (rc=$rc, expected" \
+             "non-zero with a $code finding)" >&2
+        FAIL=$((FAIL + 1))
+    fi
+done
+
+gate_finish

@@ -94,3 +94,46 @@ def test_audit_and_bytes_on_real_compiled_program():
     assert audit.total_bytes >= 3 * 256 * 256 * 4  # 2 reads + 2 writes min
     b = bytes_per_step(lowered=lowered)
     assert b and b > 0
+
+
+# a reduction (Input) fusion feeding one elementwise fusion, whose output a
+# top-level convert then downcasts: the norm-prologue and cast-epilogue
+# pallas-candidate patterns in one module
+NORM_HLO = """\
+HloModule norm, entry_computation_layout={(f32[1024,1024]{1,0})->bf16[1024,1024]{1,0}}
+
+ENTRY %main.9 (p0: f32[1024,1024]) -> bf16[1024,1024] {
+  %p0 = f32[1024,1024]{1,0} parameter(0)
+  %stats = f32[1024]{0} fusion(%p0), kind=kInput, calls=%reduce_body
+  %norm = f32[1024,1024]{1,0} fusion(%p0, %stats), kind=kLoop, calls=%scale_body
+  ROOT %down = bf16[1024,1024]{1,0} convert(%norm)
+}
+"""
+
+
+def test_pallas_candidate_classification():
+    audit = audit_hlo_text(NORM_HLO)
+    by_name = {r.name: r for r in audit.records}
+    assert by_name["stats"].fusible == "norm-prologue"
+    assert by_name["down"].fusible == "cast-epilogue"
+    # the chain pattern comes from the missed-fusion detector
+    toy = audit_hlo_text(TOY_HLO)
+    toy_by_name = {r.name: r for r in toy.records}
+    assert toy_by_name["dup"].fusible == "elementwise-chain"
+    # a copy of a parameter is layout churn but NOT a kernel epilogue
+    assert toy_by_name["cp"].fusible == ""
+
+
+def test_pallas_candidates_worklist():
+    cands = audit_hlo_text(NORM_HLO).pallas_candidates()
+    assert [c["pattern"] for c in cands] == ["cast-epilogue", "norm-prologue"]
+    assert all(c["fusible"] == "pallas-candidate" for c in cands)
+    # the folded convert saves its full round-trip (f32 read + bf16 write);
+    # the norm prologue saves its stats intermediate
+    assert cands[0] == {"name": "down", "fusible": "pallas-candidate",
+                        "pattern": "cast-epilogue",
+                        "bytes_saved": MB4 + MB4 // 2}
+    assert cands[1]["bytes_saved"] == 1024 * 4
+    report = audit_hlo_text(NORM_HLO).report()
+    assert "fusible=pallas-candidate (norm-prologue)" in report
+    assert "pallas candidates: 2" in report

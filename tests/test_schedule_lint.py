@@ -281,6 +281,20 @@ def test_self_lint_clean():
     assert not rep.counts(), rep.report()
 
 
+def test_self_lint_covers_obs_and_cache_backend():
+    """The scan scope includes the thread-shared observability layer and
+    the serving cache backend — dropping them from DEFAULT_SUBDIRS would
+    silently shrink the fence."""
+    from paddle_tpu.analysis.host_lint import DEFAULT_SUBDIRS
+
+    assert "obs" in DEFAULT_SUBDIRS
+    assert "serving/cache_backend.py" in DEFAULT_SUBDIRS
+    distributed_only = [s for s in DEFAULT_SUBDIRS
+                        if s.startswith("distributed")]
+    assert (lint_tree().meta["files_scanned"]
+            > lint_tree(subdirs=distributed_only).meta["files_scanned"])
+
+
 # ---------------------------------------------------------------------------
 # analytic vs measured bubble (slow: executes the compiled pipeline)
 

@@ -1028,6 +1028,106 @@ def _bench_ssd(jax, paddle, backend, on_tpu, args):
     return result
 
 
+def _bench_fuse(jax, paddle, backend, on_tpu, preset, args):
+    """``--fuse`` A/B: the fusion transformer's substituted program vs stock,
+    in ONE process (pretrain presets).
+
+    Protocol: audit the stock step's optimized HLO, run the transformer pass
+    (``analysis.fusion_transform.plan_transform`` — interpret bit-identity +
+    registry admission per site, audit byte model per candidate), then run
+    the SAME preset three times: stock, substituted (``plan.apply()``),
+    stock again.  Per-step losses must be bit-identical across all three
+    legs — the fused-sandwiched-by-stock order proves substitution both
+    ways round in one process (no state leaks in either direction).
+
+    Byte accounting: the fused leg's ``bytes_per_step`` is the stock audit
+    total minus the verified, admitted region savings
+    (``bytes_source: "hlo_audit_model"``) — a ``pallas_call`` is a custom
+    call opaque to the textual audit, so the credit comes from the same
+    analytic-minimum model that flagged the regions.  ``vs_baseline`` is
+    the measured drop over the >=20% acceptance bar."""
+    import numpy as np
+
+    from paddle_tpu.analysis.fusion_transform import plan_transform
+    from paddle_tpu.profiler.fusion_audit import audit_lowered
+
+    step_fn, ids, model, cfg, (batch, seq, steps) = build_pretrain_step(
+        preset, on_tpu, batch=args.batch, seq=args.seq, steps=args.steps,
+        accum=max(1, args.accum), grad_dtype=args.grad_dtype)
+    n_params = sum(p.size for p in model.parameters())
+    lowered = lower_pretrain_step(step_fn, ids)
+    audit = audit_lowered(lowered)
+    if audit is None or not audit.total_bytes:
+        raise RuntimeError("--fuse: could not audit the stock step's HLO")
+    stock_total = int(audit.total_bytes)
+    plan = plan_transform(audit)
+    print(f"== fusion transform ({preset}) ==", file=sys.stderr)
+    print(plan.describe(), file=sys.stderr)
+
+    def run_leg(activation):
+        import contextlib
+
+        from paddle_tpu.kernels import emit
+
+        ctx = (contextlib.nullcontext() if activation is None
+               else emit.activate(activation))
+        with ctx:
+            # fresh build per leg (same seed -> identical params); tracing
+            # happens inside the scope so the seams see the activation table
+            sf, pids, _m, _c, _shape = build_pretrain_step(
+                preset, on_tpu, batch=args.batch, seq=args.seq,
+                steps=args.steps, accum=max(1, args.accum),
+                grad_dtype=args.grad_dtype)
+            losses = []
+            loss = sf(pids)
+            losses.append(np.asarray(loss._data).tobytes())
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = sf(pids)
+                losses.append(np.asarray(loss._data).tobytes())
+            dt = time.perf_counter() - t0
+        return losses, dt
+
+    losses_stock, dt_stock = run_leg(None)
+    losses_fused, dt_fused = run_leg(plan.activation())
+    losses_stock2, _ = run_leg(None)
+    bitident = (losses_stock == losses_fused == losses_stock2)
+
+    fused_total = plan.fused_bytes(stock_total)
+    drop = (stock_total - fused_total) / stock_total
+    dev_kind, _ = _peak_flops(jax, on_tpu)
+    rej_codes = {}
+    for r in plan.rejected:
+        rej_codes[r["code"]] = rej_codes.get(r["code"], 0) + 1
+    return {
+        "metric": f"llama_{preset}_fuse_bytes_drop_frac",
+        "value": round(drop, 4),
+        "unit": "frac_of_stock_bytes",
+        "vs_baseline": round(drop / 0.20, 4),
+        "mfu": 0.0,
+        "device": dev_kind,
+        "backend": backend,
+        "preset": preset,
+        "params": n_params,
+        "batch": batch,
+        "seq_len": seq,
+        "steps": steps,
+        "fuse_loss_bitident": bool(bitident),
+        "fuse_candidates": plan.candidates,
+        "fuse_accepted": len(plan.accepted),
+        "fuse_rejected": len(plan.rejected),
+        "fuse_sites": plan.sites(),
+        "fuse_reject_codes": rej_codes,
+        "fuse_bytes_saved": plan.bytes_saved,
+        "bytes_per_step_stock": float(stock_total),
+        "bytes_per_step_fused": float(fused_total),
+        "bytes_per_step": float(fused_total),
+        "bytes_source": "hlo_audit_model",
+        "stock_step_time_ms": round(1000 * dt_stock / steps, 2),
+        "fused_step_time_ms": round(1000 * dt_fused / steps, 2),
+    }
+
+
 def _bench_ocr(jax, paddle, backend, on_tpu, args):
     """DBNet detector train step: images/s; FLOPs from XLA's cost analysis of
     the compiled program (convs don't have a tidy closed form like 6P)."""
@@ -1448,6 +1548,13 @@ def main():
     ap.add_argument("--serve-cache", default="on", choices=["on", "off"],
                     help="serve --trace only: force the prefix cache off in "
                          "the feature-on run (gate injection hook)")
+    ap.add_argument("--fuse", action="store_true",
+                    help="pretrain presets: run the fusion-transformer A/B "
+                         "(analysis.fusion_transform over the audit's "
+                         "pallas-candidate worklist) — stock, substituted, "
+                         "stock again in one process with bit-identical "
+                         "per-step losses required; reports the audited "
+                         "bytes_per_step drop (>=20% bar in vs_baseline)")
     ap.add_argument("--audit-only", action="store_true",
                     help="pretrain presets: lower + compile + cost-analyse "
                          "the step but skip the timed run (bytes_per_step "
@@ -1618,6 +1725,14 @@ def main():
             if args.tune_out:
                 run_plan.save(args.tune_out)
 
+    if args.fuse:
+        if preset not in DEFAULTS:
+            raise SystemExit(f"--fuse supports the pretrain presets "
+                             f"{sorted(DEFAULTS)}, not {preset!r}")
+        result = _bench_fuse(jax, paddle, backend, on_tpu, preset, args)
+        print(json.dumps(_stamp(result)))
+        return
+
     if preset == "decode":
         result = _bench_decode(jax, paddle, backend, on_tpu, args)
         result.update(_kernel_lint_fields(args.lint, preset))
@@ -1651,6 +1766,18 @@ def main():
         print(json.dumps(_stamp(result)))
         return
 
+    fuse_act = None
+    if run_plan is not None and run_plan.fuse == "auto":
+        # adopted fuse=auto plan: substitute the verified emitted kernels for
+        # the whole run (the ExitStack keeps the activation alive through
+        # trace, lower and the timed loop; the process ends with it open)
+        import contextlib
+
+        from paddle_tpu.kernels import emit as _emit
+        fuse_act = _emit.verified_activation()
+        _fuse_stack = contextlib.ExitStack()
+        _fuse_stack.enter_context(_emit.activate(fuse_act))
+
     # mirror build_pretrain_step's plan resolution so the tokens/s math
     # below sees the effective accum/wus
     accum = max(1, args.accum)
@@ -1677,6 +1804,8 @@ def main():
     bytes_fields.update(tune_fields)
     if run_plan is not None:
         bytes_fields["plan"] = run_plan.label()
+    if fuse_act is not None:
+        bytes_fields["fuse_sites"] = sorted(fuse_act)
 
     if args.audit_only:
         print(json.dumps(_stamp({

@@ -33,6 +33,9 @@ class PlanConfig:
     double_buffer: bool = False     # pipeline transfer double-buffering (pp > 1)
     remat: str = "off"              # "off" | "full" | "policy:<k>" (k layers)
     grad_dtype: Optional[str] = None  # accumulation dtype override
+    fuse: str = "off"               # "off" | "auto": substitute the fusion
+                                    # transformer's verified emitted kernels;
+                                    # scored by the audit byte model's credit
     source: str = "hand"            # "hand" | "tuner" | "injected"
 
     @property
@@ -65,6 +68,8 @@ class PlanConfig:
             bits.append(f"remat-{self.remat}")
         if self.grad_dtype:
             bits.append(self.grad_dtype)
+        if self.fuse != "off":
+            bits.append(f"fuse-{self.fuse}")
         if self.source != "hand":
             bits.append(self.source)
         return "/".join(bits)

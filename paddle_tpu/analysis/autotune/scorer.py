@@ -61,6 +61,8 @@ class PlanScore:
     flops_per_step: float = 0.0
     exposed_bytes: float = 0.0     # collective bytes the schedule cannot hide
     bubble: float = 0.0            # pipeline bubble fraction (pp > 1)
+    fuse_bytes_saved: float = 0.0  # audit byte-model credit (plan.fuse=auto)
+    fuse_sites: List[str] = field(default_factory=list)
     reshard_bytes: int = 0         # one-time transition traffic from current
     reshard_peak: int = 0          # planner-modeled transition peak
     tokens_per_step: int = 1
@@ -69,7 +71,7 @@ class PlanScore:
     notes: List[str] = field(default_factory=list)
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "plan": self.plan.label(), "fits": self.fits,
             "peak_bytes": int(self.peak_bytes),
             "hbm_budget": int(self.hbm_budget),
@@ -81,6 +83,10 @@ class PlanScore:
             "tokens_per_step": int(self.tokens_per_step),
             "score": float(self.score),
         }
+        if self.plan.fuse != "off":
+            d["fuse_bytes_saved"] = float(self.fuse_bytes_saved)
+            d["fuse_sites"] = list(self.fuse_sites)
+        return d
 
 
 def _plan_bubble(plan: PlanConfig, *, hop_cost: float = 0.0) -> float:
@@ -158,6 +164,35 @@ def score_compiled(compiled, plan: PlanConfig, *, hbm_budget: int,
         s.score = float("inf")
         s.notes.append(f"emitted schedule rejected by static lint: {e}")
         return s
+
+    if plan.fuse == "auto":
+        # fusion-transformer axis: run the transformer pass over THIS
+        # candidate's audit worklist; the byte credit is the same
+        # analytic-minimum model that flagged the regions.  A plan whose
+        # emitted kernels fail registry admission is pruned, never ranked —
+        # the same discipline as the ScheduleRejected branch above.
+        from ..fusion_transform import plan_transform
+        from ...profiler.fusion_audit import audit_compiled
+        aud = audit_compiled(compiled)
+        tp = plan_transform(aud if aud is not None else [])
+        if any(r["code"] == "fuse-admission-rejected" for r in tp.rejected):
+            s.fits = False
+            s.score = float("inf")
+            s.notes.append("fuse=auto: emitted kernel(s) refused by registry "
+                           "admission (pallas_lint); plan pruned")
+            return s
+        # the audit counts loop bodies x trip count while XLA's cost model
+        # counts them once, so the credit is applied as the audited FRACTION
+        # of traffic removed — scale-free, same model both sides
+        stock_total = float(aud.total_bytes) if aud is not None else 0.0
+        frac = min(1.0, tp.bytes_saved / stock_total) if stock_total else 0.0
+        s.fuse_sites = tp.sites()
+        s.fuse_bytes_saved = s.bytes_per_step * frac
+        s.bytes_per_step -= s.fuse_bytes_saved
+        s.notes.append(
+            f"fuse=auto: {len(tp.accepted)}/{tp.candidates} candidate(s) "
+            f"accepted ({', '.join(s.fuse_sites) or 'none'}), "
+            f"-{frac:.1%} audited traffic")
 
     ref = REF_CHIP
     roof = max(s.flops_per_step / ref["flops_per_s"],

@@ -76,6 +76,12 @@ def default_grid(preset: str, *, on_tpu: bool = False,
         grid.append(hand.but(batch=6, remat="full", accum=2, source="tuner"))
     if on_tpu and preset in ("base", "small"):
         grid.append(hand.but(accum=4, grad_dtype="bfloat16", source="tuner"))
+    # fusion-transformer axis: substitute the verified emitted Pallas kernels
+    # (kernels.emit); the scorer credits the audit byte model's savings and
+    # prunes — never ranks — a plan whose emitted kernels fail admission
+    if preset != "moe":
+        grid.append(hand.but(fuse="auto", source="tuner"))
+        grid.append(hand.but(accum=4, fuse="auto", source="tuner"))
     return grid
 
 
@@ -182,7 +188,8 @@ def sweep(preset: str,
             out.hand = s
         if log:
             log(f"[tune] scored {plan.label()}: "
-                + (f"score={s.score:.3e}" if s.fits else "PRUNED (HBM)"))
+                + (f"score={s.score:.3e}" if s.fits
+                   else f"PRUNED ({s.notes[-1] if s.notes else 'HBM'})"))
 
     # the injected bad plan advertises a perfect score — the HBM prune,
     # which runs FIRST, is the only thing standing between it and "chosen"

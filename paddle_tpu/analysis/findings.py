@@ -109,6 +109,21 @@ code                      level  meaning
                                  data or the grid is too large to
                                  enumerate — footprint checks skipped
                                  for that operand (advisory)
+``fuse-unmatched-site``   fuse   an audit pallas-candidate has no emitter
+                                 site in ``kernels.emit`` — the pattern
+                                 is real but nothing acts on it yet
+                                 (advisory)
+``fuse-no-byte-win``      fuse   the audit's analytic-minimum model shows
+                                 no traffic saved — substitution would be
+                                 churn, the seam stays stock
+``fuse-verify-mismatch``  fuse   an emitted kernel (fwd, bwd, or the
+                                 end-to-end grad through its custom_vjp)
+                                 diverges bit-wise from the jnp reference
+                                 in interpret mode
+``fuse-admission-rejected`` fuse  ``kernels.registry`` admission
+                                 (pallas_lint) refused an emitted kernel
+                                 — the site is never activated and a
+                                 ``fuse=auto`` tuner plan is pruned
 ========================  =====  ========================================
 
 Severity is ``high`` / ``medium`` / ``low``; ranking is by severity first,

@@ -80,6 +80,7 @@ def load_all() -> None:
     """Import every kernel module so its registrations run."""
     from . import adamw, flash_attention, rms_norm, ssd_scan  # noqa: F401
     from . import decode_attention  # noqa: F401  (not in package __init__)
+    from . import emit  # noqa: F401  (fusion-transformer emitted kernels)
 
 
 def check(name: str, vmem_budget: Optional[int] = None):
@@ -206,9 +207,24 @@ def _build_injected_parallel_carry():
     return fn, (jax.ShapeDtypeStruct((2, 32, 128), jnp.float32),)
 
 
+def _build_injected_emit_race():
+    # the fusion transformer's own seeded defect: with
+    # KERNEL_GATE_INJECT=emit-race in the environment, every *emitted*
+    # kernel's output index_map collapses to block (0, 0) under parallel
+    # semantics (emit._row_block_call reads the env var at trace time), so
+    # the real registered ``fuse_*`` entries fail lint on their own.  This
+    # builder re-exposes one of them under the ``injected_*`` name the gate
+    # greps for, proving the defect rides the genuine emission path rather
+    # than a purpose-built toy kernel.
+    from . import emit
+
+    return emit._fwd_builder(emit.SITES["fuse_swiglu_mlp"])()
+
+
 _INJECTIONS = {
     "write-race": _build_injected_write_race,
     "parallel-carry": _build_injected_parallel_carry,
+    "emit-race": _build_injected_emit_race,
 }
 
 

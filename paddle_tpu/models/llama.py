@@ -336,8 +336,24 @@ def paged_chunk_attention_fn(hidden, w_qkv, w_o, k_pool, v_pool, block_table,
     return out, k_pool, v_pool
 
 
+def _emit_active(name: str):
+    """The fusion transformer's substituted callable for a seam, or None.
+
+    Activation is scoped (``TransformPlan.apply()`` / ``emit.activate``) and
+    every activated site has already passed interpret bit-identity plus
+    registry admission — outside such a scope every seam runs its stock jnp
+    path unchanged."""
+    from ..kernels import emit
+
+    return emit.active(name)
+
+
 def mlp_fn(hidden, w_gate_up, w_down, intermediate_size: int):
     """Pure SwiGLU MLP over raw arrays with fused gate_up matmul."""
+    fused = _emit_active("fuse_swiglu_mlp")
+    if fused is not None:
+        return fused(hidden, w_gate_up, w_down,
+                     intermediate_size=intermediate_size)
     gu = hidden @ w_gate_up.astype(hidden.dtype)
     gate, up = jnp.split(gu, [intermediate_size], axis=-1)
     return (jax.nn.silu(gate) * up) @ w_down.astype(hidden.dtype)
@@ -463,13 +479,31 @@ class LlamaDecoderLayer(Layer):
         else:
             h = self.self_attn(self.input_layernorm(x), cos, sin, position_ids)
             new_kv = None
-        x = x + h
-        x = _constrain_hidden(x, self._mesh, self._sp)
-        if self._is_moe:
-            h, aux = self.mlp.forward_with_aux(self.post_attention_layernorm(x))
-        else:
-            h = self.mlp(self.post_attention_layernorm(x))
+        fused_arn = (None if (self._is_moe or cache is not None)
+                     else _emit_active("fuse_add_rms_norm"))
+        if fused_arn is not None:
+            # residual add + post-attention RMSNorm in one emitted kernel
+            # (cast-epilogue site); the summed stream and its norm leave
+            # VMEM exactly once
+            ln = self.post_attention_layernorm
+            eps = ln.epsilon
+
+            def add_norm(xx, hh, wn):
+                return fused_arn(xx, hh, wn, epsilon=eps)
+
+            x, normed = apply_op("fuse_add_rms_norm", add_norm,
+                                 (x, h, ln.weight), {}, num_outputs=2)
+            x = _constrain_hidden(x, self._mesh, self._sp)
+            h = self.mlp(normed)
             aux = None
+        else:
+            x = x + h
+            x = _constrain_hidden(x, self._mesh, self._sp)
+            if self._is_moe:
+                h, aux = self.mlp.forward_with_aux(self.post_attention_layernorm(x))
+            else:
+                h = self.mlp(self.post_attention_layernorm(x))
+                aux = None
         x = x + h
         x = _constrain_hidden(x, self._mesh, self._sp)
         if new_kv is not None:
@@ -523,10 +557,15 @@ class LlamaModel(Layer):
         return (tuple(jnp.zeros(shape, dt) for _ in range(cfg.num_hidden_layers)),
                 tuple(jnp.zeros(shape, dt) for _ in range(cfg.num_hidden_layers)))
 
-    def forward(self, input_ids, position_ids=None, cache=None):
+    def forward(self, input_ids, position_ids=None, cache=None,
+                skip_final_norm: bool = False):
         """Returns the final hidden states; for MoE configs returns
         ``(hidden, aux_loss_total)``.  With ``cache`` (from :meth:`init_cache`)
-        runs incrementally and additionally returns the updated cache."""
+        runs incrementally and additionally returns the updated cache.
+
+        ``skip_final_norm`` (non-cache path only) returns the PRE-norm hidden
+        states so a caller owning the norm-prologue fusion (RMSNorm + lm_head
+        in one emitted kernel) can apply ``self.norm``'s weight itself."""
         x = F.embedding(input_ids, self.embed_tokens)
         if self.config.pdtype != self.config.dtype:
             # fp32-stored params, bf16 compute: enter the compute dtype here;
@@ -591,6 +630,8 @@ class LlamaModel(Layer):
                 x, aux_total = self._merge_aux(out, aux_total, is_moe)
         if is_moe:
             return self.norm(x), aux_total
+        if skip_final_norm:
+            return x
         return self.norm(x)
 
     @staticmethod
@@ -630,7 +671,11 @@ class LlamaForCausalLM(Layer):
     def forward(self, input_ids, position_ids=None, cache=None):
         """Returns logits; with ``cache`` returns ``(logits, new_cache)``
         (the reference's ``use_cache=True`` contract)."""
-        out = self.llama(input_ids, position_ids, cache=cache)
+        fused_head = (None if (cache is not None
+                               or self.config.moe_num_experts > 1)
+                      else _emit_active("fuse_rms_norm_head"))
+        out = self.llama(input_ids, position_ids, cache=cache,
+                         skip_final_norm=fused_head is not None)
         new_cache = None
         if cache is not None:
             *out_rest, new_cache = out
@@ -642,7 +687,26 @@ class LlamaForCausalLM(Layer):
             self._moe_aux = None
         w = self.lm_head
 
-        if w is None:
+        if fused_head is not None:
+            # norm-prologue site: final RMSNorm + vocab projection in one
+            # emitted kernel; ``x`` is pre-norm (skip_final_norm above)
+            norm = self.llama.norm
+            eps = norm.epsilon
+            if w is None:
+                def head_tied_fused(hidden, wn, e):
+                    return fused_head(hidden, wn, e, epsilon=eps,
+                                      transpose=True)
+
+                logits = apply_op("lm_head", head_tied_fused,
+                                  (x, norm.weight, self.llama.embed_tokens), {})
+            else:
+                def head_fused(hidden, wn, wh):
+                    return fused_head(hidden, wn, wh, epsilon=eps,
+                                      transpose=False)
+
+                logits = apply_op("lm_head", head_fused,
+                                  (x, norm.weight, w), {})
+        elif w is None:
             emb = self.llama.embed_tokens
 
             def head_tied(hidden, e):

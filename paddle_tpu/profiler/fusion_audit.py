@@ -39,6 +39,24 @@ worklist (name, pattern, bytes a kernel saves) — the input queue for
 generated kernels, which must then pass ``analysis.pallas_lint`` through
 the ``kernels.registry`` admission seam.
 
+Beyond the three per-record shapes, the auditor groups records into **source
+regions**: connected components of the dataflow graph whose instructions
+trace back to the same Python source file (XLA keeps ``metadata={...
+source_file= source_line=}`` through optimization, including through AD — a
+region therefore spans a reference op's forward *and* backward instructions).
+A region's byte win is the analytic-minimum model applied to the whole
+group::
+
+    saved = sum(member bytes_accessed) - unique external inputs - external outputs
+
+i.e. exactly what one fused kernel pair (forward + vjp) keeps in VMEM:
+every intermediate crossing between members, including dot operands, never
+round-trips HBM.  Region entries dominate the worklist (one MLP region on
+the tiny preset carries ~34 MB); per-record entries whose record already
+belongs to a region are deduplicated away, and the ranking is fully
+deterministic (stable ``(-bytes_saved, name)`` order) so emitter baselines
+are reproducible run to run.
+
 Works on the text HLO (``compiled.as_text()``) because jaxlib exposes
 cost_analysis only as a module-level aggregate — per-fusion numbers must
 come from the instruction stream.  Aggregate ``bytes accessed`` for BENCH
@@ -80,6 +98,12 @@ _FREE_OPS = {
 }
 
 _KIND_RE = re.compile(r"kind=k(\w+)")
+_META_RE = re.compile(
+    r'metadata=\{[^}]*?source_file="([^"]+)"[^}]*?source_line=(\d+)')
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_SCOPE_RE = re.compile(r"jit\((\w+)\)")
+# jit scopes that name the step itself, not a fusible sub-region
+_OUTER_SCOPES = {"main", "step_fn", "train_step", "wrapped", "step"}
 
 
 @dataclass
@@ -95,6 +119,12 @@ class FusionRecord:
     # pallas-candidate pattern ("elementwise-chain" / "norm-prologue" /
     # "cast-epilogue"); empty when no kernel-shaped rewrite applies
     fusible: str = ""
+    # basename of the Python source file XLA's metadata attributes this
+    # instruction to ("" when the dump carries no metadata)
+    source: str = ""
+    source_line: int = 0
+    # innermost jit scope from op_name metadata (e.g. "silu"), "" if none
+    op_hint: str = ""
 
     @property
     def bytes_accessed(self) -> int:
@@ -113,6 +143,10 @@ class FusionRecord:
 class FusionAudit:
     records: List[FusionRecord]
     missed_fusions: List[Tuple[str, str, int]] = field(default_factory=list)
+    # source regions: one dict per connected same-source component with the
+    # analytic-minimum byte model applied to the whole group (see module
+    # docstring).  Built by audit_hlo_text when metadata is present.
+    regions: List[Dict[str, object]] = field(default_factory=list)
 
     @property
     def total_bytes(self) -> int:
@@ -133,22 +167,37 @@ class FusionAudit:
                       reverse=True)
 
     def pallas_candidates(self) -> List[Dict[str, object]]:
-        """Machine-readable worklist of records classified ``fusible`` —
-        the next kernels to write (or generate), ranked by the HBM bytes a
-        kernel saves.  Each entry: ``{"name", "fusible": "pallas-candidate",
-        "pattern", "bytes_saved"}``.  Generated kernels re-enter through
-        ``kernels.registry`` and must pass the pallas_lint admission gate."""
-        out = []
+        """Machine-readable worklist of fusible regions and records — the
+        input queue for ``kernels.emit`` / ``analysis.fusion_transform``,
+        ranked by the HBM bytes a kernel saves.  Each entry carries at least
+        ``{"name", "fusible": "pallas-candidate", "pattern", "bytes_saved",
+        "members", "source", "op_hints"}``.
+
+        The worklist is deduplicated (a record appears in at most one entry:
+        source regions win over the per-record classifications they subsume)
+        and deterministically ordered — stable ``(-bytes_saved, name)`` —
+        so the transformer's baselines reproduce run to run.  Generated
+        kernels re-enter through ``kernels.registry`` and must pass the
+        pallas_lint admission gate before their first call."""
+        out: List[Dict[str, object]] = []
+        covered: set = set()
+        for reg in self.regions:
+            if reg["bytes_saved"] <= 0 or len(reg["members"]) < 2:
+                continue
+            out.append(dict(reg, fusible="pallas-candidate"))
+            covered.update(reg["members"])
         for r in self.records:
-            if not r.fusible:
+            if not r.fusible or r.name in covered:
                 continue
             # a folded cast/copy removes its whole round-trip; the chain and
             # norm patterns kill the intermediate output buffer
             saved = (r.bytes_accessed if r.fusible == "cast-epilogue"
                      else r.bytes_out)
             out.append({"name": r.name, "fusible": "pallas-candidate",
-                        "pattern": r.fusible, "bytes_saved": saved})
-        return sorted(out, key=lambda d: -d["bytes_saved"])
+                        "pattern": r.fusible, "bytes_saved": saved,
+                        "members": [r.name], "source": r.source,
+                        "op_hints": [r.op_hint] if r.op_hint else []})
+        return sorted(out, key=lambda d: (-d["bytes_saved"], d["name"]))
 
     def report(self, top: int = 12) -> str:
         lines = [
@@ -177,48 +226,141 @@ class FusionAudit:
         return "\n".join(lines)
 
 
-def audit_hlo_text(text: str) -> FusionAudit:
-    """Audit the ENTRY computation of an optimized HLO text dump."""
-    entry = _entry_body(text)
+_WHILE_BODY_RE = re.compile(r"body=%?([\w.\-]+)")
+_WHILE_COND_RE = re.compile(r"condition=%?([\w.\-]+)")
 
-    sizes: Dict[str, int] = {}
+
+def _comp_body(text: str, name: str) -> str:
+    """Instruction lines of the named non-entry computation ("" if absent)."""
+    m = re.search(rf"^\s*%?{re.escape(name)}\b[^\n]*\{{\s*$", text, re.M)
+    if not m:
+        return ""
+    rest = text[m.end():]
+    close = rest.find("\n}")
+    return rest[: close if close >= 0 else len(rest)]
+
+
+def _while_trip_count(text: str, cond_name: str) -> int:
+    """Static trip count of a canonical counted loop: the integer constant
+    the condition's ``compare`` tests the counter against (1 when the shape
+    is anything else — an unknown loop scales nothing rather than guessing).
+    """
+    body = _comp_body(text, cond_name)
+    if not body:
+        return 1
+    consts: Dict[str, int] = {}
+    for raw in body.splitlines():
+        mi = _INSTR_RE.match(raw.strip())
+        if not mi:
+            continue
+        mc = re.search(r"constant\((\d+)\)", mi.group("rest"))
+        if mc:
+            consts[mi.group("name")] = int(mc.group(1))
+    for raw in body.splitlines():
+        line = raw.strip()
+        mcmp = re.search(r"compare\(([^)]*)\)", line)
+        if not mcmp or "direction=LT" not in line:
+            continue
+        for op in re.findall(r"[\w.\-]+", mcmp.group(1)):
+            if op in consts:
+                return max(1, consts[op])
+    return 1
+
+
+def audit_hlo_text(text: str) -> FusionAudit:
+    """Audit the ENTRY computation of an optimized HLO text dump.
+
+    ``while`` loops are one opaque call at entry, but their body computation
+    carries the real per-iteration traffic — a gradient-accumulation step
+    wraps the whole layer stack in one.  Body computations are therefore
+    parsed too, with every byte count scaled by the loop's static trip
+    count, so fusible regions inside an accumulation loop stay on the
+    pallas worklist and audit totals stay comparable across accum settings.
+    """
+    sizes: Dict[str, int] = {}       # scaled: per-use traffic of one step
+    base_sizes: Dict[str, int] = {}  # unscaled shape bytes
     records: List[FusionRecord] = []
     consumers: Dict[str, List[str]] = {}
     by_name: Dict[str, FusionRecord] = {}
+    free_src: Dict[str, List[str]] = {}  # free op -> operands (origin chase)
+    loops: List[Tuple[str, int]] = []    # (body computation, byte scale)
 
-    for raw in entry.splitlines():
-        line = raw.strip()
-        if not line or line.startswith("//") or line.endswith("{") or line == "}":
+    def scan(comp: str, scale: int) -> None:
+        for raw in comp.splitlines():
+            line = raw.strip()
+            if (not line or line.startswith("//") or line.endswith("{")
+                    or line == "}"):
+                continue
+            mi = _INSTR_RE.match(line)
+            if not mi or "=" not in line:
+                continue
+            name = mi.group("name")
+            type_str, opcode, tail = _split_type_op(mi.group("rest"))
+            if not opcode:
+                continue
+            # a dynamic-update-slice updating loop-carried state aliases its
+            # buffer across iterations and touches one slice per trip —
+            # scaling the full shape by the trip count would invent traffic
+            # that never happens, so in-place updates count once
+            in_place = scale > 1 and (opcode == "dynamic-update-slice"
+                                      or "dynamic-update-slice" in name)
+            eff = 1 if in_place else scale
+            base = shape_bytes(type_str)
+            sizes[name] = base * eff
+            base_sizes[name] = base
+            operands = [t for t in re.findall(r"%([\w.\-]+)", _paren_args(tail))
+                        if t in sizes]
+            for op_name in operands:
+                consumers.setdefault(op_name, []).append(name)
+            if opcode in _FREE_OPS:
+                free_src[name] = operands
+                continue
+            if opcode == "while":
+                mb = _WHILE_BODY_RE.search(tail)
+                mc = _WHILE_COND_RE.search(tail)
+                if mb:
+                    trips = _while_trip_count(text, mc.group(1)) if mc else 1
+                    loops.append((mb.group(1), scale * trips))
+            rec = FusionRecord(name=name, opcode=opcode,
+                               bytes_out=base * eff, operands=operands)
+            mk = _KIND_RE.search(tail)
+            if mk:
+                rec.kind = mk.group(1)
+            mm = _META_RE.search(tail)
+            if mm:
+                rec.source = mm.group(1).replace("\\", "/").rsplit("/", 1)[-1]
+                rec.source_line = int(mm.group(2))
+            mo = _OPNAME_RE.search(tail)
+            if mo:
+                scopes = [s for s in _SCOPE_RE.findall(mo.group(1))
+                          if s not in _OUTER_SCOPES]
+                if scopes:
+                    rec.op_hint = scopes[-1]
+            opsz = sizes if not in_place else base_sizes
+            rec.bytes_in = sum(opsz[o] for o in operands)
+            rec.bytes_in_unique = sum(opsz[o] for o in dict.fromkeys(operands))
+            dups = [o for o in dict.fromkeys(operands) if operands.count(o) > 1]
+            if dups:
+                rec.notes.append(f"re-reads {len(dups)} operand(s)")
+            if opcode in ("copy", "transpose", "convert"):
+                rec.notes.append("pure data movement at top level")
+            if in_place:
+                rec.notes.append("loop-carried in-place update (counted once)")
+            elif scale > 1:
+                rec.notes.append(f"in loop body x{scale}")
+            records.append(rec)
+            by_name[name] = rec
+
+    scan(_entry_body(text), 1)
+    descended: set = set()
+    while loops:
+        body_name, scale = loops.pop(0)
+        if body_name in descended:
             continue
-        mi = _INSTR_RE.match(line)
-        if not mi or "=" not in line:
-            continue
-        name = mi.group("name")
-        type_str, opcode, tail = _split_type_op(mi.group("rest"))
-        if not opcode:
-            continue
-        out_bytes = shape_bytes(type_str)
-        sizes[name] = out_bytes
-        operands = [t for t in re.findall(r"%([\w.\-]+)", _paren_args(tail))
-                    if t in sizes]
-        for op_name in operands:
-            consumers.setdefault(op_name, []).append(name)
-        if opcode in _FREE_OPS:
-            continue
-        rec = FusionRecord(name=name, opcode=opcode, bytes_out=out_bytes,
-                           operands=operands)
-        mk = _KIND_RE.search(tail)
-        if mk:
-            rec.kind = mk.group(1)
-        rec.bytes_in = sum(sizes[o] for o in operands)
-        rec.bytes_in_unique = sum(sizes[o] for o in dict.fromkeys(operands))
-        dups = [o for o in dict.fromkeys(operands) if operands.count(o) > 1]
-        if dups:
-            rec.notes.append(f"re-reads {len(dups)} operand(s)")
-        if opcode in ("copy", "transpose", "convert"):
-            rec.notes.append("pure data movement at top level")
-        records.append(rec)
-        by_name[name] = rec
+        descended.add(body_name)
+        body = _comp_body(text, body_name)
+        if body:
+            scan(body, scale)
 
     audit = FusionAudit(records=records)
     # missed producer->consumer fusion: a loop fusion feeding exactly one
@@ -251,7 +393,108 @@ def audit_hlo_text(text: str) -> FusionAudit:
     for rec in records:
         if rec.fusible:
             rec.notes.append(f"fusible=pallas-candidate ({rec.fusible})")
+    audit.regions = _build_regions(records, by_name, consumers, free_src, sizes)
     return audit
+
+
+def _build_regions(records, by_name, consumers, free_src, sizes):
+    """Connected components of same-source records with the group byte model.
+
+    Two records join the same region when one consumes the other (possibly
+    through free ops: bitcast/reshape/get-tuple-element chains) and both
+    carry the same ``source_file`` basename.  Iteration and canonical names
+    are sorted, so the result is deterministic regardless of dict order."""
+
+    def origin(name):
+        # resolve through free ops to the producing record (or None)
+        seen = set()
+        while name not in by_name:
+            if name in seen or name not in free_src or not free_src[name]:
+                return None
+            seen.add(name)
+            name = free_src[name][0]
+        return name
+
+    parent: Dict[str, str] = {}
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for rec in records:
+        if rec.source:
+            parent.setdefault(rec.name, rec.name)
+    for rec in records:
+        if not rec.source:
+            continue
+        for op_name in rec.operands:
+            o = origin(op_name)
+            if o is None or by_name[o].source != rec.source:
+                continue
+            parent.setdefault(o, o)
+            ra, rb = find(rec.name), find(o)
+            if ra != rb:
+                parent[max(ra, rb)] = min(ra, rb)
+
+    comps: Dict[str, List[str]] = {}
+    for name in sorted(parent):
+        comps.setdefault(find(name), []).append(name)
+
+    regions: List[Dict[str, object]] = []
+    for root in sorted(comps):
+        members = comps[root]
+        mset = set(members)
+
+        def interior(name):  # does this value stay inside the region?
+            o = origin(name)
+            return o is not None and o in mset
+
+        traffic = ext_out = 0
+        ext_in: Dict[str, int] = {}
+        has_reduction = has_interior_dot = feeds_dot = False
+        hints: List[str] = []
+        for name in members:
+            rec = by_name[name]
+            traffic += rec.bytes_accessed
+            if rec.opcode in ("reduce", "reduce-window") or rec.kind == "Input":
+                has_reduction = True
+            if rec.opcode == "dot":
+                has_interior_dot = True
+            if rec.op_hint and rec.op_hint not in hints:
+                hints.append(rec.op_hint)
+            for op_name in rec.operands:
+                if not interior(op_name):
+                    ext_in[op_name] = sizes.get(op_name, 0)
+            cons = consumers.get(rec.name, [])
+            outside = [c for c in cons if c not in mset]
+            if outside or not cons:
+                ext_out += rec.bytes_out
+                if any(c in by_name and by_name[c].opcode == "dot"
+                       for c in outside):
+                    feeds_dot = True
+        saved = traffic - sum(ext_in.values()) - ext_out
+        if has_reduction and feeds_dot and not has_interior_dot:
+            pattern = "norm-prologue"
+        elif has_reduction or has_interior_dot:
+            pattern = "elementwise-chain"
+        else:
+            pattern = "cast-epilogue"
+        src = by_name[members[0]].source
+        regions.append({
+            "name": f"region:{src}:{members[0]}",
+            "pattern": pattern,
+            "bytes_saved": saved,
+            "bytes_traffic": traffic,
+            "bytes_ext_in": sum(ext_in.values()),
+            "bytes_ext_out": ext_out,
+            "members": members,
+            "source": src,
+            "op_hints": sorted(hints),
+        })
+    regions.sort(key=lambda r: (-r["bytes_saved"], r["name"]))
+    return regions
 
 
 def audit_compiled(compiled) -> Optional[FusionAudit]:

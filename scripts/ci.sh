@@ -23,6 +23,10 @@
 #   kernel_gate   Pallas kernel verifier: every registered kernel clean
 #                 (write-race/coverage/OOB/carry/alias/VMEM), seeded
 #                 defects refused vs scripts/KERNEL_BASELINE.json
+#   fuse_gate     fusion transformer: emitted kernels bit-exact + admission
+#                 clean, bench --fuse loss bit-identity + >=20% audited
+#                 byte drop, emit-race injections refused vs
+#                 scripts/FUSE_BASELINE.json
 #   host_lint     standalone self-lint summary line (rc 1 on any finding)
 #
 # Exit code: number of failed stages (0 = green).
@@ -56,6 +60,7 @@ stage overlap_gate  ./scripts/overlap_gate.sh
 stage tune_gate     ./scripts/tune_gate.sh
 stage obs_gate      ./scripts/obs_gate.sh
 stage kernel_gate   ./scripts/kernel_gate.sh
+stage fuse_gate     ./scripts/fuse_gate.sh
 stage store_chaos   bash -c "\
     timeout -k 10 300 python -m pytest -q -p no:cacheprovider \
         tests/test_store_replicated.py \

@@ -84,3 +84,54 @@ eng.add_request(GenRequest(prompt_ids=p, max_new_tokens=8, temperature=0.8, top_
 (out,) = eng.run_to_completion()
 print("sampling-on-chip OK:", out.output_ids)
 PY
+# fusion-transformer A/B on real ICI: stock vs emitted-Pallas-substituted
+# program in ONE process (losses must stay bit-identical both directions).
+# CPU-proxy numbers (2026-08-07): tiny audited bytes 237.6MB -> 163.7MB
+# (-31.1%), wall 57.9 -> 47.7 ms/step; these rows measure the same A/B
+# where the fused kernels run compiled on the chip instead of interpret
+for preset in tiny base; do
+    echo "[revival] $preset --fuse" >&2
+    line=$(timeout 2400 python bench.py --preset $preset --device tpu --fuse 2>/dev/null | tail -1)
+    [ -n "$line" ] && echo "$line" >> "$OUT" && echo "$line" | head -c 200 >&2 && echo >&2
+done
+# tuner with the fuse=auto axis on-chip: the grid now carries fuse plans
+# (admission-failing ones are pruned, never ranked); the chosen row lands
+# next to the --fuse A/B above so the byte-model credit can be checked
+# against the measured drop
+echo "[revival] base --tune (fuse=auto axis)" >&2
+line=$(timeout 2400 python bench.py --preset base --device tpu --tune 2>/dev/null | tail -1)
+[ -n "$line" ] && echo "$line" >> "$OUT" && echo "$line" | head -c 200 >&2 && echo >&2
+# SSD chunked scan vs flash attention, matched token-mixing shape, real
+# chip: the O(1)-state scan's step time next to the O(S) flash kernel it
+# replaces (B=4, S=2048, H=8, D=64; fwd, jitted, median of 20)
+echo "[revival] ssd chunked-scan vs flash step time" >&2
+timeout 1200 env -u JAX_PLATFORMS python - <<'PY' >&2
+import sys, time
+sys.path.insert(0, '.')
+import jax, jax.numpy as jnp
+import numpy as np
+from paddle_tpu.kernels.flash_attention import flash_attention
+from paddle_tpu.kernels.ssd_scan import ssd_scan
+
+B, S, H, D, N = 4, 2048, 8, 64, 64
+rng = np.random.default_rng(0)
+f = lambda *sh: jnp.asarray(rng.standard_normal(sh), jnp.float32) * 0.1
+q, k, v = f(B, S, H, D), f(B, S, H, D), f(B, S, H, D)
+x, b, c = f(B * H, S, D), f(B * H, S, N), f(B * H, S, N)
+la = -jnp.abs(f(B * H, S))
+
+def med_ms(fn, *a):
+    jax.block_until_ready(fn(*a))          # compile
+    ts = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*a))
+        ts.append(time.perf_counter() - t0)
+    return 1e3 * float(np.median(ts))
+
+flash_ms = med_ms(jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True)), q, k, v)
+ssd_ms = med_ms(jax.jit(lambda x, b, c, la: ssd_scan(x, b, c, la, chunk=128)[0]), x, b, c, la)
+print(f"ssd-vs-flash OK: B={B} S={S} H={H} D={D}: "
+      f"flash {flash_ms:.2f} ms, ssd chunked scan {ssd_ms:.2f} ms "
+      f"({flash_ms / max(ssd_ms, 1e-9):.2f}x)")
+PY

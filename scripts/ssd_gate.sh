@@ -13,7 +13,11 @@
 #     - the per-sequence footprint at 8B scale is FLAT in context length
 #       (4k == 64k) — the headline the family exists for;
 #     - tests/test_cache_backend.py passes (alloc/evict/exactly-once
-#       release/migrate-plan conformance for both backends + hybrid).
+#       release/migrate-plan conformance for both backends + hybrid);
+#     - the loadgen arrival trace completes through a pure RecurrentState
+#       replica, and the flat per-slot footprint turns into memory_plan()
+#       admission headroom: more concurrent 64k-context sequences than
+#       PagedKV under the same budget (tests/test_ssd.py -k loadgen).
 #
 #   Baseline-gated (deterministic arithmetic, any drift is a code change):
 #     - state_bytes_per_slot at 8B scale must not grow;
@@ -40,6 +44,14 @@ echo "[ssd_gate] cache_backend conformance" >&2
 if ! timeout -k 10 300 python -m pytest tests/test_cache_backend.py -q \
         -p no:cacheprovider >&2; then
     echo "[ssd_gate] conformance: FAILED (tests/test_cache_backend.py)" >&2
+    FAIL=$((FAIL + 1))
+fi
+
+echo "[ssd_gate] loadgen trace through the RecurrentState replica" >&2
+if ! timeout -k 10 600 python -m pytest tests/test_ssd.py -q -k loadgen \
+        -p no:cacheprovider >&2; then
+    echo "[ssd_gate] loadgen: FAILED (tests/test_ssd.py -k loadgen: flat" \
+         "footprint / memory_plan headroom / trace completion)" >&2
     FAIL=$((FAIL + 1))
 fi
 

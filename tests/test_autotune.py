@@ -404,3 +404,44 @@ def test_save_relayout_equals_migrate_then_save(tmp_path):
         load_state_dict(tgt, p)
         outs.append(np.asarray(tgt["w"]))
     assert outs[0].tobytes() == outs[1].tobytes()
+
+
+# --------------------------------------------------- fuse=auto (PR 19 axis)
+
+def test_fuse_auto_axis_credits_and_selects(monkeypatch):
+    monkeypatch.delenv("KERNEL_GATE_INJECT", raising=False)
+    monkeypatch.delenv("FUSE_GATE_INJECT", raising=False)
+    from paddle_tpu.kernels import registry as kreg
+    from paddle_tpu.analysis.autotune.scorer import score_compiled
+    kreg.reset_admission_cache()
+
+    hand = PlanConfig(preset="tiny")
+    assert "fuse-auto" in hand.but(fuse="auto").label()
+    grid = at.default_grid("tiny")
+    assert any(p.fuse == "auto" for p in grid)  # the axis is in the sweep
+
+    lowered, tokens = _tiny_builder(hand)
+    compiled = lowered.compile()
+    budget = at.default_budget("tiny", False)
+    off = score_compiled(compiled, hand, hbm_budget=budget,
+                         tokens_per_step=tokens)
+    auto = score_compiled(compiled, hand.but(fuse="auto", source="tuner"),
+                          hbm_budget=budget, tokens_per_step=tokens)
+    # the audit byte model credits the verified substitutions, so on the
+    # bytes-bound tiny preset fuse=auto outranks the identical stock plan
+    assert auto.fits and auto.fuse_sites and auto.fuse_bytes_saved > 0
+    assert auto.bytes_per_step < off.bytes_per_step
+    assert auto.score < off.score
+    d = auto.to_dict()
+    assert d["fuse_sites"] and d["fuse_bytes_saved"] > 0
+
+    # an admission-failing emitted kernel prunes the plan — never ranked,
+    # exactly the ScheduleRejected discipline
+    monkeypatch.setenv("KERNEL_GATE_INJECT", "emit-race")
+    kreg.reset_admission_cache()
+    pruned = score_compiled(compiled, hand.but(fuse="auto", source="tuner"),
+                            hbm_budget=budget, tokens_per_step=tokens)
+    assert not pruned.fits
+    assert pruned.score == float("inf")
+    assert any("admission" in n for n in pruned.notes)
+    kreg.reset_admission_cache()

@@ -1,0 +1,186 @@
+"""Fusion transformer: emitted Pallas kernels from the audit's worklist
+(``paddle_tpu.kernels.emit`` + ``paddle_tpu.analysis.fusion_transform``).
+
+The contract under test, per ISSUE/ROADMAP item 4:
+
+- every emitted kernel (forward AND backward) replays bit-exact against the
+  jnp reference in interpret mode, including the end-to-end ``jax.grad``
+  through the installed ``custom_vjp``;
+- every emitted kernel registers in ``kernels.registry`` and passes the
+  pallas_lint admission gate;
+- the transformer pass accepts only candidates with a real audit byte win
+  and a matching verified site; everything else is rejected-and-reported
+  through the ``fuse-*`` findings codes, deterministically;
+- ``KERNEL_GATE_INJECT=emit-race`` corrupts the genuine emission path:
+  admission must raise :class:`KernelRejected` BEFORE the first
+  ``pallas_call`` and the transformer must report ``fuse-admission-rejected``;
+- the model seams (``models.llama``) substitute bit-identically when a site
+  is activated and fall back to stock when it is not.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle  # noqa: F401  (registers ops/flags)
+from paddle_tpu.framework import flags
+from paddle_tpu.kernels import emit, registry
+from paddle_tpu.analysis.fusion_transform import TransformPlan, plan_transform
+
+
+@pytest.fixture(autouse=True)
+def _clean_admission(monkeypatch):
+    monkeypatch.delenv("KERNEL_GATE_INJECT", raising=False)
+    monkeypatch.delenv("FUSE_GATE_INJECT", raising=False)
+    registry.reset_admission_cache()
+    yield
+    registry.reset_admission_cache()
+
+
+# ------------------------------------------------------- emitted-kernel proofs
+
+def test_verify_swiglu_and_head_bit_exact():
+    # the two dot-anchored sites replay bit-for-bit, all three legs
+    assert not emit.verify_site("fuse_swiglu_mlp")
+    assert not emit.verify_site("fuse_rms_norm_head")
+
+
+def test_every_emitted_kernel_registers_and_admits_clean():
+    registry.load_all()
+    names = registry.names()
+    for site in emit.SITES:
+        assert site in names and site + "_bwd" in names
+        registry.admit(site)
+        registry.admit(site + "_bwd")
+
+
+# ------------------------------------------------------------ transformer pass
+
+def _cand(**kw):
+    base = {"name": "region:llama.py:fusion.1", "fusible": "pallas-candidate",
+            "pattern": "elementwise-chain", "bytes_saved": 1 << 20,
+            "members": ["fusion.1"], "source": "llama.py",
+            "op_hints": ["silu"]}
+    base.update(kw)
+    return base
+
+
+def test_plan_transform_accept_reject_unmatched():
+    cands = [
+        _cand(),  # silu MLP region -> fuse_swiglu_mlp
+        _cand(name="region:llama.py:fusion.2", bytes_saved=0),
+        _cand(name="region:flash_attention.py:fusion.3",
+              source="flash_attention.py", op_hints=["_where"]),
+    ]
+    plan = plan_transform(cands)
+    assert plan.candidates == 3
+    assert [a["site"] for a in plan.accepted] == ["fuse_swiglu_mlp"]
+    assert sorted(r["code"] for r in plan.rejected) == [
+        "fuse-no-byte-win", "fuse-unmatched-site"]
+    assert plan.bytes_saved == 1 << 20
+    assert plan.fused_bytes(10 << 20) == 9 << 20
+    assert plan.sites() == ["fuse_swiglu_mlp"]
+    assert set(plan.activation()) == {"fuse_swiglu_mlp"}
+    # reject-and-report: the findings carry the fuse-* codes
+    counts = plan.report.counts()
+    assert counts.get("fuse-no-byte-win") == 1
+    assert counts.get("fuse-unmatched-site") == 1
+
+
+def test_plan_transform_deterministic():
+    cands = [_cand(), _cand(name="region:llama.py:fusion.9")]
+    a = plan_transform(cands).summary()
+    b = plan_transform(cands).summary()
+    assert a == b
+
+
+def test_norm_prologue_routes_to_head_site_not_add_rms_norm():
+    # the big rms_norm.py source region is a norm-prologue: pattern agreement
+    # must route it to fuse_rms_norm_head, not the cast-epilogue site
+    cand = _cand(name="region:rms_norm.py:fusion.7", pattern="norm-prologue",
+                 source="rms_norm.py", op_hints=[])
+    plan = plan_transform([cand])
+    assert [a["site"] for a in plan.accepted] == ["fuse_rms_norm_head"]
+
+
+# ------------------------------------------------------- emit-race injection
+
+def test_emit_race_rejected_before_first_pallas_call(monkeypatch):
+    monkeypatch.setenv("KERNEL_GATE_INJECT", "emit-race")
+    registry.reset_admission_cache()
+
+    # the registry refuses the genuinely-registered emitted kernel
+    with pytest.raises(registry.KernelRejected):
+        registry.admit("fuse_swiglu_mlp")
+
+    # the substituted callable's admission guard fires before any pallas_call
+    flags.set_flags({"kernel_admission": True})
+    try:
+        site = emit.SITES["fuse_swiglu_mlp"]
+        fused = emit.make_fused("fuse_swiglu_mlp", interpret=True)
+        args = emit._example_concrete(site)
+        with pytest.raises(registry.KernelRejected):
+            fused(*args, **site.example_static)
+    finally:
+        flags.set_flags({"kernel_admission": False})
+        registry.reset_admission_cache()
+
+    # and the transformer rejects-and-reports instead of activating
+    plan = plan_transform([_cand()], verify=False)
+    assert plan.accepted == []
+    assert plan.rejected[0]["code"] == "fuse-admission-rejected"
+    assert plan.report.counts().get("fuse-admission-rejected") == 1
+
+
+# ------------------------------------------------------------- model seams
+
+def test_mlp_seam_substitution_bit_identical():
+    from paddle_tpu.models.llama import mlp_fn
+
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+    h = jax.random.normal(k1, (64, 128), jnp.float32) * 0.1
+    wgu = jax.random.normal(k2, (128, 768), jnp.float32) * 0.1
+    wd = jax.random.normal(k3, (384, 128), jnp.float32) * 0.1
+
+    stock = jax.jit(lambda a, b, c: mlp_fn(a, b, c, intermediate_size=384))(
+        h, wgu, wd)
+    with emit.activate({"fuse_swiglu_mlp":
+                        emit.make_fused("fuse_swiglu_mlp", interpret=True)}):
+        assert emit.active("fuse_swiglu_mlp") is not None
+        fused = jax.jit(lambda a, b, c: mlp_fn(a, b, c, intermediate_size=384))(
+            h, wgu, wd)
+    assert emit.active("fuse_swiglu_mlp") is None  # scope restored
+    assert stock.dtype == fused.dtype
+    assert np.asarray(stock).tobytes() == np.asarray(fused).tobytes()
+
+
+def test_verified_activation_covers_dot_anchored_sites():
+    act = emit.verified_activation(interpret=True)
+    assert "fuse_swiglu_mlp" in act and "fuse_rms_norm_head" in act
+    for fn in act.values():
+        assert callable(fn)
+
+
+# ---------------------------------------------------------------- plan object
+
+def test_transform_plan_describe_and_json():
+    plan = TransformPlan(candidates=2)
+    plan.accepted.append({"candidate": "r1", "site": "fuse_swiglu_mlp",
+                          "pattern": "elementwise-chain",
+                          "bytes_saved": 2 << 20})
+    plan.rejected.append({"candidate": "r2", "site": None,
+                          "pattern": "elementwise-chain",
+                          "code": "fuse-unmatched-site"})
+    text = plan.describe()
+    assert "fuse_swiglu_mlp" in text and "fuse-unmatched-site" in text
+    s = plan.summary()
+    assert s["accepted"] == 1 and s["rejected"] == 1
+    assert s["bytes_saved"] == 2 << 20
